@@ -1,0 +1,26 @@
+"""mxnet_tpu: a TPU-native framework with the capabilities of MXNet.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of Apache
+MXNet v0.12 (reference: jermainewang/mxnet; see SURVEY.md at repo root for
+the inventory this build targets).  Eager NDArray + autograd tape on one
+side, Symbol/Executor compiling whole graphs to single XLA programs on the
+other — the same dual paradigm ("mix symbolic and imperative") the reference
+is built around, mapped onto jax eager vs jax.jit.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+# MXNet supports float64/int64 tensors; jax defaults to 32-bit only.
+_jax.config.update("jax_enable_x64", True)
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+from . import base
+from . import engine
+from . import random
+from .random import seed
+from . import ndarray
+from . import ndarray as nd
+from . import autograd

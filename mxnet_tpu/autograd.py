@@ -1,0 +1,253 @@
+"""Define-by-run autograd: record/pause scopes + tape backward.
+
+Parity surface: reference ``python/mxnet/autograd.py`` (record/pause/
+train_mode/predict_mode at :121-194, mark_variables :196, backward :247,
+grad :274) over ``src/imperative/imperative.cc`` (RecordOp :182, Backward
+:357).
+
+TPU-native redesign: instead of re-building an NNVM gradient graph, every
+recorded op captures a ``jax.vjp`` closure at execution time (the forward
+runs *once*, inside vjp tracing, so there is no double compute); backward is
+a reverse sweep over the tape accumulating cotangents.  Ops whose reference
+gradient is semantic rather than mathematical (SoftmaxOutput & friends)
+registered a ``custom_vjp`` and bypass jax.vjp.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "get_symbol",
+           "set_recording", "set_training"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape = []
+
+
+_STATE = _State()
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(flag):
+    prev = _STATE.recording
+    _STATE.recording = bool(flag)
+    return prev
+
+
+def set_training(flag):
+    prev = _STATE.training
+    _STATE.training = bool(flag)
+    return prev
+
+
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        self._prev_rec = _STATE.recording
+        self._prev_train = _STATE.training
+        if self._rec is not None:
+            if self._rec and not _STATE.recording:
+                _clear_tape()  # fresh outermost recording session
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording = self._prev_rec
+        _STATE.training = self._prev_train
+
+    def __call__(self, fn):
+        def wrapped(*a, **kw):
+            with self.__class__(self._rec, self._train):
+                return fn(*a, **kw)
+        return wrapped
+
+
+def record(train_mode=True):  # noqa: A002 - reference name
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+class TapeNode:
+    """One recorded op invocation."""
+    __slots__ = ("op", "attrs", "inputs", "outputs", "diff_idx", "vjp_fn",
+                 "custom_bwd", "in_vals", "out_vals")
+
+    def __init__(self, op, attrs, inputs, outputs, diff_idx, vjp_fn=None,
+                 custom_bwd=None, in_vals=None, out_vals=None):
+        self.op, self.attrs = op, attrs
+        self.inputs, self.outputs = inputs, outputs
+        self.diff_idx = diff_idx
+        self.vjp_fn = vjp_fn
+        self.custom_bwd = custom_bwd
+        self.in_vals, self.out_vals = in_vals, out_vals
+
+
+def _clear_tape():
+    for node in _STATE.tape:
+        for o in node.outputs:
+            o._tape_node = None
+    _STATE.tape = []
+
+
+def append_node(node):
+    _STATE.tape.append(node)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference imperative.cc:112)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._marked = True
+
+
+def _backward_impl(heads, head_grads=None, retain_graph=False,
+                   train_mode=True, variables=None):
+    from .ndarray import NDArray, _wrap
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    grad_map = {}
+    keepalive = {}
+    for h, hg in zip(heads, head_grads):
+        if getattr(h, "_tape_node", None) is None and not getattr(h, "_marked", False):
+            raise MXNetError(
+                "cannot differentiate a head that is not in a recorded "
+                "computational graph (did you run inside autograd.record()?)")
+        g = jnp.ones_like(h._data) if hg is None else hg._data
+        grad_map[id(h)] = grad_map.get(id(h), 0) + g
+        keepalive[id(h)] = h
+
+    marked = {}
+    for node in reversed(_STATE.tape):
+        if not any(id(o) in grad_map for o in node.outputs):
+            continue
+        out_grads = tuple(
+            grad_map.get(id(o), jnp.zeros_like(o._data)) for o in node.outputs)
+        if node.custom_bwd is not None:
+            all_in_grads = node.custom_bwd(out_grads, node.in_vals,
+                                           node.out_vals, node.attrs)
+            in_grads = [all_in_grads[i] for i in node.diff_idx]
+        else:
+            in_grads = node.vjp_fn(out_grads)
+        for pos, g in zip(node.diff_idx, in_grads):
+            inp = node.inputs[pos]
+            key = id(inp)
+            keepalive[key] = inp
+            if key in grad_map:
+                grad_map[key] = grad_map[key] + g
+            else:
+                grad_map[key] = g
+            if getattr(inp, "_marked", False):
+                marked[key] = inp
+
+    for h in heads:
+        if getattr(h, "_marked", False):
+            marked[id(h)] = h
+
+    # write accumulated grads into attached buffers
+    for key, v in marked.items():
+        if v._grad is None or key not in grad_map:
+            continue
+        g = grad_map[key]
+        if v._grad_req == "add":
+            v._grad._set_data(v._grad._data + g)
+        elif v._grad_req != "null":
+            v._grad._set_data(jnp.broadcast_to(g, v._grad.shape).astype(
+                v._grad.dtype) if g.shape != tuple(v._grad.shape) else g.astype(v._grad.dtype))
+
+    result = None
+    if variables is not None:
+        result = []
+        for v in variables:
+            if id(v) not in grad_map:
+                raise MXNetError("one of the requested variables is not part "
+                                 "of the recorded graph")
+            result.append(_wrap(grad_map[id(v)], v.context))
+    if not retain_graph:
+        _clear_tape()
+    return result
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    return _backward_impl(heads, head_grads, retain_graph, train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Compute grads of heads w.r.t. variables (reference autograd.py:274)."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order autograd) is not supported yet")
+    variables = variables if isinstance(variables, (list, tuple)) else [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+    return _backward_impl(heads, head_grads, retain_graph, train_mode,
+                          variables=variables)
+
+
+def get_symbol(x):
+    """Trace-back symbol extraction (reference autograd.py:351).
+
+    Returns a Symbol describing the recorded computation that produced x.
+    """
+    from .symbol import Symbol
+    node = getattr(x, "_tape_node", None)
+    if node is None:
+        raise MXNetError("array is not an output of a recorded computation")
+    from . import symbol as _sym
+
+    memo = {}
+
+    def build(arr):
+        key = id(arr)
+        if key in memo:
+            return memo[key]
+        n = getattr(arr, "_tape_node", None)
+        if n is None:
+            s = _sym.var(getattr(arr, "name", None) or "var%d" % len(memo))
+        else:
+            ins = [build(i) for i in n.inputs]
+            attrs = {k: v for k, v in n.attrs.items()}
+            s = Symbol._from_op(n.op.name, ins, attrs)
+            idx = n.outputs.index(arr) if arr in n.outputs else 0
+            s = s[idx] if len(n.outputs) > 1 else s
+        memo[key] = s
+        return s
+
+    return build(x)

@@ -1,0 +1,130 @@
+"""Base utilities: errors, dtype mapping, registries.
+
+Reimagines the roles of the reference's ``python/mxnet/base.py`` (532 LoC ctypes
+bridge, ``include/mxnet/base.h``) for a JAX/XLA-backed framework: there is no C
+ABI to bridge, so this module only carries the pieces with user-visible
+semantics — error type, dtype codes (``mshadow/base.h`` type enum, used by the
+NDArray serialization format), and the string-keyed registries that back
+operator/optimizer/initializer/metric lookup (``dmlc::Registry``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MXNetError", "NotSupportedForSparseNDArray", "mx_real_t", "mx_uint",
+    "string_types", "numeric_types", "integer_types",
+    "DTYPE_TO_CODE", "CODE_TO_DTYPE", "dtype_np", "dtype_code", "dtype_name",
+    "Registry",
+]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (parity with ``mxnet.base.MXNetError``)."""
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__(
+            "Function {}{} is not supported for sparse NDArray".format(
+                function.__name__, " (alias %s)" % alias if alias else ""))
+
+
+mx_real_t = np.float32
+mx_uint = np.uint32
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+# mshadow type codes (reference mshadow/base.h TypeFlag) — load-bearing for the
+# binary .params / NDArray save format (src/ndarray/ndarray.cc:821).
+DTYPE_TO_CODE = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    # TPU-native extension: bfloat16 gets a code outside the reference range.
+    np.dtype("bfloat16") if hasattr(np, "bfloat16") else "bfloat16": 7,
+}
+
+
+def _bfloat16_dtype():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+try:
+    _BF16 = _bfloat16_dtype()
+    DTYPE_TO_CODE = {
+        np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+        np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+        np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+        np.dtype(np.int64): 6, _BF16: 7,
+    }
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+CODE_TO_DTYPE = {v: k for k, v in DTYPE_TO_CODE.items()}
+
+
+def dtype_np(dtype):
+    """Normalize a user dtype spec (str/np.dtype/type) to np.dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16" and _BF16 is not None:
+        return _BF16
+    return np.dtype(dtype)
+
+
+def dtype_code(dtype):
+    return DTYPE_TO_CODE[dtype_np(dtype)]
+
+
+def dtype_name(dtype):
+    d = dtype_np(dtype)
+    if _BF16 is not None and d == _BF16:
+        return "bfloat16"
+    return d.name
+
+
+class Registry:
+    """String-keyed object registry with alias support.
+
+    Plays the role of ``dmlc::Registry`` / the Python-side ``mx.registry``
+    (reference ``python/mxnet/registry.py``): optimizers, initializers,
+    metrics, and operators all register here.
+    """
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._store = {}
+
+    def register(self, obj, name=None, aliases=()):
+        key = (name or getattr(obj, "__name__", None) or str(obj)).lower()
+        self._store[key] = obj
+        for a in aliases:
+            self._store[a.lower()] = obj
+        return obj
+
+    def get(self, name):
+        key = name.lower()
+        if key not in self._store:
+            raise MXNetError(
+                "Cannot find %s '%s'. Registered: %s"
+                % (self.kind, name, sorted(self._store)))
+        return self._store[key]
+
+    def find(self, name):
+        return self._store.get(name.lower())
+
+    def names(self):
+        return sorted(self._store)
+
+    def create(self, spec, *args, **kwargs):
+        """Create an instance from a name / (name, kwargs) / instance spec."""
+        if isinstance(spec, str):
+            return self.get(spec)(*args, **kwargs)
+        return spec

@@ -1,0 +1,59 @@
+"""Engine facade: observable async semantics over XLA/PJRT dispatch.
+
+Reference analogue: the threaded dependency engine
+(``include/mxnet/engine.h:95-280``, ``src/engine/threaded_engine.cc``) whose
+*observable* contract is: ops issue asynchronously; ``WaitForVar`` blocks
+until pending writes land; ``WaitForAll`` drains everything; writes to one
+buffer serialize, reads run in parallel (SURVEY §3.3).
+
+On TPU the entire scheduler is XLA/PJRT: jax dispatch is already async, jax
+arrays are immutable (so write-serialization is by construction — each
+mutation produces a new buffer), and ``block_until_ready`` is WaitForVar.
+This facade keeps the API (and the NaiveEngine-style ``--sync_dispatch``
+debug mode, reference ``MXNET_ENGINE_TYPE=NaiveEngine``) for parity tests.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["wait_for_var", "wait_for_all", "push", "is_sync_dispatch",
+           "set_sync_dispatch"]
+
+_SYNC = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def is_sync_dispatch():
+    return _SYNC
+
+
+def set_sync_dispatch(flag):
+    """Debug mode: force synchronous execution after every op (the
+    NaiveEngine idea — crashes surface with a usable backtrace)."""
+    global _SYNC
+    _SYNC = bool(flag)
+
+
+def wait_for_var(arr):
+    """Block until all pending computation producing ``arr`` is done."""
+    jax.block_until_ready(arr)
+
+
+def wait_for_all():
+    """Engine::WaitForAll — drain every outstanding computation."""
+    # PJRT has no global barrier; sync all live committed arrays is
+    # unnecessary — an empty device sync per backend suffices.
+    for dev in jax.devices():
+        try:
+            jax.device_put(0, dev).block_until_ready()
+        except Exception:  # pragma: no cover
+            pass
+
+
+def push(fn, *args, **kwargs):
+    """Run a function 'on the engine' (async by construction under jax)."""
+    out = fn(*args, **kwargs)
+    if _SYNC:
+        jax.block_until_ready(out)
+    return out

@@ -1,0 +1,64 @@
+"""``mx.nd`` namespace: NDArray + generated operator functions.
+
+Reference analogue: ``python/mxnet/ndarray/`` — the op functions there are
+code-generated from the C op registry (``register.py`` + ``_internal.py``);
+here they are generated from the Python op registry.  Public (non-underscore)
+ops land in this namespace; every op (including ``_internal``-style names)
+lands in ``mxnet_tpu.ndarray._internal``.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from .ndarray import (NDArray, array, zeros, ones, empty, full, arange, eye,
+                      concatenate, moveaxis, onehot_encode, waitall, invoke,
+                      imperative_invoke, _wrap)
+from .utils import save, load, save_to_bytes, load_from_bytes
+from ..ops.registry import OP_REGISTRY, get_op
+
+
+def _make_op_func(name, op):
+    def op_func(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        ndargs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                ndargs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
+                ndargs.extend(a)
+            elif a is None:
+                continue
+            else:
+                # scalar positional → attr fallthrough not supported; treat
+                # numeric positionals as an error for parity with reference.
+                raise TypeError(
+                    "operator %s positional arguments must be NDArray, got %r"
+                    % (name, type(a)))
+        res = invoke(op, ndargs, kwargs, out=out)
+        return res[0] if len(res) == 1 else res
+    op_func.__name__ = name
+    op_func.__doc__ = (op.fn.__doc__ or "") + "\n(op: %s)" % op.name
+    return op_func
+
+
+_internal = types.ModuleType(__name__ + "._internal")
+_this = sys.modules[__name__]
+for _name, _op in OP_REGISTRY.items():
+    _fn = _make_op_func(_name, _op)
+    setattr(_internal, _name, _fn)
+    if not _name.startswith("_"):
+        if not hasattr(_this, _name):
+            setattr(_this, _name, _fn)
+sys.modules[__name__ + "._internal"] = _internal
+
+from . import random  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from .sparse import csr_matrix, row_sparse_array  # noqa: E402
+from . import linalg  # noqa: E402,F401
+
+__all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
+           "eye", "concatenate", "moveaxis", "onehot_encode", "waitall",
+           "save", "load", "invoke", "imperative_invoke", "random", "sparse",
+           "linalg", "csr_matrix", "row_sparse_array"]

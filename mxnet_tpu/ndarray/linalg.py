@@ -1,0 +1,31 @@
+"""``mx.nd.linalg`` namespace (reference la_op.cc LAPACK ops)."""
+from __future__ import annotations
+
+from .ndarray import invoke, NDArray
+from ..ops.registry import get_op
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "sumlogdiag",
+           "syrk", "syevd", "gelqf"]
+
+
+def _mk(opname):
+    def f(*args, **kwargs):
+        kwargs.pop("name", None)
+        out = kwargs.pop("out", None)
+        res = invoke(get_op(opname), [a for a in args if isinstance(a, NDArray)],
+                     kwargs, out=out)
+        return res[0] if len(res) == 1 else res
+    f.__name__ = opname.replace("_linalg_", "")
+    return f
+
+
+gemm = _mk("_linalg_gemm")
+gemm2 = _mk("_linalg_gemm2")
+potrf = _mk("_linalg_potrf")
+potri = _mk("_linalg_potri")
+trsm = _mk("_linalg_trsm")
+trmm = _mk("_linalg_trmm")
+sumlogdiag = _mk("_linalg_sumlogdiag")
+syrk = _mk("_linalg_syrk")
+syevd = _mk("_linalg_syevd")
+gelqf = _mk("_linalg_gelqf")

@@ -1,0 +1,584 @@
+"""NDArray: MXNet's async mutable tensor API over immutable jax.Arrays.
+
+Parity surface: reference ``python/mxnet/ndarray/ndarray.py`` (NDArray class,
+attach_grad :1691, backward :1733, wait_to_read :1360, asnumpy :1531) over
+``include/mxnet/ndarray.h`` / ``src/ndarray/ndarray.cc``.
+
+TPU-native redesign (SURVEY §7 "hard parts"): MXNet NDArrays mutate in place;
+jax arrays are immutable.  ``NDArray`` is therefore a *handle* — a mutable
+slot holding the current ``jax.Array`` — and every "mutation" rebinds the
+slot.  This reproduces the reference's observable semantics exactly (the
+dependency engine also never mutates concurrently: writes serialize per
+buffer, §3.3) while staying functional underneath, which is what lets whole
+training steps jit into one XLA program.
+
+Async semantics come free: jax dispatch is asynchronous; ``wait_to_read`` is
+``block_until_ready``; ``asnumpy`` is the only implicit sync point — same
+latency-hiding contract as the reference engine (SURVEY §3.1 note).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np, dtype_name
+from ..context import Context, current_context, cpu
+from .. import autograd as ag
+from .. import random as _random
+from ..ops.registry import get_op, Op
+
+__all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
+           "invoke", "waitall", "concatenate", "imperative_invoke", "_wrap",
+           "moveaxis", "onehot_encode"]
+
+
+class NDArray:
+    """A mutable n-dimensional array handle on a device context."""
+    __slots__ = ("_data", "_ctx", "_stype", "_grad", "_grad_req", "_marked",
+                 "_tape_node", "name", "__weakref__")
+    # numpy scalar-priority so  np_scalar * NDArray  dispatches to us
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None, stype="default"):
+        self._data = data
+        self._ctx = ctx or current_context()
+        self._stype = stype
+        self._grad = None
+        self._grad_req = "null"
+        self._marked = False
+        self._tape_node = None
+        self.name = None
+
+    # -- core properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return _wrap(self._data.T, self._ctx)
+
+    def _set_data(self, jarr):
+        """Rebind the handle (the 'mutation' primitive)."""
+        self._data = jarr
+        return self
+
+    # -- sync / host transfer ---------------------------------------------
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            self.asnumpy(), "x".join(str(s) for s in self.shape), self._ctx)
+
+    # -- conversion / copy -------------------------------------------------
+    def astype(self, dtype, copy=True):
+        return _wrap(self._data.astype(dtype_np(dtype)), self._ctx)
+
+    def copy(self):
+        return _wrap(self._data + 0 if False else jnp.array(self._data), self._ctx)
+
+    def copyto(self, other):
+        """Copy into another NDArray or to a Context (reference CopyFromTo)."""
+        if isinstance(other, Context):
+            dev = other.jax_device
+            return NDArray(jax.device_put(self._data, dev), Context(other.device_type, other.device_id))
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            dev = other._ctx.jax_device
+            other._set_data(jax.device_put(self._data.astype(other.dtype), dev))
+            return other
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    def tostype(self, stype):
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        self._grad = _wrap(jnp.zeros_like(self._data), self._ctx)
+        self._grad_req = grad_req
+        self._marked = True
+
+    def detach(self):
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        ag.backward([self], [out_grad] if out_grad is not None else None,
+                    retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops (delegate to registry so they record on the tape) -------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return invoke(get_op("Reshape"), [self], {"shape": tuple(shape)})[0]
+
+    def reshape_like(self, other):
+        return invoke(get_op("reshape_like"), [self, other], {})[0]
+
+    def expand_dims(self, axis):
+        return invoke(get_op("expand_dims"), [self], {"axis": axis})[0]
+
+    def flatten(self):
+        return invoke(get_op("Flatten"), [self], {})[0]
+
+    def transpose(self, axes=None):
+        return invoke(get_op("transpose"), [self], {"axes": axes})[0]
+
+    def swapaxes(self, dim1, dim2):
+        return invoke(get_op("SwapAxis"), [self], {"dim1": dim1, "dim2": dim2})[0]
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke(get_op("SliceChannel"), [self],
+                      {"num_outputs": num_outputs, "axis": axis,
+                       "squeeze_axis": squeeze_axis})
+
+    def slice(self, begin, end, step=None):
+        return invoke(get_op("slice"), [self],
+                      {"begin": begin, "end": end, "step": step})[0]
+
+    def slice_axis(self, axis, begin, end):
+        return invoke(get_op("slice_axis"), [self],
+                      {"axis": axis, "begin": begin, "end": end})[0]
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke(get_op("take"), [self, indices],
+                      {"axis": axis, "mode": mode})[0]
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke(get_op("pick"), [self, index],
+                      {"axis": axis, "keepdims": keepdims})[0]
+
+    def one_hot(self, depth, **kw):
+        return invoke(get_op("one_hot"), [self], dict(depth=depth, **kw))[0]
+
+    def broadcast_to(self, shape):
+        return invoke(get_op("broadcast_to"), [self], {"shape": tuple(shape)})[0]
+
+    def broadcast_axes(self, axis, size):
+        return invoke(get_op("broadcast_axis"), [self],
+                      {"axis": axis, "size": size})[0]
+
+    def tile(self, reps):
+        return invoke(get_op("tile"), [self], {"reps": reps})[0]
+
+    def repeat(self, repeats, axis=None):
+        return invoke(get_op("repeat"), [self],
+                      {"repeats": repeats, "axis": axis})[0]
+
+    def pad(self, mode, pad_width, constant_value=0.0):
+        return invoke(get_op("Pad"), [self],
+                      {"mode": mode, "pad_width": pad_width,
+                       "constant_value": constant_value})[0]
+
+    def flip(self, axis):
+        return invoke(get_op("reverse"), [self], {"axis": axis})[0]
+
+    def clip(self, a_min, a_max):
+        return invoke(get_op("clip"), [self], {"a_min": a_min, "a_max": a_max})[0]
+
+    def abs(self):
+        return invoke(get_op("abs"), [self], {})[0]
+
+    def sign(self):
+        return invoke(get_op("sign"), [self], {})[0]
+
+    def sqrt(self):
+        return invoke(get_op("sqrt"), [self], {})[0]
+
+    def square(self):
+        return invoke(get_op("square"), [self], {})[0]
+
+    def exp(self):
+        return invoke(get_op("exp"), [self], {})[0]
+
+    def log(self):
+        return invoke(get_op("log"), [self], {})[0]
+
+    def sigmoid(self):
+        return invoke(get_op("sigmoid"), [self], {})[0]
+
+    def tanh(self):
+        return invoke(get_op("tanh"), [self], {})[0]
+
+    def relu(self):
+        return invoke(get_op("relu"), [self], {})[0]
+
+    def softmax(self, axis=-1):
+        return invoke(get_op("softmax"), [self], {"axis": axis})[0]
+
+    def log_softmax(self, axis=-1):
+        return invoke(get_op("log_softmax"), [self], {"axis": axis})[0]
+
+    def dot(self, other, **kw):
+        return invoke(get_op("dot"), [self, other], kw)[0]
+
+    # -- reductions --------------------------------------------------------
+    def _reduce(self, opname, axis=None, keepdims=False, **kw):
+        return invoke(get_op(opname), [self],
+                      dict(axis=axis, keepdims=keepdims, **kw))[0]
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def nansum(self, axis=None, keepdims=False):
+        return self._reduce("nansum", axis, keepdims)
+
+    def nanprod(self, axis=None, keepdims=False):
+        return self._reduce("nanprod", axis, keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._reduce("argmax", axis, keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._reduce("argmin", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke(get_op("norm"), [self],
+                      {"ord": ord, "axis": axis, "keepdims": keepdims})[0]
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke(get_op("argsort"), [self],
+                      {"axis": axis, "is_ascend": is_ascend})[0]
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke(get_op("sort"), [self],
+                      {"axis": axis, "is_ascend": is_ascend})[0]
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return invoke(get_op("topk"), [self],
+                      {"axis": axis, "k": k, "ret_typ": ret_typ,
+                       "is_ascend": is_ascend})
+
+    # -- arithmetic --------------------------------------------------------
+    def _binary(self, opname, other, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(get_op(opname), [a, b], {})[0]
+        if isinstance(other, (int, float, np.generic, bool)):
+            scalar_map = {
+                "elemwise_add": "_plus_scalar",
+                "elemwise_sub": "_rminus_scalar" if reverse else "_minus_scalar",
+                "elemwise_mul": "_mul_scalar",
+                "elemwise_div": "_rdiv_scalar" if reverse else "_div_scalar",
+                "elemwise_mod": "_rmod_scalar" if reverse else "_mod_scalar",
+                "elemwise_power": "_rpower_scalar" if reverse else "_power_scalar",
+                "elemwise_maximum": "_maximum_scalar",
+                "elemwise_minimum": "_minimum_scalar",
+                "_equal": "_equal_scalar", "_not_equal": "_not_equal_scalar",
+                "_greater": "_lesser_scalar" if reverse else "_greater_scalar",
+                "_greater_equal": "_lesser_equal_scalar" if reverse else "_greater_equal_scalar",
+                "_lesser": "_greater_scalar" if reverse else "_lesser_scalar",
+                "_lesser_equal": "_greater_equal_scalar" if reverse else "_lesser_equal_scalar",
+            }
+            return invoke(get_op(scalar_map[opname]), [self],
+                          {"scalar": float(other)})[0]
+        return NotImplemented
+
+    def __add__(self, o): return self._binary("elemwise_add", o)
+    def __radd__(self, o): return self._binary("elemwise_add", o, True)
+    def __sub__(self, o): return self._binary("elemwise_sub", o)
+    def __rsub__(self, o): return self._binary("elemwise_sub", o, True)
+    def __mul__(self, o): return self._binary("elemwise_mul", o)
+    def __rmul__(self, o): return self._binary("elemwise_mul", o, True)
+    def __truediv__(self, o): return self._binary("elemwise_div", o)
+    def __rtruediv__(self, o): return self._binary("elemwise_div", o, True)
+    def __div__(self, o): return self._binary("elemwise_div", o)
+    def __rdiv__(self, o): return self._binary("elemwise_div", o, True)
+    def __mod__(self, o): return self._binary("elemwise_mod", o)
+    def __rmod__(self, o): return self._binary("elemwise_mod", o, True)
+    def __pow__(self, o): return self._binary("elemwise_power", o)
+    def __rpow__(self, o): return self._binary("elemwise_power", o, True)
+    def __matmul__(self, o): return self.dot(o)
+    def __neg__(self): return invoke(get_op("negative"), [self], {})[0]
+    def __abs__(self): return invoke(get_op("abs"), [self], {})[0]
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary("_equal", o)
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary("_not_equal", o)
+    def __gt__(self, o): return self._binary("_greater", o)
+    def __ge__(self, o): return self._binary("_greater_equal", o)
+    def __lt__(self, o): return self._binary("_lesser", o)
+    def __le__(self, o): return self._binary("_lesser_equal", o)
+    __hash__ = object.__hash__
+
+    def __iadd__(self, o):
+        return self._set_data((self + o)._data)
+
+    def __isub__(self, o):
+        return self._set_data((self - o)._data)
+
+    def __imul__(self, o):
+        return self._set_data((self * o)._data)
+
+    def __itruediv__(self, o):
+        return self._set_data((self / o)._data)
+
+    __idiv__ = __itruediv__
+
+    # -- indexing ----------------------------------------------------------
+    def _norm_key(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype(jnp.int32)
+        if isinstance(key, tuple):
+            return tuple(self._norm_key(k) if isinstance(k, NDArray) else k
+                         for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._norm_key(key)
+        return _wrap(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        key = self._norm_key(key)
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (np.ndarray, list, tuple)):
+            v = jnp.asarray(np.asarray(value, dtype=self.dtype))
+        else:
+            v = value
+        self._set_data(self._data.at[key].set(v))
+
+
+def _wrap(jarr, ctx=None):
+    return NDArray(jarr, ctx or current_context())
+
+
+def _current_rng():
+    return _random.next_key()
+
+
+def invoke(op, inputs, attrs, out=None):
+    """Execute a registered op eagerly; record on the autograd tape if needed.
+
+    Reference analogue: MXImperativeInvokeEx → Imperative::Invoke
+    (``src/imperative/imperative.cc:86``) and RecordOp (:182).
+    """
+    if isinstance(op, str):
+        op = get_op(op)
+    attrs = dict(attrs)
+    ctx = attrs.pop("ctx", None)
+    if ctx is None:
+        ctx = inputs[0]._ctx if inputs else current_context()
+    elif not isinstance(ctx, Context):
+        ctx = Context(ctx) if isinstance(ctx, str) else ctx
+    attrs.pop("name", None)
+    attrs.pop("dtype_np", None)
+
+    jin = [x._data for x in inputs]
+    rng = _current_rng() if op.needs_rng else None
+    train = ag.is_training()
+
+    recording = (ag.is_recording() and inputs
+                 and not all(i in op.nondiff_inputs for i in range(len(inputs))))
+
+    if recording:
+        diff_idx = [i for i in range(len(inputs))
+                    if i not in op.nondiff_inputs]
+        if op.custom_vjp is not None:
+            out_vals = op.apply(jin, attrs, train_mode=train, rng=rng)
+            node_kw = dict(custom_bwd=op.custom_vjp, in_vals=tuple(jin),
+                           out_vals=out_vals)
+        else:
+            def pure(*diff_vals):
+                full = list(jin)
+                for i, v in zip(diff_idx, diff_vals):
+                    full[i] = v
+                return op.apply(full, attrs, train_mode=train, rng=rng)
+            out_vals, vjp_fn = jax.vjp(pure, *[jin[i] for i in diff_idx])
+            node_kw = dict(vjp_fn=vjp_fn)
+        outputs = [_wrap(v, ctx) for v in out_vals]
+        node = ag.TapeNode(op, attrs, list(inputs), outputs, diff_idx,
+                           **node_kw)
+        for o in outputs:
+            o._tape_node = node
+        ag.append_node(node)
+    else:
+        out_vals = op.apply(jin, attrs, train_mode=train, rng=rng)
+        outputs = [_wrap(v, ctx) for v in out_vals]
+
+    # aux-state writeback (BatchNorm moving stats, optimizer state slots)
+    for aux_in, out_idx in op.aux_updates.items():
+        if aux_in < len(inputs):
+            inputs[aux_in]._set_data(out_vals[out_idx])
+
+    nvis = op.n_visible_outputs(attrs)
+    visible = outputs[:nvis]
+    if op.no_inputs and ctx is not None:
+        for o in visible:
+            o._ctx = ctx
+            o._set_data(jax.device_put(o._data, ctx.jax_device))
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, visible):
+            dst._set_data(src._data.astype(dst.dtype))
+        return list(outs)
+    return visible
+
+
+def imperative_invoke(op_name, *inputs, **attrs):
+    """C-API-shaped entry (MXImperativeInvoke parity)."""
+    out = attrs.pop("out", None)
+    res = invoke(get_op(op_name), list(inputs), attrs, out=out)
+    return res[0] if len(res) == 1 else res
+
+
+# --- creation API -----------------------------------------------------------
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray (reference semantics: dtype defaults to
+    source.dtype for NDArray source, float32 otherwise)."""
+    ctx = ctx or current_context()
+    if isinstance(source_array, NDArray):
+        dt = dtype_np(dtype) if dtype is not None else source_array.dtype
+        return NDArray(jax.device_put(source_array._data.astype(dt),
+                                      ctx.jax_device), ctx)
+    arr = np.asarray(source_array)
+    # reference semantics: default dtype is float32 unless source is NDArray
+    dt = dtype_np(dtype) if dtype is not None else np.dtype(np.float32)
+    return NDArray(jax.device_put(jnp.asarray(arr.astype(dt)), ctx.jax_device), ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kw):
+    ctx = ctx or current_context()
+    return invoke(get_op("_zeros"), [],
+                  {"shape": shape, "dtype": dtype or "float32", "ctx": ctx})[0]
+
+
+def ones(shape, ctx=None, dtype=None, **kw):
+    ctx = ctx or current_context()
+    return invoke(get_op("_ones"), [],
+                  {"shape": shape, "dtype": dtype or "float32", "ctx": ctx})[0]
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def full(shape, val, ctx=None, dtype=None, out=None):
+    return invoke(get_op("_full"), [],
+                  {"shape": shape, "value": val, "dtype": dtype or "float32",
+                   "ctx": ctx or current_context()}, out=out)[0]
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None,
+           infer_range=False):
+    return invoke(get_op("_arange"), [],
+                  {"start": start, "stop": stop, "step": step,
+                   "repeat": repeat, "dtype": dtype or "float32",
+                   "ctx": ctx or current_context()})[0]
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    return invoke(get_op("_eye"), [],
+                  {"N": N, "M": M, "k": k, "dtype": dtype or "float32",
+                   "ctx": ctx or current_context()})[0]
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke(get_op("Concat"), list(arrays), {"dim": axis})[0]
+
+
+def moveaxis(tensor, source, destination):
+    axes = list(range(tensor.ndim))
+    axes.remove(source % tensor.ndim)
+    axes.insert(destination % tensor.ndim, source % tensor.ndim)
+    return tensor.transpose(axes)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    return invoke(get_op("one_hot"), [indices], {"depth": depth}, out=out)[0]
+
+
+def waitall():
+    from .. import engine
+    engine.wait_for_all()

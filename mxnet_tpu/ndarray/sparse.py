@@ -1,0 +1,163 @@
+"""Sparse NDArray: row_sparse + csr (reference python/mxnet/ndarray/sparse.py).
+
+Reference analogue: ``include/mxnet/ndarray.h:58-63`` storage types and the
+FComputeEx sparse kernel path (SURVEY §2.1 NDArray row).
+
+TPU-native design decision (SURVEY §7 hard-parts "Sparse parity"): XLA wants
+static shapes, and TPU has no scatter-gather-friendly sparse format, so the
+*backing store is dense* with sparse metadata materialized lazily on host.
+The sparse classes preserve the reference API (``.indices``, ``.indptr``,
+``.data``, ``tostype``, ``retain``) and its semantics (row-sparse gradients
+for Embedding/dot, kvstore row_sparse push/pull), while every device compute
+runs dense — which on TPU is usually *faster* than emulated scatter for the
+model sizes the reference targets; the dense path is also exactly what the
+reference's ``FComputeFallback`` does.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np
+from ..context import current_context
+from .ndarray import NDArray, _wrap, array, invoke
+from ..ops.registry import get_op
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(str(s) for s in self.shape),
+                                  self.context)
+
+    def asscipy(self):
+        import scipy.sparse as sp
+        if self.stype == "csr":
+            return sp.csr_matrix(self.asnumpy())
+        raise MXNetError("asscipy only supported for csr")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: most rows are zero; ``indices`` lists non-zero rows."""
+    __slots__ = ()
+
+    @property
+    def indices(self):
+        rows = np.nonzero(np.any(self.asnumpy().reshape(self.shape[0], -1) != 0,
+                                 axis=1))[0]
+        return array(rows.astype(np.int64), ctx=self.context, dtype=np.int64)
+
+    @property
+    def data(self):
+        idx = self.indices.asnumpy().astype(np.int64)
+        return _wrap(jnp.take(self._data, jnp.asarray(idx), axis=0), self.context)
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def retain(self, indices):
+        return invoke(get_op("sparse_retain"), [self, indices], {})[0]
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix."""
+    __slots__ = ()
+
+    def _csr_parts(self):
+        import scipy.sparse as sp
+        m = sp.csr_matrix(self.asnumpy())
+        return m
+
+    @property
+    def indices(self):
+        return array(self._csr_parts().indices.astype(np.int64),
+                     ctx=self.context, dtype=np.int64)
+
+    @property
+    def indptr(self):
+        return array(self._csr_parts().indptr.astype(np.int64),
+                     ctx=self.context, dtype=np.int64)
+
+    @property
+    def data(self):
+        return array(self._csr_parts().data, ctx=self.context,
+                     dtype=self.dtype)
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+
+def _retag(arr, stype):
+    cls = {"default": NDArray, "row_sparse": RowSparseNDArray,
+           "csr": CSRNDArray}[stype]
+    out = cls(arr._data, arr.context)
+    out._stype = stype
+    return out
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (reference cast_storage op)."""
+    if stype == arr.stype:
+        return arr
+    return _retag(arr, stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = np.asarray(data, dtype=dtype_np(dtype))
+        indices = np.asarray(indices, dtype=np.int64).reshape(-1)
+        if shape is None:
+            nrows = int(indices.max()) + 1 if indices.size else 0
+            shape = (nrows,) + tuple(data.shape[1:])
+        dense = np.zeros(shape, dtype=data.dtype)
+        if indices.size:
+            dense[indices] = data
+        out = array(dense, ctx=ctx, dtype=data.dtype)
+        return _retag(out, "row_sparse")
+    if isinstance(arg1, NDArray):
+        return cast_storage(arg1, "row_sparse")
+    out = array(np.asarray(arg1, dtype=dtype_np(dtype)), ctx=ctx)
+    return _retag(out, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or dense/scipy."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = np.asarray(data, dtype=dtype_np(dtype))
+        indices = np.asarray(indices, dtype=np.int64)
+        indptr = np.asarray(indptr, dtype=np.int64)
+        if shape is None:
+            ncols = int(indices.max()) + 1 if indices.size else 0
+            shape = (len(indptr) - 1, ncols)
+        dense = np.zeros(shape, dtype=data.dtype)
+        for r in range(shape[0]):
+            for j in range(indptr[r], indptr[r + 1]):
+                dense[r, indices[j]] = data[j]
+        out = array(dense, ctx=ctx, dtype=data.dtype)
+        return _retag(out, "csr")
+    if isinstance(arg1, NDArray):
+        return cast_storage(arg1, "csr")
+    if hasattr(arg1, "toarray"):  # scipy sparse
+        out = array(arg1.toarray(), ctx=ctx, dtype=dtype)
+        return _retag(out, "csr")
+    out = array(np.asarray(arg1), ctx=ctx, dtype=dtype)
+    return _retag(out, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    from .ndarray import zeros as _dense_zeros
+    out = _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "default":
+        return out
+    return _retag(out, stype)

@@ -1,0 +1,134 @@
+"""NDArray save/load in the reference's binary format.
+
+Format parity with ``src/ndarray/ndarray.cc`` (Save at :826, list container
+at :1022): files written here are bit-compatible with MXNet v0.12 ``.params``
+/ ``mx.nd.save`` files for dense arrays, so reference checkpoints load and
+vice versa.
+
+Layout (little-endian):
+  file   := uint64 0x112 (kMXAPINDArrayListMagic) | uint64 reserved
+          | uint64 n | NDArray*n | uint64 nkeys | (uint64 len | bytes)*nkeys
+  ndarray:= uint32 0xF993fac9 (NDARRAY_V2_MAGIC) | int32 stype(0=dense)
+          | shape | int32 dev_type | int32 dev_id | int32 type_flag | raw data
+  shape  := uint32 ndim | int64 dim[ndim]          (nnvm::TShape::Save)
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..base import MXNetError, CODE_TO_DTYPE, DTYPE_TO_CODE
+from ..context import cpu
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load", "save_to_bytes", "load_from_bytes"]
+
+_LIST_MAGIC = 0x112
+_V2_MAGIC = 0xF993FAC9
+
+
+def _write_shape(buf, shape):
+    buf.append(struct.pack("<I", len(shape)))
+    if shape:
+        buf.append(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _read_shape(mv, off):
+    (ndim,) = struct.unpack_from("<I", mv, off)
+    off += 4
+    dims = struct.unpack_from("<%dq" % ndim, mv, off) if ndim else ()
+    off += 8 * ndim
+    return tuple(dims), off
+
+
+def _save_one(buf, arr):
+    if arr.stype != "default":
+        arr = arr.tostype("default")
+    buf.append(struct.pack("<I", _V2_MAGIC))
+    buf.append(struct.pack("<i", 0))  # kDefaultStorage
+    _write_shape(buf, arr.shape)
+    buf.append(struct.pack("<ii", 1, 0))  # Context: cpu(0)
+    npy = arr.asnumpy()
+    code = DTYPE_TO_CODE[np.dtype(npy.dtype)]
+    buf.append(struct.pack("<i", code))
+    buf.append(np.ascontiguousarray(npy).tobytes())
+
+
+def _load_one(mv, off):
+    (magic,) = struct.unpack_from("<I", mv, off)
+    off += 4
+    if magic != _V2_MAGIC:
+        raise MXNetError("unsupported NDArray binary version 0x%x "
+                         "(only V2 is supported)" % magic)
+    (stype,) = struct.unpack_from("<i", mv, off)
+    off += 4
+    if stype != 0:
+        raise MXNetError("sparse NDArray load not supported yet")
+    shape, off = _read_shape(mv, off)
+    dev_type, dev_id = struct.unpack_from("<ii", mv, off)
+    off += 8
+    (type_flag,) = struct.unpack_from("<i", mv, off)
+    off += 4
+    dt = np.dtype(CODE_TO_DTYPE[type_flag])
+    count = int(np.prod(shape)) if shape else 1
+    nbytes = count * dt.itemsize
+    data = np.frombuffer(mv, dtype=dt, count=count, offset=off).reshape(shape)
+    off += nbytes
+    return array(data, ctx=cpu(), dtype=dt), off
+
+
+def save_to_bytes(data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        arrays = [data[k] for k in keys]
+    else:
+        keys = []
+        arrays = list(data)
+    buf = [struct.pack("<QQ", _LIST_MAGIC, 0), struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _save_one(buf, a)
+    buf.append(struct.pack("<Q", len(keys)))
+    for k in keys:
+        kb = k.encode("utf-8")
+        buf.append(struct.pack("<Q", len(kb)))
+        buf.append(kb)
+    return b"".join(buf)
+
+
+def save(fname, data):
+    """Save list/dict of NDArrays (reference mx.nd.save)."""
+    with open(fname, "wb") as f:
+        f.write(save_to_bytes(data))
+
+
+def load_from_bytes(raw):
+    mv = memoryview(raw)
+    header, _res = struct.unpack_from("<QQ", mv, 0)
+    if header != _LIST_MAGIC:
+        raise MXNetError("Invalid NDArray file format")
+    (n,) = struct.unpack_from("<Q", mv, 16)
+    off = 24
+    arrays = []
+    for _ in range(n):
+        a, off = _load_one(mv, off)
+        arrays.append(a)
+    (nkeys,) = struct.unpack_from("<Q", mv, off)
+    off += 8
+    keys = []
+    for _ in range(nkeys):
+        (ln,) = struct.unpack_from("<Q", mv, off)
+        off += 8
+        keys.append(bytes(mv[off:off + ln]).decode("utf-8"))
+        off += ln
+    if keys:
+        return dict(zip(keys, arrays))
+    return arrays
+
+
+def load(fname):
+    """Load list/dict of NDArrays (reference mx.nd.load)."""
+    with open(fname, "rb") as f:
+        return load_from_bytes(f.read())

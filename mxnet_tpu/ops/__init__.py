@@ -1,0 +1,8 @@
+"""Operator corpus: importing this package populates the registry."""
+from .registry import Op, register, get_op, list_ops, OP_REGISTRY  # noqa: F401
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optim_ops  # noqa: F401

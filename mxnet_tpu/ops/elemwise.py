@@ -1,0 +1,215 @@
+"""Elementwise operator corpus (unary / binary / scalar / comparison).
+
+Reference analogue: ``src/operator/tensor/elemwise_unary_op_*.cc``,
+``elemwise_binary_op*.cc``, ``*_scalar_op*.cc``, ``mshadow_op.h`` functor zoo
+(SURVEY §2.2).  On TPU every one of these is a single XLA HLO that fuses into
+neighbours, so the whole file is just jnp lambdas behind the registry.
+
+MXNet name conventions preserved: ``elemwise_add``/``_plus``/``broadcast_add``
+all exist; scalar variants take attr ``scalar``; reverse variants ``_r*``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp_special
+
+from .registry import register, Op, OP_REGISTRY
+
+_f = jnp.asarray
+
+
+def _reg_unary(name, fn, aliases=()):
+    register(name, aliases=aliases)(lambda x, **kw: fn(x))
+
+
+# --- unary math (reference: elemwise_unary_op_basic.cc, _trig.cc) -----------
+_UNARY = {
+    "abs": jnp.abs,
+    "arccos": jnp.arccos, "arccosh": jnp.arccosh,
+    "arcsin": jnp.arcsin, "arcsinh": jnp.arcsinh,
+    "arctan": jnp.arctan, "arctanh": jnp.arctanh,
+    "cbrt": jnp.cbrt, "ceil": jnp.ceil,
+    "cos": jnp.cos, "cosh": jnp.cosh,
+    "degrees": jnp.degrees, "exp": jnp.exp, "expm1": jnp.expm1,
+    "fix": jnp.trunc, "floor": jnp.floor,
+    "gamma": lambda x: jnp.exp(jsp_special.gammaln(x)),
+    "gammaln": jsp_special.gammaln,
+    "log": jnp.log, "log10": jnp.log10, "log1p": jnp.log1p, "log2": jnp.log2,
+    "negative": jnp.negative,
+    "radians": jnp.radians,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "reciprocal": lambda x: 1.0 / x,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "rint": jnp.rint, "round": jnp.round,
+    "rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+    "sigmoid": jax.nn.sigmoid,
+    "sign": jnp.sign, "sin": jnp.sin, "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt, "square": jnp.square,
+    "tan": jnp.tan, "tanh": jnp.tanh, "trunc": jnp.trunc,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "softsign": jax.nn.soft_sign,
+}
+for _name, _fn in _UNARY.items():
+    _reg_unary(_name, _fn)
+
+register("_copy", aliases=["identity"])(lambda x, **kw: x)
+
+
+def _block_grad_bwd(out_grads, inputs, outputs, attrs):
+    return (jnp.zeros_like(inputs[0]),)
+
+
+register("BlockGrad", aliases=["stop_gradient"], custom_vjp=_block_grad_bwd)(
+    lambda x, **kw: jax.lax.stop_gradient(x))
+
+
+def _make_loss_bwd(out_grads, inputs, outputs, attrs):
+    # reference make_loss: gradient is ones (the output *is* the loss)
+    return (jnp.ones_like(inputs[0]) * attrs.get("grad_scale", 1.0),)
+
+
+register("make_loss", custom_vjp=_make_loss_bwd)(lambda x, **kw: x)
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0, **kw):
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+@register("softmax")
+def _softmax(x, axis=-1, temperature=None, **kw):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def _log_softmax(x, axis=-1, temperature=None, **kw):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def _softmin(x, axis=-1, **kw):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("Cast", aliases=["cast"])
+def _cast(x, dtype="float32", **kw):
+    from ..base import dtype_np
+    return x.astype(dtype_np(dtype))
+
+
+@register("clip")
+def _clip(x, a_min=None, a_max=None, **kw):
+    return jnp.clip(x, a_min, a_max)
+
+
+# --- binary elemwise + broadcast (reference: elemwise_binary_op_basic.cc,
+# broadcast ops in elemwise_binary_broadcast_op_*.cc) ------------------------
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum, "hypot": jnp.hypot,
+}
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less, "lesser_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+_OLD_NAMES = {"add": "_plus", "sub": "_minus", "mul": "_mul", "div": "_div"}
+
+
+def _mk_binary(fn, as_dtype=False):
+    if as_dtype:
+        return lambda a, b, **kw: fn(a, b).astype(a.dtype)
+    return lambda a, b, **kw: fn(a, b)
+
+
+for _n, _fn in _BINARY.items():
+    _b = _mk_binary(_fn)
+    aliases = ["broadcast_%s" % _n, "_%s" % _n]
+    if _n in _OLD_NAMES:
+        aliases.append(_OLD_NAMES[_n])
+    if _n in ("maximum", "minimum", "hypot", "mod", "power"):
+        aliases.append("_%s" % _n)
+    register("elemwise_%s" % _n, aliases=aliases)(_b)
+
+for _n, _fn in _CMP.items():
+    _b = _mk_binary(_fn, as_dtype=True)
+    register("_%s" % _n, aliases=["broadcast_%s" % _n])(_b)
+
+register("_grad_add")(_mk_binary(jnp.add))
+
+
+def _bwd_div_out_zero(out_grads, inputs, outputs, attrs):
+    raise NotImplementedError
+
+
+# scalar variants (reference: elemwise_binary_scalar_op_*.cc)
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, _f(s).astype(x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: jnp.logical_and(x, s).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: jnp.logical_or(x, s).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: jnp.logical_xor(x, s).astype(x.dtype),
+}
+
+
+def _mk_scalar(fn):
+    return lambda x, scalar=0.0, **kw: fn(x, scalar)
+
+
+for _n, _fn in _SCALAR.items():
+    register(_n)(_mk_scalar(_fn))
+
+register("_scatter_plus_scalar")(_mk_scalar(lambda x, s: x + s))
+register("_scatter_minus_scalar")(_mk_scalar(lambda x, s: x - s))
+register("_scatter_elemwise_div")(_mk_binary(jnp.divide))
+
+
+@register("add_n", aliases=["ElementWiseSum", "_sparse_add_n"])
+def _add_n(*args, num_args=None, **kw):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("elemwise_sum")
+def _elemwise_sum(*args, num_args=None, **kw):
+    return _add_n(*args)
+
+
+@register("_identity_with_attr_like_rhs", nondiff_inputs=(1,))
+def _id_attr_like(lhs, rhs, **kw):
+    return lhs
+
+
+@register("where", nondiff_inputs=(0,))
+def _where(cond, x, y, **kw):
+    return jnp.where(cond.astype(bool), x, y)

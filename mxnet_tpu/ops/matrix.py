@@ -1,0 +1,410 @@
+"""Shape / layout / linear-algebra / indexing ops.
+
+Reference analogue: ``src/operator/tensor/matrix_op.cc`` (reshape, transpose,
+slice, flip, ...), ``dot-inl.h`` (dot/batch_dot), ``indexing_op.cc``
+(take/Embedding/one_hot/gather_nd/scatter_nd), ``ordering_op.cc``
+(sort/argsort/topk), ``init_op.cc`` (zeros/ones/arange), ``la_op.cc`` (linalg).
+
+TPU notes: ``dot`` lowers to ``lax.dot_general`` (MXU); ``take``/gather are
+XLA gathers; dynamic output shapes are avoided throughout (topk's k is an
+attr, so shapes stay static under jit).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ..base import dtype_np
+
+
+# --- reshape family ---------------------------------------------------------
+@register("Reshape", aliases=["reshape"])
+def _reshape(x, shape=None, reverse=False, target_shape=None, keep_highest=False, **kw):
+    if shape is None and target_shape is not None:  # legacy attr
+        return x.reshape(tuple(target_shape))
+    src = list(x.shape)
+    if reverse:
+        src = src[::-1]
+        shape = tuple(shape)[::-1]
+    out = []
+    src_i = 0
+    infer_idx = None
+    i = 0
+    shape = tuple(shape)
+    while i < len(shape):
+        s = shape[i]
+        if s > 0:
+            out.append(s)
+            src_i += 1
+        elif s == 0:  # copy dim
+            out.append(src[src_i])
+            src_i += 1
+        elif s == -1:  # infer
+            infer_idx = len(out)
+            out.append(1)
+            src_i += 1
+        elif s == -2:  # copy all remaining
+            out.extend(src[src_i:])
+            src_i = len(src)
+        elif s == -3:  # merge two dims
+            out.append(src[src_i] * src[src_i + 1])
+            src_i += 2
+        elif s == -4:  # split dim into next two shape values
+            a, b = shape[i + 1], shape[i + 2]
+            d = src[src_i]
+            if a == -1:
+                a = d // b
+            if b == -1:
+                b = d // a
+            out.extend([a, b])
+            src_i += 1
+            i += 2
+        i += 1
+    if infer_idx is not None:
+        known = int(np.prod([d for j, d in enumerate(out) if j != infer_idx]))
+        out[infer_idx] = int(np.prod(x.shape)) // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    return x.reshape(tuple(out))
+
+
+@register("Flatten", aliases=["flatten"])
+def _flatten(x, **kw):
+    return x.reshape((x.shape[0], -1))
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0, **kw):
+    return jnp.expand_dims(x, axis)
+
+
+@register("transpose")
+def _transpose(x, axes=None, **kw):
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, axes)
+
+
+@register("SwapAxis", aliases=["swapaxes"])
+def _swapaxes(x, dim1=0, dim2=0, **kw):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("reshape_like", nondiff_inputs=(1,))
+def _reshape_like(x, like, **kw):
+    return x.reshape(like.shape)
+
+
+@register("Concat", aliases=["concat"])
+def _concat(*args, dim=1, num_args=None, **kw):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0, num_args=None, **kw):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_outputs(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+@register("SliceChannel", aliases=["split"], num_outputs=_split_outputs)
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False, **kw):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [p.squeeze(axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=["crop"])
+def _slice(x, begin=(), end=(), step=None, **kw):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return x[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None, **kw):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", nondiff_inputs=(1,))
+def _slice_like(x, like, axes=(), **kw):
+    shape = list(x.shape)
+    axes = axes or range(x.ndim)
+    for a in axes:
+        shape[a] = like.shape[a]
+    return x[tuple(slice(0, s) for s in shape)]
+
+
+@register("reverse", aliases=["flip"])
+def _reverse(x, axis=(), **kw):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis=ax)
+
+
+@register("tile")
+def _tile(x, reps=(), **kw):
+    return jnp.tile(x, tuple(reps))
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None, **kw):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("Pad", aliases=["pad"])
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0, **kw):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise ValueError("unknown pad mode %s" % mode)
+
+
+# --- dot / linalg -----------------------------------------------------------
+@register("dot", aliases=["_sparse_dot"])
+def _dot(a, b, transpose_a=False, transpose_b=False, forward_stype=None, **kw):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    # mxnet dot on >2d: contracts last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False, **kw):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+def _reg_linalg():
+    register("_linalg_gemm2", aliases=["linalg_gemm2"])(
+        lambda a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2, **kw:
+        alpha * jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+                           jnp.swapaxes(b, -1, -2) if transpose_b else b))
+
+    def gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, **kw):
+        return (alpha * jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+                                   jnp.swapaxes(b, -1, -2) if transpose_b else b)
+                + beta * c)
+    register("_linalg_gemm", aliases=["linalg_gemm"])(gemm)
+    register("_linalg_potrf", aliases=["linalg_potrf"])(
+        lambda a, **kw: jnp.linalg.cholesky(a))
+
+    def potri(a, **kw):
+        l = jnp.linalg.cholesky(a) if False else a  # input is already potrf output
+        linv = jax.scipy.linalg.solve_triangular(
+            a, jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape), lower=True)
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+    register("_linalg_potri", aliases=["linalg_potri"])(potri)
+
+    def trsm(a, b, transpose=False, rightside=False, alpha=1.0, lower=True, **kw):
+        sol = jax.scipy.linalg.solve_triangular
+        if rightside:
+            # solve X A = alpha B  ->  A^T X^T = alpha B^T
+            x = sol(jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * b, -1, -2),
+                    lower=not lower, trans=1 if transpose else 0)
+            return jnp.swapaxes(x, -1, -2)
+        return sol(a, alpha * b, lower=lower, trans=1 if transpose else 0)
+    register("_linalg_trsm", aliases=["linalg_trsm"])(trsm)
+
+    def trmm(a, b, transpose=False, rightside=False, alpha=1.0, lower=True, **kw):
+        at = jnp.swapaxes(a, -1, -2) if transpose else a
+        return alpha * (jnp.matmul(b, at) if rightside else jnp.matmul(at, b))
+    register("_linalg_trmm", aliases=["linalg_trmm"])(trmm)
+    register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])(
+        lambda a, **kw: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1))
+    register("_linalg_syrk", aliases=["linalg_syrk"])(
+        lambda a, transpose=False, alpha=1.0, **kw:
+        alpha * (jnp.matmul(jnp.swapaxes(a, -1, -2), a) if transpose
+                 else jnp.matmul(a, jnp.swapaxes(a, -1, -2))))
+
+    def syevd(a, **kw):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+    register("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2)(syevd)
+
+    def gelqf(a, **kw):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    register("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2)(gelqf)
+
+
+_reg_linalg()
+
+
+# --- indexing ---------------------------------------------------------------
+@register("take", nondiff_inputs=(1,))
+def _take(a, indices, axis=0, mode="clip", **kw):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis, mode=mode if mode != "raise" else "clip")
+
+
+@register("batch_take", nondiff_inputs=(1,))
+def _batch_take(a, indices, **kw):
+    return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("pick", nondiff_inputs=(1,))
+def _pick(x, index, axis=-1, keepdims=False, mode="clip", **kw):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis if axis >= 0 else x.ndim + axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("Embedding", nondiff_inputs=(0,))
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+               sparse_grad=False, **kw):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("one_hot")
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth), dtype=dtype_np(dtype))
+    return oh * on_value + (1 - oh) * off_value
+
+
+@register("gather_nd", nondiff_inputs=(1,))
+def _gather_nd(data, indices, **kw):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd", nondiff_inputs=(1,))
+def _scatter_nd(data, indices, shape=(), **kw):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd", nondiff_inputs=(1,))
+def _scatter_set_nd(lhs, indices, rhs, shape=(), **kw):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("sparse_retain", aliases=["_sparse_retain"], nondiff_inputs=(1,))
+def _sparse_retain_dense(data, indices, **kw):
+    mask = jnp.zeros((data.shape[0],), dtype=bool).at[indices.astype(jnp.int32)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+# --- ordering ---------------------------------------------------------------
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True, **kw):
+    out = jnp.sort(x, axis=axis if axis is not None else None)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort")
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32", **kw):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype_np(dtype))
+
+
+def _topk_nout(attrs):
+    rt = attrs.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", num_outputs=_topk_nout)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **kw):
+    axis = x.ndim - 1 if axis is None else axis % x.ndim
+    xs = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-xs if is_ascend else xs, int(k))
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx.astype(dtype_np(dtype))
+    if ret_typ == "mask":
+        m = jnp.zeros(xs.shape, x.dtype)
+        m = m.at[..., :].set(0)
+        oh = jax.nn.one_hot(idx if idx.ndim else idx, xs.shape[-1], dtype=x.dtype)
+        mask = jnp.moveaxis(oh.sum(axis=-2), -1, axis)
+        return mask
+    return vals, idx.astype(dtype_np(dtype))
+
+
+# --- creation (reference: init_op.cc) --------------------------------------
+@register("_zeros", aliases=["zeros_like_dummy"], no_inputs=True)
+def _zeros(shape=(), dtype="float32", ctx=None, **kw):
+    return jnp.zeros(tuple(shape) if not isinstance(shape, int) else (shape,),
+                     dtype=dtype_np(dtype))
+
+
+@register("_ones", no_inputs=True)
+def _ones(shape=(), dtype="float32", ctx=None, **kw):
+    return jnp.ones(tuple(shape) if not isinstance(shape, int) else (shape,),
+                    dtype=dtype_np(dtype))
+
+
+@register("_full", no_inputs=True)
+def _full(shape=(), dtype="float32", value=0.0, ctx=None, **kw):
+    return jnp.full(tuple(shape) if not isinstance(shape, int) else (shape,),
+                    value, dtype=dtype_np(dtype))
+
+
+@register("_arange", no_inputs=True)
+def _arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None,
+            infer_range=False, **kw):
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", no_inputs=True)
+def _eye(N=0, M=0, k=0, dtype="float32", ctx=None, **kw):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=dtype_np(dtype))
+
+
+@register("zeros_like")
+def _zeros_like(x, **kw):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x, **kw):
+    return jnp.ones_like(x)
+
+
+@register("diag")
+def _diag(x, k=0, **kw):
+    return jnp.diag(x, k=int(k))
+
+
+# --- control-flow-ish (reference: control_flow_op.cc handled by `where`) ----
+@register("cast_storage", aliases=["_sparse_cast_storage"])
+def _cast_storage(x, stype=None, **kw):
+    # dense backing for all stypes; the NDArray wrapper re-tags the stype.
+    return x

@@ -1,0 +1,130 @@
+"""Fused optimizer-update ops.
+
+Reference analogue: ``src/operator/optimizer_op.cc`` — sgd_update,
+sgd_mom_update, mp_* (multi-precision), adam_update, rmsprop_update,
+rmspropalex_update, ftrl_update (SURVEY §2.2).  Optimizers run *as ops* so the
+whole update fuses into one XLA program (reference runs them as engine ops for
+async overlap; here fusion gives the same effect).
+
+Semantics match the reference kernels: rescale_grad, clip_gradient, wd applied
+to the *rescaled, clipped* gradient (``optimizer_op-inl.h``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", nondiff_inputs=(0, 1))
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True, **kw):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", nondiff_inputs=(0, 1, 2), num_outputs=2,
+          num_visible_outputs=1, aux_updates={2: 1})
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True, **kw):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", nondiff_inputs=(0, 1, 2), num_outputs=2,
+          num_visible_outputs=1, aux_updates={2: 1})
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, **kw):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", nondiff_inputs=(0, 1, 2, 3), num_outputs=3,
+          num_visible_outputs=1, aux_updates={2: 1, 3: 2})
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", nondiff_inputs=(0, 1, 2, 3), num_outputs=3,
+          num_visible_outputs=1, aux_updates={2: 1, 3: 2})
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True, **kw):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return (weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon),
+            new_mean, new_var)
+
+
+@register("rmsprop_update", nondiff_inputs=(0, 1, 2), num_outputs=2,
+          num_visible_outputs=1, aux_updates={2: 1})
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0, **kw):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register("rmspropalex_update", nondiff_inputs=(0, 1, 2, 3, 4), num_outputs=4,
+          num_visible_outputs=1, aux_updates={2: 1, 3: 2, 4: 3})
+def _rmspropalex_update(weight, grad, n, g_, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0, **kw):
+    grd = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(grd)
+    new_g = gamma1 * g_ + (1 - gamma1) * grd
+    new_delta = gamma2 * delta - lr * grd / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", nondiff_inputs=(0, 1, 2, 3), num_outputs=3,
+          num_visible_outputs=1, aux_updates={2: 1, 3: 2})
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+@register("signsgd_update", nondiff_inputs=(0, 1))
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, **kw):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", nondiff_inputs=(0, 1, 2), num_outputs=2,
+          num_visible_outputs=1, aux_updates={2: 1})
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, **kw):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    return weight - lr * (jnp.sign(-new_mom) + wd * weight), new_mom

@@ -1,0 +1,146 @@
+"""Random sampling ops (counter-based JAX PRNG behind MXNet's sampling API).
+
+Reference analogue: ``src/operator/random/sample_op.cc`` (``_random_*`` shape-
+parameterized samplers and ``_sample_*`` tensor-parameterized variants,
+SURVEY appendix A) backed by a per-device parallel RNG resource
+(``ResourceRequest::kRandom``).  TPU-native: every sampler is a pure function
+of an explicit threefry key (``needs_rng``), so sampling is reproducible,
+jit-safe, and shardable — the "RNG resource" is just key-splitting.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import dtype_np
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape or ())
+
+
+def _reg_random(name, fn):
+    register(name, aliases=["random_" + name.split("_random_")[-1]]
+             if name.startswith("_random_") else [], needs_rng=True,
+             no_inputs=True)(fn)
+
+
+@register("_random_uniform", aliases=["uniform", "random_uniform"],
+          needs_rng=True, no_inputs=True)
+def _random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None,
+                    rng=None, **kw):
+    return jax.random.uniform(rng, _shape(shape), dtype_np(dtype), low, high)
+
+
+@register("_random_normal", aliases=["normal", "random_normal"],
+          needs_rng=True, no_inputs=True)
+def _random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None,
+                   rng=None, **kw):
+    return loc + scale * jax.random.normal(rng, _shape(shape), dtype_np(dtype))
+
+
+@register("_random_gamma", aliases=["random_gamma"], needs_rng=True, no_inputs=True)
+def _random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None,
+                  rng=None, **kw):
+    return jax.random.gamma(rng, alpha, _shape(shape), dtype_np(dtype)) * beta
+
+
+@register("_random_exponential", aliases=["random_exponential"], needs_rng=True,
+          no_inputs=True)
+def _random_exponential(lam=1.0, shape=(), dtype="float32", ctx=None, rng=None, **kw):
+    return jax.random.exponential(rng, _shape(shape), dtype_np(dtype)) / lam
+
+
+@register("_random_poisson", aliases=["random_poisson"], needs_rng=True,
+          no_inputs=True)
+def _random_poisson(lam=1.0, shape=(), dtype="float32", ctx=None, rng=None, **kw):
+    return jax.random.poisson(rng, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_negative_binomial", aliases=["random_negative_binomial"],
+          needs_rng=True, no_inputs=True)
+def _random_negbin(k=1, p=1.0, shape=(), dtype="float32", ctx=None, rng=None, **kw):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, float(k), _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=["random_generalized_negative_binomial"], needs_rng=True,
+          no_inputs=True)
+def _random_gnegbin(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None,
+                    rng=None, **kw):
+    k1, k2 = jax.random.split(rng)
+    if alpha == 0:
+        return jax.random.poisson(k1, mu, _shape(shape)).astype(dtype_np(dtype))
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_randint", aliases=["random_randint"], needs_rng=True,
+          no_inputs=True)
+def _random_randint(low=0, high=1, shape=(), dtype="int32", ctx=None, rng=None, **kw):
+    return jax.random.randint(rng, _shape(shape), int(low), int(high)).astype(
+        dtype_np(dtype))
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial"], needs_rng=True,
+          nondiff_inputs=(0,))
+def _sample_multinomial(data, shape=(), get_prob=False, dtype="int32",
+                        rng=None, **kw):
+    n = int(jnp.prod(jnp.array(_shape(shape)))) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    out_shape = data.shape[:-1] + (_shape(shape) or (1,))[0:len(_shape(shape)) or 1]
+    samp = jax.random.categorical(rng, logits, axis=-1,
+                                  shape=(_shape(shape) or (1,)) + data.shape[:-1])
+    samp = jnp.moveaxis(samp, 0, -1)
+    if not shape:
+        samp = samp[..., 0]
+    samp = samp.astype(dtype_np(dtype))
+    if get_prob:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        lp = jnp.take_along_axis(
+            logp, samp.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32), axis=-1)
+        return samp, lp.reshape(samp.shape)
+    return samp
+
+
+# tensor-parameterized samplers: _sample_uniform(low_arr, high_arr, shape=s)
+def _mk_tensor_sampler(sampler):
+    def fn(*params, shape=(), dtype="float32", rng=None, **kw):
+        s = _shape(shape)
+        def one(key, *p):
+            return sampler(key, s, dtype_np(dtype), *p)
+        n = params[0].shape[0] if params[0].ndim else 1
+        keys = jax.random.split(rng, n)
+        flat = [p.reshape(n) if p.ndim else p.reshape(1) for p in params]
+        out = jax.vmap(one)(keys, *flat)
+        return out.reshape(params[0].shape + s)
+    return fn
+
+
+register("_sample_uniform", aliases=["sample_uniform"], needs_rng=True,
+         nondiff_inputs=(0, 1))(
+    _mk_tensor_sampler(lambda k, s, d, lo, hi: jax.random.uniform(k, s, d, lo, hi)))
+register("_sample_normal", aliases=["sample_normal"], needs_rng=True,
+         nondiff_inputs=(0, 1))(
+    _mk_tensor_sampler(lambda k, s, d, mu, sig: mu + sig * jax.random.normal(k, s, d)))
+register("_sample_gamma", aliases=["sample_gamma"], needs_rng=True,
+         nondiff_inputs=(0, 1))(
+    _mk_tensor_sampler(lambda k, s, d, a, b: jax.random.gamma(k, a, s, d) * b))
+register("_sample_exponential", aliases=["sample_exponential"], needs_rng=True,
+         nondiff_inputs=(0,))(
+    _mk_tensor_sampler(lambda k, s, d, lam: jax.random.exponential(k, s, d) / lam))
+register("_sample_poisson", aliases=["sample_poisson"], needs_rng=True,
+         nondiff_inputs=(0,))(
+    _mk_tensor_sampler(lambda k, s, d, lam: jax.random.poisson(k, lam, s).astype(d)))
+
+
+@register("shuffle", aliases=["_shuffle"], needs_rng=True)
+def _shuffle(data, rng=None, **kw):
+    return jax.random.permutation(rng, data, axis=0)

@@ -1,0 +1,101 @@
+"""Reduction / broadcasting-shape ops.
+
+Reference analogue: ``src/operator/tensor/broadcast_reduce_op_{value,index}.cc``
+(SURVEY §2.2 — sum/mean/prod/nansum/nanprod/max/min/argmax/argmin/norm/
+broadcast_axis/broadcast_to).  MXNet reduce attrs kept: ``axis`` (None = all),
+``keepdims``, ``exclude`` (reduce over the complement of ``axis``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        ax = tuple(range(ndim))
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _mk_reduce(fn):
+    def red(x, axis=None, keepdims=False, exclude=False, **kw):
+        ax = _norm_axis(axis, x.ndim, exclude)
+        return fn(x, axis=ax, keepdims=bool(keepdims))
+    return red
+
+
+for _n, _fn in {
+    "sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+    "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+    "max": jnp.max, "min": jnp.min,
+}.items():
+    register(_n, aliases=["%s_axis" % _n] if _n in ("sum", "max", "min") else [])(
+        _mk_reduce(_fn))
+
+
+def _mk_arg_reduce(fn):
+    def red(x, axis=None, keepdims=False, **kw):
+        if axis is None:
+            out = fn(x.reshape(-1), axis=0)
+            if keepdims:
+                out = out.reshape((1,) * x.ndim)
+            return out.astype(x.dtype)
+        out = fn(x, axis=int(axis))
+        if keepdims:
+            out = jnp.expand_dims(out, int(axis))
+        return out.astype(x.dtype)
+    return red
+
+
+register("argmax")(_mk_arg_reduce(jnp.argmax))
+register("argmin")(_mk_arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel")
+def _argmax_channel(x, **kw):
+    return jnp.argmax(x, axis=1).astype(x.dtype)
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False, **kw):
+    if axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x))).reshape((1,))
+    ax = axis if isinstance(axis, int) else tuple(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("_square_sum")
+def _square_sum(x, axis=None, keepdims=False, **kw):
+    ax = _norm_axis(axis, x.ndim)
+    return jnp.sum(jnp.square(x), axis=ax, keepdims=bool(keepdims))
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def _broadcast_axis(x, axis=(), size=(), **kw):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=(), **kw):
+    # mxnet allows 0 meaning "keep this dim"
+    tgt = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like", nondiff_inputs=(1,))
+def _broadcast_like(x, like, **kw):
+    return jnp.broadcast_to(x, like.shape)

@@ -1,0 +1,150 @@
+"""Operator registry: one pure-JAX function per op, shared by every frontend.
+
+Reference analogue: the NNVM ``Op`` registry plus its typed attributes
+(``include/mxnet/op_attr_types.h:184-261`` — FCompute/FGradient/
+FInferStorageType) and the dmlc parameter reflection system
+(``DMLC_DECLARE_PARAMETER``, e.g. ConvolutionParam at
+``src/operator/convolution-inl.h:56``).
+
+TPU-first redesign: an op is a *pure function* ``fn(*jax_arrays, **attrs)``
+returning one or more jax arrays.  There are no per-device kernels, no
+FCompute/FComputeEx split, and no storage-type dispatch — XLA compiles and
+fuses everything.  Gradients come from ``jax.vjp`` over the same function
+(replacing hand-written FGradient registrations), except where the reference
+defines a *semantic* gradient that differs from the mathematical one
+(SoftmaxOutput, MakeLoss, BlockGrad ...), which declare ``custom_vjp``.
+
+Attributes serialize to strings for symbol-JSON parity
+(reference symbols store every param stringified).
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["Op", "register", "get_op", "list_ops", "OP_REGISTRY",
+           "parse_attr_string", "attr_to_string"]
+
+OP_REGISTRY = {}
+
+
+def parse_attr_string(v):
+    """Parse a stringified attr back to a python value (symbol JSON parity)."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def attr_to_string(v):
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (bool, int, float, type(None))):
+        return str(v)
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    if isinstance(v, np.dtype):
+        return v.name
+    return str(v)
+
+
+class Op:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (reference-compatible, e.g. ``Convolution``).
+    fn : pure function ``(*arrays, **attrs) -> array | tuple``.  If
+        ``takes_mode``, it receives ``train_mode=<bool>``; if ``needs_rng`` it
+        receives ``rng=<jax PRNG key>``.  Both are trace-safe (static bool /
+        traced key), which is what makes the whole graph jittable.
+    num_outputs : int or callable(attrs) -> int.
+    num_visible_outputs : outputs exposed to the user (reference: BatchNorm
+        registers 3 outputs, 1 visible).
+    nondiff_inputs : input positions excluded from autograd (labels, aux
+        state) — reference analogue: DeclareBackwardDependency pruning.
+    aux_updates : {aux_input_pos: output_pos} — outputs that are *new values
+        of auxiliary state* (BatchNorm moving stats).  Eager mode writes them
+        back into the aux NDArray; the executor updates its aux dict; they are
+        never differentiated.
+    custom_vjp : optional ``(attrs) -> (fwd_fn, bwd_fn)``-style override; here
+        simply a function ``bwd(out_grads, inputs, outputs, attrs) ->
+        input_grads`` used instead of jax.vjp (semantic gradients).
+    """
+
+    def __init__(self, name, fn, num_outputs=1, num_visible_outputs=None,
+                 nondiff_inputs=(), aux_updates=None, takes_mode=False,
+                 needs_rng=False, custom_vjp=None, attr_defaults=None,
+                 no_inputs=False):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.num_visible_outputs = num_visible_outputs
+        self.nondiff_inputs = tuple(nondiff_inputs)
+        self.aux_updates = dict(aux_updates or {})
+        self.takes_mode = takes_mode
+        self.needs_rng = needs_rng
+        self.custom_vjp = custom_vjp
+        self.attr_defaults = dict(attr_defaults or {})
+        self.no_inputs = no_inputs  # creation ops (zeros, ones, arange, random)
+
+    def n_outputs(self, attrs):
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    def n_visible_outputs(self, attrs):
+        if self.num_visible_outputs is None:
+            n = self.n_outputs(attrs)
+            return n - len(self.aux_updates)
+        if callable(self.num_visible_outputs):
+            return self.num_visible_outputs(attrs)
+        return self.num_visible_outputs
+
+    def apply(self, inputs, attrs, train_mode=False, rng=None):
+        """Run the pure function; always returns a tuple of jax arrays."""
+        kw = dict(attrs)
+        if self.takes_mode:
+            kw["train_mode"] = train_mode
+        if self.needs_rng:
+            kw["rng"] = rng
+        out = self.fn(*inputs, **kw)
+        if isinstance(out, (tuple, list)):
+            return tuple(out)
+        return (out,)
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+def register(name, aliases=(), **kwargs):
+    """Decorator: register a pure function as operator ``name``."""
+    def deco(fn):
+        op = Op(name, fn, **kwargs)
+        OP_REGISTRY[name] = op
+        for a in aliases:
+            OP_REGISTRY[a] = op
+        return fn
+    return deco
+
+
+def get_op(name):
+    if name not in OP_REGISTRY:
+        raise MXNetError("Operator %s is not registered (have %d ops)"
+                         % (name, len(OP_REGISTRY)))
+    return OP_REGISTRY[name]
+
+
+def list_ops():
+    return sorted(OP_REGISTRY)

@@ -1,0 +1,38 @@
+"""Global random state: counter-based threefry keys behind ``mx.random.seed``.
+
+Reference analogue: the per-device parallel RNG resource
+(``src/resource.cc``, ``ResourceRequest::kRandom``) seeded by
+``mx.random.seed`` (``python/mxnet/random.py``).  TPU-native: one root key +
+a split counter; every sampling op consumes a fresh subkey, so eager sampling
+is reproducible given a seed, and jitted graphs thread keys explicitly.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_lock = threading.Lock()
+_seed = 0
+_key = jax.random.PRNGKey(0)
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG (reference: mx.random.seed)."""
+    global _key, _seed
+    with _lock:
+        _seed = int(seed_state)
+        _key = jax.random.PRNGKey(_seed)
+
+
+def next_key():
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+        return sub
+
+
+def current_seed():
+    return _seed

@@ -1,0 +1,23 @@
+"""Test config: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's test doctrine (SURVEY §4): tests must run without
+accelerator hardware; multi-device paths are exercised on a virtual mesh
+(the reference used multi-GPU hosts; we use XLA's forced host device count).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

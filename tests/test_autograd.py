@@ -1,0 +1,156 @@
+"""Autograd tests (modeled on reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.base import MXNetError
+
+
+def test_simple_grad():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * 2).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy())
+
+
+def test_chain_and_branches():
+    x = nd.array([[1., 2.], [3., 4.]])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y * x + y.sum()
+        out = z.sum()
+    out.backward()
+    # d/dx [2x^2 + sum(2x)*n_elements...] -> 4x + 2*4 per element? compute numerically
+    eps = 1e-3
+    xe = x.asnumpy()
+    def f(v):
+        y = v * 2
+        return (y * v + y.sum()).sum()
+    num = np.zeros_like(xe)
+    for i in np.ndindex(*xe.shape):
+        p = xe.copy(); p[i] += eps
+        m = xe.copy(); m[i] -= eps
+        num[i] = (f(p) - f(m)) / (2 * eps)
+    np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2)
+
+
+def test_head_gradient():
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10., 100.]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30., 300.])
+
+
+def test_grad_req_add():
+    x = nd.array([1., 2.])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * 2 * x.asnumpy())
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.])  # only d(z)/dx via second factor
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * x) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.])
+
+
+def test_autograd_grad_function():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+        g = autograd.grad(y, [x], retain_graph=True)
+    np.testing.assert_allclose(g[0].asnumpy(), 2 * x.asnumpy())
+
+
+def test_training_mode_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    assert not autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    out_pred = nd.Dropout(x, p=0.5)  # not recording, not training -> identity
+    np.testing.assert_allclose(out_pred.asnumpy(), x.asnumpy())
+    with autograd.record():
+        out_train = nd.Dropout(x, p=0.5)
+    frac_zero = (out_train.asnumpy() == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+
+
+def test_backward_non_recorded_raises():
+    x = nd.ones((2,))
+    with pytest.raises(MXNetError):
+        x.backward()
+
+
+def test_mark_variables():
+    x = nd.array([3.])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [6.])
+
+
+def test_softmax_output_semantic_grad():
+    # SoftmaxOutput backward = softmax(data) - onehot(label), ignoring head grad
+    data = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype=np.float32)
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    import scipy.special as sp
+    expect = sp.softmax(data.asnumpy(), axis=-1)
+    oh = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(data.grad.asnumpy(), expect - oh, rtol=1e-5)
+
+
+def test_rnn_op_grad_flows():
+    T, N, I, H = 3, 2, 4, 5
+    from mxnet_tpu.ops.nn import rnn_param_size
+    psz = rnn_param_size(1, I, H, "lstm")
+    data = nd.random.uniform(shape=(T, N, I))
+    params = nd.random.normal(scale=0.1, shape=(psz,))
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    params.attach_grad()
+    with autograd.record():
+        out = nd.RNN(data, params, h0, c0, state_size=H, num_layers=1,
+                     mode="lstm")
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(params.grad.asnumpy()).sum() > 0

@@ -1,0 +1,169 @@
+"""NDArray semantics tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_dtype_defaults():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.dtype == np.float32  # reference default
+    assert a.shape == (2, 2)
+    b = nd.array(np.arange(6, dtype=np.int64), dtype=np.int64)
+    assert b.dtype == np.int64
+
+
+def test_basic_arith_and_broadcast():
+    a = nd.array([[1., 2.], [3., 4.]])
+    b = nd.array([10., 20.])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    np.testing.assert_allclose((a - 1).asnumpy(), [[0, 1], [2, 3]])
+    np.testing.assert_allclose((2 / a).asnumpy(), 2 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    np.testing.assert_allclose((-a).asnumpy(), -a.asnumpy())
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), 3 * np.ones((2, 2)))
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), np.arange(4) + 4)
+    np.testing.assert_allclose(a[1:3].asnumpy(), np.arange(12).reshape(3, 4)[1:3])
+    a[0] = 7.0
+    np.testing.assert_allclose(a.asnumpy()[0], 7 * np.ones(4))
+    a[1:3, 1] = 0.0
+    assert a.asnumpy()[2, 1] == 0
+
+
+def test_reductions_match_numpy():
+    x = np.random.uniform(-1, 1, (3, 4, 5)).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sum().asnumpy(), x.sum().reshape(()), rtol=1e-5)
+    np.testing.assert_allclose(a.sum(axis=1).asnumpy(), x.sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(a.max(axis=(0, 2)).asnumpy(), x.max(axis=(0, 2)))
+    np.testing.assert_allclose(a.mean(axis=2, keepdims=True).asnumpy(),
+                               x.mean(axis=2, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(a.argmax(axis=1).asnumpy(), x.argmax(axis=1))
+
+
+def test_reshape_semantics():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+
+
+def test_copy_and_context():
+    a = nd.ones((2, 3))
+    b = a.copyto(mx.cpu(0))
+    b[:] = 5.0
+    assert a.asnumpy().sum() == 6  # copy is deep
+    c = a.as_in_context(mx.cpu(0))
+    assert c is a
+
+
+def test_concat_stack_split():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    np.testing.assert_allclose(parts[0].asnumpy(), a.asnumpy())
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "x.params")
+    data = {"arg:w": nd.array(np.random.rand(3, 4)),
+            "aux:m": nd.array(np.arange(5), dtype=np.int32)}
+    nd.save(f, data)
+    back = nd.load(f)
+    assert set(back) == set(data)
+    for k in data:
+        np.testing.assert_allclose(back[k].asnumpy(), data[k].asnumpy())
+        assert back[k].dtype == data[k].dtype
+
+
+def test_save_load_list(tmp_path):
+    f = str(tmp_path / "l.params")
+    data = [nd.ones((2,)), nd.zeros((3, 3))]
+    nd.save(f, data)
+    back = nd.load(f)
+    assert isinstance(back, list) and len(back) == 2
+    np.testing.assert_allclose(back[1].asnumpy(), np.zeros((3, 3)))
+
+
+def test_wait_and_asscalar():
+    a = nd.ones((1,))
+    a.wait_to_read()
+    assert a.asscalar() == 1.0
+    nd.waitall()
+
+
+def test_astype_and_T():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.astype(np.int32).dtype == np.int32
+    np.testing.assert_allclose(a.T.asnumpy(), a.asnumpy().T)
+
+
+def test_take_onehot_pick():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2], dtype=np.int32)
+    np.testing.assert_allclose(w.take(idx).asnumpy(),
+                               w.asnumpy()[[0, 2]])
+    oh = nd.one_hot(idx, depth=4)
+    assert oh.shape == (2, 4)
+    x = nd.array([[1., 2.], [3., 4.]])
+    p = x.pick(nd.array([1, 0]), axis=1)
+    np.testing.assert_allclose(p.asnumpy(), [2., 3.])
+
+
+def test_topk_sort():
+    x = nd.array([[3., 1., 2.], [0., 5., 4.]])
+    np.testing.assert_allclose(x.sort(axis=1).asnumpy(),
+                               np.sort(x.asnumpy(), axis=1))
+    k = x.topk(k=2, axis=1, ret_typ="value")
+    np.testing.assert_allclose(k[0].asnumpy() if isinstance(k, list) else k.asnumpy(),
+                               [[3., 2.], [5., 4.]])
+
+
+def test_random_reproducible():
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(3, 3)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(3, 3)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    assert ((a >= 0) & (a < 1)).all()
+
+
+def test_random_moments():
+    x = nd.random.normal(loc=2.0, scale=0.5, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 2.0) < 0.05
+    assert abs(x.std() - 0.5) < 0.05
+
+
+def test_sparse_row_sparse():
+    rsp = nd.row_sparse_array(([[1., 2.], [3., 4.]], [0, 2]), shape=(4, 2))
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.indices.asnumpy(), [0, 2])
+    np.testing.assert_allclose(rsp.data.asnumpy(), [[1, 2], [3, 4]])
+    dense = rsp.tostype("default")
+    assert dense.stype == "default"
+    np.testing.assert_allclose(dense.asnumpy(),
+                               [[1, 2], [0, 0], [3, 4], [0, 0]])
+
+
+def test_sparse_csr():
+    m = nd.csr_matrix(([1., 2., 3.], [0, 2, 1], [0, 2, 3]), shape=(2, 3))
+    assert m.stype == "csr"
+    np.testing.assert_allclose(m.asnumpy(), [[1, 0, 2], [0, 3, 0]])
+    np.testing.assert_allclose(m.indptr.asnumpy(), [0, 2, 3])
