@@ -24,3 +24,25 @@ from .random import seed
 from . import ndarray
 from . import ndarray as nd
 from . import autograd
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from .name import NameManager
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from .executor import Executor
+from . import initializer
+from .initializer import init
+from . import optimizer
+from . import optimizer as opt
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import io
+from . import kvstore as kv
+from . import kvstore
+from . import model
+from . import module
+from . import module as mod
+from .model import FeedForward

@@ -1,0 +1,361 @@
+"""Executor: a bound symbolic graph compiled to single XLA programs.
+
+Parity surface: reference ``python/mxnet/executor.py`` (forward :113,
+backward :154, outputs, arg/grad/aux dicts, reshape, monitor) over
+``src/executor/graph_executor.cc`` (Init :507/916, RunOps :1403).
+
+TPU-native redesign (SURVEY §7 step 4): the entire GraphExecutor machinery —
+gradient-graph synthesis (nnvm Gradient pass), memory planning
+(PlanMemory/DetectInplaceAddTo), op-executor attachment, bulk segmenting —
+collapses into *one jitted function per (train/eval) mode*:
+
+    eval:  jit(graph_fn)                         — XLA plans memory, fuses
+    train: jax.vjp(graph_fn, grad_args)          — replaces pass::Gradient;
+           forward runs once (residuals kept on device), backward() applies
+           the stored vjp — both legs are compiled XLA programs.
+
+Auxiliary state (BatchNorm moving stats) flows functionally: graph_fn
+returns updated aux values, forward writes them back into the aux NDArrays
+(reference mutates aux in-kernel).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from . import random as _random
+from .ndarray import NDArray, _wrap, zeros as nd_zeros
+from .symbol.symbol import Symbol, _topo
+
+__all__ = ["Executor"]
+
+
+def _build_graph_fn(symbol, train_mode):
+    """Build pure fn(arg_vals, aux_vals, rng) -> (outputs, new_aux)."""
+    nodes = _topo(symbol._outputs)
+    arg_nodes = [n for n in nodes if n.op is None and not n.is_aux]
+    aux_nodes = [n for n in nodes if n.op is None and n.is_aux]
+    rng_nodes = [n for n in nodes if n.op is not None and n.op.needs_rng]
+    arg_pos = {id(n): i for i, n in enumerate(arg_nodes)}
+    aux_pos = {id(n): i for i, n in enumerate(aux_nodes)}
+    rng_pos = {id(n): i for i, n in enumerate(rng_nodes)}
+
+    # map aux var node -> (producing op node, output index of new value)
+    aux_update_src = {}
+    for node in nodes:
+        if node.op is None or not node.op.aux_updates:
+            continue
+        for aux_in, out_idx in node.op.aux_updates.items():
+            if aux_in < len(node.inputs):
+                src, _ = node.inputs[aux_in]
+                if src.op is None and src.is_aux:
+                    aux_update_src[id(src)] = (node, out_idx)
+
+    heads = list(symbol._outputs)
+
+    def graph_fn(arg_vals, aux_vals, rng):
+        env = {}
+        for n in arg_nodes:
+            env[(id(n), 0)] = arg_vals[arg_pos[id(n)]]
+        for n in aux_nodes:
+            env[(id(n), 0)] = aux_vals[aux_pos[id(n)]]
+        keys = (jax.random.split(rng, len(rng_nodes))
+                if rng_nodes else None)
+        for node in nodes:
+            if node.op is None:
+                continue
+            ins = [env[(id(s), oi)] for s, oi in node.inputs]
+            key = keys[rng_pos[id(node)]] if node.op.needs_rng else None
+            fn = node.op.traceable(node.attrs, train_mode=train_mode, rng=key)
+            outs = fn(*ins)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        outputs = tuple(env[(id(n), oi)] for n, oi in heads)
+        new_aux = tuple(
+            env[(id(aux_update_src[id(n)][0]), aux_update_src[id(n)][1])]
+            if id(n) in aux_update_src else env[(id(n), 0)]
+            for n in aux_nodes)
+        return outputs, new_aux
+
+    return graph_fn, arg_nodes, aux_nodes
+
+
+class Executor:
+    """A bound computation graph (create via Symbol.bind / simple_bind)."""
+
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                 group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        missing = [n for n in self.arg_names if n not in arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        self.arg_dict = {n: arg_dict[n] for n in self.arg_names}
+        self.aux_dict = {n: aux_dict.get(n) for n in self.aux_names}
+        for n in self.aux_names:
+            if self.aux_dict[n] is None:
+                raise MXNetError("bind: missing auxiliary state %s" % n)
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self.arg_names, grad_req))
+        self.grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        self.grad_dict = {n: (grad_dict or {}).get(n) for n in self.arg_names}
+        for n, req in self.grad_req.items():
+            if req != "null" and self.grad_dict[n] is None:
+                self.grad_dict[n] = nd_zeros(self.arg_dict[n].shape,
+                                             ctx=self._ctx,
+                                             dtype=self.arg_dict[n].dtype)
+        self._grad_names = [n for n in self.arg_names
+                            if self.grad_req[n] != "null"]
+
+        fn_eval, self._arg_nodes, self._aux_nodes = _build_graph_fn(
+            symbol, train_mode=False)
+        fn_train, _, _ = _build_graph_fn(symbol, train_mode=True)
+        self._eval_jit = jax.jit(fn_eval)
+        self._train_fn = fn_train  # vjp'd per forward; jit inside
+        self._train_jit = jax.jit(fn_train)
+        self._vjp = None
+        self._outputs = None
+        self._monitor = None
+        self._group2ctx = group2ctx
+
+    # -- array views -------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict[n] for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            raise MXNetError("run forward() first")
+        return self._outputs
+
+    @property
+    def output_dict(self):
+        return dict(zip(self.output_names, self.outputs))
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %s" % k)
+            src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            self.arg_dict[k]._set_data(src.astype(self.arg_dict[k].dtype))
+        dev = self._ctx.jax_device
+        arg_vals = [self._pin(self.arg_dict[n], dev) for n in self.arg_names]
+        aux_vals = [self._pin(self.aux_dict[n], dev) for n in self.aux_names]
+        rng = _random.next_key()
+
+        if self._monitor is not None:
+            outs, new_aux = self._forward_monitored(arg_vals, aux_vals, rng,
+                                                    is_train)
+            if is_train and self._grad_names:
+                # monitor path is observation-only; still set up the vjp so
+                # backward() works (costs one extra forward, debug mode only)
+                gpos = [self.arg_names.index(n) for n in self._grad_names]
+
+                def f_mon(grad_vals):
+                    full = list(arg_vals)
+                    for p, v in zip(gpos, grad_vals):
+                        full[p] = v
+                    return self._train_jit(full, aux_vals, rng)
+
+                _outs, self._vjp, _na = jax.vjp(
+                    f_mon, [arg_vals[p] for p in gpos], has_aux=True)
+        elif is_train and self._grad_names:
+            gpos = [self.arg_names.index(n) for n in self._grad_names]
+
+            def f(grad_vals):
+                full = list(arg_vals)
+                for p, v in zip(gpos, grad_vals):
+                    full[p] = v
+                outs, new_aux = self._train_jit(full, aux_vals, rng)
+                return outs, new_aux
+
+            outs, self._vjp, new_aux = jax.vjp(
+                f, [arg_vals[p] for p in gpos], has_aux=True)
+        elif is_train:
+            outs, new_aux = self._train_jit(arg_vals, aux_vals, rng)
+        else:
+            outs, new_aux = self._eval_jit(arg_vals, aux_vals, rng)
+
+        for n, v in zip(self.aux_names, new_aux):
+            self.aux_dict[n]._set_data(v)
+        self._outputs = [_wrap(o, self._ctx) for o in outs]
+        return self._outputs
+
+    @staticmethod
+    def _pin(arr, dev):
+        """Ensure the buffer is committed to this executor's device (cross-
+        device inputs arrive when the user loads data on another context —
+        reference engine would insert a CrossDeviceCopy node)."""
+        data = arr._data
+        arr_dev = getattr(data, "devices", lambda: {None})()
+        if arr_dev != {dev}:
+            data = jax.device_put(data, dev)
+            arr._set_data(data)
+        return data
+
+    def _forward_monitored(self, arg_vals, aux_vals, rng, is_train):
+        """Eager node-by-node path so the monitor callback sees every
+        intermediate (reference: ExecuteMonCallback, graph_executor.cc:1380)."""
+        from .symbol.symbol import _topo as topo
+        nodes = topo(self._symbol._outputs)
+        env = {}
+        ai = {id(n): i for i, n in enumerate(self._arg_nodes)}
+        xi = {id(n): i for i, n in enumerate(self._aux_nodes)}
+        for n in nodes:
+            if n.op is None:
+                env[(id(n), 0)] = (arg_vals[ai[id(n)]] if id(n) in ai
+                                   else aux_vals[xi[id(n)]])
+        key = rng
+        aux_new = {id(n): None for n in self._aux_nodes}
+        for node in nodes:
+            if node.op is None:
+                continue
+            ins = [env[(id(s), oi)] for s, oi in node.inputs]
+            sub = None
+            if node.op.needs_rng:
+                key, sub = jax.random.split(key)
+            fn = node.op.traceable(node.attrs, train_mode=is_train, rng=sub)
+            outs = fn(*ins)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+                self._monitor(node.output_name(i) if i < node.num_outputs()
+                              else "%s_aux%d" % (node.name, i),
+                              _wrap(o, self._ctx))
+            for aux_in, oidx in (node.op.aux_updates or {}).items():
+                if aux_in < len(node.inputs):
+                    src, _ = node.inputs[aux_in]
+                    if id(src) in aux_new:
+                        aux_new[id(src)] = outs[oidx]
+        outs = tuple(env[(id(n), oi)] for n, oi in self._symbol._outputs)
+        new_aux = tuple(aux_new[id(n)] if aux_new[id(n)] is not None
+                        else env[(id(n), 0)] for n in self._aux_nodes)
+        return outs, new_aux
+
+    def backward(self, out_grads=None, is_train=True):
+        if self._vjp is None:
+            if not self._grad_names:
+                return  # nothing requires grad
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            grads_in = tuple(jnp.ones_like(o._data) for o in self._outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            grads_in = tuple(
+                g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads)
+        (in_grads,) = self._vjp(grads_in)
+        for n, g in zip(self._grad_names, in_grads):
+            dst = self.grad_dict[n]
+            if self.grad_req[n] == "add":
+                dst._set_data(dst._data + g.astype(dst.dtype))
+            else:
+                dst._set_data(g.astype(dst.dtype))
+
+    # -- params ------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in (arg_params or {}).items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" that is not in the "
+                                 "arguments" % name)
+        for name, array in (aux_params or {}).items():
+            if name in self.aux_dict:
+                array.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" that is not in the "
+                                 "auxiliary states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new input shapes, sharing parameter
+        values (reference executor.py reshape)."""
+        new_shapes = {}
+        for n in self.arg_names:
+            new_shapes[n] = kwargs.get(n, self.arg_dict[n].shape)
+        ex = Executor._simple_bind(self._symbol, self._ctx, self.grad_req,
+                                   None, self._group2ctx,
+                                   {n: kwargs[n] for n in kwargs})
+        for n in ex.arg_names:
+            if n not in kwargs and n in self.arg_dict and \
+                    ex.arg_dict[n].shape == self.arg_dict[n].shape:
+                self.arg_dict[n].copyto(ex.arg_dict[n])
+        for n in ex.aux_names:
+            if ex.aux_dict[n].shape == self.aux_dict[n].shape:
+                self.aux_dict[n].copyto(ex.aux_dict[n])
+        return ex
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % self.output_names]
+        for n in self.arg_names:
+            lines.append("arg %s: %s %s" % (n, self.arg_dict[n].shape,
+                                            self.grad_req[n]))
+        for n in self.aux_names:
+            lines.append("aux %s: %s" % (n, self.aux_dict[n].shape))
+        return "\n".join(lines)
+
+    # -- binding entry points ---------------------------------------------
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states, group2ctx):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = dict(zip(arg_names, args))
+        else:
+            arg_dict = dict(args)
+        if isinstance(args_grad, (list, tuple)):
+            grad_dict = dict(zip(arg_names, args_grad))
+        else:
+            grad_dict = dict(args_grad or {})
+        if isinstance(aux_states, (list, tuple)):
+            aux_dict = dict(zip(aux_names, aux_states))
+        else:
+            aux_dict = dict(aux_states or {})
+        return Executor(symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                        group2ctx)
+
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, group2ctx,
+                     shape_kwargs):
+        a, o, x = symbol._infer(shape_kwargs=shape_kwargs,
+                                dtype_kwargs=type_dict)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        unknown = [n for n, s in zip(arg_names, a) if s is None]
+        if unknown:
+            raise MXNetError("simple_bind could not infer shapes for %s; "
+                             "pass their shapes as kwargs" % unknown)
+        ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        arg_dict = {n: nd_zeros(tuple(s.shape), ctx=ctx, dtype=s.dtype)
+                    for n, s in zip(arg_names, a)}
+        aux_dict = {n: nd_zeros(tuple(s.shape), ctx=ctx, dtype=s.dtype)
+                    for n, s in zip(aux_names, x)}
+        return Executor(symbol, ctx, arg_dict, None, grad_req, aux_dict,
+                        group2ctx)

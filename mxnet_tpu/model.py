@@ -1,0 +1,240 @@
+"""Model helpers: kvstore plumbing, checkpointing, legacy FeedForward.
+
+Parity surface: reference ``python/mxnet/model.py`` (967 LoC):
+``_create_kvstore`` :57 (update_on_kvstore decision), ``_initialize_kvstore``
+:96, ``_update_params_on_kvstore`` :105, ``_update_params`` :117,
+``save_checkpoint``/``load_checkpoint``, ``FeedForward``.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym
+from . import kvstore as kvs
+from . import optimizer as opt
+from . import metric as metric_mod
+from .context import cpu, current_context
+
+__all__ = ["_create_kvstore", "_initialize_kvstore",
+           "_update_params_on_kvstore", "_update_params", "save_checkpoint",
+           "load_checkpoint", "FeedForward", "BatchEndParam"]
+
+
+class BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Decide kvstore + update_on_kvstore (reference model.py:57)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape)
+                               for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """Init kvstore keys from params (reference model.py:96)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
+                              param_names):
+    """Push grads, pull updated weights (reference model.py:105)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """Reduce via kvstore, update locally per device (reference model.py:117)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or grad_list[0] is None:
+            continue
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p, g in zip(range(num_device), arg_list, grad_list):
+            updater(index * num_device + k, g, p)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save ``prefix-symbol.json`` + ``prefix-####.params`` (reference
+    model.py save_checkpoint; format per §5.4)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load a checkpoint saved by save_checkpoint."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Legacy training API (reference model.py FeedForward) — a thin shim
+    over Module, kept for example-source compatibility."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx or cpu()]
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs
+        self._module = None
+
+    def _get_module(self, data_iter):
+        from .module import Module
+        label_names = [d.name if hasattr(d, "name") else d[0]
+                       for d in (data_iter.provide_label or [])]
+        if not label_names:
+            # predict-mode iter carries no labels; label args are still
+            # graph inputs, not params (they'd break set_params otherwise)
+            label_names = [n for n in self.symbol.list_arguments()
+                           if n.endswith("_label")]
+        data_names = [d.name if hasattr(d, "name") else d[0]
+                      for d in data_iter.provide_data]
+        mod = Module(self.symbol, data_names=data_names,
+                     label_names=label_names, context=self.ctx)
+        return mod
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None, **kwargs):
+        train_data = self._prepare_data(X, y)
+        mod = self._get_module(train_data)
+        mod.fit(train_data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=dict(self.kwargs),
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch or 1)
+        self._module = mod
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def _prepare_data(self, X, y=None):
+        from .io import NDArrayIter, DataIter
+        if isinstance(X, DataIter):
+            return X
+        return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                           shuffle=True)
+
+    def _ensure_module(self, data_iter):
+        """Bind a predict-mode module from loaded params when fit() never
+        ran (the FeedForward.load → predict path)."""
+        if self._module is not None and self._module.binded:
+            return self._module
+        mod = self._get_module(data_iter)
+        mod.bind(data_shapes=data_iter.provide_data,
+                 label_shapes=data_iter.provide_label or None,
+                 for_training=False)
+        if self.arg_params is not None:
+            mod.set_params(self.arg_params, self.aux_params or {},
+                           allow_missing=False)
+        else:
+            mod.init_params(initializer=self.initializer)
+            self.arg_params, self.aux_params = mod.get_params()
+        self._module = mod
+        return mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._prepare_data(X)
+        outs = self._ensure_module(data).predict(data, num_batch=num_batch)
+        return outs.asnumpy() if hasattr(outs, "asnumpy") else outs
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        data = self._prepare_data(X)
+        res = self._ensure_module(data).score(data, eval_metric,
+                                              num_batch=num_batch)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params,
+                        self.aux_params)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list)
+        return model

@@ -136,6 +136,10 @@ class NDArray:
         return "%s\n<NDArray %s @%s>" % (
             self.asnumpy(), "x".join(str(s) for s in self.shape), self._ctx)
 
+    def __reduce__(self):
+        return (_nd_unpickle, (self.asnumpy(), self._ctx.device_type,
+                               self._ctx.device_id, self._stype))
+
     # -- conversion / copy -------------------------------------------------
     def astype(self, dtype, copy=True):
         return _wrap(self._data.astype(dtype_np(dtype)), self._ctx)
@@ -431,6 +435,12 @@ class NDArray:
 
 def _wrap(jarr, ctx=None):
     return NDArray(jarr, ctx or current_context())
+
+
+def _nd_unpickle(npy, dev_type, dev_id, stype):
+    out = array(npy, ctx=Context(dev_type, dev_id), dtype=npy.dtype)
+    out._stype = stype
+    return out
 
 
 def _current_rng():

@@ -138,8 +138,8 @@ for _n, _fn in _BINARY.items():
     aliases = ["broadcast_%s" % _n, "_%s" % _n]
     if _n in _OLD_NAMES:
         aliases.append(_OLD_NAMES[_n])
-    if _n in ("maximum", "minimum", "hypot", "mod", "power"):
-        aliases.append("_%s" % _n)
+    if _n in ("maximum", "minimum", "hypot"):
+        aliases.append(_n)  # public numpy-style names
     register("elemwise_%s" % _n, aliases=aliases)(_b)
 
 for _n, _fn in _CMP.items():

@@ -128,24 +128,23 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
     else:
         padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.array(init, data.dtype), lax.max,
-                                 window, strides, padding)
+        init = (-np.inf if jnp.issubdtype(data.dtype, jnp.floating)
+                else np.iinfo(np.dtype(data.dtype)).min)
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, jnp.array(0, data.dtype), lax.add,
-                              window, strides, padding)
+        s = lax.reduce_window(data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+                              lax.add, window, strides, padding)
         if pool_type == "sum":
             return s
         if count_include_pad:
             return s / float(np.prod(kernel))
         ones = jnp.ones_like(data)
-        cnt = lax.reduce_window(ones, jnp.array(0, data.dtype), lax.add,
-                                window, strides, padding)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return s / cnt
     if pool_type == "lp":
         p = float(p_value)
-        s = lax.reduce_window(jnp.abs(data) ** p, jnp.array(0, data.dtype),
-                              lax.add, window, strides, padding)
+        s = lax.reduce_window(jnp.abs(data) ** p, 0.0, lax.add,
+                              window, strides, padding)
         return s ** (1.0 / p)
     raise ValueError("unknown pool_type %s" % pool_type)
 

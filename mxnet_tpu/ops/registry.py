@@ -124,6 +124,35 @@ class Op:
             return tuple(out)
         return (out,)
 
+    def traceable(self, attrs, train_mode=False, rng=None):
+        """Return a jax-traceable callable ``f(*arrays) -> tuple`` with attrs
+        closed over, honoring ``custom_vjp`` under jax transforms (the
+        executor-path analogue of the eager tape's semantic gradients)."""
+        import jax as _jax
+
+        if self.custom_vjp is None:
+            def plain(*arrs):
+                return self.apply(arrs, attrs, train_mode=train_mode, rng=rng)
+            return plain
+
+        bwd_rule = self.custom_vjp
+
+        @_jax.custom_vjp
+        def f(*arrs):
+            return self.apply(arrs, attrs, train_mode=train_mode, rng=rng)
+
+        def fwd(*arrs):
+            out = self.apply(arrs, attrs, train_mode=train_mode, rng=rng)
+            return out, (arrs, out)
+
+        def bwd(res, gout):
+            arrs, out = res
+            grads = bwd_rule(gout, arrs, out, attrs)
+            return tuple(grads)
+
+        f.defvjp(fwd, bwd)
+        return f
+
     def __repr__(self):
         return "Op(%s)" % self.name
 

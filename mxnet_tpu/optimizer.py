@@ -1,0 +1,547 @@
+"""Optimizers (parity: reference python/mxnet/optimizer.py:36-1167).
+
+Updates dispatch to the fused update *ops* (``mxnet_tpu/ops/optim_ops.py``,
+reference ``src/operator/optimizer_op.cc``) so that under jit the whole
+update fuses into the training-step XLA program; pure-python fallbacks cover
+the optimizers the reference implements in Python (AdaGrad, AdaDelta, ...).
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy as np
+
+from .base import Registry, MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum",
+           "Test", "create", "get_updater", "Updater", "register"]
+
+_REG = Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass, klass.__name__)
+    return klass
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:36)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = None
+        if sym is not None:
+            self.sym_info = (sym.attr_dict(), sym.list_arguments())
+        self.param_dict = param_dict or {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- serialization for kvstore set_optimizer ---------------------------
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return _REG.get(name)(**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kw(self):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision (reference :434)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True,
+                 multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype(np.float32)
+            mom = (nd.zeros(weight.shape, ctx=weight.context,
+                            dtype=np.float32) if self.momentum else None)
+            return (mom, w32)
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kw()
+        if isinstance(state, tuple):  # multi-precision
+            mom, w32 = state
+            if mom is not None:
+                nd._internal.mp_sgd_mom_update(
+                    weight, grad, mom, w32, out=weight, lr=lr, wd=wd,
+                    momentum=self.momentum, **kw)
+            else:
+                nd._internal.mp_sgd_update(weight, grad, w32, out=weight,
+                                           lr=lr, wd=wd, **kw)
+        elif state is not None:
+            nd._internal.sgd_mom_update(weight, grad, state, out=weight,
+                                        lr=lr, wd=wd,
+                                        momentum=self.momentum, **kw)
+        else:
+            nd._internal.sgd_update(weight, grad, out=weight, lr=lr, wd=wd,
+                                    **kw)
+
+
+register(SGD, )  # default name already registered; keep ccSGD alias:
+_REG.register(SGD, "ccsgd")
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference :585)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            state *= self.momentum
+            state += grad
+            grad += self.momentum * state
+            weight -= lr * (grad + wd * weight)
+        else:
+            weight -= lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference :631)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        weight -= lr / 2 * (grad + wd * weight)
+        weight += nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                   ctx=weight.context, dtype=weight.dtype)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference :560)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        if mom is not None:
+            mom *= self.momentum
+            mom += -lr * (grad + wd * weight + self.lamda * grad * grad *
+                          (weight - previous_weight))
+            weight.copyto(previous_weight)
+            weight += mom
+        else:
+            weight += -lr * (grad + wd * weight + self.lamda * grad * grad *
+                             (weight - previous_weight))
+            weight.copyto(previous_weight)
+            # previous updated after
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference :754); dispatches to the fused adam_update op."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        nd._internal.adam_update(weight, grad, mean, var, out=weight, lr=lr,
+                                 wd=wd, beta1=self.beta1, beta2=self.beta2,
+                                 epsilon=self.epsilon, **self._common_kw())
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference :902)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        history = state
+        history += grad * grad
+        weight -= lr * (grad / nd.sqrt(history + self.float_stable_eps)
+                        + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered or not (reference :938)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kw()
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            nd._internal.rmsprop_update(weight, grad, n, out=weight, lr=lr,
+                                        wd=wd, gamma1=self.gamma1,
+                                        epsilon=self.epsilon, **kw)
+        else:
+            n, g, delta = state
+            nd._internal.rmspropalex_update(weight, grad, n, g, delta,
+                                            out=weight, lr=lr, wd=wd,
+                                            gamma1=self.gamma1,
+                                            gamma2=self.gamma2,
+                                            epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference :1004)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g *= self.rho
+        acc_g += (1.0 - self.rho) * grad * grad
+        current_delta = (nd.sqrt(acc_delta + self.epsilon)
+                         / nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta *= self.rho
+        acc_delta += (1.0 - self.rho) * current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference :1040); fused ftrl_update op."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),  # z
+                nd.zeros(weight.shape, ctx=weight.context))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        nd._internal.ftrl_update(weight, grad, z, n, out=weight, lr=lr,
+                                 wd=wd, lamda1=self.lamda1, beta=self.beta,
+                                 **self._common_kw())
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference :1084)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t *= self.beta1
+        m_t += (1.0 - self.beta1) * grad
+        u_t[:] = nd.maximum(self.beta2 * u_t, nd.abs(grad))
+        weight -= lr * m_t / u_t
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference :1119)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t *= self.beta1
+        m_t += (1.0 - self.beta1) * grad
+        v_t *= self.beta2
+        v_t += (1.0 - self.beta2) * grad * grad
+        grad_prime = grad / (1.0 - self.m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / (1.0 - self.beta2 ** t)
+        m_t_bar = ((1.0 - momentum_t) * grad_prime
+                   + momentum_t_1 * m_t_prime)
+        weight -= lr * m_t_bar / (nd.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kw()
+        if state is not None:
+            nd._internal.signum_update(weight, grad, state, out=weight, lr=lr,
+                                       wd=wd, momentum=self.momentum,
+                                       wd_lh=self.wd_lh, **kw)
+        else:
+            nd._internal.signsgd_update(weight, grad, out=weight, lr=lr,
+                                        wd=wd, **kw)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w -= rescale_grad * grad (reference :1110)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight -= grad * self.rescale_grad
+        state[:] = weight
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.get(name)(**kwargs)
+
+
+class Updater:
+    """Stateful per-index updater (reference optimizer.py:1124 get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2:
+            self.states, opt = data
+            if opt is not None:
+                self.optimizer = opt
+        else:
+            self.states = data
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

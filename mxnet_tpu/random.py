@@ -16,7 +16,7 @@ __all__ = ["seed", "next_key", "current_seed"]
 
 _lock = threading.Lock()
 _seed = 0
-_key = jax.random.PRNGKey(0)
+_key = None  # lazily created: backend init must not run at import time
 
 
 def seed(seed_state, ctx="all"):
@@ -30,6 +30,8 @@ def seed(seed_state, ctx="all"):
 def next_key():
     global _key
     with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(_seed)
         _key, sub = jax.random.split(_key)
         return sub
 

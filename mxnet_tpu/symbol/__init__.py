@@ -1,0 +1,97 @@
+"""``mx.sym`` namespace: Symbol + generated symbolic op functions.
+
+Reference analogue: ``python/mxnet/symbol/`` generated op modules.  Symbolic
+op functions accept Symbols positionally or by input-name kwargs
+(``sym.Convolution(data=d, weight=w, ...)``) and auto-create variable nodes
+for omitted parameter inputs — the behavior Module/simple_bind rely on.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from .symbol import (Symbol, SymNode, var, Variable, Group, load, load_json,
+                     zeros, ones, arange)
+from .op_meta import op_input_names, HINTS
+from ..ops.registry import OP_REGISTRY
+from .. import name as _name_mod
+
+
+def _make_sym_func(name, op):
+    def sym_func(*args, **kwargs):
+        attr = kwargs.pop("attr", None)
+        sym_name = kwargs.pop("name", None)
+        # split symbol kwargs from attr kwargs
+        sym_kwargs = {}
+        for k in list(kwargs):
+            if isinstance(kwargs[k], Symbol):
+                sym_kwargs[k] = kwargs.pop(k)
+        pos_syms = []
+        rest_args = []
+        for a in args:
+            if isinstance(a, Symbol):
+                pos_syms.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
+                pos_syms.extend(a)
+            else:
+                rest_args.append(a)
+        if rest_args:
+            raise TypeError("op %s: non-Symbol positional args not allowed; "
+                            "pass attrs as keywords" % name)
+        in_names, aux_names = op_input_names(op, kwargs)
+        if name in ("Concat", "add_n", "stack", "elemwise_sum",
+                    "ElementWiseSum", "UpSampling") and pos_syms:
+            kwargs.setdefault("num_args", len(pos_syms))
+            in_names = ["arg%d" % i for i in range(len(pos_syms))]
+        all_names = in_names + aux_names
+        # assemble inputs: positional fill first, then kwargs by name,
+        # then auto-created variables
+        hint = HINTS.get(name, name.lower().strip("_"))
+        node_name = _name_mod.current().get(sym_name, hint)
+        inputs = []
+        pos_iter = iter(pos_syms)
+        from .symbol import var as _var
+        for i, iname in enumerate(all_names):
+            if iname in sym_kwargs:
+                inputs.append(sym_kwargs.pop(iname))
+                continue
+            s = next(pos_iter, None)
+            if s is not None:
+                inputs.append(s)
+                continue
+            # auto-create variable (aux flagged)
+            v = _var("%s_%s" % (node_name, iname))
+            if iname in aux_names:
+                v._outputs[0][0].is_aux = True
+            inputs.append(v)
+        leftovers = list(pos_iter)
+        if leftovers:
+            inputs.extend(leftovers)
+        if sym_kwargs:
+            raise TypeError("op %s got unexpected symbol kwargs %s (inputs "
+                            "are %s)" % (name, list(sym_kwargs), all_names))
+        if attr:
+            kwargs.update({"__%s__" % k: v for k, v in attr.items()})
+        # mark trailing aux inputs via is_aux on their variable nodes
+        for iname, s in zip(all_names, inputs):
+            if iname in aux_names and s._outputs[0][0].op is None:
+                s._outputs[0][0].is_aux = True
+        return Symbol._from_op(name, inputs, kwargs, name=node_name)
+    sym_func.__name__ = name
+    return sym_func
+
+
+_internal = types.ModuleType(__name__ + "._internal")
+_this = sys.modules[__name__]
+for _name, _op in OP_REGISTRY.items():
+    _fn = _make_sym_func(_name, _op)
+    setattr(_internal, _name, _fn)
+    if not _name.startswith("_"):
+        if not hasattr(_this, _name):
+            setattr(_this, _name, _fn)
+sys.modules[__name__ + "._internal"] = _internal
+
+from . import random  # noqa: E402,F401
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]

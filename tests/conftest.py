@@ -3,13 +3,22 @@
 Mirrors the reference's test doctrine (SURVEY §4): tests must run without
 accelerator hardware; multi-device paths are exercised on a virtual mesh
 (the reference used multi-GPU hosts; we use XLA's forced host device count).
+
+Environment note: the axon TPU plugin registers itself via sitecustomize at
+interpreter start and force-selects "axon,cpu"; overriding the config *after*
+jax import (but before backend init) pins tests to CPU and avoids touching
+the TPU tunnel.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

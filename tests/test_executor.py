@@ -1,0 +1,120 @@
+"""Executor tests (modeled on reference tests/python/unittest/test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def test_bind_forward_matches_numpy():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b * 2
+    ex = c.bind(mx.cpu(), {"a": nd.array([1., 2.]), "b": nd.array([3., 4.])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [7., 10.])
+
+
+def test_backward_grads():
+    x = sym.var("x")
+    y = sym.var("y")
+    z = x * y + sym.square(x)
+    xg, yg = nd.zeros((3,)), nd.zeros((3,))
+    ex = z.bind(mx.cpu(), {"x": nd.array([1., 2., 3.]),
+                           "y": nd.array([4., 5., 6.])},
+                args_grad={"x": xg, "y": yg})
+    ex.forward(is_train=True)
+    ex.backward(nd.ones((3,)))
+    np.testing.assert_allclose(xg.asnumpy(), [4 + 2, 5 + 4, 6 + 6])
+    np.testing.assert_allclose(yg.asnumpy(), [1., 2., 3.])
+
+
+def test_grad_req_add_and_null():
+    x = sym.var("x")
+    z = sym.sum(sym.square(x))
+    xg = nd.zeros((2,))
+    ex = z.bind(mx.cpu(), {"x": nd.array([1., 2.])}, args_grad={"x": xg},
+                grad_req="add")
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(xg.asnumpy(), 3 * 2 * np.array([1., 2.]))
+
+
+def test_simple_bind_infers_params():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=5, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(7, 3))
+    assert ex.arg_dict["fc_weight"].shape == (5, 3)
+    assert ex.arg_dict["fc_bias"].shape == (5,)
+    assert ex.grad_dict["fc_weight"].shape == (5, 3)
+
+
+def test_forward_kwargs_update_args():
+    data = sym.var("data")
+    out = sym.square(data)
+    ex = out.simple_bind(mx.cpu(), data=(2, 2))
+    r1 = ex.forward(data=np.full((2, 2), 3.0, np.float32))
+    np.testing.assert_allclose(r1[0].asnumpy(), 9 * np.ones((2, 2)))
+
+
+def test_aux_state_update_only_in_train():
+    d = sym.var("d")
+    bn = sym.BatchNorm(d, name="bn", momentum=0.0, fix_gamma=True)
+    net = sym.sum(bn)
+    ex = net.simple_bind(mx.cpu(), d=(16, 4))
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.RandomState(0).rand(16, 4).astype(np.float32) * 5
+    mm_before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=False, d=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               mm_before)
+    ex.forward(is_train=True, d=x)
+    # momentum=0 -> moving_mean == batch mean
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               x.mean(axis=0), rtol=1e-5)
+
+
+def test_copy_params_from_and_outputs_dict():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(1, 2))
+    ex.copy_params_from({"fc_weight": nd.array([[1., 0.], [0., 1.]]),
+                         "fc_bias": nd.array([1., 1.])})
+    out = ex.forward(data=np.array([[2., 3.]], np.float32))
+    np.testing.assert_allclose(out[0].asnumpy(), [[3., 4.]])
+    assert "fc_output" in ex.output_dict
+
+
+def test_monitor_sees_intermediates():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = sym.Activation(net, act_type="relu", name="act")
+    ex = net.simple_bind(mx.cpu(), data=(1, 2))
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(is_train=False, data=np.ones((1, 2), np.float32))
+    assert any("fc_output" in s for s in seen)
+    assert any("act_output" in s for s in seen)
+
+
+def test_reshape():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 6))
+    ex.arg_dict["fc_weight"][:] = 1.0
+    ex2 = ex.reshape(data=(5, 6))
+    assert ex2.arg_dict["data"].shape == (5, 6)
+    np.testing.assert_allclose(ex2.arg_dict["fc_weight"].asnumpy(),
+                               np.ones((4, 6)))
+
+
+def test_dropout_train_vs_eval_in_graph():
+    d = sym.var("d")
+    net = sym.Dropout(d, p=0.5)
+    ex = net.simple_bind(mx.cpu(), d=(100, 100))
+    x = np.ones((100, 100), np.float32)
+    out_eval = ex.forward(is_train=False, d=x)[0].asnumpy()
+    np.testing.assert_allclose(out_eval, x)
+    out_train = ex.forward(is_train=True, d=x)[0].asnumpy()
+    assert 0.3 < (out_train == 0).mean() < 0.7

@@ -1,0 +1,161 @@
+"""Module tests (modeled on reference tests/python/unittest/test_module.py)
+plus a small convergence run (reference tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd, io
+
+
+def _mlp_sym():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _blob_data(n=600, d=50, k=10, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, k, n)
+    centers = rng.randn(k, d).astype(np.float32) * 2
+    X = centers[y] + rng.randn(n, d).astype(np.float32) * 0.4
+    return X, y.astype(np.float32)
+
+
+def test_module_bind_init_forward():
+    net = _mlp_sym()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 50))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = io.DataBatch([nd.ones((8, 50))], [nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (8, 10)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1),
+                               np.ones(8), rtol=1e-5)
+
+
+def test_module_fit_convergence():
+    X, y = _blob_data()
+    train = io.NDArrayIter(X[:500], y[:500], batch_size=50, shuffle=True)
+    val = io.NDArrayIter(X[500:], y[500:], batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            num_epoch=4, eval_metric="acc")
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _blob_data(n=200)
+    train = io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "chk")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label, for_training=False)
+    p1, _ = mod.get_params()
+    p2, _ = mod2.get_params()
+    for k in p1:
+        np.testing.assert_allclose(p1[k].asnumpy(), p2[k].asnumpy())
+
+
+def test_module_predict_and_score():
+    X, y = _blob_data(n=200)
+    it = io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (200, 10)
+    res = mod.score(it, "acc")
+    assert 0.0 <= res[0][1] <= 1.0
+
+
+def test_module_multi_device_data_parallel():
+    """ctx list → batch sliced per device (reference executor_group)."""
+    X, y = _blob_data(n=400)
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    train = io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=ctxs)
+    mod.fit(train, num_epoch=2, kvstore="local",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+    score = mod.score(io.NDArrayIter(X, y, batch_size=40), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_input_grads():
+    net = _mlp_sym()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 50))],
+             label_shapes=[("softmax_label", (4,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = io.DataBatch([nd.ones((4, 50))], [nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()
+    assert ig[0].shape == (4, 50)
+    assert np.abs(ig[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    """Shared params across per-length buckets (reference test_bucketing)."""
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        net = sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+    b1 = io.DataBatch([nd.ones((4, 10))], [nd.zeros((4,))], bucket_key=10,
+                      provide_data=[io.DataDesc("data", (4, 10))],
+                      provide_label=[io.DataDesc("softmax_label", (4,))])
+    mod.forward(b1, is_train=True)
+    mod.backward()
+    mod.update()
+    # params live in the shared pool; switching buckets keeps them
+    mod.switch_bucket(10, [io.DataDesc("data", (4, 10))],
+                      [io.DataDesc("softmax_label", (4,))])
+    arg, _ = mod.get_params()
+    assert "fc_shared_weight" in arg
+
+
+def test_fixed_params_not_updated():
+    net = _mlp_sym()
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    X, y = _blob_data(n=100)
+    train = io.NDArrayIter(X, y, batch_size=50)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    before = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy().copy()
+    mod.init_optimizer(optimizer_params={"learning_rate": 1.0})
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(before, after)
+
+
+def test_feedforward_legacy_api():
+    X, y = _blob_data(n=200)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=4,
+                                 learning_rate=0.2, momentum=0.9,
+                                 numpy_batch_size=50)
+    model.fit(X, y)
+    acc = model.score(io.NDArrayIter(X, y, batch_size=50))
+    assert acc > 0.8

@@ -1,0 +1,144 @@
+"""Symbol composition/inference/serialization tests (modeled on reference
+tests/python/unittest/{test_symbol,test_infer_shape}.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu0")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_auto_variable_creation():
+    d = sym.var("x")
+    c = sym.Convolution(d, kernel=(3, 3), num_filter=4, name="conv0")
+    assert "conv0_weight" in c.list_arguments()
+    assert "conv0_bias" in c.list_arguments()
+    c2 = sym.Convolution(d, kernel=(3, 3), num_filter=4, no_bias=True,
+                         name="c2")
+    assert "c2_bias" not in c2.list_arguments()
+
+
+def test_infer_shape_mlp():
+    net = _mlp()
+    a, o, x = net.infer_shape(data=(32, 100))
+    args = dict(zip(net.list_arguments(), a))
+    assert args["fc1_weight"] == (16, 100)
+    assert args["fc1_bias"] == (16,)
+    assert args["fc2_weight"] == (10, 16)
+    assert o == [(32, 10)]
+
+
+def test_infer_shape_conv_bn():
+    d = sym.var("data")
+    net = sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          stride=(2, 2), name="conv")
+    net = sym.BatchNorm(net, name="bn")
+    a, o, x = net.infer_shape(data=(2, 3, 32, 32))
+    args = dict(zip(net.list_arguments(), a))
+    auxs = dict(zip(net.list_auxiliary_states(), x))
+    assert args["conv_weight"] == (8, 3, 3, 3)
+    assert args["bn_gamma"] == (8,)
+    assert auxs["bn_moving_mean"] == (8,)
+    assert o == [(2, 8, 16, 16)]
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_infer_type():
+    d = sym.var("data")
+    net = sym.FullyConnected(d, num_hidden=4)
+    a, o, x = net.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in a)
+
+
+def test_symbol_arith_operators():
+    a, b = sym.var("a"), sym.var("b")
+    c = 2 * a + b ** 2 - 3 / b
+    args = sorted(c.list_arguments())
+    assert args == ["a", "b"]
+    ash, osh, _ = c.infer_shape(a=(2, 2), b=(2, 2))
+    assert osh == [(2, 2)]
+
+
+def test_group_and_getitem():
+    a, b = sym.var("a"), sym.var("b")
+    g = sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    back = sym.load_json(js)
+    assert back.list_arguments() == net.list_arguments()
+    assert back.list_outputs() == net.list_outputs()
+    assert back.list_auxiliary_states() == net.list_auxiliary_states()
+    # attrs survive
+    a1, o1, _ = back.infer_shape(data=(4, 50))
+    a2, o2, _ = net.infer_shape(data=(4, 50))
+    assert o1 == o2 and a1 == a2
+
+
+def test_json_file_roundtrip(tmp_path):
+    net = _mlp()
+    f = str(tmp_path / "m-symbol.json")
+    net.save(f)
+    assert sym.load(f).tojson() == net.tojson()
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_multi_output_split():
+    d = sym.var("data")
+    s = sym.SliceChannel(d, num_outputs=3, name="split")
+    assert s.num_outputs == 3
+    assert s.list_outputs() == ["split_output0", "split_output1",
+                                "split_output2"]
+    one = s[1]
+    a, o, _ = one.infer_shape(data=(2, 6))
+    assert o == [(2, 2)]
+
+
+def test_attr_scope_and_var_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = sym.var("w")
+    assert v.attr("ctx_group") == "dev1"
+    v2 = sym.var("x", shape=(3, 4), lr_mult=2.0)
+    a, o, _ = v2.infer_shape()
+    assert o == [(3, 4)]
+
+
+def test_name_uniqueness():
+    d = sym.var("d")
+    c1 = sym.FullyConnected(d, num_hidden=2)
+    c2 = sym.FullyConnected(d, num_hidden=2)
+    assert c1.name != c2.name
+
+
+def test_infer_shape_error_message():
+    d = sym.var("data")
+    net = sym.FullyConnected(d, num_hidden=4)
+    with pytest.raises(MXNetError):
+        net.infer_shape()  # no shapes at all
