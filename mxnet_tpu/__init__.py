@@ -46,3 +46,6 @@ from . import model
 from . import module
 from . import module as mod
 from .model import FeedForward
+from . import recordio
+from . import image
+from . import gluon

@@ -1,0 +1,15 @@
+"""Gluon: the imperative/eager high-level API (reference python/mxnet/gluon).
+
+``Block``/``HybridBlock`` + ``Parameter``/``Trainer`` over the eager
+NDArray path; ``hybridize()`` compiles blocks into single XLA programs
+(the reference's CachedOp ≡ jax.jit — SURVEY §3.2 note).
+"""
+from .parameter import Parameter, ParameterDict, DeferredInitializationError  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
+from . import loss  # noqa: F401
+from . import data  # noqa: F401
+from . import utils  # noqa: F401
+from . import model_zoo  # noqa: F401

@@ -1,0 +1,37 @@
+"""Pretrained-weight store (reference model_zoo/model_store.py).
+
+The reference downloads ``.params`` files from S3 keyed by sha1
+(``MXNET_GLUON_REPO`` env).  This build has zero network egress:
+``get_model_file`` only resolves files already present in the local
+cache directory (same layout/naming as the reference), so pretrained
+checkpoints copied in by the user work identically.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Return the path of a locally cached pretrained model file."""
+    file_name = "{name}".format(name=name)
+    root = os.path.expanduser(root)
+    file_path = os.path.join(root, file_name + ".params")
+    if os.path.exists(file_path):
+        return file_path
+    raise FileNotFoundError(
+        "Pretrained model file %s is not found in %s and this build has "
+        "no network egress. Copy the .params file into the cache "
+        "directory (MXNet model zoo format) to use pretrained=True."
+        % (file_name + ".params", root))
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    files = os.listdir(root)
+    for f in files:
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -1,0 +1,317 @@
+"""Gluon basic NN layers.
+
+Parity surface: reference ``python/mxnet/gluon/nn/basic_layers.py:29-462``
+(Sequential, HybridSequential, Dense, Activation, Dropout, BatchNorm,
+LeakyReLU, Embedding, Flatten).  All compute lowers to the shared op
+registry (XLA-fused under hybridize).
+"""
+from __future__ import annotations
+
+from ..block import Block, HybridBlock
+from ... import initializer
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Activation",
+           "Dropout", "BatchNorm", "LeakyReLU", "Embedding", "Flatten",
+           "InstanceNorm", "LayerNorm", "Lambda", "HybridLambda"]
+
+
+class Sequential(Block):
+    """Stacks Blocks sequentially (reference basic_layers.py:29)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(Sequential, self).__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        for block in self._children:
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return self._children[i]
+
+
+class HybridSequential(HybridBlock):
+    """Stacks HybridBlocks sequentially (reference basic_layers.py:53)."""
+
+    def __init__(self, prefix=None, params=None):
+        super(HybridSequential, self).__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        for block in self._children:
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return self._children[i]
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer: ``out = act(dot(x, W.T) + b)``
+    (reference basic_layers.py:77; lowers to the FullyConnected op →
+    one MXU matmul)."""
+
+    def __init__(self, units, activation=None, use_bias=True,
+                 flatten=True, weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super(Dense, self).__init__(**kwargs)
+        self._flatten = flatten
+        with self.name_scope():
+            self._units = units
+            self._in_units = in_units
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units),
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        if bias is None:
+            act = F.FullyConnected(x, weight, no_bias=True,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten)
+        else:
+            act = F.FullyConnected(x, weight, bias,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten)
+        if self.act is not None:
+            act = self.act(act)
+        return act
+
+    def __repr__(self):
+        s = "{name}({layout}, {act})"
+        shape = self.weight.shape
+        return s.format(name=self.__class__.__name__,
+                        act=self.act if self.act else "linear",
+                        layout="{0} -> {1}".format(
+                            shape[1] if shape[1] else None, shape[0]))
+
+
+class Activation(HybridBlock):
+    """Applies an activation ('relu','sigmoid','tanh','softrelu')
+    (reference basic_layers.py:160)."""
+
+    def __init__(self, activation, **kwargs):
+        self._act_type = activation
+        super(Activation, self).__init__(**kwargs)
+
+    def _alias(self):
+        return self._act_type
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return "{name}({act})".format(
+            name=self.__class__.__name__, act=self._act_type)
+
+
+class Dropout(HybridBlock):
+    """Dropout (reference basic_layers.py:187); active only under
+    ``autograd.train_mode``, RNG threaded jit-safely."""
+
+    def __init__(self, rate, **kwargs):
+        super(Dropout, self).__init__(**kwargs)
+        self._rate = rate
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate)
+
+    def __repr__(self):
+        return "{name}(p = {_rate})".format(
+            name=self.__class__.__name__, **self.__dict__)
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization (reference basic_layers.py:224).  The moving
+    stats are aux parameters updated functionally (explicit extra outputs
+    of the BatchNorm op) — jit-safe on TPU."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 **kwargs):
+        super(BatchNorm, self).__init__(**kwargs)
+        self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
+                        "fix_gamma": not scale,
+                        "use_global_stats": use_global_stats}
+        self._axis = axis
+        if in_channels != 0:
+            self.in_channels = in_channels
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+        self.running_mean = self.params.get(
+            "running_mean", grad_req="null", shape=(in_channels,),
+            init=running_mean_initializer, allow_deferred_init=True,
+            differentiable=False)
+        self.running_var = self.params.get(
+            "running_var", grad_req="null", shape=(in_channels,),
+            init=running_variance_initializer, allow_deferred_init=True,
+            differentiable=False)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        return F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                           **self._kwargs)
+
+    def __repr__(self):
+        in_channels = self.gamma.shape[0]
+        return "{name}({content}, in_channels={in_channels})".format(
+            name=self.__class__.__name__, in_channels=in_channels,
+            content=", ".join("=".join([k, str(v)])
+                              for k, v in self._kwargs.items()))
+
+
+class LeakyReLU(HybridBlock):
+    """Leaky ReLU (reference basic_layers.py:288)."""
+
+    def __init__(self, alpha, **kwargs):
+        super(LeakyReLU, self).__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+    def __repr__(self):
+        return "{name}({alpha})".format(
+            name=self.__class__.__name__, alpha=self._alpha)
+
+
+class Embedding(HybridBlock):
+    """Index → dense vector lookup (reference basic_layers.py:315)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super(Embedding, self).__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype}
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim),
+            init=weight_initializer, allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, **self._kwargs)
+
+    def __repr__(self):
+        return "{name}({input_dim} -> {output_dim}, {dtype})".format(
+            name=self.__class__.__name__, **self._kwargs)
+
+
+class Flatten(HybridBlock):
+    """Flattens to 2D (reference basic_layers.py:355)."""
+
+    def hybrid_forward(self, F, x):
+        return F.Flatten(x)
+
+    def __repr__(self):
+        return self.__class__.__name__
+
+
+class InstanceNorm(HybridBlock):
+    """Instance normalization (reference basic_layers.py has it in later
+    revs; op parity with InstanceNorm operator)."""
+
+    def __init__(self, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super(InstanceNorm, self).__init__(**kwargs)
+        self._kwargs = {"eps": epsilon}
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, **self._kwargs)
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization over the last axis."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super(LayerNorm, self).__init__(**kwargs)
+        self._kwargs = {"axis": axis, "eps": epsilon}
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, **self._kwargs)
+
+
+class Lambda(Block):
+    """Wraps a function as a Block."""
+
+    def __init__(self, function, prefix=None):
+        super(Lambda, self).__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd
+            assert hasattr(nd, function), \
+                "Function name %s is not found in ndarray." % function
+            self._func_impl = getattr(nd, function)
+        else:
+            self._func_impl = function
+
+    def forward(self, *args):
+        return self._func_impl(*args)
+
+
+class HybridLambda(HybridBlock):
+    """Wraps a function as a HybridBlock."""
+
+    def __init__(self, function, prefix=None):
+        super(HybridLambda, self).__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd
+            from ... import symbol as sym
+            assert hasattr(nd, function) and hasattr(sym, function), \
+                "Function name %s is not found in ndarray/symbol." % function
+            self._func_name = function
+            self._func_impl = None
+        else:
+            self._func_impl = function
+            self._func_name = None
+
+    def hybrid_forward(self, F, x, *args):
+        if self._func_name is not None:
+            return getattr(F, self._func_name)(x, *args)
+        return self._func_impl(F, x, *args)

@@ -1,0 +1,314 @@
+"""Gluon convolution / pooling layers.
+
+Parity surface: reference ``python/mxnet/gluon/nn/conv_layers.py:40-780``
+(Conv1D/2D/3D, Conv1D/2D/3DTranspose, Max/Avg pooling 1-3D, global
+variants).  All lower to the Convolution/Deconvolution/Pooling ops —
+XLA conv_general_dilated on the MXU.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D",
+           "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+           "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D",
+           "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
+           "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D"]
+
+
+def _to_tuple(x, n):
+    if isinstance(x, int):
+        return (x,) * n
+    assert len(x) == n
+    return tuple(x)
+
+
+class _Conv(HybridBlock):
+    """Base conv layer (reference conv_layers.py:40)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", op_name="Convolution",
+                 adj=None, **kwargs):
+        super(_Conv, self).__init__(**kwargs)
+        with self.name_scope():
+            self._channels = channels
+            self._in_channels = in_channels
+            dim = len(kernel_size)
+            self._op_name = op_name
+            self._kwargs = {
+                "kernel": kernel_size, "stride": strides,
+                "dilate": dilation, "pad": padding,
+                "num_filter": channels, "num_group": groups,
+                "no_bias": not use_bias, "layout": layout}
+            if adj is not None:
+                self._kwargs["adj"] = adj
+
+            # canonical NCHW-family weight shape: conv (O, I/g, *k);
+            # deconv (I, O/g, *k) — _ConvTranspose patches after super()
+            if op_name == "Convolution":
+                wshape = [channels,
+                          in_channels // groups if in_channels else 0] + \
+                    list(kernel_size)
+            else:
+                wshape = [in_channels,
+                          channels // groups] + list(kernel_size)
+            self.weight = self.params.get(
+                "weight", shape=tuple(wshape), init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                from .basic_layers import Activation
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        if bias is None:
+            act = op(x, weight, **self._kwargs)
+        else:
+            act = op(x, weight, bias, **self._kwargs)
+        if self.act is not None:
+            act = self.act(act)
+        return act
+
+    def __repr__(self):
+        s = "{name}({mapping}, kernel_size={kernel}, stride={stride})"
+        shape = self.weight.shape
+        return s.format(name=self.__class__.__name__,
+                        mapping="{0} -> {1}".format(
+                            shape[1] if shape[1] else None, shape[0]),
+                        **self._kwargs)
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        kernel_size = _to_tuple(kernel_size, 1)
+        strides = _to_tuple(strides, 1)
+        padding = _to_tuple(padding, 1)
+        dilation = _to_tuple(dilation, 1)
+        super(Conv1D, self).__init__(
+            channels, kernel_size, strides, padding, dilation, groups,
+            layout, in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        kernel_size = _to_tuple(kernel_size, 2)
+        strides = _to_tuple(strides, 2)
+        padding = _to_tuple(padding, 2)
+        dilation = _to_tuple(dilation, 2)
+        super(Conv2D, self).__init__(
+            channels, kernel_size, strides, padding, dilation, groups,
+            layout, in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        kernel_size = _to_tuple(kernel_size, 3)
+        strides = _to_tuple(strides, 3)
+        padding = _to_tuple(padding, 3)
+        dilation = _to_tuple(dilation, 3)
+        super(Conv3D, self).__init__(
+            channels, kernel_size, strides, padding, dilation, groups,
+            layout, in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, **kwargs)
+
+
+class _ConvTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides, padding,
+                 output_padding, dilation, groups, layout, in_channels,
+                 activation, use_bias, weight_initializer,
+                 bias_initializer, **kwargs):
+        super(_ConvTranspose, self).__init__(
+            channels, kernel_size, strides, padding, dilation, groups,
+            layout, in_channels, activation, use_bias, weight_initializer,
+            bias_initializer, op_name="Deconvolution",
+            adj=output_padding, **kwargs)
+        # Deconvolution weight is (in_channels, channels/groups, *k)
+        dim = len(kernel_size)
+        wshape = [in_channels, channels // groups] + list(kernel_size)
+        if in_channels == 0:
+            wshape[0] = 0
+        self.weight.shape = tuple(wshape)
+
+
+class Conv1DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super(Conv1DTranspose, self).__init__(
+            channels, _to_tuple(kernel_size, 1), _to_tuple(strides, 1),
+            _to_tuple(padding, 1), _to_tuple(output_padding, 1),
+            _to_tuple(dilation, 1), groups, layout, in_channels,
+            activation, use_bias, weight_initializer, bias_initializer,
+            **kwargs)
+
+
+class Conv2DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), output_padding=(0, 0), dilation=(1, 1),
+                 groups=1, layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super(Conv2DTranspose, self).__init__(
+            channels, _to_tuple(kernel_size, 2), _to_tuple(strides, 2),
+            _to_tuple(padding, 2), _to_tuple(output_padding, 2),
+            _to_tuple(dilation, 2), groups, layout, in_channels,
+            activation, use_bias, weight_initializer, bias_initializer,
+            **kwargs)
+
+
+class Conv3DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super(Conv3DTranspose, self).__init__(
+            channels, _to_tuple(kernel_size, 3), _to_tuple(strides, 3),
+            _to_tuple(padding, 3), _to_tuple(output_padding, 3),
+            _to_tuple(dilation, 3), groups, layout, in_channels,
+            activation, use_bias, weight_initializer, bias_initializer,
+            **kwargs)
+
+
+class _Pooling(HybridBlock):
+    """Base pooling (reference conv_layers.py:600)."""
+
+    def __init__(self, pool_size, strides, padding, ceil_mode=False,
+                 global_pool=False, pool_type="max", **kwargs):
+        super(_Pooling, self).__init__(**kwargs)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "global_pool": global_pool, "pool_type": pool_type,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+    def __repr__(self):
+        return "{name}(size={kernel}, stride={stride}, padding={pad}" \
+            ")".format(name=self.__class__.__name__, **self._kwargs)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        assert layout == "NCW"
+        super(MaxPool1D, self).__init__(
+            _to_tuple(pool_size, 1),
+            _to_tuple(strides, 1) if strides is not None else None,
+            _to_tuple(padding, 1), ceil_mode, False, "max", **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        assert layout == "NCHW"
+        super(MaxPool2D, self).__init__(
+            _to_tuple(pool_size, 2),
+            _to_tuple(strides, 2) if strides is not None else None,
+            _to_tuple(padding, 2), ceil_mode, False, "max", **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        assert layout == "NCDHW"
+        super(MaxPool3D, self).__init__(
+            _to_tuple(pool_size, 3),
+            _to_tuple(strides, 3) if strides is not None else None,
+            _to_tuple(padding, 3), ceil_mode, False, "max", **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        assert layout == "NCW"
+        super(AvgPool1D, self).__init__(
+            _to_tuple(pool_size, 1),
+            _to_tuple(strides, 1) if strides is not None else None,
+            _to_tuple(padding, 1), ceil_mode, False, "avg", **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        assert layout == "NCHW"
+        super(AvgPool2D, self).__init__(
+            _to_tuple(pool_size, 2),
+            _to_tuple(strides, 2) if strides is not None else None,
+            _to_tuple(padding, 2), ceil_mode, False, "avg", **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        assert layout == "NCDHW"
+        super(AvgPool3D, self).__init__(
+            _to_tuple(pool_size, 3),
+            _to_tuple(strides, 3) if strides is not None else None,
+            _to_tuple(padding, 3), ceil_mode, False, "avg", **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super(GlobalMaxPool1D, self).__init__(
+            (1,), None, (0,), True, True, "max", **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super(GlobalMaxPool2D, self).__init__(
+            (1, 1), None, (0, 0), True, True, "max", **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super(GlobalMaxPool3D, self).__init__(
+            (1, 1, 1), None, (0, 0, 0), True, True, "max", **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super(GlobalAvgPool1D, self).__init__(
+            (1,), None, (0,), True, True, "avg", **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super(GlobalAvgPool2D, self).__init__(
+            (1, 1), None, (0, 0), True, True, "avg", **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super(GlobalAvgPool3D, self).__init__(
+            (1, 1, 1), None, (0, 0, 0), True, True, "avg", **kwargs)

@@ -1,0 +1,367 @@
+"""Gluon Parameter / ParameterDict.
+
+Parity surface: reference ``python/mxnet/gluon/parameter.py`` (Parameter
+:43 — deferred init, grad_req, data/grad accessors :251,348-399;
+ParameterDict :416 — prefixed registry with get/update/initialize/save/load).
+
+TPU-native redesign: the reference keeps one copy of every parameter per
+device context and reduces gradients across them (``_check_and_get`` over
+``_data`` lists).  On TPU, replication and sharding are properties of one
+``jax.Array`` over a mesh, so a Parameter owns exactly one NDArray; the
+``list_data``/``list_grad`` API survives as views of that single logical
+value (length == len(ctx list) for API parity, same buffer).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from .. import initializer
+from .. import symbol as _sym
+from .. import autograd
+
+__all__ = ["DeferredInitializationError", "Parameter", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a parameter's value is requested before shape is known."""
+
+
+def _shape_known(shape):
+    return shape is not None and all(s > 0 for s in shape)
+
+
+class Parameter(object):
+    """A Container holding parameters (weights) of Blocks.
+
+    Reference: ``gluon/parameter.py:43``.  ``grad_req`` in
+    {'write','add','null'}; shape dims of 0 mean "infer on first forward"
+    (deferred initialization).
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        self._data = None
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self.shape, self.dtype)
+
+    # -- grad_req ----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError("invalid grad_req %s" % req)
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                self._data._grad = None
+                self._data._marked = False
+        elif self._data is not None:
+            self._init_grad()
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize parameter data (reference parameter.py:251)."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if not _shape_known(self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter %s because it has invalid "
+                "shape %s." % (self.name, self.shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        self._deferred_init = ()
+        data = nd.zeros(self.shape, ctx=ctx[0], dtype=self.dtype)
+        initializer.create(init or self.init or default_init)(
+            initializer.InitDesc(self.name), data)
+        self._data = data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = nd.zeros(self.shape, ctx=self._data.context,
+                              dtype=self._data.dtype)
+        autograd.mark_variables([self._data], [self._grad],
+                                grad_reqs=self._grad_req)
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        if not _shape_known(self.shape):
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape %s" % (self.name, self.shape))
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _set_shape_if_deferred(self, shape):
+        """Fill in inferred dims (0 → concrete) during deferred init."""
+        if self.shape is None:
+            self.shape = tuple(shape)
+            return
+        new = []
+        for old, got in zip(self.shape, shape):
+            if old > 0 and got > 0 and old != got:
+                raise MXNetError(
+                    "inferred shape %s incompatible with declared %s for %s"
+                    % (shape, self.shape, self.name))
+            new.append(old if old > 0 else got)
+        self.shape = tuple(new)
+
+    # -- accessors ---------------------------------------------------------
+    def _check_and_get(self, what="data"):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "Parameter %s has not been initialized yet because "
+                    "initialization was deferred. Actual initialization "
+                    "happens during the first forward pass." % self.name)
+            raise RuntimeError(
+                "Parameter %s has not been initialized. You should "
+                "initialize parameters with Block.collect_params()."
+                "initialize(...) before use." % self.name)
+        return self._data if what == "data" else self._grad
+
+    def data(self, ctx=None):
+        return self._check_and_get("data")
+
+    def list_data(self):
+        d = self._check_and_get("data")
+        return [d] * max(1, len(self._ctx_list or [None]))
+
+    def grad(self, ctx=None):
+        g = self._check_and_get("grad")
+        if g is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because "
+                "grad_req='null'" % self.name)
+        return g
+
+    def list_grad(self):
+        g = self.grad()
+        return [g] * max(1, len(self._ctx_list or [None]))
+
+    def list_ctx(self):
+        if self._data is None and not self._deferred_init:
+            raise RuntimeError("Parameter %s has not been initialized"
+                               % self.name)
+        return list(self._ctx_list or [current_context()])
+
+    def set_data(self, data):
+        """Set this parameter's value everywhere (finishes deferred or
+        uninitialized params from the data, reference _load_init)."""
+        if self._data is None:
+            self._set_shape_if_deferred(data.shape)
+            if self._deferred_init:
+                init, ctx, default_init = self._deferred_init
+                self._finish_init(init, ctx, default_init)
+            else:
+                ctx = self._ctx_list or [current_context()]
+                self._finish_init(initializer.Zero(), ctx,
+                                  initializer.Zero())
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        self._data._set_data(data._data.astype(self._data.dtype))
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self._data is not None:
+            self._data._set_data(self._data.as_in_context(ctx[0])._data)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            with autograd.pause():
+                self._data._set_data(self._data._data.astype(
+                    np.dtype(dtype) if not isinstance(dtype, str)
+                    else dtype))
+            if self._grad is not None:
+                self._grad._set_data(self._grad._data.astype(
+                    self._data.dtype))
+
+    def var(self):
+        """A symbol representing this parameter (reference :399)."""
+        shape = self.shape if _shape_known(self.shape) else None
+        return _sym.var(self.name, shape=shape, dtype=self.dtype,
+                        lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                        init=self.init)
+
+
+class ParameterDict(object):
+    """A dictionary managing Parameters with a common prefix.
+
+    Reference: ``gluon/parameter.py:416``.
+    """
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = "%s(\n" % (self._prefix + " " if self._prefix else "")
+        s += "\n".join("  " + repr(p) for p in self._params.values())
+        return s + "\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieve or create a Parameter named ``prefix+name``."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        # merge partial shapes
+                        if len(v) == len(existing):
+                            merged = tuple(
+                                e if e > 0 else n
+                                for e, n in zip(existing, v))
+                            param.shape = merged
+                            continue
+                    if v is not None and v != existing:
+                        raise AssertionError(
+                            "Cannot retrieve Parameter %s because desired "
+                            "attribute %s does not match stored: %s vs %s"
+                            % (name, k, v, existing))
+                elif v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(
+                    "Cannot update self with other because they have "
+                    "different Parameters with the same name %s" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix %s is to be striped before saving, but "
+                    "Parameter %s does not start with it"
+                    % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = nd.load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise IOError(
+                        "Parameter %s is missing in file %s"
+                        % (name, filename))
+        for name in arg_dict:
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError(
+                        "Parameter %s loaded from file %s is not present "
+                        "in ParameterDict" % (name, filename))
+                continue
+            self[name].set_data(arg_dict[name])

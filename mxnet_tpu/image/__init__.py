@@ -1,0 +1,3 @@
+"""Image IO + augmentation (reference python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from . import image  # noqa: F401

@@ -1,0 +1,494 @@
+"""Image IO + augmentation pipeline.
+
+Parity surface: reference ``python/mxnet/image/image.py`` — ``imdecode``,
+``scale_down``, ``resize_short``, ``fixed_crop``, ``random_crop``,
+``center_crop``, ``color_normalize``, augmenter classes, and ``ImageIter``
+(python-side image pipeline over .rec / .lst files).
+
+TPU note: decode/augment run on host (cv2) exactly like the reference's
+OpenCV path (``src/io/image_aug_default.cc``); the device only sees the
+final batched float tensor — one upload per batch.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+
+import numpy as np
+
+try:
+    import cv2
+except ImportError:
+    cv2 = None
+
+from .. import ndarray as nd
+from .. import io as _io
+from .. import recordio
+
+__all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "imresize", "CreateAugmenter", "Augmenter",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+           "CenterCropAug", "RandomOrderAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "HorizontalFlipAug", "CastAug", "ImageIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an NDArray (HWC, BGR→RGB)
+    (reference image.py:imdecode over src/io/image_io.cc)."""
+    if cv2 is None:
+        raise ImportError("imdecode requires cv2")
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+    if img is None:
+        raise ValueError("Decoding image failed")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype=np.uint8)
+
+
+def imresize(src, w, h, interp=1):
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = cv2.resize(arr, (w, h), interpolation=interp)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=out.dtype)
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit in src_size (reference image.py:scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to size (reference image.py:resize_short)."""
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(arr, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return nd.array(out, dtype=out.dtype)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random crop w/ size in [min_area*area, area] and aspect in ratio."""
+    h, w = src.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = pyrandom.uniform(*ratio)
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if pyrandom.random() < 0.5:
+            new_h, new_w = new_w, new_h
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter(object):
+    """Image augmenter base (reference image.py:Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self._kwargs]
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(ResizeAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [resize_short(src, self.size, self.interp)]
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(ForceResizeAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [imresize(src, self.size[0], self.size[1], self.interp)]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(RandomCropAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_crop(src, self.size, self.interp)[0]]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super(RandomSizedCropAug, self).__init__(
+            size=size, min_area=min_area, ratio=ratio, interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_size_crop(src, self.size, self.min_area,
+                                 self.ratio, self.interp)[0]]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(CenterCropAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [center_crop(src, self.size, self.interp)[0]]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super(RandomOrderAug, self).__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        pyrandom.shuffle(self.ts)
+        srcs = [src]
+        for t in self.ts:
+            srcs = [j for i in srcs for j in t(i)]
+        return srcs
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super(BrightnessJitterAug, self).__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return [src.astype(np.float32) * alpha]
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, contrast):
+        super(ContrastJitterAug, self).__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = src.asnumpy().astype(np.float32)
+        gray = (arr * self._coef).sum() * (3.0 / arr.size)
+        return [nd.array(arr * alpha + gray * (1.0 - alpha),
+                         dtype=np.float32)]
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, saturation):
+        super(SaturationJitterAug, self).__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy().astype(np.float32)
+        gray = (arr * self._coef).sum(axis=2, keepdims=True)
+        return [nd.array(arr * alpha + gray * (1.0 - alpha),
+                         dtype=np.float32)]
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super(HueJitterAug, self).__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        arr = src.asnumpy().astype(np.float32)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], dtype=np.float32)
+        tyiq = np.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], dtype=np.float32)
+        ityiq = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], dtype=np.float32)
+        t = np.dot(np.dot(ityiq, bt), tyiq).T
+        return [nd.array(np.dot(arr, t), dtype=np.float32)]
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super(ColorJitterAug, self).__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting jitter (AlexNet style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super(LightingAug, self).__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval)
+        self.eigvec = np.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return [src.astype(np.float32) + nd.array(rgb, dtype=np.float32)]
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super(ColorNormalizeAug, self).__init__()
+        self.mean = nd.array(mean) if mean is not None else None
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return [color_normalize(src.astype(np.float32), self.mean,
+                                self.std)]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super(HorizontalFlipAug, self).__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = src.asnumpy()[:, ::-1]
+            return [nd.array(np.ascontiguousarray(arr), dtype=arr.dtype)]
+        return [src]
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return [src.astype(np.float32)]
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Create the standard augmenter list (reference image.py:CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
+                                                           4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Image data iterator over .rec files or .lst + raw images
+    (reference image.py:ImageIter) with pluggable augmenters."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0,
+                 num_parts=1, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super(ImageIter, self).__init__()
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        self.imgrec = None
+        self.imglist = None
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        if path_imglist:
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]])
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+                self.seq = imgkeys
+        elif isinstance(imglist, list):
+            result = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                label = np.array(img[0]) if isinstance(
+                    img[0], (list, np.ndarray)) else np.array([img[0]])
+                result[key] = (label, img[1])
+                imgkeys.append(str(key))
+            self.imglist = result
+            self.seq = imgkeys
+        else:
+            self.seq = self.imgidx
+
+        self.path_root = path_root
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.provide_data = [
+            _io.DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [
+            _io.DataDesc(label_name, (batch_size, label_width)
+                         if label_width > 1 else (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as fin:
+                img = fin.read()
+            return label, img
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
+        batch_label = np.zeros((batch_size,) + (
+            (self.label_width,) if self.label_width > 1 else ()),
+            dtype=np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = imdecode(s)
+                for aug in self.auglist:
+                    data = aug(data)[0]
+                batch_data[i] = data.asnumpy().reshape(h, w, c)
+                batch_label[i] = label
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = batch_size - i
+        # NCHW for the device
+        arr = nd.array(batch_data.transpose(0, 3, 1, 2))
+        return _io.DataBatch([arr], [nd.array(batch_label)], pad=pad)

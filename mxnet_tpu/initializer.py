@@ -123,11 +123,17 @@ class Zero(Initializer):
     _init_default = _init_weight
 
 
+_REG.register(Zero, "zeros")
+
+
 @register
 class One(Initializer):
     def _init_weight(self, _, arr):
         arr[:] = 1.0
     _init_default = _init_weight
+
+
+_REG.register(One, "ones")
 
 
 @register

@@ -18,11 +18,28 @@ from .utils import save, load, save_to_bytes, load_from_bytes
 from ..ops.registry import OP_REGISTRY, get_op
 
 
+def _scalar_attr_names(op):
+    """Keyword parameter names of the op fn, in declaration order (for
+    mapping scalar positional args, reference generated-op behaviour)."""
+    import inspect
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return []
+    return [p.name for p in sig.parameters.values()
+            if p.default is not inspect.Parameter.empty
+            and p.name not in ("train_mode", "rng")]
+
+
 def _make_op_func(name, op):
+    scalar_names = None
+
     def op_func(*args, **kwargs):
+        nonlocal scalar_names
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)
         ndargs = []
+        scalars = []
         for a in args:
             if isinstance(a, NDArray):
                 ndargs.append(a)
@@ -31,11 +48,18 @@ def _make_op_func(name, op):
             elif a is None:
                 continue
             else:
-                # scalar positional → attr fallthrough not supported; treat
-                # numeric positionals as an error for parity with reference.
+                scalars.append(a)
+        if scalars:
+            # scalar positionals fill the op's attr params in order
+            if scalar_names is None:
+                scalar_names = _scalar_attr_names(op)
+            free = [n for n in scalar_names if n not in kwargs]
+            if len(scalars) > len(free):
                 raise TypeError(
-                    "operator %s positional arguments must be NDArray, got %r"
-                    % (name, type(a)))
+                    "operator %s got %d scalar positional args but only "
+                    "has attr slots %s" % (name, len(scalars), free))
+            for n, v in zip(free, scalars):
+                kwargs[n] = v
         res = invoke(op, ndargs, kwargs, out=out)
         return res[0] if len(res) == 1 else res
     op_func.__name__ = name
@@ -52,6 +76,14 @@ for _name, _op in OP_REGISTRY.items():
         if not hasattr(_this, _name):
             setattr(_this, _name, _fn)
 sys.modules[__name__ + "._internal"] = _internal
+
+# mx.nd.contrib namespace: _contrib_* ops under their stripped names
+contrib = types.ModuleType(__name__ + ".contrib")
+for _name, _op in OP_REGISTRY.items():
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):],
+                _make_op_func(_name, _op))
+sys.modules[__name__ + ".contrib"] = contrib
 
 from . import random  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401

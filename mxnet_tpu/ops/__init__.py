@@ -6,3 +6,4 @@ from . import matrix  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optim_ops  # noqa: F401
+from . import contrib  # noqa: F401

@@ -1,0 +1,160 @@
+"""contrib ops: CTC loss, quantization, FFT, count_sketch.
+
+Parity surface: reference ``src/operator/contrib/`` — ``ctc_loss.cc``
+(warp-ctc style CTC), ``quantize.cc``/``dequantize.cc``, ``fft.cc``/
+``ifft.cc``, ``count_sketch.cc``.
+
+TPU-native: CTC is the classic forward-alpha dynamic program expressed as
+``lax.scan`` over time (compiler-friendly control flow; no host sync),
+vmapped over the batch.  FFT maps to jnp.fft; quantize to scaled casts.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+NEG_INF = -1e30
+
+
+def _ctc_single(logp, ext, ext_valid, T_len, S_len):
+    """CTC -log p(label|data) for one sequence.
+
+    logp: (T, C) log-softmax scores; ext: (S,) extended label seq
+    (blank interleaved); ext_valid: (S,) bool; T_len, S_len: actual lengths.
+    """
+    T, C = logp.shape
+    S = ext.shape[0]
+    idx = jnp.arange(S)
+    # allowed skip transition s-2 -> s: s odd (a label) and ext[s]!=ext[s-2]
+    prev2 = jnp.where(idx >= 2, ext[jnp.maximum(idx - 2, 0)], -1)
+    can_skip = (idx % 2 == 1) & (ext != prev2) & (idx >= 2)
+
+    alpha0 = jnp.full((S,), NEG_INF)
+    alpha0 = alpha0.at[0].set(logp[0, ext[0]])
+    alpha0 = jnp.where((idx == 1) & (S_len > 1),
+                       alpha0.at[1].set(logp[0, ext[1]]), alpha0)
+
+    def step(alpha, t):
+        a_prev1 = jnp.concatenate([jnp.array([NEG_INF]), alpha[:-1]])
+        a_prev2 = jnp.concatenate([jnp.full((2,), NEG_INF), alpha[:-2]])
+        a_prev2 = jnp.where(can_skip, a_prev2, NEG_INF)
+        stacked = jnp.stack([alpha, a_prev1, a_prev2])
+        merged = jax.nn.logsumexp(stacked, axis=0)
+        new = merged + logp[t, ext]
+        new = jnp.where(ext_valid, new, NEG_INF)
+        # freeze after the true sequence length (supports data_lengths)
+        new = jnp.where(t < T_len, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    last = alpha[jnp.maximum(S_len - 1, 0)]
+    last2 = jnp.where(S_len >= 2, alpha[jnp.maximum(S_len - 2, 0)], NEG_INF)
+    ll = jax.nn.logsumexp(jnp.stack([last, last2]))
+    return -ll
+
+
+@register("_contrib_CTCLoss", aliases=["ctc_loss", "CTCLoss"],
+          num_outputs=2, num_visible_outputs=1, nondiff_inputs=(1, 2, 3))
+def _ctc_loss(data, label, *opt, use_data_lengths=False,
+              use_label_lengths=False, blank_label="first", **kw):
+    """data: (T, N, C) activations; label: (N, L) padded labels."""
+    opt = list(opt)
+    data_lengths = opt.pop(0) if use_data_lengths else None
+    label_lengths = opt.pop(0) if use_label_lengths else None
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        blank = 0
+        # real labels are 1..C-1; padding value 0
+        if label_lengths is None:
+            lab_len = jnp.sum((lab != 0).astype(jnp.int32), axis=1)
+        else:
+            lab_len = label_lengths.astype(jnp.int32)
+    else:
+        blank = C - 1
+        if label_lengths is None:
+            lab_len = jnp.sum((lab != -1).astype(jnp.int32), axis=1)
+        else:
+            lab_len = label_lengths.astype(jnp.int32)
+    d_len = (data_lengths.astype(jnp.int32) if data_lengths is not None
+             else jnp.full((N,), T, jnp.int32))
+
+    S = 2 * L + 1
+    sidx = jnp.arange(S)
+
+    def extend(labels_n, len_n):
+        lab_pos = (sidx - 1) // 2
+        ext = jnp.where(sidx % 2 == 1,
+                        labels_n[jnp.clip(lab_pos, 0, L - 1)], blank)
+        valid = sidx < 2 * len_n + 1
+        return ext, valid, 2 * len_n + 1
+
+    def one(logp_n, labels_n, dl, ll):
+        ext, valid, s_len = extend(labels_n, ll)
+        return _ctc_single(logp_n, ext, valid, dl, s_len)
+
+    logp_bn = jnp.transpose(logp, (1, 0, 2))  # (N, T, C)
+    loss = jax.vmap(one)(logp_bn, lab, d_len, lab_len)
+    return loss.astype(data.dtype), jnp.zeros_like(data)
+
+
+@register("_contrib_quantize", num_outputs=3, nondiff_inputs=(0, 1, 2))
+def _quantize(data, min_range, max_range, out_type="uint8", **kw):
+    if out_type == "uint8":
+        qmin, qmax, qdt = 0.0, 255.0, jnp.uint8
+    else:  # int8
+        qmin, qmax, qdt = -127.0, 127.0, jnp.int8
+    mn = min_range.reshape(())
+    mx_ = max_range.reshape(())
+    scale = (qmax - qmin) / (mx_ - mn)
+    q = jnp.clip(jnp.round((data - mn) * scale + qmin), qmin, qmax)
+    return q.astype(qdt), mn.reshape((1,)), mx_.reshape((1,))
+
+
+@register("_contrib_dequantize", nondiff_inputs=(0, 1, 2))
+def _dequantize(data, min_range, max_range, out_type="float32", **kw):
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    mn = min_range.reshape(())
+    mx_ = max_range.reshape(())
+    scale = (mx_ - mn) / (qmax - qmin)
+    return ((data.astype(jnp.float32) - qmin) * scale + mn).astype(
+        np.dtype(out_type))
+
+
+@register("_contrib_fft")
+def _fft(data, compute_size=128, **kw):
+    """Reference fft.cc: output interleaves real/imag along last dim."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (data.shape[-1] * 2,)).astype(
+        jnp.float32)
+
+
+@register("_contrib_ifft")
+def _ifft(data, compute_size=128, **kw):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1) * n  # reference does not normalize
+    return out.real.astype(jnp.float32)
+
+
+@register("_contrib_count_sketch", nondiff_inputs=(1, 2))
+def _count_sketch(data, h, s, out_dim=0, processing_batch_size=32, **kw):
+    """Count sketch projection (reference count_sketch.cc)."""
+    n, d = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)[:d]
+    ss = s.reshape(-1)[:d]
+    signed = data * ss[None, :]
+    out = jnp.zeros((n, int(out_dim)), data.dtype)
+    return out.at[:, hh].add(signed)

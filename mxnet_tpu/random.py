@@ -12,11 +12,12 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "current_seed"]
+__all__ = ["seed", "next_key", "current_seed", "key_scope"]
 
 _lock = threading.Lock()
 _seed = 0
 _key = None  # lazily created: backend init must not run at import time
+_scope = threading.local()  # per-thread key override stack (jit tracing)
 
 
 def seed(seed_state, ctx="all"):
@@ -28,12 +29,34 @@ def seed(seed_state, ctx="all"):
 
 
 def next_key():
+    stack = getattr(_scope, "stack", None)
+    if stack:
+        # inside a key_scope (jit trace): split the scoped key so traced
+        # programs thread randomness explicitly (may be a tracer)
+        stack[-1], sub = jax.random.split(stack[-1])
+        return sub
     global _key
     with _lock:
         if _key is None:
             _key = jax.random.PRNGKey(_seed)
         _key, sub = jax.random.split(_key)
         return sub
+
+
+class key_scope:
+    """Thread randomness from an explicit key (used while jit-tracing)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        if not hasattr(_scope, "stack"):
+            _scope.stack = []
+        _scope.stack.append(self._key)
+        return self
+
+    def __exit__(self, *exc):
+        _scope.stack.pop()
 
 
 def current_seed():

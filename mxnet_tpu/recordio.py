@@ -1,0 +1,241 @@
+"""RecordIO: the reference's packed binary record format.
+
+Parity surface: reference ``python/mxnet/recordio.py`` —
+``MXRecordIO`` (:36), ``MXIndexedRecordIO`` (:170), ``IRHeader``
+pack/unpack (+jpeg payloads) (:291-380), over the dmlc-core chunked
+format (``src/io/image_recordio.h``).
+
+Format (dmlc-core recordio): each record is
+``[kMagic:u32][lrec:u32][data][pad to 4B]`` where ``lrec`` encodes
+cflag (upper 3 bits, 0 = complete record) and length (lower 29 bits).
+This is a pure-python reimplementation of the wire format — files it
+writes are readable by the reference and vice versa.
+"""
+from __future__ import annotations
+
+import collections
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_KMAGIC = 0xced7230a
+
+
+class MXRecordIO(object):
+    """Sequential reader/writer of RecordIO files (reference :36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.fd = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fd = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fd = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d.pop("fd", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        self.fd = None
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.fd.close()
+        self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        lrec = len(buf)  # cflag 0 (complete)
+        self.fd.write(struct.pack("<II", _KMAGIC, lrec))
+        self.fd.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.fd.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.fd.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        assert magic == _KMAGIC, "Invalid RecordIO magic"
+        length = lrec & ((1 << 29) - 1)
+        cflag = lrec >> 29
+        assert cflag == 0, "multi-chunk records not supported"
+        buf = self.fd.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fd.read(pad)
+        return buf
+
+    def tell(self):
+        return self.fd.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with a .idx sidecar (reference :170)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super(MXIndexedRecordIO, self).__init__(uri, flag)
+
+    def open(self):
+        super(MXIndexedRecordIO, self).open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super(MXIndexedRecordIO, self).close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.fd.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+IRHeader = collections.namedtuple("HEADER",
+                                  ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a string with an IRHeader (reference recordio.py:291)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        header = header._replace(label=float(header.label))
+        ret = struct.pack(_IR_FORMAT, 0, header.label, header.id,
+                          header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        ret = struct.pack(_IR_FORMAT, header.flag, header.label,
+                          header.id, header.id2)
+        ret += label.tobytes()
+    return ret + s
+
+
+def unpack(s):
+    """Unpack an IRHeader-packed string (reference recordio.py:322)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a packed image record (reference recordio.py:344).
+    JPEG decode requires PIL or cv2; raw numpy payloads always work."""
+    header, s = unpack(s)
+    img = _imdecode(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image (reference recordio.py:366)."""
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode(buf, iscolor=-1):
+    try:
+        import cv2
+        return cv2.imdecode(np.frombuffer(buf, np.uint8), iscolor)
+    except ImportError:
+        pass
+    try:
+        import io as _io
+        from PIL import Image
+        return np.asarray(Image.open(_io.BytesIO(buf)))
+    except ImportError:
+        raise ImportError("unpack_img requires cv2 or PIL")
+
+
+def _imencode(img, quality, img_fmt):
+    try:
+        import cv2
+        jpg_formats = [".JPG", ".JPEG"]
+        png_formats = [".PNG"]
+        encode_params = None
+        if img_fmt.upper() in jpg_formats:
+            encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+        elif img_fmt.upper() in png_formats:
+            encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+        ret, buf = cv2.imencode(img_fmt, img, encode_params)
+        assert ret, "failed to encode image"
+        return buf.tobytes()
+    except ImportError:
+        pass
+    try:
+        import io as _io
+        from PIL import Image
+        bio = _io.BytesIO()
+        fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+        Image.fromarray(np.asarray(img)).save(bio, format=fmt,
+                                              quality=quality)
+        return bio.getvalue()
+    except ImportError:
+        raise ImportError("pack_img requires cv2 or PIL")

@@ -91,6 +91,14 @@ for _name, _op in OP_REGISTRY.items():
             setattr(_this, _name, _fn)
 sys.modules[__name__ + "._internal"] = _internal
 
+# mx.sym.contrib namespace: _contrib_* ops under their stripped names
+contrib = types.ModuleType(__name__ + ".contrib")
+for _name, _op in OP_REGISTRY.items():
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):],
+                _make_sym_func(_name, _op))
+sys.modules[__name__ + ".contrib"] = contrib
+
 from . import random  # noqa: E402,F401
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",

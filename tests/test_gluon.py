@@ -1,0 +1,315 @@
+"""Gluon API contract tests (modeled on reference
+tests/python/unittest/test_gluon.py and test_loss.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_basic():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10, flatten=False))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation="tanh", in_units=256))
+    model.add(nn.Dense(32, in_units=64))
+    model.add(nn.Activation("relu"))
+
+    # symbol-free eager execution
+    model.initialize()
+    x = mx.nd.zeros((32, 2, 10))
+    out = model(x)
+    assert out.shape == (32, 32)
+
+    # params of nested blocks collected
+    params = model.collect_params()
+    assert len(params) == 6  # 3 dense layers x (weight, bias)
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test1_")
+    inputs = mx.sym.Variable("data")
+    outputs = model(inputs)
+    assert set(model.collect_params().keys()) == \
+        {"test1_weight", "test1_bias"}
+    x = mx.nd.array(np.random.rand(17, 2, 10).astype("float32"))
+    model.initialize()
+    assert model(x).shape == (17, 2, 128)
+
+    model2 = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                      prefix="test2_")
+    model2.initialize()
+    x = mx.nd.array(np.random.rand(17, 2, 15).astype("float32"))
+    assert model2(x).shape == (17, 128)
+
+
+def test_dense_deferred_init():
+    model = nn.Dense(8)
+    model.initialize()
+    x = mx.nd.ones((4, 3))
+    out = model(x)
+    assert out.shape == (4, 8)
+    assert model.weight.shape == (8, 3)
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_conv_pool_net(hybridize):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Conv2D(16, kernel_size=3, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    x = mx.nd.array(np.random.randn(2, 3, 16, 16).astype("float32"))
+    out = net(x)
+    assert out.shape == (2, 10)
+
+
+def test_hybrid_eager_consistency():
+    def make():
+        net = nn.HybridSequential(prefix="c_")
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"))
+            net.add(nn.Dense(4))
+        return net
+    net = make()
+    net.initialize()
+    x = mx.nd.array(np.random.randn(3, 7).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_grad_consistency():
+    x = mx.nd.array(np.random.randn(4, 5).astype("float32"))
+    y = mx.nd.array(np.random.randn(4, 2).astype("float32"))
+    loss_fn = gluon.loss.L2Loss()
+
+    grads = []
+    for hyb in (False, True):
+        net = nn.HybridSequential(prefix="g_")
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh", in_units=5))
+            net.add(nn.Dense(2, in_units=8))
+        net.initialize(mx.init.Constant(0.1))
+        if hyb:
+            net.hybridize()
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        grads.append({k: p.grad().asnumpy()
+                      for k, p in net.collect_params().items()})
+    for k in grads[0]:
+        np.testing.assert_allclose(grads[0][k], grads[1][k],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.array(np.random.randn(4, 3, 5, 5).astype("float32"))
+    with autograd.record():
+        bn(x)
+    assert abs(bn.running_mean.data().asnumpy()).sum() > 0
+    # inference mode must use (not update) running stats
+    rm = bn.running_mean.data().asnumpy().copy()
+    bn(x)
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm)
+
+
+def test_trainer_step_converges():
+    # tiny linear regression must converge (reference train-test doctrine)
+    np.random.seed(0)
+    w_true = np.array([[2.0, -3.4]], dtype=np.float32)
+    b_true = 4.2
+    X = np.random.randn(200, 2).astype(np.float32)
+    Y = X.dot(w_true.T) + b_true
+
+    net = nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(100):
+        with autograd.record():
+            loss = loss_fn(net(mx.nd.array(X)), mx.nd.array(Y))
+        loss.backward()
+        trainer.step(X.shape[0])
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    np.testing.assert_allclose(w, w_true, atol=1e-1)
+    np.testing.assert_allclose(b, [b_true], atol=1e-1)
+
+
+def test_losses():
+    pred = mx.nd.array(np.random.randn(4, 5).astype("float32"))
+    label = mx.nd.array(np.random.randn(4, 5).astype("float32"))
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(
+        l1, np.abs(pred.asnumpy() - label.asnumpy()).mean(axis=1),
+        rtol=1e-5)
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(
+        l2, 0.5 * ((pred.asnumpy() - label.asnumpy()) ** 2).mean(axis=1),
+        rtol=1e-5)
+    cls = mx.nd.array(np.array([1, 0, 2, 4], dtype=np.float32))
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()(pred, cls).asnumpy()
+    p = pred.asnumpy()
+    logp = p - p.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    expected = -logp[np.arange(4), cls.asnumpy().astype(int)]
+    np.testing.assert_allclose(sce, expected, rtol=1e-4)
+
+
+def test_bce_loss():
+    pred = mx.nd.array(np.random.randn(4, 3).astype("float32"))
+    label = mx.nd.array((np.random.rand(4, 3) > 0.5).astype("float32"))
+    loss = gluon.loss.SigmoidBinaryCrossEntropyLoss()(pred, label).asnumpy()
+    p = pred.asnumpy()
+    l = label.asnumpy()
+    expected = (np.maximum(p, 0) - p * l +
+                np.log1p(np.exp(-np.abs(p)))).mean(axis=1)
+    np.testing.assert_allclose(loss, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss():
+    # uniform activations over alphabet of 5 (+blank at 0), T=10
+    T, N, C = 10, 2, 6
+    pred = mx.nd.zeros((N, T, C))
+    label = mx.nd.array(np.array([[1, 2, 0, 0], [1, 2, 3, 0]],
+                                 dtype=np.float32))
+    loss = gluon.loss.CTCLoss(layout="NTC")(pred, label)
+    assert loss.shape == (N,)
+    out = loss.asnumpy()
+    assert np.all(np.isfinite(out)) and np.all(out > 0)
+
+
+def test_rnn_cells_and_layers():
+    # fused LSTM vs manual cell unroll consistency
+    np.random.seed(0)
+    T, N, C, H = 4, 2, 3, 5
+    x = mx.nd.array(np.random.randn(T, N, C).astype("float32"))
+
+    lstm = gluon.rnn.LSTM(H, input_size=C)
+    lstm.initialize(mx.init.Xavier())
+    out = lstm(x)
+    assert out.shape == (T, N, H)
+
+    cell = gluon.rnn.LSTMCell(H, input_size=C,
+                              params=None, prefix="c_")
+    # copy fused weights into the cell
+    cell.initialize()
+    cell.i2h_weight.set_data(lstm.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(lstm.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(lstm.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(lstm.l0_h2h_bias.data())
+    xs = mx.nd.swapaxes(x, 0, 1)  # NTC
+    outs, _ = cell.unroll(T, xs, layout="NTC")
+    manual = np.stack([o.asnumpy() for o in outs], axis=0)
+    np.testing.assert_allclose(manual, out.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_rnn_layers():
+    x = mx.nd.array(np.random.randn(4, 2, 3).astype("float32"))
+    for layer, h in ((gluon.rnn.GRU(5), 5),
+                     (gluon.rnn.RNN(5, activation="tanh"), 5)):
+        layer.initialize()
+        assert layer(x).shape == (4, 2, h)
+    bi = gluon.rnn.LSTM(5, num_layers=2, bidirectional=True)
+    bi.initialize()
+    out, states = bi(x, bi.begin_state(2))
+    assert out.shape == (4, 2, 10)
+    assert states[0].shape == (4, 2, 5)
+
+
+def test_block_save_load_params():
+    net = nn.HybridSequential(prefix="sl_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.save_params("/tmp/test_block.params")
+
+    net2 = nn.HybridSequential(prefix="sl_")
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+    net2.load_params("/tmp/test_block.params")
+    x = mx.nd.ones((2, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_data_api():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.random.randn(11, 3).astype("float32")
+    Y = np.arange(11).astype("float32")
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 11
+    dl = DataLoader(ds, batch_size=4, last_batch="keep")
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[2][0].shape == (3, 3)
+    dl = DataLoader(ds, batch_size=4, shuffle=True, last_batch="discard")
+    assert len(list(dl)) == 2
+    # threaded prefetch path
+    dl = DataLoader(ds, batch_size=4, num_workers=2)
+    assert len(list(dl)) == 3
+
+
+def test_vision_datasets():
+    from mxnet_tpu.gluon.data.vision import MNIST, CIFAR10
+    m = MNIST(root="/tmp/mxtpu_mnist")
+    assert m[0][0].shape == (28, 28, 1)
+    c = CIFAR10(root="/tmp/mxtpu_cifar")
+    assert c[0][0].shape == (32, 32, 3)
+
+
+def test_model_zoo_smoke():
+    from mxnet_tpu.gluon.model_zoo import get_model
+    x = mx.nd.array(np.random.randn(1, 3, 32, 32).astype("float32"))
+    net = get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net = get_model("resnet18_v2", classes=10, thumbnail=True)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+
+
+def test_split_and_load():
+    from mxnet_tpu.gluon.utils import split_data, clip_global_norm
+    x = mx.nd.array(np.random.randn(8, 3).astype("float32"))
+    slices = split_data(x, 4)
+    assert len(slices) == 4 and slices[0].shape == (2, 3)
+    arrs = [mx.nd.ones((2, 2)) * 10 for _ in range(2)]
+    norm = clip_global_norm(arrs, 1.0)
+    assert norm > 1.0
+    total = sum((a.asnumpy() ** 2).sum() for a in arrs)
+    np.testing.assert_allclose(np.sqrt(total), 1.0, rtol=1e-4)
