@@ -279,7 +279,9 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        # ** 0.5, not math.sqrt: ShardedTrainer.apply_updates patches
+        # _index_update_count with traced step counts, so t may be a tracer.
+        lr *= coef2 ** 0.5 / coef1
         mean, var = state
         nd._internal.adam_update(weight, grad, mean, var, out=weight, lr=lr,
                                  wd=wd, beta1=self.beta1, beta2=self.beta2,

@@ -1,0 +1,33 @@
+"""Parallelism: device meshes, collectives, sharded training, ring attention.
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack (SURVEY §2.5, §5.8):
+
+- ``mesh``        — `jax.sharding.Mesh` construction/management; replaces
+                    context lists + `DataParallelExecutorGroup` device slicing
+                    (reference ``module/executor_group.py:233-258``).
+- ``collectives`` — named XLA collectives (psum/all_gather/reduce_scatter/
+                    ppermute) over ICI/DCN; replaces ps-lite + Comm
+                    (reference ``src/kvstore/comm.h``, ``kvstore_dist.h``).
+- ``sharded``     — one jitted SPMD train step over a mesh with
+                    data/tensor-parallel shardings; replaces per-device
+                    executor groups + kvstore push/pull
+                    (reference ``model.py:105-140``).
+- ``ring_attention`` — sequence/context parallelism via ppermute rings
+                    (beyond the reference, which only had bucketing;
+                    SURVEY §5.7).
+"""
+from .mesh import make_mesh, auto_mesh, factor_devices, current_mesh, using_mesh
+from .collectives import (psum, pmean, pmax, all_gather, reduce_scatter,
+                          ppermute_shift, all_to_all, axis_index, axis_size,
+                          barrier, host_allreduce)
+from .sharded import ShardedTrainer, block_pure_fn, sharded_data
+from .ring_attention import ring_attention, local_attention
+
+__all__ = [
+    "make_mesh", "auto_mesh", "factor_devices", "current_mesh", "using_mesh",
+    "psum", "pmean", "pmax", "all_gather", "reduce_scatter", "ppermute_shift",
+    "all_to_all", "axis_index", "axis_size", "barrier", "host_allreduce",
+    "ShardedTrainer", "block_pure_fn", "sharded_data",
+    "ring_attention", "local_attention",
+]

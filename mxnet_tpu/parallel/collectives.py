@@ -1,0 +1,97 @@
+"""Named collectives over the device mesh.
+
+This module replaces the reference's entire communication backend
+(SURVEY §5.8): ps-lite ZPush/ZPull RPC (``src/kvstore/kvstore_dist.h:253-313``)
+and the Comm reduce/broadcast trees (``src/kvstore/comm.h:90-560``) become
+XLA collectives compiled into the program — riding ICI within a slice and
+DCN across slices, with no parameter-server round-trip.
+
+Two levels:
+- *in-program* wrappers (``psum`` …) used inside ``shard_map``/``pjit``-traced
+  code, thin over ``jax.lax`` so user code reads like the scaling-book recipe;
+- *host-level* helpers (``host_allreduce``, ``barrier``) used by the KVStore
+  facade and multi-host setup.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["psum", "pmean", "pmax", "all_gather", "reduce_scatter",
+           "ppermute_shift", "all_to_all", "axis_index", "axis_size",
+           "barrier", "host_allreduce"]
+
+
+def psum(x, axis_name):
+    """All-reduce sum over a mesh axis (replaces Comm::Reduce+Broadcast)."""
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    """Gather shards along ``axis`` from every device on the mesh axis."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    """Sum-reduce then scatter shards along ``axis`` (psum_scatter)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute_shift(x, axis_name, shift=1):
+    """Rotate shards around the ring by ``shift`` (the ring-attention and
+    pipeline primitive). Positive shift sends to the next-higher index."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis):
+    """All-to-all (the Ulysses/DeepSpeed sequence-parallel primitive)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def barrier(name="barrier"):
+    """Cross-host barrier (reference ``KVStore::Barrier``, kvstore.h:339).
+
+    Single-process: no-op.  Multi-host: sync over all global devices.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def host_allreduce(arrays):
+    """Sum a list of per-device host arrays into one (kvstore local reduce).
+
+    The reference staged through pinned CPU memory with an OMP tree-reduce
+    (comm.h:301-436); here the arrays are summed by one fused XLA program
+    on the first array's device.
+    """
+    if len(arrays) == 1:
+        return arrays[0]
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + jax.device_put(a, out.devices().pop())
+    return out
+
+
+def _tree_psum(tree, axis_name):
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree)

@@ -1,0 +1,105 @@
+"""Device-mesh construction and management.
+
+The reference expresses multi-device placement as a context list handed to
+``Module``/``DataParallelExecutorGroup`` (reference ``module/module.py:39``,
+``executor_group.py:233``).  TPU-native, placement is a ``jax.sharding.Mesh``
+with named axes; data parallelism shards the batch over ``"data"``, tensor
+parallelism shards weights over ``"model"``, sequence parallelism shards the
+sequence over ``"seq"``.  Collectives ride ICI within a slice and DCN across
+slices — axis order puts the fastest-varying (innermost) axis on the
+best-connected devices.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "auto_mesh", "factor_devices", "current_mesh",
+           "using_mesh"]
+
+_tls = threading.local()
+
+
+def factor_devices(n, num_axes):
+    """Factor ``n`` devices into ``num_axes`` near-balanced mesh dims.
+
+    Largest factors go first (outermost); e.g. 8 devices, 3 axes →
+    (2, 2, 2); 8 devices, 2 axes → (4, 2); 6, 2 → (3, 2).
+    """
+    dims = []
+    remaining = n
+    for i in range(num_axes - 1, 0, -1):
+        # greedily peel the smallest factor > 1 for the innermost axes
+        target = max(2, int(round(remaining ** (1.0 / (i + 1)))))
+        f = 1
+        for cand in range(target, 1, -1):
+            if remaining % cand == 0:
+                f = cand
+                break
+        if f == 1:
+            for cand in range(target + 1, remaining + 1):
+                if remaining % cand == 0:
+                    f = cand
+                    break
+        dims.append(f)
+        remaining //= f
+    dims.append(remaining)
+    return tuple(sorted(dims, reverse=True))
+
+
+def make_mesh(axis_shapes, devices=None):
+    """Create a ``Mesh`` from ``{axis_name: size}`` (insertion-ordered).
+
+    ``-1`` for at most one axis means "all remaining devices".
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = list(axis_shapes.keys())
+    sizes = list(axis_shapes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(
+                "cannot infer -1 axis: %d devices not divisible by %d"
+                % (n, known))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError("mesh %s needs %d devices, only %d available"
+                         % (dict(zip(names, sizes)), total, n))
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def auto_mesh(axis_names=("data",), n_devices=None, devices=None):
+    """Mesh over all (or ``n_devices``) devices, balanced across axes."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    dims = factor_devices(len(devices), len(axis_names))
+    return make_mesh(dict(zip(axis_names, dims)), devices)
+
+
+def current_mesh():
+    """The innermost active mesh (from ``using_mesh``), or None."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def using_mesh(mesh):
+    """Activate ``mesh`` for the enclosed scope (and as jax's global mesh)."""
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    _tls.stack.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _tls.stack.pop()

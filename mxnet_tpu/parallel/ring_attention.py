@@ -1,0 +1,116 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+The reference (2017) predates attention entirely — its long-sequence story
+was bucketing (SURVEY §5.7).  The TPU build makes long-context first-class:
+the sequence axis is sharded over a mesh axis, each device holds a local
+block of Q/K/V, and K/V blocks rotate around the ring via ``lax.ppermute``
+while an online-softmax accumulator (flash-attention numerics) combines
+partial results.  Communication overlaps compute and rides ICI; memory per
+device is O(S/n · S/n) per block instead of O(S²).
+
+Use inside ``jax.shard_map`` over a mesh with the sequence axis bound to
+``axis_name``.  ``local_attention`` is the single-device exact reference
+(also the per-block kernel).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "local_attention"]
+
+_NEG = -1e30  # large-negative mask; avoids -inf NaN edge cases in exp
+
+
+def local_attention(q, k, v, causal=False, sm_scale=None,
+                    q_offset=0, k_offset=0):
+    """Exact softmax attention on local blocks.
+
+    q: [B, H, Sq, D]; k, v: [B, H, Sk, D].  ``q_offset``/``k_offset`` are
+    the absolute sequence positions of the first row of each block (used
+    for causal masking when blocks are shards of a longer sequence).
+    """
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])
+        k_pos = k_offset + jnp.arange(k.shape[2])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+    """Ring attention over a sequence-sharded mesh axis.
+
+    q, k, v: local shards [B, H, S_local, D]; the global sequence length is
+    S_local * axis_size.  Must be called inside ``shard_map`` (or pmap) with
+    ``axis_name`` bound.  Returns the local output shard [B, H, S_local, D].
+
+    Algorithm: N = axis_size steps; at step t each device holds the K/V
+    block that originated on device (idx - t) mod N, computes its partial
+    attention with online-softmax rescaling, then rotates K/V to the next
+    device (ppermute).  Exact — matches ``local_attention`` on the gathered
+    sequence to float tolerance.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+
+    def _vary(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+    acc = _vary(jnp.zeros((b, h, s_loc, d), dtype=jnp.float32))
+    m = _vary(jnp.full((b, h, s_loc), _NEG, dtype=jnp.float32))
+    l = _vary(jnp.zeros((b, h, s_loc), dtype=jnp.float32))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        acc, m, l, kb, vb = carry
+        src = (idx - t) % n                      # origin shard of this block
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       kb.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, _NEG)
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m[..., None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)          # kill fully-masked rows
+        new_l = l * corr + jnp.sum(p, axis=-1)
+        new_acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (new_acc, new_m, new_l, kb, vb), None
+
+    (acc, m, l, _, _), _ = lax.scan(step, (acc, m, l, k, v),
+                                    jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="seq", causal=False,
+                           sm_scale=None):
+    """Convenience wrapper: shard_map ring_attention over ``mesh``.
+
+    q, k, v: global arrays [B, H, S, D]; the sequence dim is sharded over
+    ``axis_name``, everything else replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
