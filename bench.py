@@ -1,0 +1,54 @@
+"""Headline benchmark: ResNet-50 inference throughput (img/s), batch 32.
+
+Baseline (BASELINE.md / reference example/image-classification/README.md:
+149-155): 109 img/s on 1x K80 at batch 32.  Prints ONE JSON line.
+
+Compute runs in bfloat16 (the MXU design point); the driver executes this
+on the real TPU chip.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+BATCH = 32
+BASELINE_IMG_S = 109.0
+
+
+def main():
+    import mxnet_tpu as mx
+    from __graft_entry__ import _build_flagship
+
+    dev = (mx.tpu() if mx.context.num_tpus() else mx.cpu()).jax_device
+    forward, params, aux, _ = _build_flagship(
+        batch=BATCH, dtype=jnp.bfloat16, device=dev)
+    fwd = jax.jit(forward)
+
+    rng = np.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(rng.randn(BATCH, 3, 224, 224),
+                                   jnp.bfloat16), dev)
+
+    # warmup + compile
+    jax.block_until_ready(fwd(params, aux, x))
+    jax.block_until_ready(fwd(params, aux, x))
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(params, aux, x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_infer_bs32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

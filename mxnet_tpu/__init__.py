@@ -49,3 +49,6 @@ from .model import FeedForward
 from . import recordio
 from . import image
 from . import gluon
+from . import parallel
+# models and test_utils are opt-in imports (mxnet_tpu.models /
+# mxnet_tpu.test_utils), keeping `import mxnet_tpu` lean like the reference.

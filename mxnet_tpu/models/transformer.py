@@ -1,0 +1,177 @@
+"""Transformer LM with 3D (data × sequence × tensor) parallelism.
+
+The reference framework predates attention (SURVEY §5.7 — its long-sequence
+answer was bucketing); this model is the TPU-native long-context flagship:
+
+- **data parallel**: batch sharded over the ``data`` mesh axis; gradient
+  all-reduce inserted by XLA (replaces kvstore push/pull, SURVEY §2.5).
+- **tensor parallel**: attention heads and MLP hidden sharded over
+  ``model``; the pair of matmuls per block keeps one all-reduce per
+  sub-layer (Megatron layout), compiled to ICI collectives.
+- **sequence parallel**: activations sharded over ``seq``; exact attention
+  across shards via the ring-attention ppermute pipeline
+  (``parallel/ring_attention.py``) inside a ``shard_map`` island.
+
+Everything else is plain ``jit`` + ``NamedSharding`` annotations: pick a
+mesh, annotate, let XLA insert collectives (the scaling-book recipe).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.ring_attention import ring_attention
+
+__all__ = ["TransformerLMConfig", "init_transformer_params",
+           "transformer_forward", "make_train_step"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerLMConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    d_ff: int = 256
+    n_layers: int = 2
+    max_len: int = 128
+    dtype: object = jnp.float32
+
+
+def _param_specs(cfg):
+    """name -> (shape, PartitionSpec). Megatron TP layout over 'model'."""
+    hd = cfg.d_model // cfg.n_heads
+    specs = {
+        "embed": ((cfg.vocab, cfg.d_model), P(None, None)),
+        "pos_embed": ((cfg.max_len, cfg.d_model), P(None, None)),
+        "out_norm_scale": ((cfg.d_model,), P(None)),
+        "out_proj": ((cfg.d_model, cfg.vocab), P(None, None)),
+    }
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        specs.update({
+            # QKV/out projections: head dim sharded over 'model'
+            pre + "wq": ((cfg.d_model, cfg.n_heads, hd), P(None, "model", None)),
+            pre + "wk": ((cfg.d_model, cfg.n_heads, hd), P(None, "model", None)),
+            pre + "wv": ((cfg.d_model, cfg.n_heads, hd), P(None, "model", None)),
+            pre + "wo": ((cfg.n_heads, hd, cfg.d_model), P("model", None, None)),
+            # MLP: hidden sharded over 'model' (col- then row-parallel)
+            pre + "w1": ((cfg.d_model, cfg.d_ff), P(None, "model")),
+            pre + "b1": ((cfg.d_ff,), P("model")),
+            pre + "w2": ((cfg.d_ff, cfg.d_model), P("model", None)),
+            pre + "norm1_scale": ((cfg.d_model,), P(None)),
+            pre + "norm2_scale": ((cfg.d_model,), P(None)),
+        })
+    return specs
+
+
+def _filter_spec(spec, mesh):
+    """Drop axis names the mesh doesn't have (lets one model definition run
+    on dp-only, dp+tp, or dp+tp+sp meshes)."""
+    if mesh is None:
+        return spec
+    return P(*[a if a in mesh.axis_names else None for a in spec])
+
+
+def init_transformer_params(key, cfg, mesh=None):
+    """Initialize params; placed with TP shardings when a mesh is given."""
+    specs = _param_specs(cfg)
+    params = {}
+    for name, (shape, spec) in sorted(specs.items()):
+        spec = _filter_spec(spec, mesh)
+        key, sub = jax.random.split(key)
+        if name.endswith(("_scale",)):
+            v = jnp.ones(shape, cfg.dtype)
+        elif name.endswith(("b1",)):
+            v = jnp.zeros(shape, cfg.dtype)
+        else:
+            # fan-in = the contracted dims: leading axis for wq/wk/wv/w1/w2
+            # (they contract shape[0]), all-but-last for wo (contracts h,k)
+            if name.endswith("wo"):
+                fan_in = int(np.prod(shape[:-1]))
+            else:
+                fan_in = shape[0]
+            v = (jax.random.normal(sub, shape, cfg.dtype)
+                 * (1.0 / math.sqrt(max(fan_in, 1))))
+        if mesh is not None:
+            v = jax.device_put(v, NamedSharding(mesh, spec))
+        params[name] = v
+    return params
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def transformer_forward(params, tokens, cfg, mesh=None, seq_axis="seq"):
+    """Causal LM forward: tokens [B, S] int32 -> logits [B, S, vocab].
+
+    With a mesh, attention runs as a shard_map ring over ``seq_axis`` and
+    activations carry (data, seq, -) shardings; without one it is plain
+    single-device jax (used by tests and the single-chip entry).
+    """
+    b, s = tokens.shape
+    x = params["embed"][tokens] + params["pos_embed"][:s][None, :, :]
+    use_ring = mesh is not None and mesh.shape.get(seq_axis, 1) > 1
+
+    if use_ring:
+        qkv_spec = _filter_spec(P("data", "model", seq_axis, None), mesh)
+        attn = jax.shard_map(
+            functools.partial(ring_attention, axis_name=seq_axis,
+                              causal=True),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec)
+    else:
+        attn = functools.partial(_causal_attn_local,)
+
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _rmsnorm(x, params[pre + "norm1_scale"])
+        q = jnp.einsum("bsd,dhk->bhsk", h, params[pre + "wq"])
+        k = jnp.einsum("bsd,dhk->bhsk", h, params[pre + "wk"])
+        v = jnp.einsum("bsd,dhk->bhsk", h, params[pre + "wv"])
+        o = attn(q, k, v)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o, params[pre + "wo"])
+        h = _rmsnorm(x, params[pre + "norm2_scale"])
+        h = jax.nn.gelu(h @ params[pre + "w1"] + params[pre + "b1"])
+        x = x + h @ params[pre + "w2"]
+
+    x = _rmsnorm(x, params["out_norm_scale"])
+    return x @ params["out_proj"]
+
+
+def _causal_attn_local(q, k, v):
+    from ..parallel.ring_attention import local_attention
+    return local_attention(q, k, v, causal=True)
+
+
+def make_train_step(cfg, mesh, lr=0.1, seq_axis="seq"):
+    """Build the jitted SPMD train step: (params, tokens, labels) ->
+    (new_params, loss).  Batch is sharded P('data', seq_axis); gradient
+    reduction, TP collectives and the loss mean are all XLA-inserted."""
+
+    def loss_of(params, tokens, labels):
+        logits = transformer_forward(params, tokens, cfg, mesh, seq_axis)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def step(params, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_of)(params, tokens, labels)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return new_params, loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def place_batch(tokens, labels, mesh, seq_axis="seq"):
+    """Shard a [B, S] token batch over (data, seq)."""
+    spec = NamedSharding(mesh, _filter_spec(P("data", seq_axis), mesh))
+    return (jax.device_put(tokens, spec), jax.device_put(labels, spec))

@@ -65,8 +65,13 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     q_pos = idx * s_loc + jnp.arange(s_loc)
 
+    # scan carries must be device-varying over every mesh axis the inputs
+    # vary on (not just the ring axis), or the carry types won't match
+    vary_axes = tuple(jax.typeof(q).vma | jax.typeof(k).vma |
+                      jax.typeof(v).vma | {axis_name})
+
     def _vary(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return lax.pcast(x, vary_axes, to="varying")
     acc = _vary(jnp.zeros((b, h, s_loc, d), dtype=jnp.float32))
     m = _vary(jnp.full((b, h, s_loc), _NEG, dtype=jnp.float32))
     l = _vary(jnp.zeros((b, h, s_loc), dtype=jnp.float32))

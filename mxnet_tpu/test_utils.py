@@ -1,0 +1,386 @@
+"""Testing framework (reference ``python/mxnet/test_utils.py``, 1540 LoC).
+
+The de-facto test harness of the reference (SURVEY §4): numeric-gradient
+checking as the universal op-correctness oracle, symbolic forward/backward
+vs numpy references, cross-context consistency, sparse random generators,
+and dtype-scaled tolerances.  Ported TPU-native: contexts resolve to jax
+devices; ``check_consistency`` compares eager vs jit (the analogue of the
+reference's CPU↔GPU comparison) and cpu↔accelerator when one is attached.
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol as sym
+from .symbol import Symbol
+from . import autograd
+
+__all__ = ["default_context", "set_default_context", "default_dtype",
+           "assert_almost_equal", "almost_equal", "same", "rand_shape_nd",
+           "rand_shape_2d", "rand_shape_3d", "rand_ndarray", "rand_sparse_ndarray",
+           "random_arrays", "check_numeric_gradient", "check_symbolic_forward",
+           "check_symbolic_backward", "check_consistency", "check_speed",
+           "numeric_grad", "simple_forward", "retry"]
+
+_default_ctx = None
+
+
+def default_context():
+    """Current default test context (reference common.py:50)."""
+    return _default_ctx or current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def _dtype_tol(dtype):
+    dtype = np.dtype(dtype)
+    if dtype == np.float16:
+        return 1e-1, 1e-2
+    if dtype == np.float32:
+        return 1e-3, 1e-4
+    return 1e-5, 1e-7
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = np.asarray(a), np.asarray(b)
+    rtol = rtol if rtol is not None else _dtype_tol(a.dtype)[0]
+    atol = atol if atol is not None else _dtype_tol(a.dtype)[1]
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    """Assert closeness with dtype-scaled tolerances
+    (reference test_utils.py:467)."""
+    if isinstance(a, NDArray):
+        a = a.asnumpy()
+    if isinstance(b, NDArray):
+        b = b.asnumpy()
+    a, b = np.asarray(a), np.asarray(b)
+    rtol = rtol if rtol is not None else _dtype_tol(a.dtype)[0]
+    atol = atol if atol is not None else _dtype_tol(a.dtype)[1]
+    if np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True):
+        return
+    a, b = np.broadcast_arrays(a, b)  # so the error index is valid
+    index = np.unravel_index(
+        np.argmax(np.abs(a - b)), a.shape) if a.shape else ()
+    rel = np.max(np.abs(a - b) / (np.abs(b) + atol))
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%f, atol=%f. Location of maximum "
+        "error: %s, %s=%r, %s=%r"
+        % (rel, rtol, atol, str(index), names[0],
+           a[index] if a.shape else a, names[1], b[index] if b.shape else b))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def random_arrays(*shapes):
+    """Random numpy float32 arrays (reference test_utils.py)."""
+    arrays = [np.random.randn(*s).astype(np.float32) if s else
+              np.float32(np.random.randn()) for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None):
+    """Random dense/sparse NDArray (reference rand_ndarray/rand_sparse)."""
+    if stype == "default":
+        return nd.array(np.random.uniform(-1, 1, shape), ctx=ctx,
+                        dtype=dtype or np.float32)
+    arr, _ = rand_sparse_ndarray(shape, stype, density=density, dtype=dtype)
+    return arr
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        data_init=None, rsp_indices=None):
+    """Random sparse NDArray + dense numpy twin
+    (reference test_utils.py:254)."""
+    from .ndarray import sparse as sp
+    density = 0.5 if density is None else density
+    dtype = dtype or np.float32
+    if stype == "row_sparse":
+        num_rows = shape[0]
+        if rsp_indices is not None:
+            indices = np.asarray(rsp_indices)
+        else:
+            idx_mask = np.random.rand(num_rows) < density
+            indices = np.nonzero(idx_mask)[0]
+        dense = np.zeros(shape, dtype=dtype)
+        if len(indices):
+            vals = np.random.uniform(-1, 1, (len(indices),) + shape[1:])
+            if data_init is not None:
+                vals[:] = data_init
+            dense[indices] = vals
+        arr = sp.row_sparse_array(
+            (dense[indices], indices), shape=shape, dtype=dtype) \
+            if len(indices) else sp.zeros("row_sparse", shape, dtype=dtype)
+        return arr, dense
+    if stype == "csr":
+        dense = np.random.uniform(0, 1, shape).astype(dtype)
+        dense[np.random.rand(*shape) >= density] = 0
+        arr = sp.csr_matrix(dense, shape=shape, dtype=dtype)
+        return arr, dense
+    raise ValueError("unknown stype %s" % stype)
+
+
+def numeric_grad(f, xs, eps=1e-4):
+    """Central-difference gradients of scalar f wrt list of numpy arrays.
+
+    Uses ``.flat`` indexing (valid for any memory layout — ``reshape(-1)``
+    would silently copy non-contiguous arrays and lose the perturbation).
+    """
+    grads = []
+    for i, x in enumerate(xs):
+        g = np.zeros_like(x, dtype=np.float64)
+        for j in range(x.size):
+            orig = x.flat[j]
+            x.flat[j] = orig + eps
+            fp = f(xs)
+            x.flat[j] = orig - eps
+            fm = f(xs)
+            x.flat[j] = orig
+            g.flat[j] = (fp - fm) / (2 * eps)
+        grads.append(g)
+    return grads
+
+
+def check_numeric_gradient(sym_or_fn, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, ctx=None):
+    """Finite-difference vs autograd — the universal op-correctness oracle
+    (reference test_utils.py:789).
+
+    ``sym_or_fn``: a Symbol (single output; reduced by sum to a scalar) or a
+    callable taking NDArrays and returning an NDArray.
+    ``location``: list or dict of input numpy arrays.
+    """
+    if isinstance(location, (list, tuple)):
+        loc_arrays = [np.ascontiguousarray(a, dtype=np.float64)
+                      for a in location]
+        names = None
+    else:
+        names = list(location.keys())
+        loc_arrays = [np.ascontiguousarray(location[k], dtype=np.float64)
+                      for k in names]
+
+    is_symbol = isinstance(sym_or_fn, Symbol)
+    if is_symbol and names is None:
+        names = sym_or_fn.list_arguments()
+
+    if grad_nodes is None:
+        grad_idx = list(range(len(loc_arrays)))
+    elif names is not None:
+        grad_idx = [names.index(g) for g in grad_nodes]
+    else:
+        raise ValueError(
+            "grad_nodes requires named inputs: pass location as a dict "
+            "(or a Symbol, whose argument names are used)")
+
+    if is_symbol:
+        # symbolic path: grads come from the executor's compiled backward
+        # (the eager tape does not see inside Executor.forward)
+        args = {k: nd.array(a.astype(np.float32))
+                for k, a in zip(names, loc_arrays)}
+        grad_dict = {names[i]: nd.zeros(loc_arrays[i].shape,
+                                        dtype=np.float32)
+                     for i in grad_idx}
+        aux = {k: nd.array(v) for k, v in (aux_states or {}).items()}
+        ex = sym_or_fn.bind(ctx or default_context(), args,
+                            args_grad=grad_dict, grad_req="write",
+                            aux_states=aux)
+        outs = ex.forward(is_train=True)
+        ex.backward([nd.ones_like(o) for o in outs])
+        sym_grads = [grad_dict[names[i]].asnumpy() for i in grad_idx]
+
+        def scalar_f(xs):
+            a = {k: nd.array(x.astype(np.float32))
+                 for k, x in zip(names, xs)}
+            e = sym_or_fn.bind(ctx or default_context(), a,
+                               grad_req="null", aux_states=aux)
+            return float(sum(o.sum().asnumpy()
+                             for o in e.forward(is_train=True)))
+    else:
+        fn = sym_or_fn
+        # autograd gradients via the eager tape
+        inputs = [nd.array(a.astype(np.float32)) for a in loc_arrays]
+        grads = [nd.zeros(a.shape, dtype=np.float32) for a in loc_arrays]
+        for i in grad_idx:
+            autograd.mark_variables([inputs[i]], [grads[i]])
+        with autograd.record():
+            out = fn(*inputs)
+            loss = out.sum() if np.prod(out.shape) > 1 else out
+        loss.backward()
+        sym_grads = [grads[i].asnumpy() for i in grad_idx]
+
+        def scalar_f(xs):
+            ins = [nd.array(x.astype(np.float32)) for x in xs]
+            o = fn(*ins)
+            return float(o.sum().asnumpy() if np.prod(o.shape) > 1
+                         else o.asnumpy())
+
+    num_grads_all = numeric_grad(scalar_f, loc_arrays, eps=numeric_eps)
+    num_grads = [num_grads_all[i] for i in grad_idx]
+
+    for i, (sg, ng) in enumerate(zip(sym_grads, num_grads)):
+        assert_almost_equal(sg, ng, rtol=rtol,
+                            atol=atol if atol is not None else rtol * 1e-1,
+                            names=("autograd[%d]" % i, "numeric[%d]" % i))
+
+
+def _eval_symbol(symbol, arg_dict, aux_states=None):
+    args = {k: (v if isinstance(v, NDArray) else nd.array(v))
+            for k, v in arg_dict.items()}
+    aux = {k: (v if isinstance(v, NDArray) else nd.array(v))
+           for k, v in (aux_states or {}).items()}
+    ex = symbol.bind(cpu(), args, grad_req="null", aux_states=aux)
+    outs = ex.forward(is_train=False)
+    return outs[0]
+
+
+def check_symbolic_forward(symbol, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None):
+    """Forward outputs vs numpy expectations (reference :921)."""
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(symbol.list_arguments(), location))
+    args = {k: nd.array(v) for k, v in location.items()}
+    aux = {k: nd.array(v) for k, v in (aux_states or {}).items()}
+    ex = symbol.bind(ctx or default_context(), args, grad_req="null",
+                     aux_states=aux)
+    outputs = ex.forward(is_train=False)
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out.asnumpy(), exp, rtol=rtol, atol=atol)
+    return outputs
+
+
+def check_symbolic_backward(symbol, location, out_grads, expected,
+                            rtol=1e-4, atol=None, grad_req="write",
+                            aux_states=None, ctx=None):
+    """Backward grads vs numpy expectations (reference :995)."""
+    arg_names = symbol.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    args = {k: nd.array(v) for k, v in location.items()}
+    grad_dict = {k: nd.zeros(np.asarray(v).shape)
+                 for k, v in location.items()}
+    aux = {k: nd.array(v) for k, v in (aux_states or {}).items()}
+    ex = symbol.bind(ctx or default_context(), args, args_grad=grad_dict,
+                     grad_req=grad_req, aux_states=aux)
+    ex.forward(is_train=True)
+    ex.backward([nd.array(g) for g in out_grads] if
+                isinstance(out_grads, (list, tuple)) else
+                [nd.array(out_grads)])
+    for name, exp in expected.items():
+        assert_almost_equal(grad_dict[name].asnumpy(), exp, rtol=rtol,
+                            atol=atol, names=(name + "_grad", "expected"))
+    return grad_dict
+
+
+def check_consistency(sym, ctx_list=None, location=None, scale=1.0,
+                      rtol=1e-3, atol=1e-4):
+    """Run the same symbol eagerly-bound on multiple contexts and
+    cross-compare outputs (reference :1203; the CPU↔GPU matrix becomes
+    cpu↔accelerator and jit↔eager on TPU builds)."""
+    from .context import num_tpus, tpu
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        if num_tpus():
+            ctx_list.append(tpu())
+    arg_names = sym.list_arguments()
+    shapes = location if location is not None else None
+    assert shapes is not None, "provide location={name: ndarray-or-shape}"
+    args0 = {}
+    for k, v in shapes.items():
+        v = np.asarray(v)
+        args0[k] = (np.random.uniform(-scale, scale, v).astype(np.float32)
+                    if v.ndim == 1 and v.dtype.kind == "i" else
+                    v.astype(np.float32))
+    outs = []
+    for ctx in ctx_list:
+        args = {k: nd.array(v, ctx=ctx) for k, v in args0.items()}
+        ex = sym.bind(ctx, args, grad_req="null")
+        outs.append([o.asnumpy() for o in ex.forward(is_train=False)])
+    ref = outs[0]
+    for other, ctx in zip(outs[1:], ctx_list[1:]):
+        for a, b in zip(ref, other):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                                names=(str(ctx_list[0]), str(ctx)))
+    return outs
+
+
+def check_speed(sym_or_fn, location=None, ctx=None, n=20, typ="whole"):
+    """Time forward passes (reference :1129)."""
+    ctx = ctx or default_context()
+    if isinstance(sym_or_fn, Symbol):
+        args = {k: nd.array(v, ctx=ctx) for k, v in (location or {}).items()}
+        ex = sym_or_fn.bind(ctx, args, grad_req="null")
+        ex.forward()
+        [o.wait_to_read() for o in ex.outputs]
+        t0 = time.time()
+        for _ in range(n):
+            outs = ex.forward()
+        [o.wait_to_read() for o in outs]
+        return (time.time() - t0) / n
+    fn = sym_or_fn
+    fn()
+    t0 = time.time()
+    for _ in range(n):
+        out = fn()
+    if isinstance(out, NDArray):
+        out.wait_to_read()
+    return (time.time() - t0) / n
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Feed numpy kwargs, return numpy outputs (reference :569)."""
+    ctx = ctx or default_context()
+    args = {k: nd.array(v, ctx=ctx) for k, v in inputs.items()}
+    ex = sym.bind(ctx, args, grad_req="null")
+    outputs = [o.asnumpy() for o in ex.forward(is_train=is_train)]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def retry(n):
+    """Retry-flaky decorator (reference :550)."""
+    assert n > 0
+
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError as e:
+                    if i == n - 1:
+                        raise e
+                    np.random.seed(np.random.randint(0, 100000))
+        return wrapper
+    return decorate

@@ -1,0 +1,48 @@
+"""Transformer LM (models/) tests: sharded-vs-unsharded equivalence and
+training sanity on the virtual 8-device mesh."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu  # noqa: F401  (configures platform via conftest)
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.models.transformer import (
+    TransformerLMConfig, init_transformer_params, transformer_forward,
+    make_train_step, place_batch)
+
+
+def _data(cfg, b, s, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab, (b, s)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab, (b, s)), jnp.int32)
+    return tokens, labels
+
+
+def test_forward_sharded_matches_unsharded():
+    cfg = TransformerLMConfig(vocab=32, d_model=16, n_heads=4, d_ff=32,
+                              n_layers=2, max_len=16)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    tokens, _ = _data(cfg, 4, 16)
+    ref = transformer_forward(params, tokens, cfg)  # single device
+
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    out = jax.jit(lambda p, t: transformer_forward(p, t, cfg, mesh))(
+        params, tokens)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+
+
+def test_train_step_loss_decreases():
+    cfg = TransformerLMConfig(vocab=32, d_model=16, n_heads=4, d_ff=32,
+                              n_layers=2, max_len=16)
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg, mesh)
+    tokens, labels = _data(cfg, 8, 16)
+    tokens, labels = place_batch(tokens, labels, mesh)
+    step = make_train_step(cfg, mesh, lr=0.5)
+    losses = []
+    for _ in range(20):
+        params, loss = step(params, tokens, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses[::5]
+    assert np.isfinite(losses[-1])

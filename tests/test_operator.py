@@ -1,0 +1,232 @@
+"""Operator correctness via the numeric-gradient oracle + numpy references
+(reference tests/python/unittest/test_operator.py doctrine, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward, rand_ndarray)
+
+
+# ---- elementwise unary: forward vs numpy + numeric gradient ---------------
+UNARY_CASES = [
+    ("relu", lambda x: np.maximum(x, 0), (-2, 2)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), (-4, 4)),
+    ("tanh", np.tanh, (-2, 2)),
+    ("exp", np.exp, (-2, 2)),
+    ("log", np.log, (0.1, 4)),
+    ("sqrt", np.sqrt, (0.1, 4)),
+    ("square", np.square, (-2, 2)),
+    ("abs", np.abs, (0.3, 2)),
+    ("sin", np.sin, (-3, 3)),
+    ("cos", np.cos, (-3, 3)),
+    ("arctan", np.arctan, (-2, 2)),
+    ("cbrt", np.cbrt, (0.1, 4)),
+    ("log1p", np.log1p, (-0.5, 3)),
+    ("expm1", np.expm1, (-2, 2)),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), (0.5, 4)),
+    ("reciprocal", lambda x: 1 / x, (0.5, 4)),
+]
+
+
+@pytest.mark.parametrize("name,ref,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_forward_and_grad(name, ref, rng):
+    x = np.random.uniform(rng[0], rng[1], (3, 4)).astype(np.float32)
+    fn = getattr(nd, name)
+    out = fn(nd.array(x)).asnumpy()
+    assert_almost_equal(out, ref(x).astype(np.float32), rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(lambda a: fn(a), [x], rtol=5e-2)
+
+
+# ---- binary broadcast ------------------------------------------------------
+BIN_CASES = [
+    ("broadcast_add", np.add),
+    ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply),
+    ("broadcast_div", np.divide),
+    ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_power", np.power),
+]
+
+
+@pytest.mark.parametrize("name,ref", BIN_CASES, ids=[c[0] for c in BIN_CASES])
+def test_binary_broadcast(name, ref):
+    a = np.random.uniform(0.5, 2, (2, 1, 4)).astype(np.float32)
+    b = np.random.uniform(0.5, 2, (1, 3, 4)).astype(np.float32)
+    fn = getattr(nd, name)
+    assert_almost_equal(fn(nd.array(a), nd.array(b)).asnumpy(), ref(a, b),
+                        rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(lambda x, y: fn(x, y), [a, b], rtol=5e-2)
+
+
+# ---- reductions ------------------------------------------------------------
+def test_reductions():
+    x = np.random.uniform(-2, 2, (3, 4, 5)).astype(np.float32)
+    for name, ref in [("sum", np.sum), ("mean", np.mean),
+                      ("max", np.max), ("min", np.min),
+                      ("prod", np.prod)]:
+        fn = getattr(nd, name)
+        assert_almost_equal(fn(nd.array(x)).asnumpy(), ref(x), rtol=1e-3)
+        assert_almost_equal(fn(nd.array(x), axis=1).asnumpy(),
+                            ref(x, axis=1), rtol=1e-3)
+    check_numeric_gradient(lambda a: nd.sum(a, axis=1), [x], rtol=5e-2)
+    assert_almost_equal(nd.argmax(nd.array(x), axis=1).asnumpy(),
+                        np.argmax(x, axis=1))
+    assert_almost_equal(nd.argmin(nd.array(x), axis=2).asnumpy(),
+                        np.argmin(x, axis=2))
+
+
+# ---- matrix / indexing -----------------------------------------------------
+def test_dot_and_batch_dot():
+    a = np.random.randn(4, 5).astype(np.float32)
+    b = np.random.randn(5, 3).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)).asnumpy(), a @ b,
+                        rtol=1e-4)
+    check_numeric_gradient(lambda x, y: nd.dot(x, y), [a, b], rtol=5e-2)
+    ba = np.random.randn(2, 4, 5).astype(np.float32)
+    bb = np.random.randn(2, 5, 3).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(ba), nd.array(bb)).asnumpy(),
+                        ba @ bb, rtol=1e-4)
+
+
+def test_transpose_reshape_slice():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    assert_almost_equal(nd.transpose(nd.array(x)).asnumpy(), x.T)
+    assert_almost_equal(
+        nd.transpose(nd.array(x), axes=(1, 0, 2)).asnumpy(),
+        x.transpose(1, 0, 2))
+    assert_almost_equal(nd.reshape(nd.array(x), shape=(4, 6)).asnumpy(),
+                        x.reshape(4, 6))
+    assert_almost_equal(
+        nd.slice_axis(nd.array(x), axis=1, begin=1, end=3).asnumpy(),
+        x[:, 1:3])
+    assert_almost_equal(nd.flip(nd.array(x), axis=1).asnumpy(),
+                        x[:, ::-1])
+
+
+def test_take_one_hot_pick_where():
+    x = np.random.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 3, 1], dtype=np.float32)
+    assert_almost_equal(nd.take(nd.array(x), nd.array(idx)).asnumpy(),
+                        x[idx.astype(int)])
+    oh = nd.one_hot(nd.array(idx), depth=5).asnumpy()
+    assert_almost_equal(oh, np.eye(5, dtype=np.float32)[idx.astype(int)])
+    p = nd.pick(nd.array(x), nd.array(np.array([0, 1, 2, 0, 1],
+                                               dtype=np.float32)), axis=1)
+    assert_almost_equal(p.asnumpy(), x[np.arange(5), [0, 1, 2, 0, 1]])
+    cond = np.array([[1, 0, 1], [0, 1, 0]], dtype=np.float32)
+    a = np.ones((2, 3), np.float32)
+    b = np.zeros((2, 3), np.float32)
+    assert_almost_equal(
+        nd.where(nd.array(cond), nd.array(a), nd.array(b)).asnumpy(), cond)
+
+
+def test_topk_sort_argsort():
+    x = np.random.randn(3, 6).astype(np.float32)
+    out = nd.topk(nd.array(x), k=2, axis=1).asnumpy()
+    ref = np.argsort(-x, axis=1)[:, :2]
+    assert_almost_equal(out, ref.astype(np.float32))
+    assert_almost_equal(nd.sort(nd.array(x), axis=1).asnumpy(),
+                        np.sort(x, axis=1))
+    assert_almost_equal(nd.argsort(nd.array(x), axis=1).asnumpy(),
+                        np.argsort(x, axis=1).astype(np.float32))
+
+
+# ---- NN ops ----------------------------------------------------------------
+def test_softmax_log_softmax():
+    x = np.random.randn(4, 7).astype(np.float32)
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    ref = e / e.sum(axis=1, keepdims=True)
+    assert_almost_equal(nd.softmax(nd.array(x)).asnumpy(), ref, rtol=1e-4)
+    assert_almost_equal(nd.log_softmax(nd.array(x)).asnumpy(), np.log(ref),
+                        rtol=1e-4)
+    check_numeric_gradient(lambda a: nd.softmax(a), [x], rtol=5e-2)
+
+
+def test_fully_connected_grad():
+    x = np.random.randn(4, 6).astype(np.float32)
+    w = np.random.randn(3, 6).astype(np.float32)
+    b = np.random.randn(3).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=3).asnumpy()
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4)
+    check_numeric_gradient(
+        lambda a, ww, bb: nd.FullyConnected(a, ww, bb, num_hidden=3),
+        [x, w, b], rtol=5e-2)
+
+
+def test_convolution_grad():
+    x = np.random.randn(2, 3, 7, 7).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    check_numeric_gradient(
+        lambda a, ww, bb: nd.Convolution(a, ww, bb, kernel=(3, 3),
+                                         num_filter=4, pad=(1, 1)),
+        [x, w, b], rtol=5e-2, numeric_eps=1e-2)
+
+
+def test_batchnorm_inference_matches_numpy():
+    x = np.random.randn(4, 3, 5, 5).astype(np.float32)
+    gamma = np.random.uniform(0.5, 1.5, 3).astype(np.float32)
+    beta = np.random.randn(3).astype(np.float32)
+    mean = np.random.randn(3).astype(np.float32)
+    var = np.random.uniform(0.5, 1.5, 3).astype(np.float32)
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), fix_gamma=False,
+                       use_global_stats=True, eps=1e-5).asnumpy()
+    ref = ((x - mean[None, :, None, None]) /
+           np.sqrt(var[None, :, None, None] + 1e-5) *
+           gamma[None, :, None, None] + beta[None, :, None, None])
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+# ---- symbolic check helpers on ops ----------------------------------------
+def test_check_symbolic_forward_backward():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = 2 * a + a * b
+    av = np.random.randn(3, 4).astype(np.float32)
+    bv = np.random.randn(3, 4).astype(np.float32)
+    check_symbolic_forward(out, [av, bv], [2 * av + av * bv])
+    og = np.ones((3, 4), np.float32)
+    check_symbolic_backward(out, [av, bv], [og],
+                            {"a": 2 + bv, "b": av})
+
+
+def test_check_numeric_gradient_symbol_path():
+    """The Symbol overload must produce real (non-zero) autograd grads."""
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = mx.sym.broadcast_mul(a, b) + a
+    av = np.random.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+    bv = np.random.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+    check_numeric_gradient(out, {"a": av, "b": bv}, rtol=5e-2)
+    check_numeric_gradient(out, {"a": av, "b": bv}, grad_nodes=["b"],
+                           rtol=5e-2)
+
+
+# ---- random ops ------------------------------------------------------------
+def test_random_ops_statistics():
+    mx.random.seed(7)
+    u = nd.random.uniform(0, 1, shape=(20000,)).asnumpy()
+    assert 0.48 < u.mean() < 0.52
+    n = nd.random.normal(0, 1, shape=(20000,)).asnumpy()
+    assert abs(n.mean()) < 0.03 and 0.95 < n.std() < 1.05
+    p = nd.random.poisson(lam=4.0, shape=(20000,)).asnumpy()
+    assert 3.8 < p.mean() < 4.2
+    g = nd.random.gamma(alpha=3.0, beta=1.0, shape=(20000,)).asnumpy()
+    assert 2.8 < g.mean() < 3.2
+
+
+def test_clip_round_sign():
+    x = np.random.uniform(-3, 3, (4, 5)).astype(np.float32)
+    assert_almost_equal(nd.clip(nd.array(x), -1, 1).asnumpy(),
+                        np.clip(x, -1, 1))
+    assert_almost_equal(nd.sign(nd.array(x)).asnumpy(), np.sign(x))
+    assert_almost_equal(nd.round(nd.array(x)).asnumpy(), np.round(x))
+    assert_almost_equal(nd.floor(nd.array(x)).asnumpy(), np.floor(x))
+    assert_almost_equal(nd.ceil(nd.array(x)).asnumpy(), np.ceil(x))
