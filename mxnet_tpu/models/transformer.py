@@ -128,7 +128,7 @@ def transformer_forward(params, tokens, cfg, mesh=None, seq_axis="seq"):
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec)
     else:
-        attn = functools.partial(_causal_attn_local,)
+        attn = _causal_attn_local
 
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i

@@ -1,0 +1,83 @@
+"""Initializer parity (reference tests/python/unittest/test_init.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _init_arr(init, name="weight", shape=(50, 100)):
+    arr = nd.zeros(shape)
+    desc = mx.init.InitDesc(name)
+    init(desc, arr)
+    return arr.asnumpy()
+
+
+def test_zero_one_constant():
+    assert (_init_arr(mx.init.Zero()) == 0).all()
+    assert (_init_arr(mx.init.One()) == 1).all()
+    assert (_init_arr(mx.init.Constant(3.5)) == 3.5).all()
+
+
+def test_uniform_range():
+    out = _init_arr(mx.init.Uniform(0.5))
+    assert out.min() >= -0.5 and out.max() <= 0.5
+    assert out.std() > 0.1
+
+
+def test_normal_stats():
+    out = _init_arr(mx.init.Normal(2.0), shape=(100, 100))
+    assert abs(out.mean()) < 0.1
+    assert 1.9 < out.std() < 2.1
+
+
+def test_xavier_scale():
+    shape = (64, 128)
+    out = _init_arr(mx.init.Xavier(rnd_type="uniform", factor_type="avg",
+                                   magnitude=3), shape=shape)
+    bound = np.sqrt(3.0 / ((shape[0] + shape[1]) / 2))
+    assert out.min() >= -bound - 1e-6 and out.max() <= bound + 1e-6
+
+
+def test_orthogonal_is_orthogonal():
+    out = _init_arr(mx.init.Orthogonal(), shape=(32, 32))
+    eye = out @ out.T
+    assert np.allclose(eye, np.eye(32) * eye[0, 0], atol=1e-3)
+
+
+def test_name_pattern_dispatch():
+    """Initializer dispatches on name suffix: bias→0, gamma→1, beta→0."""
+    init = mx.init.Xavier()
+    bias = nd.zeros((10,))
+    init(mx.init.InitDesc("fc1_bias"), bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = nd.zeros((10,))
+    init(mx.init.InitDesc("bn0_gamma"), gamma)
+    assert (gamma.asnumpy() == 1).all()
+    mean = nd.ones((10,))
+    init(mx.init.InitDesc("bn0_running_mean"), mean)
+    assert (mean.asnumpy() == 0).all()
+    var = nd.zeros((10,))
+    init(mx.init.InitDesc("bn0_running_var"), var)
+    assert (var.asnumpy() == 1).all()
+
+
+def test_msra_prelu():
+    out = _init_arr(mx.init.MSRAPrelu(), shape=(64, 64))
+    assert out.std() > 0
+
+
+def test_bilinear_upsampling_kernel():
+    arr = nd.zeros((1, 1, 4, 4))
+    mx.init.Bilinear()(mx.init.InitDesc("upsample_weight"), arr)
+    k = arr.asnumpy()[0, 0]
+    assert k.max() <= 1.0 and k[1, 1] > k[0, 0]
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed(["bias", ".*"], [mx.init.Zero(), mx.init.One()])
+    w = nd.zeros((4,))
+    init(mx.init.InitDesc("fc_weight"), w)
+    assert (w.asnumpy() == 1).all()
+    b = nd.ones((4,))
+    init(mx.init.InitDesc("fc_bias"), b)
+    assert (b.asnumpy() == 0).all()

@@ -1,0 +1,110 @@
+"""Data IO: NDArrayIter / CSVIter / ResizeIter / RecordIO round trips
+(reference tests/python/unittest/test_io.py, test_recordio.py)."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    label = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=4, shuffle=False,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[-1].pad == 2
+    assert_almost_equal(batches[0].data[0].asnumpy(), data[:4])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarrayiter_discard_and_rollover():
+    data = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(data, batch_size=4,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_dict_data():
+    data = {"a": np.zeros((6, 2), np.float32),
+            "b": np.ones((6, 3), np.float32)}
+    it = mx.io.NDArrayIter(data, batch_size=3)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    b0 = next(iter(it))
+    assert len(b0.data) == 2
+
+
+def test_resize_iter():
+    data = np.zeros((8, 2), np.float32)
+    base = mx.io.NDArrayIter(data, batch_size=2)
+    it = mx.io.ResizeIter(base, 2)
+    assert len(list(it)) == 2
+
+
+def test_csv_iter(tmp_path):
+    path = str(tmp_path / "data.csv")
+    arr = np.random.randint(0, 9, (12, 3)).astype(np.float32)
+    np.savetxt(path, arr, delimiter=",", fmt="%g")
+    it = mx.io.CSVIter(data_csv=path, data_shape=(3,), batch_size=4)
+    got = np.concatenate([b.data[0].asnumpy() for b in it])
+    assert_almost_equal(got[:12], arr, rtol=1e-5)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "x.rec")
+    rec = mx.recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        rec.write(b"rec%d" % i)
+    rec.close()
+    rec = mx.recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert rec.read() == b"rec%d" % i
+    assert rec.read() is None
+    rec.close()
+
+
+def test_indexed_recordio_seek(tmp_path):
+    path = str(tmp_path / "y.rec")
+    idx = str(tmp_path / "y.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(6):
+        rec.write_idx(i, b"item%d" % i)
+    rec.close()
+    rec = mx.recordio.MXIndexedRecordIO(idx, path, "r")
+    assert rec.read_idx(4) == b"item4"
+    assert rec.read_idx(1) == b"item1"
+    assert sorted(rec.keys) == list(range(6))
+    rec.close()
+
+
+def test_irheader_pack_unpack():
+    header = mx.recordio.IRHeader(0, [1.0, 2.0], 7, 0)
+    s = mx.recordio.pack(header, b"payload")
+    h2, blob = mx.recordio.unpack(s)
+    assert list(h2.label) == [1.0, 2.0]
+    assert h2.id == 7
+    assert blob == b"payload"
+
+
+def test_pack_img_unpack_img(tmp_path):
+    img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+    header = mx.recordio.IRHeader(0, 3.0, 1, 0)
+    s = mx.recordio.pack_img(header, img, quality=95, img_fmt=".png")
+    h2, img2 = mx.recordio.unpack_img(s)
+    assert float(np.asarray(h2.label)) == 3.0
+    assert img2.shape == (8, 8, 3)
+    assert np.abs(img2.astype(int) - img.astype(int)).mean() < 3
+
+
+def test_dataiter_provide_semantics():
+    data = np.zeros((8, 2, 3), np.float32)
+    it = mx.io.NDArrayIter(data, batch_size=4)
+    desc = it.provide_data[0]
+    assert tuple(desc.shape) == (4, 2, 3)
+    assert desc.name == "data"

@@ -1,0 +1,100 @@
+"""KVStore semantics (reference tests/python/unittest/test_kvstore.py:
+single-process multi-device aggregation vs numpy, updater mode, sparse)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kind="local"):
+    kv = mx.kv.create(kind)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, nd.ones(SHAPE) * 4)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 4)
+
+
+def test_aggregator_multiple_devs():
+    """Push a list of 'device' arrays; they must be summed (Comm::Reduce)."""
+    kv = _init_kv()
+    num_devs = 4
+    vals = [nd.ones(SHAPE)] * num_devs
+    kv.push(3, vals)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * num_devs)
+
+    kv.push(KEYS, [[nd.ones(SHAPE) * 2] * num_devs] * len(KEYS))
+    outs = [nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.ones(SHAPE) * 2 * num_devs)
+
+
+def test_updater_runs_on_push():
+    kv = _init_kv()
+    updates = []
+
+    def updater(key, grad, weight):
+        updates.append(key)
+        weight += grad * 2  # noqa: PLW2901
+
+    kv.set_updater(updater)
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE) * 2)
+    assert updates == [3]
+
+
+def test_set_optimizer_update_on_kvstore():
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0,
+                                      wd=0.0))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    # w = 0 - lr * grad = -0.1
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, -0.1), rtol=1e-5)
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.random.randn(6, 3).astype(np.float32)
+    kv.init("w", nd.array(w))
+    out = nd.zeros((6, 3))
+    rows = nd.array(np.array([1, 4], dtype=np.int64))
+    kv.row_sparse_pull("w", out=out, row_ids=rows)
+    expect = np.zeros_like(w)
+    expect[[1, 4]] = w[[1, 4]]
+    assert_almost_equal(out.asnumpy(), expect)
+
+
+def test_get_type_rank():
+    kv = mx.kv.create("local")
+    assert kv.type == "local"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(3, nd.ones(SHAPE))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert np.isfinite(out.asnumpy()).all()

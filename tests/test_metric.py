@@ -1,0 +1,104 @@
+"""EvalMetric registry parity (reference tests/python/unittest/test_metric.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = nd.array(np.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]]))
+    label = nd.array(np.array([1, 0, 0]))
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array(np.array([[0.1, 0.5, 0.4], [0.8, 0.15, 0.05]]))
+    label = nd.array(np.array([2, 2]))
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 0.5) < 1e-6
+
+
+def test_mse_mae_rmse():
+    pred = nd.array(np.array([[1.0], [2.0]]))
+    label = nd.array(np.array([[1.5], [1.0]]))
+    for cls, expect in [(mx.metric.MSE, (0.25 + 1.0) / 2),
+                        (mx.metric.MAE, (0.5 + 1.0) / 2),
+                        (mx.metric.RMSE, np.sqrt((0.25 + 1.0) / 2))]:
+        m = cls()
+        m.update([label], [pred])
+        _, v = m.get()
+        assert abs(v - expect) < 1e-5, cls
+
+
+def test_cross_entropy_and_nll():
+    pred = nd.array(np.array([[0.2, 0.8], [0.9, 0.1]]))
+    label = nd.array(np.array([1, 0]))
+    m = mx.metric.CrossEntropy()
+    m.update([label], [pred])
+    _, v = m.get()
+    expect = -(np.log(0.8) + np.log(0.9)) / 2
+    assert abs(v - expect) < 1e-5
+
+
+def test_perplexity():
+    pred = nd.array(np.array([[0.5, 0.5], [0.5, 0.5]]))
+    label = nd.array(np.array([0, 1]))
+    m = mx.metric.Perplexity(ignore_label=None)
+    m.update([label], [pred])
+    _, v = m.get()
+    assert abs(v - 2.0) < 1e-4
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = nd.array(np.array([[0.7, 0.3], [0.2, 0.8], [0.1, 0.9],
+                              [0.6, 0.4]]))
+    label = nd.array(np.array([0, 1, 1, 1]))
+    m.update([label], [pred])
+    _, f1 = m.get()
+    # tp=2 fp=0 fn=1 -> precision 1, recall 2/3 -> f1 = 0.8
+    assert abs(f1 - 0.8) < 1e-6
+
+
+def test_composite_and_custom():
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.MSE())
+    pred = nd.array(np.array([[0.3, 0.7]]))
+    label = nd.array(np.array([1]))
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert len(names) == 2 and len(vals) == 2
+
+    def feval(l, p):
+        return float(np.abs(l - p.argmax(axis=1)).mean())
+    m = mx.metric.create(feval)
+    m.update([label], [pred])
+    _, v = m.get()
+    assert v == 0.0
+
+
+def test_metric_create_by_name_and_reset():
+    m = mx.metric.create("acc")
+    pred = nd.array(np.array([[0.3, 0.7]]))
+    m.update([nd.array(np.array([1]))], [pred])
+    _, v1 = m.get()
+    assert v1 == 1.0
+    m.reset()
+    name, v = m.get()
+    assert np.isnan(v)
+
+
+def test_pearson():
+    m = mx.metric.PearsonCorrelation()
+    pred = nd.array(np.array([[1.0], [2.0], [3.0], [4.0]]))
+    label = nd.array(np.array([[1.1], [2.2], [2.9], [4.1]]))
+    m.update([label], [pred])
+    _, v = m.get()
+    assert v > 0.99

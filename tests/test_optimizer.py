@@ -1,0 +1,123 @@
+"""Optimizer update rules vs numpy references (reference
+tests/python/unittest/test_optimizer.py doctrine)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(opt, w0, g, n=3):
+    w = nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for _ in range(n):
+        opt.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.randn(4, 3).astype(np.float32)
+    g = np.random.randn(4, 3).astype(np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0)
+    out = _run_steps(opt, w0, g, n=3)
+    ref = w0 - 3 * 0.1 * g
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = np.random.randn(5).astype(np.float32)
+    g = np.random.randn(5).astype(np.float32)
+    lr, mom = 0.1, 0.9
+    opt = mx.optimizer.SGD(learning_rate=lr, momentum=mom, rescale_grad=1.0,
+                           wd=0.0)
+    out = _run_steps(opt, w0, g, n=3)
+    w, m = w0.copy(), np.zeros_like(w0)
+    for _ in range(3):
+        m = mom * m - lr * g
+        w = w + m
+    assert_almost_equal(out, w, rtol=1e-5)
+
+
+def test_sgd_wd_matches_numpy():
+    w0 = np.random.randn(5).astype(np.float32)
+    g = np.zeros(5, np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.01)
+    out = _run_steps(opt, w0, g, n=1)
+    assert_almost_equal(out, w0 * (1 - 0.1 * 0.01), rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.randn(6).astype(np.float32)
+    g = np.random.randn(6).astype(np.float32)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                            epsilon=eps, rescale_grad=1.0, wd=0.0)
+    out = _run_steps(opt, w0, g, n=4)
+    w = w0.copy().astype(np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 5):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(out, w.astype(np.float32), rtol=1e-4)
+
+
+def test_rmsprop_runs_and_converges_direction():
+    w0 = np.ones(4, np.float32)
+    g = np.ones(4, np.float32)
+    opt = mx.optimizer.RMSProp(learning_rate=0.1, rescale_grad=1.0, wd=0.0)
+    out = _run_steps(opt, w0, g, n=5)
+    assert (out < w0).all()
+
+
+@pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adagrad", "rmsprop",
+                                  "adadelta", "ftrl", "adamax", "nadam",
+                                  "sgld", "dcasgd", "signum"])
+def test_all_optimizers_step_finite(name):
+    opt = mx.optimizer.create(name)
+    w = nd.array(np.random.randn(8).astype(np.float32))
+    g = nd.array(np.random.randn(8).astype(np.float32))
+    state = opt.create_state(0, w)
+    for _ in range(3):
+        opt.update(0, w, g, state)
+    assert np.isfinite(w.asnumpy()).all()
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    sched.base_lr = 1.0
+    lrs = [sched(i) for i in [1, 2, 3, 4, 5]]
+    assert lrs[0] == 1.0 and lrs[-1] <= 0.25 + 1e-6
+
+
+def test_multifactor_scheduler():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.1)
+    sched.base_lr = 1.0
+    assert abs(sched(5) - 0.01) < 1e-9
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(np.random.randn(4).astype(np.float32))
+    g = nd.array(np.random.randn(4).astype(np.float32))
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.get_updater(mx.optimizer.SGD(learning_rate=0.1,
+                                                     momentum=0.9))
+    upd2.set_states(blob)
+    upd2(0, g, w)
+    assert np.isfinite(w.asnumpy()).all()
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0, wd=0.0,
+                           param_idx2name={0: "a", 1: "b"})
+    opt.set_lr_mult({"a": 0.0})
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.ones(3, np.float32))
+    opt.update(0, w, g, opt.create_state(0, w))
+    assert_almost_equal(w.asnumpy(), np.ones(3))  # lr_mult 0 → no change
