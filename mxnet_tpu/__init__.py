@@ -52,3 +52,9 @@ from . import gluon
 from . import parallel
 # models and test_utils are opt-in imports (mxnet_tpu.models /
 # mxnet_tpu.test_utils), keeping `import mxnet_tpu` lean like the reference.
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import log

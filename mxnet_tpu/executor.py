@@ -165,7 +165,8 @@ class Executor:
         aux_vals = [self._pin(self.aux_dict[n], dev) for n in self.aux_names]
         rng = _random.next_key()
 
-        if self._monitor is not None:
+        if self._monitor is not None and \
+                getattr(self._monitor, "is_active", lambda: True)():
             outs, new_aux = self._forward_monitored(arg_vals, aux_vals, rng,
                                                     is_train)
             if is_train and self._grad_names:
@@ -359,3 +360,25 @@ class Executor:
                     for n, s in zip(aux_names, x)}
         return Executor(symbol, ctx, arg_dict, None, grad_req, aux_dict,
                         group2ctx)
+
+
+def _profiled(method, label):
+    """Wrap an Executor method with a profiler program span (SURVEY §5.1:
+    the reference stamps engine ops; here the unit of execution is the
+    whole compiled program, so that's what gets a trace event)."""
+    def wrapper(self, *args, **kwargs):
+        from . import profiler as _prof
+        if not _prof.is_running():
+            return method(self, *args, **kwargs)
+        t0 = _prof._now_us()
+        try:
+            return method(self, *args, **kwargs)
+        finally:
+            _prof.record_program(label, t0, _prof._now_us() - t0)
+    wrapper.__name__ = method.__name__
+    wrapper.__doc__ = method.__doc__
+    return wrapper
+
+
+Executor.forward = _profiled(Executor.forward, "executor_forward")
+Executor.backward = _profiled(Executor.backward, "executor_backward")

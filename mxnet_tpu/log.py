@@ -1,0 +1,60 @@
+"""Colorized logging (reference ``python/mxnet/log.py``)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger"]
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+PY3 = True
+
+
+class _Formatter(logging.Formatter):
+    """Per-level colored prefix when attached to a tty."""
+
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def _color(self, level):
+        return {logging.DEBUG: "\x1b[32m",       # green
+                logging.INFO: "\x1b[34m",        # blue
+                logging.WARNING: "\x1b[33m",     # yellow
+                logging.ERROR: "\x1b[31m",       # red
+                logging.CRITICAL: "\x1b[35m"}.get(level, "")
+
+    def format(self, record):
+        label = record.levelname[0]
+        if self.colored:
+            head = "%s%s%s" % (self._color(record.levelno), label,
+                               "\x1b[0m")
+        else:
+            head = label
+        self._style._fmt = head + "%(asctime)s %(process)d %(pathname)s:" \
+            "%(lineno)d] %(message)s"
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Get a logger with the mxnet formatting (reference log.py:getLogger)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", False):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+            hdlr.setFormatter(_Formatter(colored=False))
+        else:
+            hdlr = logging.StreamHandler(sys.stderr)
+            hdlr.setFormatter(_Formatter(
+                colored=getattr(sys.stderr, "isatty", lambda: False)()))
+        logger.addHandler(hdlr)
+    logger.setLevel(level)
+    return logger

@@ -1,0 +1,91 @@
+"""Monitor: tap every op output during Executor forward for debugging.
+
+Parity surface: reference ``python/mxnet/monitor.py:33`` + executor monitor
+callback (``GraphExecutor::SetMonitorCallback``, graph_executor.cc:120,
+ExecuteMonCallback :1380).  On the TPU build, installing a monitor switches
+the Executor to its eager node-by-node path so every intermediate value is
+observable (the compiled XLA program has no per-op boundaries to tap).
+"""
+from __future__ import annotations
+
+import re
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    """Collect per-op output statistics every ``interval`` batches.
+
+    Parameters mirror the reference: ``stat_func`` maps NDArray -> NDArray
+    stat (default: mean of |x|), ``pattern`` filters output names,
+    ``sort`` orders results by name in ``toc()``.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean() if hasattr(x, "abs") else x
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, arr):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(arr)))
+        # Executor consults is_active to decide whether THIS forward must
+        # take the slow eager per-node path; off-interval batches stay on
+        # the compiled program instead of paying eager speed for nothing.
+        stat_helper.is_active = lambda: self.activated
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Attach to an Executor (reference monitor.py:install)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting if due this step (call before forward)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; return [(step, name, stat_str), ...]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                if not isinstance(v, NDArray):
+                    raise MXNetError("the argument must be NDArray")
+                if v.shape == () or v.shape == (1,):
+                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and print (reference monitor.py:toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            print("Batch: {:7d} {:30s} {:s}".format(n, k, v))
+        return res

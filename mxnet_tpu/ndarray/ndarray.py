@@ -453,6 +453,18 @@ def invoke(op, inputs, attrs, out=None):
     Reference analogue: MXImperativeInvokeEx → Imperative::Invoke
     (``src/imperative/imperative.cc:86``) and RecordOp (:182).
     """
+    from .. import profiler as _prof
+    if _prof.is_running() and _prof._state["mode"] == "all":
+        t0 = _prof._now_us()
+        try:
+            return _invoke(op, inputs, attrs, out)
+        finally:
+            _prof.record_op(op if isinstance(op, str) else op.name,
+                            t0, _prof._now_us() - t0)
+    return _invoke(op, inputs, attrs, out)
+
+
+def _invoke(op, inputs, attrs, out=None):
     if isinstance(op, str):
         op = get_op(op)
     attrs = dict(attrs)

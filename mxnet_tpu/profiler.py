@@ -1,0 +1,162 @@
+"""Operator-level profiler with Chrome trace-event output.
+
+Parity surface: reference ``python/mxnet/profiler.py:27-55`` +
+``src/engine/profiler.{h,cc}`` (SURVEY §5.1): engine workers stamp each op
+with ``OprExecStat{opr_name, start/end µs, thread_id, dev}`` and
+``Profiler::DumpProfile`` emits Chrome trace-event JSON.
+
+TPU-native redesign: there is no engine worker to instrument — eager ops
+dispatch through ``ndarray.invoke`` and compiled graphs execute as one XLA
+program.  So the profiler has two layers:
+
+1. **Op events** (this module): when running, the eager dispatch path and
+   the Executor forward/backward record wall-clock spans per op / per
+   program, dumped as Chrome ``traceEvents`` JSON — same file format the
+   reference produces, loadable in chrome://tracing or Perfetto.
+2. **Device profile**: ``start()/stop()`` also drive ``jax.profiler``
+   (XPlane/TensorBoard) when a trace dir is configured, which is where
+   real per-kernel TPU timing lives (XLA fuses ops, so per-op host spans
+   are the honest analogue of the reference's engine stats).
+
+Env autostart: ``MXNET_PROFILER_AUTOSTART=1`` (reference env_var.md:101).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["profiler_set_config", "set_config", "set_state", "dump_profile",
+           "dump", "pause", "resume", "clear", "Marker"]
+
+_lock = threading.Lock()
+_state = {
+    "mode": "symbolic",
+    "filename": "profile.json",
+    "running": False,
+    "jax_trace_dir": None,
+    "jax_tracing": False,
+}
+_events = []          # finished spans: dicts in Chrome trace format
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        **kwargs):
+    """Configure profiler (reference profiler.py:27).
+
+    mode: 'symbolic' records Executor program spans only; 'all' also
+    records eager op dispatches.  ``jax_trace_dir`` additionally captures
+    an XLA device trace viewable in TensorBoard.
+    """
+    with _lock:
+        _state["mode"] = mode
+        _state["filename"] = filename
+        _state["jax_trace_dir"] = kwargs.get("jax_trace_dir")
+        _events.clear()  # new config = new profiling session
+
+
+set_config = profiler_set_config
+
+
+def set_state(state="stop"):
+    """'run' | 'stop' (reference profiler.py:40).
+
+    Events accumulate across run/stop cycles (so ``pause``/``resume``
+    exclude a window without losing prior spans); ``set_config`` or
+    ``clear`` starts a fresh buffer.
+    """
+    with _lock:
+        run = state == "run"
+        already_tracing = _state["jax_tracing"]
+        _state["running"] = run
+        tdir = _state["jax_trace_dir"]
+    if run and tdir and not already_tracing:
+        import jax
+        jax.profiler.start_trace(tdir)
+        _state["jax_tracing"] = True
+    elif not run and already_tracing:
+        import jax
+        jax.profiler.stop_trace()
+        _state["jax_tracing"] = False
+
+
+def clear():
+    """Drop all accumulated events."""
+    with _lock:
+        _events.clear()
+
+
+def pause():
+    set_state("stop")
+
+
+def resume():
+    set_state("run")
+
+
+def is_running():
+    return _state["running"]
+
+
+def _record(name, cat, start_us, dur_us, tid=0):
+    _events.append({"name": name, "cat": cat, "ph": "X",
+                    "ts": start_us, "dur": dur_us,
+                    "pid": os.getpid(), "tid": tid})
+
+
+def record_op(name, start_us, dur_us):
+    """Called from the eager dispatch path (mode='all')."""
+    if _state["running"] and _state["mode"] == "all":
+        _record(name, "operator", start_us, dur_us,
+                tid=threading.get_ident() % 10000)
+
+
+def record_program(name, start_us, dur_us):
+    """Called from Executor forward/backward (any mode)."""
+    if _state["running"]:
+        _record(name, "program", start_us, dur_us,
+                tid=threading.get_ident() % 10000)
+
+
+class Marker:
+    """User annotation span: ``with profiler.Marker("data-load"): ...``"""
+
+    def __init__(self, name, cat="user"):
+        self._name = name
+        self._cat = cat
+
+    def __enter__(self):
+        self._start = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if _state["running"]:
+            _record(self._name, self._cat, self._start,
+                    _now_us() - self._start)
+
+
+def dump_profile(filename=None):
+    """Write accumulated events as Chrome trace JSON
+    (reference Profiler::DumpProfile, profiler.cc:127-192)."""
+    fname = filename or _state["filename"]
+    with _lock:
+        payload = {"traceEvents": list(_events),
+                   "displayTimeUnit": "ms"}
+    with open(fname, "w") as f:
+        json.dump(payload, f)
+    return fname
+
+
+dump = dump_profile
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    profiler_set_config(mode=os.environ.get("MXNET_PROFILER_MODE",
+                                            "symbolic"))
+    set_state("run")
