@@ -1,0 +1,88 @@
+"""ctypes bindings to the native runtime (native/ → librecordio.so).
+
+Reference analogue: the ctypes bridge in ``python/mxnet/base.py`` loading
+``libmxnet.so``.  Here the native surface is the IO substrate (RecordIO
+codec; SURVEY §2.1 "Data IO (native)").  Binding is optional: when the
+shared object hasn't been built (``make -C native``), callers fall back to
+the pure-python implementation of the identical wire format.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "librecordio.so")
+_lib = None
+_tried = False
+
+
+def _try_build():
+    """Best-effort lazy build with the in-image toolchain (g++).
+
+    Serialized via a lock file so concurrent DataLoader workers don't race
+    the same `make`; logs one line so the (up to ~min) compile isn't a
+    silent stall.
+    """
+    native_dir = os.path.join(os.path.dirname(_DIR), "..", "native")
+    if not os.path.isdir(native_dir):
+        return False
+    import logging
+    logging.getLogger("mxnet_tpu").info(
+        "building native recordio codec (one-time; set "
+        "MXNET_TPU_BUILD_NATIVE=0 to skip)")
+    lock_path = os.path.join(_DIR, ".build.lock")
+    try:
+        import fcntl
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(_SO):      # another process built it
+                return True
+            subprocess.run(["make", "-C", native_dir,
+                            os.path.relpath(_SO, native_dir)],
+                           check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def lib():
+    """The loaded CDLL, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) and \
+            os.environ.get("MXNET_TPU_BUILD_NATIVE", "1") == "1":
+        _try_build()
+    if not os.path.exists(_SO):
+        return None
+    l = ctypes.CDLL(_SO)
+    l.MXRIOWriterCreate.restype = ctypes.c_void_p
+    l.MXRIOWriterCreate.argtypes = [ctypes.c_char_p]
+    l.MXRIOWrite.restype = ctypes.c_int
+    l.MXRIOWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_uint64]
+    l.MXRIOWriterTell.restype = ctypes.c_int64
+    l.MXRIOWriterTell.argtypes = [ctypes.c_void_p]
+    l.MXRIOWriterFree.restype = None
+    l.MXRIOWriterFree.argtypes = [ctypes.c_void_p]
+    l.MXRIOReaderCreate.restype = ctypes.c_void_p
+    l.MXRIOReaderCreate.argtypes = [ctypes.c_char_p]
+    l.MXRIORead.restype = ctypes.c_int
+    l.MXRIORead.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_char_p),
+                            ctypes.POINTER(ctypes.c_uint64)]
+    l.MXRIOReaderTell.restype = ctypes.c_int64
+    l.MXRIOReaderTell.argtypes = [ctypes.c_void_p]
+    l.MXRIOReaderSeek.restype = ctypes.c_int
+    l.MXRIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    l.MXRIOReaderFree.restype = None
+    l.MXRIOReaderFree.argtypes = [ctypes.c_void_p]
+    _lib = l
+    return _lib
+
+
+def available():
+    return lib() is not None

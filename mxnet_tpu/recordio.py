@@ -27,24 +27,40 @@ _KMAGIC = 0xced7230a
 
 
 class MXRecordIO(object):
-    """Sequential reader/writer of RecordIO files (reference :36)."""
+    """Sequential reader/writer of RecordIO files (reference :36).
+
+    Backed by the native C++ codec (``native/recordio.cc`` via ctypes,
+    4 MB buffered IO) when ``mxnet_tpu/_native/librecordio.so`` is built;
+    falls back to pure python on the identical wire format otherwise.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.is_open = False
         self.fd = None
+        self._h = None      # native handle
+        self._lib = None
         self.open()
 
     def open(self):
+        from . import _native
+        lib = _native.lib()
         if self.flag == "w":
-            self.fd = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fd = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        if lib is not None:
+            create = (lib.MXRIOWriterCreate if self.writable
+                      else lib.MXRIOReaderCreate)
+            self._h = create(self.uri.encode())
+            if not self._h:
+                raise IOError("cannot open %s" % self.uri)
+            self._lib = lib
+        else:
+            self.fd = open(self.uri, "wb" if self.writable else "rb")
         self.is_open = True
 
     def __del__(self):
@@ -56,11 +72,15 @@ class MXRecordIO(object):
         d = dict(self.__dict__)
         d["is_open"] = is_open
         d.pop("fd", None)
+        d.pop("_h", None)
+        d.pop("_lib", None)
         return d
 
     def __setstate__(self, d):
         self.__dict__ = d
         self.fd = None
+        self._h = None
+        self._lib = None
         is_open = d.get("is_open", False)
         self.is_open = False
         if is_open:
@@ -69,7 +89,13 @@ class MXRecordIO(object):
     def close(self):
         if not self.is_open:
             return
-        self.fd.close()
+        if self._h is not None:
+            free = (self._lib.MXRIOWriterFree if self.writable
+                    else self._lib.MXRIOReaderFree)
+            free(self._h)
+            self._h = None
+        else:
+            self.fd.close()
         self.is_open = False
 
     def reset(self):
@@ -78,6 +104,11 @@ class MXRecordIO(object):
 
     def write(self, buf):
         assert self.writable
+        if self._h is not None:
+            buf = bytes(buf)  # accept bytearray/memoryview like fd.write
+            if self._lib.MXRIOWrite(self._h, buf, len(buf)) != 0:
+                raise IOError("RecordIO write failed")
+            return
         lrec = len(buf)  # cflag 0 (complete)
         self.fd.write(struct.pack("<II", _KMAGIC, lrec))
         self.fd.write(buf)
@@ -87,6 +118,16 @@ class MXRecordIO(object):
 
     def read(self):
         assert not self.writable
+        if self._h is not None:
+            out = ctypes.c_char_p()
+            n = ctypes.c_uint64()
+            status = self._lib.MXRIORead(self._h, ctypes.byref(out),
+                                         ctypes.byref(n))
+            if status == 0:
+                return None
+            if status < 0:
+                raise IOError("corrupt RecordIO stream in %s" % self.uri)
+            return ctypes.string_at(out, n.value)
         head = self.fd.read(8)
         if len(head) < 8:
             return None
@@ -101,7 +142,18 @@ class MXRecordIO(object):
             self.fd.read(pad)
         return buf
 
+    def seek(self, pos):
+        assert not self.writable
+        if self._h is not None:
+            if self._lib.MXRIOReaderSeek(self._h, pos) != 0:
+                raise IOError("seek(%d) failed on %s" % (pos, self.uri))
+        else:
+            self.fd.seek(pos)
+
     def tell(self):
+        if self._h is not None:
+            return (self._lib.MXRIOWriterTell(self._h) if self.writable
+                    else self._lib.MXRIOReaderTell(self._h))
         return self.fd.tell()
 
 
@@ -116,7 +168,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super(MXIndexedRecordIO, self).__init__(uri, flag)
 
     def open(self):
-        super(MXIndexedRecordIO, self).open()
+        MXRecordIO.open(self)
         self.idx = {}
         self.keys = []
         if not self.writable and os.path.isfile(self.idx_path):
@@ -134,12 +186,11 @@ class MXIndexedRecordIO(MXRecordIO):
             with open(self.idx_path, "w") as fout:
                 for k in self.keys:
                     fout.write("%s\t%d\n" % (str(k), self.idx[k]))
-        super(MXIndexedRecordIO, self).close()
+        MXRecordIO.close(self)
 
     def seek(self, idx):
         assert not self.writable
-        pos = self.idx[idx]
-        self.fd.seek(pos)
+        MXRecordIO.seek(self, self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

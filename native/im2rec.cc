@@ -1,0 +1,127 @@
+// im2rec: pack a dataset listed in a .lst file into a RecordIO shard.
+//
+// Reference analogue: tools/im2rec.cc (SURVEY §2.1 "im2rec tool").  This
+// build packs files as-is (pass-through; JPEG bytes stay JPEG — the same
+// behavior as the reference's --pass-through / python im2rec with
+// pre-encoded images; decode+augment happens at load time on host).
+//
+// .lst line format (reference tools/im2rec.py make_list):
+//   <index>\t<label...>\t<relative/path>
+// Output: <prefix>.rec (+ <prefix>.idx with "<index>\t<byte offset>").
+//
+// IRHeader wire layout matches python/mxnet-style recordio.pack:
+//   uint32 flag; float label; uint64 id; uint64 id2  (flag>0 => flag floats
+//   of label vector follow the header).
+//
+// Build: `make -C native` → native/bin/im2rec
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* MXRIOWriterCreate(const char* path);
+int MXRIOWrite(void* handle, const char* data, uint64_t len);
+int64_t MXRIOWriterTell(void* handle);
+void MXRIOWriterFree(void* handle);
+}
+
+namespace {
+
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+bool read_file(const std::string& path, std::vector<char>* out) {
+  std::ifstream f(path, std::ios::binary | std::ios::ate);
+  if (!f) return false;
+  std::streamsize n = f.tellg();
+  f.seekg(0);
+  out->resize(static_cast<size_t>(n));
+  return static_cast<bool>(f.read(out->data(), n));
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: im2rec <list.lst> <image-root> <out-prefix>\n"
+              << "packs files from the .lst (pass-through) into "
+              << "<out-prefix>.rec + .idx\n";
+    return 1;
+  }
+  std::string lst = argv[1], root = argv[2], prefix = argv[3];
+  std::ifstream flst(lst);
+  if (!flst) {
+    std::cerr << "cannot open list file " << lst << "\n";
+    return 1;
+  }
+  void* w = MXRIOWriterCreate((prefix + ".rec").c_str());
+  if (!w) {
+    std::cerr << "cannot open output " << prefix << ".rec\n";
+    return 1;
+  }
+  std::ofstream fidx(prefix + ".idx");
+
+  std::string line;
+  size_t count = 0, errors = 0;
+  std::vector<char> payload, record;
+  while (std::getline(flst, line)) {
+    if (line.empty()) continue;
+    std::istringstream ss(line);
+    std::vector<std::string> fields;
+    std::string tok;
+    while (std::getline(ss, tok, '\t')) fields.push_back(tok);
+    if (fields.size() < 3) { ++errors; continue; }
+    uint64_t index = strtoull(fields[0].c_str(), nullptr, 10);
+    const std::string& relpath = fields.back();
+    std::vector<float> labels;
+    for (size_t i = 1; i + 1 < fields.size(); ++i)
+      labels.push_back(strtof(fields[i].c_str(), nullptr));
+
+    std::string path = root.empty() ? relpath : root + "/" + relpath;
+    if (!read_file(path, &payload)) {
+      std::cerr << "skip unreadable " << path << "\n";
+      ++errors;
+      continue;
+    }
+    IRHeader hdr;
+    hdr.id = index;
+    hdr.id2 = 0;
+    if (labels.size() == 1) {
+      hdr.flag = 0;
+      hdr.label = labels[0];
+    } else {
+      hdr.flag = static_cast<uint32_t>(labels.size());
+      hdr.label = 0.0f;
+    }
+    record.clear();
+    record.insert(record.end(), reinterpret_cast<char*>(&hdr),
+                  reinterpret_cast<char*>(&hdr) + sizeof(hdr));
+    if (hdr.flag > 0)
+      record.insert(record.end(),
+                    reinterpret_cast<char*>(labels.data()),
+                    reinterpret_cast<char*>(labels.data()) +
+                        labels.size() * sizeof(float));
+    record.insert(record.end(), payload.begin(), payload.end());
+
+    fidx << index << "\t" << MXRIOWriterTell(w) << "\n";
+    MXRIOWrite(w, record.data(), record.size());
+    ++count;
+    if (count % 1000 == 0)
+      std::cerr << "packed " << count << " records\n";
+  }
+  MXRIOWriterFree(w);
+  std::cerr << "done: " << count << " records, " << errors << " errors -> "
+            << prefix << ".rec\n";
+  return errors && !count ? 1 : 0;
+}
