@@ -128,7 +128,7 @@ def transformer_forward(params, tokens, cfg, mesh=None, seq_axis="seq"):
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec)
     else:
-        attn = _causal_attn_local
+        attn = functools.partial(_causal_attn_local, mesh=mesh)
 
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i
@@ -146,7 +146,28 @@ def transformer_forward(params, tokens, cfg, mesh=None, seq_axis="seq"):
     return x @ params["out_proj"]
 
 
-def _causal_attn_local(q, k, v):
+def _use_flash(s):
+    # TPU only (axon = the tunneled TPU backend); pallas_call lowers via
+    # Mosaic and is untested on other backends, and interpret mode on CPU
+    # would be needlessly slow — XLA fuses the jnp reference fine there.
+    if jax.default_backend() not in ("tpu", "axon") or s < 128:
+        return False
+    from ..ops.pallas_kernels import HAS_PALLAS
+    return HAS_PALLAS
+
+
+def _causal_attn_local(q, k, v, mesh=None):
+    if _use_flash(q.shape[2]):
+        from ..ops.pallas_kernels import flash_attention
+        fn = functools.partial(flash_attention, causal=True)
+        if mesh is not None:
+            # pallas_call is opaque to GSPMD: shard batch/heads explicitly
+            # so the TP split survives (each shard runs the kernel locally)
+            spec = _filter_spec(P("data", "model", None, None), mesh)
+            return jax.shard_map(lambda a, b_, c: fn(a, b_, c), mesh=mesh,
+                                 in_specs=(spec,) * 3, out_specs=spec)(
+                                     q, k, v)
+        return fn(q, k, v)
     from ..parallel.ring_attention import local_attention
     return local_attention(q, k, v, causal=True)
 
