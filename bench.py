@@ -21,32 +21,55 @@ def main():
     import mxnet_tpu as mx
     from __graft_entry__ import _build_flagship
 
-    dev = (mx.tpu() if mx.context.num_tpus() else mx.cpu()).jax_device
+    # num_tpus() returns 0 (not raises) on backend-init failure; resolving
+    # the cpu context can still hit a broken accelerator platform, so guard
+    # the whole device pick and fall back to the host CPU backend.
+    try:
+        dev = (mx.tpu() if mx.context.num_tpus() else mx.cpu()).jax_device
+    except RuntimeError:
+        dev = jax.devices("cpu")[0]
+
+    # CPU fallback (no chip reachable): shrink the workload so a JSON line
+    # still comes out instead of a timeout; bf16 emulation on host is slow
+    on_cpu = dev.platform == "cpu"
+    batch = 8 if on_cpu else BATCH
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+
     forward, params, aux, _ = _build_flagship(
-        batch=BATCH, dtype=jnp.bfloat16, device=dev)
+        batch=batch, dtype=dtype, device=dev)
     fwd = jax.jit(forward)
 
     rng = np.random.RandomState(0)
-    x = jax.device_put(jnp.asarray(rng.randn(BATCH, 3, 224, 224),
-                                   jnp.bfloat16), dev)
+    x = jax.device_put(jnp.asarray(rng.randn(batch, 3, 224, 224),
+                                   dtype), dev)
 
-    # warmup + compile
+    # warmup + compile; time the second (cached) call to size the run
     jax.block_until_ready(fwd(params, aux, x))
+    t0 = time.perf_counter()
     jax.block_until_ready(fwd(params, aux, x))
+    per_iter = time.perf_counter() - t0
 
-    iters = 30
+    # ~15s of steady-state measurement, capped so the CPU fallback path
+    # (seconds per iteration) still reports instead of timing out
+    iters = max(2, min(30, int(15.0 / max(per_iter, 1e-4))))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fwd(params, aux, x)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
 
-    img_s = BATCH * iters / dt
+    img_s = batch * iters / dt
     print(json.dumps({
-        "metric": "resnet50_infer_bs32",
+        # distinct metric name on the CPU fallback so the bs32-bf16 chip
+        # series is never polluted with bs8-fp32 host numbers
+        "metric": ("resnet50_infer_bs32" if not on_cpu
+                   else "resnet50_infer_cpu_fallback"),
         "value": round(img_s, 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+        "vs_baseline": (round(img_s / BASELINE_IMG_S, 2) if not on_cpu
+                        else None),
+        "device": dev.platform,
+        "batch": batch,
     }))
 
 

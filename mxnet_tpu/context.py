@@ -129,8 +129,16 @@ def current_context():
 
 
 def num_gpus():
-    """Number of attached accelerator chips (reference: mx.context.num_gpus)."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    """Number of attached accelerator chips (reference: mx.context.num_gpus).
+
+    Returns 0 — never raises — when the accelerator backend fails to
+    initialize (e.g. the TPU tunnel is down), so callers can fall back to
+    CPU the way reference code treats a CUDA-less build.
+    """
+    try:
+        devs = [d for d in jax.devices() if d.platform != "cpu"]
+    except RuntimeError:
+        return 0
     return len(devs)
 
 

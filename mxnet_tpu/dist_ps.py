@@ -1,0 +1,538 @@
+"""Multi-process distributed KVStore transport (scheduler / server / worker).
+
+Reference counterpart: ps-lite + ``src/kvstore/kvstore_dist.h`` (worker,
+ZPush/ZPull with big-array key sharding) and ``kvstore_dist_server.h``
+(sync aggregation + ApplyUpdates), launched by ``tools/launch.py`` via the
+dmlc tracker.  This rebuild keeps the *roles and semantics* — a scheduler
+for rendezvous/barrier, S servers holding key shards, N workers pushing
+gradients and pulling weights, sync mode aggregating all workers' pushes
+before one optimizer step — over a dependency-free length-prefixed-pickle
+TCP protocol instead of ZeroMQ.
+
+On real multi-host TPU pods the training hot path does not go through this
+transport at all: it is `pjit` + ``lax.psum`` over ICI/DCN (see
+``parallel/sharded.py``).  This module exists so the reference's dist
+kvstore API (``create('dist_sync')``, rank/num_workers/barrier,
+optimizer-on-server) is a working, testable surface — the nightly
+dist-invariant tests run against it with real local processes, the same
+way the reference runs ps-lite over localhost.
+
+Role selection uses the reference's env-var contract
+(``DMLC_ROLE``, ``DMLC_PS_ROOT_URI``, ``DMLC_PS_ROOT_PORT``,
+``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``), so launch scripts written for
+the reference port unchanged.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["role", "num_workers", "num_servers", "root_addr",
+           "Conn", "Scheduler", "Server", "WorkerTransport",
+           "run_scheduler", "run_server", "shard_ranges", "server_of_key",
+           "BIGARRAY_BOUND"]
+
+_LEN = struct.Struct("<Q")
+
+
+def BIGARRAY_BOUND():
+    """Elements above which a key is range-sharded across all servers
+    (reference: MXNET_KVSTORE_BIGARRAY_BOUND, kvstore_dist.h:60)."""
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
+
+
+def role():
+    return os.environ.get("DMLC_ROLE", "worker")
+
+
+def num_workers():
+    return int(os.environ.get("DMLC_NUM_WORKER", 1))
+
+
+def num_servers():
+    return int(os.environ.get("DMLC_NUM_SERVER", 1))
+
+
+def root_addr():
+    return (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)))
+
+
+class Conn:
+    """Blocking message channel: 8-byte little-endian length + pickle."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self._wlock = threading.Lock()
+
+    @classmethod
+    def connect(cls, addr, retries=100, delay=0.1):
+        import time
+        last = None
+        for _ in range(retries):
+            try:
+                s = socket.create_connection(addr, timeout=60)
+                s.settimeout(None)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return cls(s)
+            except OSError as exc:
+                last = exc
+                time.sleep(delay)
+        raise ConnectionError("cannot reach %s:%d: %s" % (addr[0], addr[1], last))
+
+    def send(self, msg):
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._wlock:
+            self.sock.sendall(_LEN.pack(len(blob)) + blob)
+
+    def recv(self):
+        n = _LEN.unpack(self._read(_LEN.size))[0]
+        return pickle.loads(self._read(n))
+
+    def _read(self, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# key → server placement
+# ---------------------------------------------------------------------------
+
+def _key_hash(key):
+    """Stable across processes (unlike hash() under PYTHONHASHSEED)."""
+    import zlib
+    return zlib.adler32(str(key).encode())
+
+
+def server_of_key(key, nserv):
+    return _key_hash(key) % nserv
+
+
+def shard_ranges(size, nserv):
+    """Split [0, size) into nserv contiguous ranges (big-array mode)."""
+    step = -(-size // nserv)
+    return [(i * step, min((i + 1) * step, size)) for i in range(nserv)
+            if i * step < size]
+
+
+def placement(key, shape, nserv):
+    """Return [(server_idx, (lo, hi))] over the *flattened* array.
+
+    Small keys live whole on one server; arrays over BIGARRAY_BOUND are
+    range-partitioned across every server so no single server bottlenecks
+    on the fat embedding/fc weights (reference kvstore_dist.h:253-313).
+    """
+    size = int(np.prod(shape)) if shape else 1
+    if size < BIGARRAY_BOUND() or nserv == 1:
+        return [(server_of_key(key, nserv), (0, size))]
+    return list(enumerate(shard_ranges(size, nserv)))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: rendezvous + barrier + shutdown fan-out
+# ---------------------------------------------------------------------------
+
+class Scheduler:
+    """Assigns ranks, publishes the server address list, serves barriers.
+
+    Lifecycle: all S servers and N workers connect and register; the
+    scheduler replies with (rank, server_addrs).  Workers keep the
+    connection for barrier()/finalize; when every worker has finalized,
+    servers are told to shut down and the scheduler exits.
+    """
+
+    def __init__(self, nworkers, nservers, port=None):
+        self.nworkers, self.nservers = nworkers, nservers
+        self.lsock = socket.socket()
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind(("", port or root_addr()[1]))
+        self.lsock.listen(128)
+        self.server_addrs = [None] * nservers
+        self.server_conns = []
+        self.worker_conns = {}
+        self._lock = threading.Lock()
+        self._registered = threading.Condition(self._lock)
+        self._barrier_waiters = []
+        self._barrier_gen = 0
+        self._finalized = 0
+        self._done = threading.Event()
+
+    def run(self):
+        threads = []
+        need = self.nworkers + self.nservers
+        for _ in range(need):
+            conn = Conn(self.lsock.accept()[0])
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            threads.append(t)
+        self._done.wait()
+        for c in self.server_conns:
+            try:
+                c.send(("shutdown",))
+            except (OSError, ConnectionError):
+                pass
+        self.lsock.close()
+
+    def _serve(self, conn):
+        msg = conn.recv()
+        kind = msg[0]
+        with self._lock:
+            if kind == "reg_server":
+                rank = sum(a is not None for a in self.server_addrs)
+                self.server_addrs[rank] = msg[1]
+                self.server_conns.append(conn)
+            else:
+                # honor the launcher's DMLC_WORKER_RANK when present so
+                # worker i deterministically gets rank i
+                hint = msg[1] if len(msg) > 1 else None
+                if hint is not None and hint not in self.worker_conns:
+                    rank = hint
+                else:
+                    rank = next(i for i in range(self.nworkers)
+                                if i not in self.worker_conns)
+                self.worker_conns[rank] = conn
+            self._registered.notify_all()
+            while (None in self.server_addrs
+                   or len(self.worker_conns) < self.nworkers):
+                self._registered.wait()
+        conn.send(("ranked", rank, list(self.server_addrs)))
+        if kind == "reg_server":
+            return  # servers only hear "shutdown" from us
+        while True:
+            try:
+                msg = conn.recv()
+            except ConnectionError:
+                break
+            if msg[0] == "barrier":
+                with self._lock:
+                    gen = self._barrier_gen
+                    self._barrier_waiters.append(conn)
+                    if len(self._barrier_waiters) == self.nworkers:
+                        for c in self._barrier_waiters:
+                            c.send(("barrier_done",))
+                        self._barrier_waiters = []
+                        self._barrier_gen += 1
+                        self._registered.notify_all()
+                    else:
+                        while self._barrier_gen == gen:
+                            self._registered.wait()
+                continue
+            if msg[0] == "finalize":
+                with self._lock:
+                    self._finalized += 1
+                    if self._finalized == self.nworkers:
+                        self._done.set()
+                conn.send(("bye",))
+                break
+
+
+# ---------------------------------------------------------------------------
+# Server: shard store + sync aggregation + optimizer-on-server
+# ---------------------------------------------------------------------------
+
+class _PendingAgg:
+    """Sync-mode merge buffer for one (key, timestamp)."""
+
+    __slots__ = ("acc", "count", "rows")
+
+    def __init__(self):
+        self.acc = None
+        self.count = 0
+        self.rows = None  # row_sparse: set of pushed row ids
+
+
+class Server:
+    """Holds flat float shards; aggregates sync pushes; runs the updater.
+
+    Push protocol (sync): each worker's push RPC blocks until all
+    ``num_workers`` contributions for that (key, timestamp) have arrived
+    and the update has been applied — this is the ordering guarantee the
+    reference gets from engine dependencies + per-key server counters
+    (kvstore_dist_server.h:164-210).
+    """
+
+    def __init__(self, nworkers):
+        self.nworkers = nworkers
+        self.store = {}        # key -> flat np array (this server's shard)
+        self.shapes = {}       # key -> full shape (for updater reshape)
+        self.ranges = {}       # key -> (lo, hi) of our shard
+        self.pending = {}      # (key, ts) -> _PendingAgg
+        self.updater = None
+        self.sync = True
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def handle(self, msg):
+        """Process one request; return the reply (or None)."""
+        op = msg[0]
+        if op == "init":
+            _, key, flat, shape, rng = msg
+            with self._lock:
+                if key not in self.store:
+                    self.store[key] = np.array(flat)
+                    self.shapes[key] = tuple(shape)
+                    self.ranges[key] = rng
+                self._cv.notify_all()
+            return ("ok",)
+        if op == "push":
+            return self._push(*msg[1:])
+        if op == "pull":
+            _, key = msg
+            with self._lock:
+                self._wait_key(key)
+                return ("val", self.store[key])
+        if op == "pull_rows":
+            _, key, rows = msg
+            with self._lock:
+                self._wait_key(key)
+                w = self.store[key].reshape(self.shapes[key])
+                return ("val", w[np.asarray(rows, np.int64)])
+        if op == "set_optimizer":
+            from . import optimizer as opt
+            optimizer = pickle.loads(msg[1])
+            with self._lock:
+                self.updater = opt.get_updater(optimizer)
+            return ("ok",)
+        if op == "set_sync":
+            with self._lock:
+                self.sync = bool(msg[1])
+            return ("ok",)
+        raise ValueError("bad server op %r" % (op,))
+
+    def _wait_key(self, key):
+        while key not in self.store:
+            self._cv.wait()
+
+    def _push(self, key, ts, flat, rows):
+        """flat: contribution to our shard (dense) or row-block (sparse)."""
+        with self._lock:
+            self._wait_key(key)
+            if not self.sync:
+                self._apply(key, np.array(flat), rows)
+                return ("ok",)
+            pend = self.pending.setdefault((key, ts), _PendingAgg())
+            if rows is None:
+                pend.acc = flat if pend.acc is None else pend.acc + flat
+            else:
+                # row-sparse: accumulate into a dense scratch of our shard
+                if pend.acc is None:
+                    pend.acc = np.zeros_like(self.store[key])
+                w = pend.acc.reshape(self.shapes[key])
+                w[np.asarray(rows, np.int64)] += flat
+            pend.count += 1
+            if pend.count == self.nworkers:
+                self._apply(key, pend.acc, None)
+                del self.pending[(key, ts)]
+                self._cv.notify_all()
+            else:
+                while (key, ts) in self.pending:
+                    self._cv.wait()
+        return ("ok",)
+
+    def _apply(self, key, agg, rows):
+        """Aggregated gradient → updater (or overwrite, matching the
+        reference server's no-updater CopyFromTo path)."""
+        if rows is not None:  # async sparse push
+            dense = np.zeros_like(self.store[key])
+            dense.reshape(self.shapes[key])[np.asarray(rows, np.int64)] = agg
+            agg = dense
+        if self.updater is None:
+            self.store[key] = np.asarray(agg, self.store[key].dtype).ravel()
+            return
+        from . import ndarray as _nd
+        shape = self.shapes[key]
+        lo, hi = self.ranges[key]
+        full = lo == 0 and hi == int(np.prod(shape))
+        wshape = shape if full else (hi - lo,)
+        w = _nd.array(self.store[key].reshape(wshape), ctx=_cpu())
+        g = _nd.array(np.asarray(agg, self.store[key].dtype).reshape(wshape),
+                      ctx=_cpu())
+        self.updater(_int_key(key), g, w)
+        self.store[key] = w.asnumpy().astype(self.store[key].dtype).ravel()
+
+    def serve_forever(self, lsock, stop):
+        while not stop.is_set():
+            try:
+                lsock.settimeout(0.25)
+                sock, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn = Conn(sock)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        while True:
+            try:
+                msg = conn.recv()
+            except ConnectionError:
+                return
+            try:
+                reply = self.handle(msg)
+            except Exception:  # surface server bugs to the worker instead
+                import traceback  # of hanging its blocking recv()
+                reply = ("err", traceback.format_exc())
+                with self._lock:      # unblock peers waiting on this key
+                    self._cv.notify_all()
+            if reply is not None:
+                conn.send(reply)
+
+
+def _cpu():
+    from .context import cpu
+    return cpu()
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+# ---------------------------------------------------------------------------
+# role mains
+# ---------------------------------------------------------------------------
+
+def run_scheduler():
+    Scheduler(num_workers(), num_servers()).run()
+
+
+def run_server():
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("", 0))
+    lsock.listen(128)
+    my_addr = ("127.0.0.1", lsock.getsockname()[1])
+
+    server = Server(num_workers())
+    stop = threading.Event()
+    t = threading.Thread(target=server.serve_forever, args=(lsock, stop),
+                         daemon=True)
+    t.start()
+
+    sched = Conn.connect(root_addr())
+    sched.send(("reg_server", my_addr))
+    sched.recv()  # ("ranked", rank, addrs)
+    # block until scheduler says shutdown
+    try:
+        msg = sched.recv()
+    except ConnectionError:
+        msg = ("shutdown",)
+    assert msg[0] == "shutdown"
+    stop.set()
+    lsock.close()
+
+
+def _check(reply):
+    """Re-raise server-side failures shipped back as ('err', traceback)."""
+    if isinstance(reply, tuple) and reply and reply[0] == "err":
+        raise RuntimeError("kvstore server error:\n" + reply[1])
+    return reply
+
+
+class WorkerTransport:
+    """Worker-side connections: one to the scheduler, one per server."""
+
+    def __init__(self):
+        self.sched = Conn.connect(root_addr())
+        rank_hint = os.environ.get("DMLC_WORKER_RANK")
+        self.sched.send(("reg_worker",
+                         int(rank_hint) if rank_hint is not None else None))
+        msg = self.sched.recv()
+        assert msg[0] == "ranked"
+        self.rank = msg[1]
+        self.server_conns = [Conn.connect(tuple(a)) for a in msg[2]]
+        self.nservers = len(self.server_conns)
+        self._ts = {}     # key -> push timestamp counter
+        self._lock = threading.Lock()
+
+    # -- scheduler ops ------------------------------------------------------
+    def barrier(self):
+        self.sched.send(("barrier",))
+        msg = self.sched.recv()
+        assert msg[0] == "barrier_done"
+
+    def finalize(self):
+        try:
+            self.sched.send(("finalize",))
+            self.sched.recv()
+        except (OSError, ConnectionError):
+            pass
+        for c in self.server_conns:
+            c.close()
+        self.sched.close()
+
+    # -- kv ops -------------------------------------------------------------
+    def init(self, key, arr):
+        flat = np.asarray(arr).ravel()
+        for sidx, (lo, hi) in placement(key, arr.shape, self.nservers):
+            c = self.server_conns[sidx]
+            c.send(("init", key, flat[lo:hi], arr.shape, (lo, hi)))
+            _check(c.recv())
+
+    def push(self, key, arr, rows=None):
+        with self._lock:
+            ts = self._ts[key] = self._ts.get(key, -1) + 1
+        if rows is not None:
+            sidx = server_of_key(key, self.nservers)
+            c = self.server_conns[sidx]
+            c.send(("push", key, ts, np.asarray(arr), np.asarray(rows)))
+            _check(c.recv())
+            return
+        flat = np.asarray(arr).ravel()
+        plc = placement(key, arr.shape, self.nservers)
+        for sidx, (lo, hi) in plc:
+            self.server_conns[sidx].send(("push", key, ts, flat[lo:hi], None))
+        for sidx, _ in plc:
+            _check(self.server_conns[sidx].recv())
+
+    def pull(self, key, shape):
+        plc = placement(key, shape, self.nservers)
+        for sidx, _ in plc:
+            self.server_conns[sidx].send(("pull", key))
+        shards = [_check(self.server_conns[sidx].recv()) for sidx, _ in plc]
+        out = np.empty(int(np.prod(shape)), shards[0][1].dtype)
+        for (_, (lo, hi)), (tag, val) in zip(plc, shards):
+            assert tag == "val"
+            out[lo:hi] = val
+        return out.reshape(shape)
+
+    def pull_rows(self, key, shape, rows):
+        sidx = server_of_key(key, self.nservers)
+        c = self.server_conns[sidx]
+        c.send(("pull_rows", key, np.asarray(rows, np.int64)))
+        tag, val = _check(c.recv())
+        assert tag == "val"
+        return val
+
+    def set_optimizer(self, optimizer):
+        blob = pickle.dumps(optimizer, protocol=pickle.HIGHEST_PROTOCOL)
+        for c in self.server_conns:
+            c.send(("set_optimizer", blob))
+        for c in self.server_conns:
+            _check(c.recv())
+
+    def set_sync(self, sync):
+        for c in self.server_conns:
+            c.send(("set_sync", sync))
+        for c in self.server_conns:
+            _check(c.recv())

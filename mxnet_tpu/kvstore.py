@@ -28,14 +28,29 @@ from . import optimizer as opt
 __all__ = ["KVStore", "create"]
 
 
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _stack_sum(arrs):
+    """One fused XLA reduction over the per-device contributions."""
+    return jnp.sum(jnp.stack(arrs), axis=0)
+
+
 def _ctx_group_sum(vals):
-    """Reduce a list of NDArrays (possibly on different devices)."""
-    out = vals[0].asnumpy().copy() if len(vals) > 1 else None
-    if out is None:
+    """Reduce a list of NDArrays (possibly on different devices).
+
+    Device path (reference ``CommDevice::Reduce``, comm.h:462-560): gather
+    the shards onto the first array's device and run one jitted sum — no
+    host round-trip.  XLA/PJRT handles the cross-device copies the way the
+    reference used P2P + a merge buffer.
+    """
+    if len(vals) == 1:
         return vals[0]
-    for v in vals[1:]:
-        out += v.asnumpy()
-    return nd.array(out, ctx=vals[0].context, dtype=vals[0].dtype)
+    dev = next(iter(vals[0]._data.devices()))
+    shards = [jax.device_put(v._data, dev) for v in vals]
+    return NDArray(_stack_sum(shards), ctx=vals[0].context)
 
 
 def _key_list(key, vals):
@@ -157,6 +172,155 @@ class KVStore:
         pass
 
 
+class KVStoreDist(KVStore):
+    """Multi-process distributed kvstore over the dist_ps transport.
+
+    Reference counterpart: ``src/kvstore/kvstore_dist.h`` (worker) +
+    ``kvstore_dist_server.h`` (server).  Semantics preserved:
+
+    - ``dist_sync``: a push blocks until every worker's contribution for
+      that (key, timestamp) is aggregated on the server and the update
+      applied — so pull-after-push observes the globally updated value.
+    - ``dist_async``: the server applies each worker's push immediately.
+    - ``set_optimizer`` pickles the optimizer to the servers
+      (update_on_kvstore mode); with no server optimizer the servers
+      store the aggregated gradient for workers to pull and apply locally.
+    - Big arrays are range-sharded across all servers
+      (MXNET_KVSTORE_BIGARRAY_BOUND).
+
+    In a process whose ``DMLC_ROLE`` is ``scheduler`` or ``server``,
+    constructing the store runs that role's loop and exits — the launcher
+    runs the same user script in every role, like the reference tracker.
+    """
+
+    def __init__(self, kind="dist_sync"):
+        super().__init__(kind)
+        import sys
+        from . import dist_ps
+        r = dist_ps.role()
+        if r == "scheduler":
+            dist_ps.run_scheduler()
+            sys.exit(0)
+        if r == "server":
+            dist_ps.run_server()
+            sys.exit(0)
+        self._trans = dist_ps.WorkerTransport()
+        self._shapes = {}
+        self._dtypes = {}
+        if "async" in kind and self._trans.rank == 0:
+            self._trans.set_sync(False)
+        # all workers rendezvous here so no push can reach a server that
+        # has not yet seen rank 0's set_sync
+        self._trans.barrier()
+        import atexit
+        atexit.register(self._finalize)
+
+    @property
+    def rank(self):
+        return self._trans.rank
+
+    @property
+    def num_workers(self):
+        from . import dist_ps
+        return dist_ps.num_workers()
+
+    def init(self, key, value):
+        keys, vals = _key_list(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            k = str(k)
+            self._shapes[k] = v.shape
+            self._dtypes[k] = v.dtype
+            if self.rank == 0:
+                self._trans.init(k, v.asnumpy())
+        self.barrier()
+
+    def _is_sharded(self, k):
+        from . import dist_ps
+        return len(dist_ps.placement(k, self._shapes[k],
+                                     self._trans.nservers)) > 1
+
+    def push(self, key, value, priority=0):
+        keys, vals = _key_list(key, value)
+        for k, v in zip(keys, vals):
+            k = str(k)
+            if k not in self._shapes:
+                raise MXNetError("key %s not initialized" % k)
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            reduced = _ctx_group_sum(list(vlist))
+            sparse = getattr(reduced, "stype", "default") == "row_sparse"
+            if sparse and not self._is_sharded(k):
+                dense = reduced.asnumpy()
+                rows = np.nonzero(np.any(dense != 0, axis=tuple(
+                    range(1, dense.ndim))))[0]
+                self._trans.push(k, dense[rows], rows=rows)
+            else:
+                # dense keys, and row_sparse keys big enough to be
+                # range-sharded across servers (row blocks don't map onto
+                # flat ranges — ship the dense aggregate instead)
+                self._trans.push(k, reduced.asnumpy())
+
+    def pull(self, key, out=None, priority=0, row_ids=None,
+             ignore_sparse=True):
+        assert out is not None
+        keys, outs = _key_list(key, out)
+        for k, o in zip(keys, outs):
+            k = str(k)
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            val = self._trans.pull(k, self._shapes.get(k, olist[0].shape))
+            for dst in olist:
+                dst._set_data(nd.array(val, ctx=dst.context,
+                                       dtype=dst.dtype)._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        assert out is not None and row_ids is not None
+        keys, outs = _key_list(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, row_ids):
+            k = str(k)
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            rows = rid.asnumpy().astype(np.int64)
+            shape = self._shapes[k]
+            if self._is_sharded(k):
+                block = self._trans.pull(k, shape)[rows]
+            else:
+                block = self._trans.pull_rows(k, shape, rows)
+            sparse = np.zeros(shape, self._dtypes[k])
+            sparse[rows] = block
+            for dst in olist:
+                dst._set_data(nd.array(sparse, ctx=dst.context,
+                                       dtype=dst.dtype)._data)
+                dst._stype = "row_sparse"
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the servers (reference kvstore.py:353:
+        rank 0 pickles it; servers build an Updater)."""
+        self._optimizer = optimizer
+        if self.rank == 0:
+            self._trans.set_optimizer(optimizer)
+        self.barrier()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise MXNetError("Cannot save states for distributed training")
+
+    def load_optimizer_states(self, fname):
+        raise MXNetError("Cannot load states for distributed training")
+
+    def barrier(self):
+        self._barrier_count += 1
+        self._trans.barrier()
+
+    def _finalize(self):
+        t, self._trans = getattr(self, "_trans", None), None
+        if t is not None:
+            t.finalize()
+
+    def __del__(self):
+        pass
+
+
 class KVStoreTPU(KVStore):
     """Mesh-collective kvstore: push records grad shards, pull materializes
     the psum'd result.  In-process it degenerates to local semantics; under
@@ -177,8 +341,12 @@ def create(name="local"):
     if name == "tpu":
         return KVStoreTPU()
     if name.startswith("dist"):
-        kv = KVStore(name)
-        return kv
+        import os
+        if "DMLC_ROLE" not in os.environ:
+            # single-process run (no launcher): degrade to local semantics,
+            # the same observable behavior as 1-worker dist
+            return KVStore(name)
+        return KVStoreDist(name)
     raise MXNetError("unknown kvstore type %s" % name)
 
 

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Local distributed-training launcher.
+
+Reference counterpart: ``tools/launch.py`` + the dmlc-core tracker
+(``launch.py:22-30``) — which spawned 1 scheduler, S servers and N workers
+over ssh/yarn/mpi/local.  This rebuild implements the ``local`` launcher:
+every role is a subprocess of this machine running the SAME command line,
+differentiated by the ``DMLC_ROLE`` env var; ``kv = mx.kv.create('dist_*')``
+inside the script detects the role and either runs the server loop or
+returns a worker kvstore (mxnet_tpu/kvstore.py).
+
+Usage:
+    python tools/launch.py -n 4 [-s 2] python train.py --kv-store dist_sync
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(num_workers, num_servers, cmd, env_extra=None, timeout=None):
+    """Spawn scheduler + servers + workers; return worker exit codes."""
+    base = dict(os.environ)
+    base.update(env_extra or {})
+    base.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(free_port()),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+    })
+
+    procs = []
+
+    def spawn(rol, rank=None):
+        env = dict(base, DMLC_ROLE=rol)
+        if rank is not None:
+            env["DMLC_WORKER_RANK"] = str(rank)
+        return subprocess.Popen(cmd, env=env)
+
+    procs.append(("scheduler", spawn("scheduler")))
+    for _ in range(num_servers):
+        procs.append(("server", spawn("server")))
+    workers = [spawn("worker", i) for i in range(num_workers)]
+
+    rcs = []
+    try:
+        for w in workers:
+            rcs.append(w.wait(timeout=timeout))
+        for _, p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+        for _, p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rcs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=None)
+    ap.add_argument("--launcher", default="local", choices=["local"])
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    nserv = args.num_servers if args.num_servers is not None else args.num_workers
+    rcs = launch(args.num_workers, nserv, args.command)
+    sys.exit(max(rcs) if rcs else 1)
+
+
+if __name__ == "__main__":
+    main()
