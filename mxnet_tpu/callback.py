@@ -1,104 +1,127 @@
-"""Training callbacks (parity: reference python/mxnet/callback.py:27-206)."""
+"""Training-loop callbacks: checkpointing, metric logging, throughput.
+
+API parity with the reference's ``python/mxnet/callback.py`` (Speedometer at
+:120, checkpoint helpers at :27-90), implemented independently around a small
+metric-formatting helper and a wall-clock rate tracker.
+"""
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Epoch-end callback to checkpoint a Module (reference callback.py:27)."""
-    period = int(max(1, period))
+def _metric_pairs(metric):
+    """Flatten an EvalMetric into a list of (name, value) tuples, or []."""
+    if metric is None:
+        return []
+    return list(metric.get_name_value())
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
-    return _callback
+
+def _fmt_pairs(pairs):
+    return "".join("\t%s=%f" % nv for nv in pairs)
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Return an epoch-end callback that snapshots *mod* every *period* epochs.
+
+    The callback signature matches the reference contract
+    ``cb(epoch, symbol, arg_params, aux_params)``; only the epoch number is
+    consulted — the module itself knows its parameters.
+    """
+    every = max(int(period), 1)
+
+    def _on_epoch_end(epoch, sym=None, arg=None, aux=None):
+        done = epoch + 1
+        if done % every == 0:
+            mod.save_checkpoint(prefix, done, save_optimizer_states)
+
+    return _on_epoch_end
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end callback to checkpoint params+symbol (reference :56)."""
-    from .model import save_checkpoint
-    period = int(max(1, period))
+    """Return an epoch-end callback writing ``prefix-symbol.json`` +
+    ``prefix-NNNN.params`` every *period* epochs (ref callback.py:56)."""
+    from .model import save_checkpoint as _save
+    every = max(int(period), 1)
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-    return _callback
+    def _on_epoch_end(epoch, sym, arg, aux):
+        done = epoch + 1
+        if done % every == 0:
+            _save(prefix, done, sym, arg, aux)
+
+    return _on_epoch_end
 
 
 def log_train_metric(period, auto_reset=False):
-    """Batch-end callback to log the metric (reference :84)."""
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
-    return _callback
+    """Return a batch-end callback logging the running training metric
+    every *period* batches (ref callback.py:84)."""
+
+    def _on_batch_end(param):
+        if param.nbatch % period != 0:
+            return
+        for name, value in _metric_pairs(param.eval_metric):
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset and param.eval_metric is not None:
+            param.eval_metric.reset()
+
+    return _on_batch_end
 
 
 class Speedometer:
-    """Throughput logger (reference callback.py:120)."""
+    """Batch-end callback printing samples/sec every ``frequent`` batches.
+
+    Mirrors the reference Speedometer (callback.py:120): the first batch of an
+    epoch only arms the timer; subsequent multiples of ``frequent`` report the
+    rate over the window since the last report and (optionally) reset the
+    metric so each report covers only its own window.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._mark = None       # wall-clock at window start; None = disarmed
+        self._prev_batch = -1
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        nbatch = param.nbatch
+        if nbatch < self._prev_batch:   # new epoch rewound the counter
+            self._mark = None
+        self._prev_batch = nbatch
+
+        if self._mark is None:
+            self._mark = time.time()
+            return
+        if nbatch % self.frequent != 0:
+            return
+
+        elapsed = time.time() - self._mark
+        rate = self.frequent * self.batch_size / max(elapsed, 1e-12)
+        pairs = _metric_pairs(param.eval_metric)
+        if pairs:
+            if self.auto_reset:
+                param.eval_metric.reset()
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, nbatch, rate, _fmt_pairs(pairs))
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, nbatch, rate)
+        self._mark = time.time()
 
 
 class ProgressBar:
-    """ASCII progress bar (reference callback.py:169)."""
+    """Batch-end callback drawing an ASCII progress bar (ref callback.py:169)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
-        self.total = total
+        self.total = max(int(total), 1)
+        self.bar_len = int(length)
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
-
-
-class BatchEndParam:
-    """Named bundle passed to batch-end callbacks (reference base.py namedtuple)."""
-
-    def __init__(self, epoch, nbatch, eval_metric, locals=None):
-        self.epoch = epoch
-        self.nbatch = nbatch
-        self.eval_metric = eval_metric
-        self.locals = locals
+        frac = min(param.nbatch / float(self.total), 1.0)
+        ticks = int(self.bar_len * frac + 0.5)
+        bar = "=" * ticks + "-" * (self.bar_len - ticks)
+        logging.info("[%s] %d%%\r", bar, int(frac * 100 + 0.999))

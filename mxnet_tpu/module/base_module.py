@@ -1,132 +1,95 @@
-"""BaseModule: the high-level train/predict interface.
+"""BaseModule: the abstract train/score/predict interface.
 
-Parity surface: reference ``python/mxnet/module/base_module.py`` —
-``fit()`` :376-530 (bind → init_params → init_optimizer → epoch loop
-{forward_backward, update, metric, callbacks, checkpoint}), ``score`` :212,
-``predict`` :272, ``forward_backward`` :189.
+API parity with the reference ``python/mxnet/module/base_module.py``
+(``fit`` :376-530, ``score`` :212, ``predict`` :272, ``forward_backward``
+:189), independently organised: the epoch loop is factored into
+``_train_one_epoch`` and callback dispatch into a shared helper.
 """
 from __future__ import annotations
 
 import logging
 import time
 
-import numpy as np
-
-from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import ndarray as nd
-from ..model import BatchEndParam
 from ..initializer import Uniform
+from ..model import BatchEndParam
 
 __all__ = ["BaseModule"]
 
 
+def _fire(callbacks, payload):
+    """Invoke a callback, or each callback in a list, with *payload*."""
+    if callbacks is None:
+        return
+    if not isinstance(callbacks, (list, tuple)):
+        callbacks = (callbacks,)
+    for cb in callbacks:
+        cb(payload)
+
+
+def _fire_epoch(callbacks, epoch, sym, arg, aux):
+    if callbacks is None:
+        return
+    if not isinstance(callbacks, (list, tuple)):
+        callbacks = (callbacks,)
+    for cb in callbacks:
+        cb(epoch, sym, arg, aux)
+
+
+def _coerce_metric(m):
+    return m if isinstance(m, metric_mod.EvalMetric) else metric_mod.create(m)
+
+
+def _subclass_must_implement(what):
+    return NotImplementedError("subclass responsibility: " + what)
+
+
 def _check_input_names(symbol, names, typename, throw):
-    args = symbol.list_arguments()
+    """Warn (or raise) when a declared data/label name is not a symbol arg."""
+    known = symbol.list_arguments()
+    weightish = ("_weight", "_bias", "_gamma", "_beta")
     for name in names:
-        if name in args:
+        if name in known:
             continue
-        candidates = [arg for arg in args if not arg.endswith("_weight")
-                      and not arg.endswith("_bias") and not arg.endswith("_gamma")
-                      and not arg.endswith("_beta")]
+        suggestions = [a for a in known
+                       if not any(a.endswith(suf) for suf in weightish)]
         msg = ("\033[91mYou created Module with Module(..., %s_names=%s) but "
                "input with name '%s' is not found in symbol.list_arguments(). "
                "Did you mean one of:\n\t%s\033[0m"
-               % (typename, str(names), name, "\n\t".join(candidates)))
+               % (typename, str(names), name, "\n\t".join(suggestions)))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
+def _trim_pad(outputs, pad):
+    """Drop the last *pad* rows (batch padding) from each output array."""
+    if not pad:
+        return list(outputs)
+    return [out[: out.shape[0] - pad] for out in outputs]
+
+
 class BaseModule:
+    """Shared state flags + the generic training/eval loops.
+
+    Concrete subclasses (Module, BucketingModule, ...) implement the
+    computation primitives (bind/forward/backward/update/...); everything
+    here is expressed in terms of those primitives only.
+    """
+
     def __init__(self, logger=logging):
         self.logger = logger
-        self.binded = False
-        self.for_training = False
-        self.inputs_need_grad = False
-        self.params_initialized = False
-        self.optimizer_initialized = False
-        self._symbol = None
-        self._total_exec_bytes = 0
+        self.binded = self.params_initialized = self.optimizer_initialized = False
+        self.for_training = self.inputs_need_grad = False
+        self._symbol, self._total_exec_bytes = None, 0
 
-    # -- high-level API ----------------------------------------------------
+    # ---- high-level driver API ----
+
     def forward_backward(self, data_batch):
-        """Run forward+backward (reference base_module.py:189)."""
-        self.forward(data_batch, is_train=True)
+        """One fused fwd+bwd pass (ref base_module.py:189)."""
+        self.forward(data_batch, True)
         self.backward()
-
-    def score(self, eval_data, eval_metric, num_batch=None,
-              batch_end_callback=None, score_end_callback=None, reset=True,
-              epoch=0):
-        """Evaluate on eval_data (reference base_module.py:212)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-        eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                eval_metric=eval_metric,
-                                                locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
-        return eval_metric.get_name_value()
-
-    def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)]
-                       for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
-
-    def predict(self, eval_data, num_batch=None, merge_batches=True,
-                reset=True, always_output_list=False):
-        """Run prediction and collect outputs (reference base_module.py:272)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad or 0
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same "\
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -136,111 +99,141 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """The full training loop (reference base_module.py:376-530)."""
-        assert num_epoch is not None, "please specify number of epochs"
-        if optimizer_params is None:
-            optimizer_params = (("learning_rate", 0.01),)
-        if initializer is None:
-            initializer = Uniform(0.01)
+        """Train for ``num_epoch - begin_epoch`` epochs (ref :376-530).
 
+        Sequence per the reference contract: bind → (monitor) → init_params →
+        init_optimizer → per-epoch {train pass, epoch callbacks, validation}.
+        """
+        if num_epoch is None:
+            raise ValueError("fit() requires num_epoch")
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(
+            kvstore=kvstore, optimizer=optimizer,
+            optimizer_params=optimizer_params or (("learning_rate", 0.01),))
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        train_metric = _coerce_metric(eval_metric)
+        val_metric = validation_metric if validation_metric is not None \
+            else train_metric
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-
-            for name, val in eval_metric.get_name_value():
+            started = time.time()
+            self._train_one_epoch(train_data, train_metric, epoch,
+                                  batch_end_callback, monitor)
+            for name, val in train_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f",
+                             epoch, time.time() - started)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            # Sync trained params back into the module's canonical copies so
+            # epoch callbacks (checkpointing) observe the latest values.
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
+            _fire_epoch(epoch_end_callback, epoch, self.symbol, arg_now, aux_now)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+                scored = self.score(eval_data, val_metric, epoch=epoch,
+                                    batch_end_callback=eval_batch_end_callback,
+                                    score_end_callback=eval_end_callback)
+                for name, val in scored:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
             train_data.reset()
 
-    # -- symbol / params ---------------------------------------------------
+    def _train_one_epoch(self, train_data, train_metric, epoch,
+                         batch_end_callback, monitor):
+        """Inner loop of one training epoch over *train_data*."""
+        train_metric.reset()
+        for nbatch, batch in enumerate(train_data):
+            self.prepare(batch)
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            self.update_metric(train_metric, batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=train_metric, locals=locals()))
+
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0):
+        """Evaluate the metric over *eval_data* (ref base_module.py:212)."""
+        self._require_ready()
+        if reset:
+            eval_data.reset()
+        eval_metric = _coerce_metric(eval_metric)
+        eval_metric.reset()
+
+        seen = 0
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals()))
+            seen += 1
+        _fire(score_end_callback,
+              BatchEndParam(epoch=epoch, nbatch=seen,
+                            eval_metric=eval_metric, locals=locals()))
+        return eval_metric.get_name_value()
+
+    def iter_predict(self, eval_data, num_batch=None, reset=True):
+        """Yield ``(padded-trimmed outputs, i, batch)`` per batch."""
+        self._require_ready()
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            yield (_trim_pad(self.get_outputs(), batch.pad or 0),
+                   nbatch, batch)
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        """Collect forward outputs over *eval_data* (ref base_module.py:272).
+
+        With ``merge_batches`` the per-batch output lists are concatenated
+        along axis 0 into one array per output head.
+        """
+        per_batch = [[o.copy() for o in outs] for outs, _, _
+                     in self.iter_predict(eval_data, num_batch, reset)]
+        if not per_batch or not merge_batches:
+            return per_batch
+        heads = len(per_batch[0])
+        if any(len(outs) != heads for outs in per_batch):
+            raise ValueError(
+                "cannot merge: per-batch output counts differ "
+                "(bucketing produces variable head counts)")
+        merged = [nd.concatenate([outs[i] for outs in per_batch])
+                  for i in range(heads)]
+        if heads == 1 and not always_output_list:
+            return merged[0]
+        return merged
+
+    # ---- parameter management ----
+
     @property
     def symbol(self):
         return self._symbol
 
-    @property
-    def data_names(self):
-        raise NotImplementedError()
-
-    @property
-    def output_names(self):
-        raise NotImplementedError()
-
-    @property
-    def data_shapes(self):
-        raise NotImplementedError()
-
-    @property
-    def label_shapes(self):
-        raise NotImplementedError()
-
-    @property
-    def output_shapes(self):
-        raise NotImplementedError()
-
     def get_params(self):
-        raise NotImplementedError()
+        raise _subclass_must_implement("get_params")
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
-        raise NotImplementedError()
+        raise _subclass_must_implement("init_params")
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
@@ -249,71 +242,93 @@ class BaseModule:
                          force_init=force_init, allow_extra=allow_extra)
 
     def save_params(self, fname):
+        """Write params to *fname* in the ``arg:``/``aux:`` dict format."""
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v.as_in_context(v.context)
-                     for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v.as_in_context(v.context)
-                          for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        blob = {}
+        for prefix, group in (("arg:", arg_params), ("aux:", aux_params)):
+            for name, array in group.items():
+                blob[prefix + name] = array
+        nd.save(fname, blob)
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
+        """Read params written by :meth:`save_params`."""
+        arg_params, aux_params = {}, {}
+        for key, array in nd.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
+                arg_params[name] = array
+            elif kind == "aux":
+                aux_params[name] = array
             else:
-                raise ValueError("Invalid param file " + fname)
+                raise ValueError("unrecognised key %r in %s" % (key, fname))
         self.set_params(arg_params, aux_params)
 
     def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        self._require_ready()
         return []
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
+        self._require_ready()
 
     def install_monitor(self, mon):
-        raise NotImplementedError()
+        raise _subclass_must_implement("install_monitor")
 
     def prepare(self, data_batch):
-        pass
+        """Hook called before each training batch (sparse row-id prefetch
+        in the reference); default no-op."""
 
-    # -- computation -------------------------------------------------------
+    def _require_ready(self):
+        if not (self.binded and self.params_initialized):
+            raise AssertionError("module must be binded and initialized")
+
+    # ---- abstract properties ----
+
+    @property
+    def data_names(self):
+        raise _subclass_must_implement("data_names")
+
+    @property
+    def output_names(self):
+        raise _subclass_must_implement("output_names")
+
+    @property
+    def data_shapes(self):
+        raise _subclass_must_implement("data_shapes")
+
+    @property
+    def label_shapes(self):
+        raise _subclass_must_implement("label_shapes")
+
+    @property
+    def output_shapes(self):
+        raise _subclass_must_implement("output_shapes")
+
+    # ---- abstract computation primitives ----
+
     def forward(self, data_batch, is_train=None):
-        raise NotImplementedError()
+        raise _subclass_must_implement("forward")
 
     def backward(self, out_grads=None):
-        raise NotImplementedError()
+        raise _subclass_must_implement("backward")
 
     def get_outputs(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise _subclass_must_implement("get_outputs")
 
     def get_input_grads(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise _subclass_must_implement("get_input_grads")
 
     def update(self):
-        raise NotImplementedError()
+        raise _subclass_must_implement("update")
 
     def update_metric(self, eval_metric, labels):
-        raise NotImplementedError()
+        raise _subclass_must_implement("update_metric")
 
-    def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        raise NotImplementedError()
+    def bind(self, data_shapes, label_shapes=None,
+             for_training=True, inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        raise _subclass_must_implement("bind")
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        raise NotImplementedError()
-
-
-def _as_list(obj):
-    if isinstance(obj, (list, tuple)):
-        return obj
-    return [obj]
+        raise _subclass_must_implement("init_optimizer")

@@ -1,16 +1,16 @@
-"""BucketingModule: per-bucket executors sharing parameters.
+"""BucketingModule: one Module per sequence-length bucket, shared params.
 
-Parity surface: reference ``python/mxnet/module/bucketing_module.py:35-106``.
-TPU note (SURVEY §5.7): buckets map naturally onto per-shape jit
-specializations — each bucket key compiles its own XLA program once, params
-are shared across buckets by name.
+API parity with the reference ``python/mxnet/module/bucketing_module.py:35-106``.
+TPU note (SURVEY §5.7): each bucket key is simply a distinct jit
+specialization — the first batch of a bucket compiles its XLA program, later
+batches reuse it; parameters are shared across buckets by name through the
+leader (default-bucket) module.
 """
 from __future__ import annotations
 
 import logging
 import warnings
 
-from ..base import MXNetError
 from .base_module import BaseModule
 from .module import Module
 
@@ -18,245 +18,251 @@ __all__ = ["BucketingModule"]
 
 
 class BucketingModule(BaseModule):
+    """Routes each batch to the Module bound for its ``bucket_key``.
+
+    The default bucket's module is the *leader*: it owns the canonical
+    parameter dicts and the optimizer; other buckets alias both.
+    """
+
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None):
         super().__init__(logger=logger)
-        assert default_bucket_key is not None
-        self._default_bucket_key = default_bucket_key
+        if default_bucket_key is None:
+            raise ValueError("default_bucket_key is required")
         self._sym_gen = sym_gen
-        self._fixed_param_names = fixed_param_names
-        self._state_names = state_names
-        self._context = context
-        self._work_load_list = work_load_list
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+        self._default_bucket_key = default_bucket_key
+        self._module_kwargs = dict(
+            logger=logger, context=context, work_load_list=work_load_list,
+            fixed_param_names=fixed_param_names, state_names=state_names)
+        self._by_key = {}
+        self._active_key = None
         self._params_dirty = False
 
-    def _reset_bind(self):
-        self.binded = False
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+    # ---- internals ----
+
+    @property
+    def _active(self):
+        return self._by_key.get(self._active_key)
+
+    @property
+    def _leader(self):
+        return self._by_key.get(self._default_bucket_key)
+
+    def _generate(self, bucket_key):
+        """Call sym_gen → (symbol, data_names, label_names)."""
+        return self._sym_gen(bucket_key)
+
+    def _spawn(self, bucket_key, data_shapes, label_shapes, shared):
+        """Create and bind a Module for *bucket_key*."""
+        sym, data_names, label_names = self._generate(bucket_key)
+        mod = Module(sym, data_names, label_names, **self._module_kwargs)
+        mod.bind(data_shapes, label_shapes,
+                 for_training=self.for_training,
+                 inputs_need_grad=self.inputs_need_grad,
+                 shared_module=shared,
+                 grad_req=getattr(self, "_grad_req", "write"))
+        self._by_key[bucket_key] = mod
+        return mod
+
+    # ---- properties (delegate to the active module) ----
 
     @property
     def data_names(self):
         if self.binded:
-            return self._curr_module.data_names
-        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
-        return data_names
+            return self._active.data_names
+        return self._generate(self._default_bucket_key)[1]
 
     @property
     def output_names(self):
         if self.binded:
-            return self._curr_module.output_names
-        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
-        return symbol.list_outputs()
+            return self._active.output_names
+        return self._generate(self._default_bucket_key)[0].list_outputs()
 
     @property
     def data_shapes(self):
-        assert self.binded
-        return self._curr_module.data_shapes
+        self._require_bound()
+        return self._active.data_shapes
 
     @property
     def label_shapes(self):
-        assert self.binded
-        return self._curr_module.label_shapes
+        self._require_bound()
+        return self._active.label_shapes
 
     @property
     def output_shapes(self):
-        assert self.binded
-        return self._curr_module.output_shapes
+        self._require_bound()
+        return self._active.output_shapes
 
     @property
     def symbol(self):
-        assert self.binded
-        return self._curr_module.symbol
+        self._require_bound()
+        return self._active.symbol
 
-    def _call_sym_gen(self, bucket_key):
-        return self._sym_gen(bucket_key)
+    def _require_bound(self):
+        if not self.binded:
+            raise AssertionError("BucketingModule is not bound")
+
+    # ---- parameters ----
 
     def get_params(self):
-        assert self.binded and self.params_initialized
-        self._curr_module._params_dirty = self._params_dirty
-        params = self._curr_module.get_params()
+        self._require_ready()
+        self._active._params_dirty = self._params_dirty
+        out = self._active.get_params()
         self._params_dirty = False
-        return params
-
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        if not allow_missing:
-            self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params,
-                             allow_missing=allow_missing,
-                             force_init=force_init, allow_extra=allow_extra)
-            return
-        if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "set_params call ignored.", stacklevel=2)
-            return
-        self._curr_module.set_params(arg_params, aux_params,
-                                     allow_missing=allow_missing,
-                                     force_init=force_init,
-                                     allow_extra=allow_extra)
-        self._params_dirty = True
-        self.params_initialized = True
+        return out
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded, "call bind before initializing the parameters"
-        self._curr_module.init_params(initializer=initializer,
-                                      arg_params=arg_params,
-                                      aux_params=aux_params,
-                                      allow_missing=allow_missing,
-                                      force_init=force_init,
-                                      allow_extra=allow_extra)
+        self._require_bound()
+        self._active.init_params(initializer=initializer,
+                                 arg_params=arg_params, aux_params=aux_params,
+                                 allow_missing=allow_missing,
+                                 force_init=force_init,
+                                 allow_extra=allow_extra)
         self._params_dirty = False
         self.params_initialized = True
 
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params, allow_missing=False,
+                             force_init=force_init, allow_extra=allow_extra)
+            return
+        if self.params_initialized and not force_init:
+            warnings.warn("set_params ignored: already initialized "
+                          "(pass force_init=True to override)", stacklevel=2)
+            return
+        self._active.set_params(arg_params, aux_params,
+                                allow_missing=True, force_init=force_init,
+                                allow_extra=allow_extra)
+        self._params_dirty, self.params_initialized = True, True
+
     def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_states(merge_multi_context)
+        self._require_ready()
+        return self._active.get_states(merge_multi_context)
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
-        self._curr_module.set_states(states, value)
+        self._require_ready()
+        self._active.set_states(states, value)
+
+    # ---- binding / bucket switching ----
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        assert shared_module is None, \
-            "shared_module for BucketingModule is not supported"
+        if shared_module is not None:
+            raise ValueError("BucketingModule does not accept shared_module")
         if force_rebind:
-            self._reset_bind()
+            self.binded = False
+            self._by_key, self._active_key = {}, None
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
         self.binded = True
-
-        symbol, data_names, label_names = self._call_sym_gen(
-            self._default_bucket_key)
-        module = Module(symbol, data_names, label_names, logger=self.logger,
-                        context=self._context,
-                        work_load_list=self._work_load_list,
-                        fixed_param_names=self._fixed_param_names,
-                        state_names=self._state_names)
-        module.bind(data_shapes, label_shapes, for_training,
-                    inputs_need_grad, force_rebind=False,
-                    shared_module=None, grad_req=grad_req)
-        self._curr_module = module
-        self._curr_bucket_key = self._default_bucket_key
-        self._buckets[self._default_bucket_key] = module
+        self._spawn(self._default_bucket_key, data_shapes, label_shapes,
+                    shared=None)
+        self._active_key = self._default_bucket_key
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
-        """Switch to a bucket's executor, binding it on first use
-        (reference bucketing_module.py:switch_bucket)."""
-        assert self.binded, "call bind before switching bucket"
-        if bucket_key not in self._buckets:
-            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
-            module = Module(symbol, data_names, label_names,
-                            logger=self.logger, context=self._context,
-                            work_load_list=self._work_load_list,
-                            fixed_param_names=self._fixed_param_names,
-                            state_names=self._state_names)
-            module.bind(data_shapes, label_shapes, self._curr_module.for_training,
-                        self._curr_module.inputs_need_grad,
-                        force_rebind=False, shared_module=self._buckets[
-                            self._default_bucket_key])
-            self._buckets[bucket_key] = module
-        self._curr_module = self._buckets[bucket_key]
-        self._curr_bucket_key = bucket_key
-        # share latest params across buckets
-        if self.params_initialized:
-            def_mod = self._buckets[self._default_bucket_key]
-            if self._curr_module is not def_mod:
-                self._curr_module._arg_params = def_mod._arg_params
-                self._curr_module._aux_params = def_mod._aux_params
-                self._curr_module._exec_group.set_params(def_mod._arg_params,
-                                                         def_mod._aux_params)
-                self._curr_module.params_initialized = True
+        """Make *bucket_key* active, binding its module on first use
+        against the leader's parameter pool."""
+        self._require_bound()
+        if bucket_key not in self._by_key:
+            self._spawn(bucket_key, data_shapes, label_shapes,
+                        shared=self._leader)
+        self._active_key = bucket_key
+        if self.params_initialized and self._active is not self._leader:
+            # alias the leader's canonical dicts and refresh device copies
+            leader = self._leader
+            mod = self._active
+            mod._arg_params, mod._aux_params = (leader._arg_params,
+                                                leader._aux_params)
+            mod._exec_group.set_params(leader._arg_params, leader._aux_params)
+            mod.params_initialized = True
+
+    # ---- optimizer ----
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        assert self.binded and self.params_initialized
+        self._require_ready()
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
-                                         force_init=force_init)
-        for mod in self._buckets.values():
-            if mod is not self._curr_module:
-                mod.borrow_optimizer(self._curr_module) \
-                    if hasattr(mod, "borrow_optimizer") else None
+        self._active.init_optimizer(kvstore, optimizer, optimizer_params,
+                                    force_init=force_init)
         self.optimizer_initialized = True
 
+    def _lend_optimizer(self, mod):
+        """Point *mod* at the leader's optimizer/kvstore/updater."""
+        leader = self._leader
+        mod._optimizer, mod._updater = leader._optimizer, leader._updater
+        mod._kvstore = leader._kvstore
+        mod._update_on_kvstore = leader._update_on_kvstore
+        mod.optimizer_initialized = True
+
+    # ---- computation ----
+
     def prepare(self, data_batch):
-        assert self.binded and self.params_initialized
-        bucket_key = getattr(data_batch, "bucket_key", None)
-        if bucket_key is not None:
-            self.switch_bucket(bucket_key, data_batch.provide_data,
+        self._require_ready()
+        key = getattr(data_batch, "bucket_key", None)
+        if key is not None:
+            self.switch_bucket(key, data_batch.provide_data,
                                data_batch.provide_label)
 
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        bucket_key = getattr(data_batch, "bucket_key", None)
-        if bucket_key is not None and bucket_key != self._curr_bucket_key:
-            self.switch_bucket(bucket_key, data_batch.provide_data,
+        self._require_ready()
+        key = getattr(data_batch, "bucket_key", None)
+        if key is not None and key != self._active_key:
+            self.switch_bucket(key, data_batch.provide_data,
                                data_batch.provide_label)
-            if not self._curr_module.optimizer_initialized and \
-                    self.optimizer_initialized:
-                self._borrow_optimizer(self._curr_module)
-        self._curr_module.forward(data_batch, is_train=is_train)
-
-    def _borrow_optimizer(self, module):
-        default = self._buckets[self._default_bucket_key]
-        module._optimizer = default._optimizer
-        module._kvstore = default._kvstore
-        module._update_on_kvstore = default._update_on_kvstore
-        module._updater = default._updater
-        module.optimizer_initialized = True
+            if self.optimizer_initialized and \
+                    not self._active.optimizer_initialized:
+                self._lend_optimizer(self._active)
+        self._active.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
-        self._curr_module.backward(out_grads=out_grads)
+        self._require_ready()
+        self._active.backward(out_grads=out_grads)
 
     def update(self):
-        assert self.binded and self.params_initialized \
-            and self.optimizer_initialized
+        self._require_ready()
+        if not self.optimizer_initialized:
+            raise AssertionError("init_optimizer must run before update")
         self._params_dirty = True
-        self._curr_module.update()
-        # propagate updated params to the default bucket's store
-        if self._curr_bucket_key != self._default_bucket_key:
-            arg, aux = self._curr_module.get_params()
-            def_mod = self._buckets[self._default_bucket_key]
-            def_mod._arg_params = arg
-            def_mod._aux_params = aux
-            def_mod._exec_group.set_params(arg, aux)
+        self._active.update()
+        if self._active_key != self._default_bucket_key:
+            # keep the leader authoritative for later bucket switches
+            arg, aux = self._active.get_params()
+            leader = self._leader
+            leader._arg_params, leader._aux_params = arg, aux
+            leader._exec_group.set_params(arg, aux)
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_outputs(merge_multi_context)
+        self._require_ready()
+        return self._active.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized \
-            and self.inputs_need_grad
-        return self._curr_module.get_input_grads(merge_multi_context)
+        self._require_ready()
+        if not self.inputs_need_grad:
+            raise AssertionError("bind with inputs_need_grad=True first")
+        return self._active.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        assert self.binded and self.params_initialized
-        self._curr_module.update_metric(eval_metric, labels)
+        self._require_ready()
+        self._active.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
-        assert self.binded
-        for mod in self._buckets.values():
+        self._require_bound()
+        for mod in self._by_key.values():
             mod.install_monitor(mon)
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        self._buckets[self._default_bucket_key].save_checkpoint(
-            prefix, epoch, save_optimizer_states)
+        self._leader.save_checkpoint(prefix, epoch, save_optimizer_states)

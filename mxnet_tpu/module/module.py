@@ -1,394 +1,405 @@
-"""Module: symbol + context list intermediate-level API.
+"""Module: the symbol + context-list training unit.
 
-Parity surface: reference ``python/mxnet/module/module.py:39`` — bind,
-init_params, init_optimizer (kvstore decision via model.py:57), forward/
-backward/update, save/load_checkpoint incl. optimizer state.
+API parity with the reference ``python/mxnet/module/module.py:39`` (bind /
+init_params / init_optimizer / forward / backward / update / checkpointing
+incl. optimizer state), built independently around a DataParallelExecutorGroup
+and the kvstore helpers in ``model.py``.
 """
 from __future__ import annotations
 
 import logging
 import warnings
 
-from ..base import MXNetError
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
 from ..initializer import Uniform, InitDesc
-from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
-                     _update_params_on_kvstore, save_checkpoint,
-                     load_checkpoint)
 from ..io import DataDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint)
 from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
 
 __all__ = ["Module"]
 
 
+def _as_descs(shapes):
+    """Normalise a list of (name, shape) tuples / DataDesc into DataDesc."""
+    if shapes is None:
+        return None
+    return [s if isinstance(s, DataDesc) else DataDesc(*s) for s in shapes]
+
+
 class Module(BaseModule):
+    """Intermediate-level module over one symbol replicated on a ctx list."""
+
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None):
         super().__init__(logger=logger)
-        if context is None:
-            context = ctx_mod.current_context()
-        if isinstance(context, ctx_mod.Context):
-            context = [context]
-        self._context = context
-        if work_load_list is None:
-            work_load_list = [1] * len(self._context)
-        assert len(work_load_list) == len(self._context)
-        self._work_load_list = work_load_list
+
+        ctxs = context if context is not None else ctx_mod.current_context()
+        if isinstance(ctxs, ctx_mod.Context):
+            ctxs = [ctxs]
+        self._context = ctxs
+        self._work_load_list = work_load_list or [1] * len(ctxs)
+        if len(self._work_load_list) != len(ctxs):
+            raise ValueError("work_load_list must have one entry per context")
 
         self._symbol = symbol
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-        arg_names = symbol.list_arguments()
-        input_names = [n for n in data_names + label_names if n in arg_names]
-        self._param_names = [x for x in arg_names if x not in data_names
-                             and x not in label_names]
-        self._fixed_param_names = list(fixed_param_names or [])
-        self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = [n for n in label_names if n in arg_names]
-        self._state_names = list(state_names or [])
-        self._output_names = symbol.list_outputs()
-        _check_input_names(symbol, data_names, "data", True)
+        self._partition_names(symbol, data_names, label_names,
+                              fixed_param_names, state_names)
+        _check_input_names(symbol, self._data_names, "data", True)
 
-        self._arg_params = None
-        self._aux_params = None
+        # Host-side canonical parameter copies; device copies live in the
+        # executor group and are flagged dirty after each update().
+        self._arg_params = self._aux_params = None
         self._params_dirty = False
-        self._optimizer = None
-        self._kvstore = None
-        self._update_on_kvstore = None
-        self._updater = None
-        self._preload_opt_states = None
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
+        self._optimizer = self._updater = self._kvstore = None
+        self._update_on_kvstore = self._preload_opt_states = None
+        self._exec_group = self._data_shapes = self._label_shapes = None
+
+    def _partition_names(self, symbol, data_names, label_names,
+                         fixed_param_names, state_names):
+        """Split symbol arguments into data / label / parameter groups."""
+        data_names = list(data_names or [])
+        label_names = list(label_names or [])
+        args = symbol.list_arguments()
+        inputs = set(data_names) | set(label_names)
+        self._data_names = data_names
+        self._label_names = [n for n in label_names if n in args]
+        self._param_names = [a for a in args if a not in inputs]
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+    # ---- checkpointing ----
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Rebuild a Module from ``prefix-symbol.json`` + ``prefix-NNNN.params``."""
         sym, args, auxs = load_checkpoint(prefix, epoch)
-        mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod = Module(sym, **kwargs)
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
-        return mod
+        return mod  # optimizer states attach lazily at init_optimizer
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        self._symbol.save("%s-symbol.json" % prefix)
-        param_name = "%s-%04d.params" % (prefix, epoch)
-        self.save_params(param_name)
-        logging.info("Saved checkpoint to \"%s\"", param_name)
+        """Write symbol json + params (+ optimizer states) for *epoch*."""
+        self._symbol.save(prefix + "-symbol.json")
+        params_file = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(params_file)
+        logging.info('Saved checkpoint to "%s"', params_file)
         if save_optimizer_states:
-            state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
-            logging.info("Saved optimizer state to \"%s\"", state_name)
+            states_file = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(states_file)
+            logging.info('Saved optimizer state to "%s"', states_file)
 
-    # -- properties --------------------------------------------------------
-    @property
-    def data_names(self):
-        return self._data_names
+    def save_optimizer_states(self, fname):
+        if not self.optimizer_initialized:
+            raise AssertionError("optimizer not initialized")
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fh:
+                fh.write(self._updater.get_states())
 
-    @property
-    def label_names(self):
-        return self._label_names
+    def load_optimizer_states(self, fname):
+        if not self.optimizer_initialized:
+            raise AssertionError("optimizer not initialized")
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as fh:
+                self._updater.set_states(fh.read())
 
-    @property
-    def output_names(self):
-        return self._output_names
+    # ---- properties ----
+
+    output_names = property(lambda self: self._output_names)
+    data_names = property(lambda self: self._data_names)
+    label_names = property(lambda self: self._label_names)
 
     @property
     def data_shapes(self):
-        assert self.binded
+        self._require_bound()
         return self._data_shapes
 
     @property
     def label_shapes(self):
-        assert self.binded
+        self._require_bound()
         return self._label_shapes
 
     @property
     def output_shapes(self):
-        assert self.binded
-        outs = self._exec_group.execs[0].outputs if self._exec_group.execs else []
-        return list(zip(self._output_names, [o.shape for o in outs]))
+        self._require_bound()
+        execs = self._exec_group.execs
+        outs = execs[0].outputs if execs else []
+        return list(zip(self._output_names, (o.shape for o in outs)))
 
-    # -- params ------------------------------------------------------------
+    def _require_bound(self):
+        if not self.binded:
+            raise AssertionError("module is not bound")
+
+    # ---- parameters ----
+
     def get_params(self):
-        assert self.binded and self.params_initialized
+        self._require_ready()
         if self._params_dirty:
             self._sync_params_from_devices()
-        return (self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
+
+    def _alloc_host_params(self):
+        """Allocate zeroed host-side copies shaped like executor 0's arrays."""
+        proto = self._exec_group.execs[0]
+        if self._arg_params is None:
+            self._arg_params = {
+                n: nd.zeros(proto.arg_dict[n].shape,
+                            dtype=proto.arg_dict[n].dtype)
+                for n in self._param_names}
+        if self._aux_params is None:
+            self._aux_params = {
+                n: nd.zeros(proto.aux_dict[n].shape,
+                            dtype=proto.aux_dict[n].dtype)
+                for n in self._aux_names}
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
+        """Fill parameters from *arg_params*/*aux_params* or *initializer*.
+
+        Contract (ref module.py:246): provided dicts win; missing entries fall
+        back to the initializer when ``allow_missing``, else raise.
+        """
         if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "init_params call ignored.", stacklevel=2)
+            warnings.warn("init_params ignored: already initialized "
+                          "(pass force_init=True to override)", stacklevel=2)
             return
-        assert self.binded, "call bind before initializing the parameters"
+        self._require_bound()
         if initializer is None:
             initializer = Uniform(0.01)
-
-        if self._arg_params is None:
-            self._arg_params = {
-                name: nd.zeros(self._exec_group.execs[0].arg_dict[name].shape,
-                               dtype=self._exec_group.execs[0].arg_dict[name].dtype)
-                for name in self._param_names}
-        if self._aux_params is None:
-            self._aux_params = {
-                name: nd.zeros(self._exec_group.execs[0].aux_dict[name].shape,
-                               dtype=self._exec_group.execs[0].aux_dict[name].dtype)
-                for name in self._aux_names}
-
+        self._alloc_host_params()
         attrs = self._symbol.attr_dict()
 
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        cache_arr.copyto(arr)
-                else:
-                    if not allow_missing:
-                        raise RuntimeError("%s is not presented" % name)
+        for target, source in ((self._arg_params, arg_params),
+                               (self._aux_params, aux_params)):
+            for name in sorted(target):
+                desc = InitDesc(name, attrs.get(name))
+                arr = target[name]
+                if source is None:
+                    initializer(desc, arr)
+                elif name in source:
+                    if source[name] is not arr:
+                        source[name].copyto(arr)
+                elif allow_missing:
                     if initializer is not None:
-                        initializer(InitDesc(name, attrs.get(name)), arr)
-            else:
-                initializer(InitDesc(name, attrs.get(name)), arr)
+                        initializer(desc, arr)
+                else:
+                    raise RuntimeError("%s is not presented" % name)
 
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name))
-            _impl(desc, arr, aux_params)
-
-        self.params_initialized = True
-        self._params_dirty = False
-        self._exec_group.set_params(self._arg_params, self._aux_params,
-                                    allow_extra=allow_extra)
+        self.params_initialized, self._params_dirty = True, False
+        self._exec_group.set_params(
+            self._arg_params, self._aux_params, allow_extra=allow_extra)
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=allow_missing,
+                             aux_params=aux_params, allow_missing=False,
                              force_init=force_init, allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "set_params call ignored.", stacklevel=2)
+            warnings.warn("set_params ignored: already initialized "
+                          "(pass force_init=True to override)", stacklevel=2)
             return
+        # Partial update: push straight to devices, host copies become stale.
         self._exec_group.set_params(arg_params, aux_params,
                                     allow_extra=allow_extra)
-        self._params_dirty = True
-        self.params_initialized = True
+        self._params_dirty, self.params_initialized = True, True
 
-    # -- bind --------------------------------------------------------------
-    def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
+    def _sync_params_from_devices(self):
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        self._params_dirty = False
+
+    # ---- binding ----
+
+    def bind(self, data_shapes, label_shapes=None,
+             for_training=True, inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        """Create device executors for the given input shapes."""
         if force_rebind:
-            self._reset_bind()
+            self.binded, self._exec_group = False, None
+            self._data_shapes = self._label_shapes = None
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
+
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = _as_descs(data_shapes)
+        self._label_shapes = _as_descs(label_shapes)
+        self._exec_group = self._make_exec_group(for_training,
+                                                 inputs_need_grad, grad_req)
         self.binded = True
 
-        self._data_shapes = DataDesc.get_list(
-            [tuple(d) if not isinstance(d, DataDesc) else d
-             for d in data_shapes])
-        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
-                             for d in data_shapes]
-        self._label_shapes = ([d if isinstance(d, DataDesc) else DataDesc(*d)
-                               for d in label_shapes]
-                              if label_shapes else None)
+        if shared_module is not None:
+            # Alias (not copy) the donor module's host params, per reference.
+            self._arg_params, self._aux_params = (
+                shared_module._arg_params, shared_module._aux_params)
+            self.params_initialized = True
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
 
-        self._exec_group = DataParallelExecutorGroup(
+    def _make_exec_group(self, for_training, inputs_need_grad,
+                         grad_req="write"):
+        return DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group=None,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
             grad_req=grad_req, state_names=self._state_names)
 
-        if shared_module is not None:
-            self.params_initialized = True
-            self._arg_params = shared_module._arg_params
-            self._aux_params = shared_module._aux_params
-            self._exec_group.set_params(self._arg_params, self._aux_params)
-        elif self.params_initialized:
+    def reshape(self, data_shapes, label_shapes=None):
+        """Rebind executors for new input shapes, keeping parameters."""
+        self._require_bound()
+        self._data_shapes = _as_descs(data_shapes)
+        self._label_shapes = _as_descs(label_shapes)
+        self._exec_group = self._make_exec_group(self.for_training,
+                                                 self.inputs_need_grad)
+        if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
-    def _reset_bind(self):
-        self.binded = False
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
+    # ---- optimizer ----
 
-    # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        assert self.binded and self.params_initialized
+        """Create kvstore + optimizer; decide update-on-kvstore placement."""
+        self._require_ready()
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
         if self._params_dirty:
             self._sync_params_from_devices()
 
-        (kvstore, update_on_kvstore) = _create_kvstore(
+        kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
-        batch_size = self._exec_group.batch_size
+
+        effective_batch = self._exec_group.batch_size
         if kvstore and "dist" in kvstore.type and "_async" in kvstore.type:
-            batch_size *= kvstore.num_workers
-        rescale_grad = 1.0 / batch_size
+            effective_batch *= kvstore.num_workers
 
         if isinstance(optimizer, str):
-            idx2name = {}
-            if update_on_kvstore:
-                idx2name.update(enumerate(self._exec_group.param_names))
-            else:
-                for k in range(len(self._context)):
-                    idx2name.update(
-                        {i * len(self._context) + k: n
-                         for i, n in enumerate(self._exec_group.param_names)})
-            optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
-            optimizer = opt.create(optimizer, sym=self.symbol,
-                                   param_idx2name=idx2name,
-                                   **optimizer_params)
-        else:
-            assert isinstance(optimizer, opt.Optimizer)
+            optimizer = self._build_optimizer(optimizer, optimizer_params,
+                                              update_on_kvstore,
+                                              1.0 / effective_batch)
+        elif not isinstance(optimizer, opt.Optimizer):
+            raise TypeError("optimizer must be a name or an Optimizer")
 
-        self._optimizer = optimizer
-        self._kvstore = kvstore
+        self._optimizer, self._kvstore = optimizer, kvstore
         self._update_on_kvstore = update_on_kvstore
-        self._updater = None
 
         if kvstore:
-            # Name keys (reference uses int keys + idx2name; names are clearer)
-            _initialize_kvstore(kvstore=kvstore,
-                                param_arrays=self._exec_group.param_arrays,
-                                arg_params=self._arg_params,
-                                param_names=self._param_names,
-                                update_on_kvstore=update_on_kvstore)
+            _initialize_kvstore(
+                kvstore=kvstore, param_arrays=self._exec_group.param_arrays,
+                arg_params=self._arg_params, param_names=self._param_names,
+                update_on_kvstore=update_on_kvstore)
         if update_on_kvstore:
-            kvstore.set_optimizer(self._optimizer)
+            self._updater = None
+            kvstore.set_optimizer(optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
-
         self.optimizer_initialized = True
-        if self._preload_opt_states is not None:
+
+        if self._preload_opt_states:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
-    # -- computation -------------------------------------------------------
+    def _build_optimizer(self, name, optimizer_params, update_on_kvstore,
+                         rescale_grad):
+        """Instantiate a named optimizer with the per-slot name mapping the
+        Updater uses for lr/wd multipliers."""
+        n_dev = len(self._context)
+        idx2name = {}
+        for i, pname in enumerate(self._exec_group.param_names):
+            if update_on_kvstore:
+                idx2name[i] = pname
+            else:
+                for k in range(n_dev):
+                    idx2name[i * n_dev + k] = pname
+        kwargs = dict(optimizer_params)
+        kwargs.setdefault("rescale_grad", rescale_grad)
+        return opt.create(name, sym=self.symbol, param_idx2name=idx2name,
+                          **kwargs)
+
+    # ---- computation ----
+
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        curr_data_shapes = tuple(i.shape for i in self._data_shapes)
-        new_data_shapes = tuple(i.shape for i in data_batch.data)
-        if curr_data_shapes != new_data_shapes:
-            if self._params_dirty and self.params_initialized:
-                # pull updated weights off the devices before the reshape
-                # rebinds fresh executors from host-side params
-                self._sync_params_from_devices()
-            if hasattr(data_batch, "provide_data") and data_batch.provide_data:
-                new_dshape = data_batch.provide_data
-            else:
-                new_dshape = [DataDesc(i.name, shape, i.dtype, i.layout)
-                              for i, shape in zip(self._data_shapes,
-                                                  new_data_shapes)]
-            if hasattr(data_batch, "provide_label") and data_batch.provide_label:
-                new_lshape = data_batch.provide_label
-            elif hasattr(data_batch, "label") and data_batch.label:
-                new_lshape = [DataDesc(i.name, j.shape, i.dtype, i.layout)
-                              for i, j in zip(self._label_shapes,
-                                              data_batch.label)]
-            else:
-                new_lshape = None
-            self.reshape(new_dshape, new_lshape)
+        self._require_ready()
+        self._maybe_reshape(data_batch)
         self._exec_group.forward(data_batch, is_train)
 
-    def reshape(self, data_shapes, label_shapes=None):
-        assert self.binded
-        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
-                             for d in data_shapes]
-        self._label_shapes = ([d if isinstance(d, DataDesc) else DataDesc(*d)
-                               for d in label_shapes]
-                              if label_shapes else None)
-        arg_params, aux_params = (self._arg_params, self._aux_params)
-        self._exec_group = DataParallelExecutorGroup(
-            self._symbol, self._context, self._work_load_list,
-            self._data_shapes, self._label_shapes, self._param_names,
-            self.for_training, self.inputs_need_grad,
-            fixed_param_names=self._fixed_param_names,
-            grad_req="write", state_names=self._state_names)
-        if self.params_initialized:
-            self._exec_group.set_params(arg_params, aux_params)
+    def _maybe_reshape(self, data_batch):
+        """Rebind when the incoming batch's shapes differ from the bound ones
+        (last partial batch, bucketing); preserves trained params."""
+        bound = tuple(d.shape for d in self._data_shapes)
+        incoming = tuple(x.shape for x in data_batch.data)
+        if bound == incoming:
+            return
+        if self._params_dirty and self.params_initialized:
+            self._sync_params_from_devices()
+        if getattr(data_batch, "provide_data", None):
+            new_data = data_batch.provide_data
+        else:
+            new_data = [DataDesc(d.name, shp, d.dtype, d.layout)
+                        for d, shp in zip(self._data_shapes, incoming)]
+        if getattr(data_batch, "provide_label", None):
+            new_label = data_batch.provide_label
+        elif getattr(data_batch, "label", None):
+            new_label = [DataDesc(d.name, arr.shape, d.dtype, d.layout)
+                         for d, arr in zip(self._label_shapes,
+                                           data_batch.label)]
+        else:
+            new_label = None
+        self.reshape(new_data, new_label)
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
+        self._require_ready()
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
-        """Apply optimizer using accumulated grads (reference module.py:615)."""
-        assert self.binded and self.params_initialized \
-            and self.optimizer_initialized
+        """Apply the optimizer to accumulated gradients (ref module.py:615)."""
+        if not self.optimizer_initialized:
+            raise AssertionError("init_optimizer must run before update")
+        self._require_ready()
         self._params_dirty = True
+        group = self._exec_group
         if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore,
-                                      self._exec_group.param_names)
+            _update_params_on_kvstore(group.param_arrays, group.grad_arrays,
+                                      self._kvstore, group.param_names)
         else:
-            _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
-                           updater=self._updater,
+            _update_params(group.param_arrays, group.grad_arrays,
+                           updater=self._updater, kvstore=self._kvstore,
                            num_device=len(self._context),
-                           kvstore=self._kvstore,
-                           param_names=self._exec_group.param_names)
+                           param_names=group.param_names)
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        self._require_ready()
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized \
-            and self.inputs_need_grad
+        self._require_ready()
+        if not self.inputs_need_grad:
+            raise AssertionError("bind with inputs_need_grad=True first")
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
-    def _sync_params_from_devices(self):
-        self._exec_group.get_params(self._arg_params, self._aux_params)
-        self._params_dirty = False
-
-    def save_optimizer_states(self, fname):
-        assert self.optimizer_initialized
-        if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
-
-    def load_optimizer_states(self, fname):
-        assert self.optimizer_initialized
-        if self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
-        else:
-            self._updater.set_states(open(fname, "rb").read())
-
     def install_monitor(self, mon):
-        assert self.binded
+        self._require_bound()
         self._exec_group.install_monitor(mon)
 
     def prepare(self, data_batch):
