@@ -1,8 +1,10 @@
-"""Gluon losses.
+"""Gluon loss blocks.
 
-Parity surface: reference ``python/mxnet/gluon/loss.py`` — L1Loss, L2Loss,
-SigmoidBinaryCrossEntropyLoss, SoftmaxCrossEntropyLoss, KLDivLoss,
-CTCLoss, plus the weighting helpers (_apply_weighting).
+API parity with the reference ``python/mxnet/gluon/loss.py`` (L1/L2, sigmoid
+BCE, softmax CE, KL divergence, CTC, Huber/hinge family). Independent design:
+pointwise losses share a ``_PointwiseLoss`` template — subclasses provide
+only the per-element residual term; label reshaping, sample weighting, and
+the mean-over-non-batch-axes reduction live in one place.
 """
 from __future__ import annotations
 
@@ -15,204 +17,196 @@ __all__ = ["Loss", "L1Loss", "L2Loss", "SigmoidBinaryCrossEntropyLoss",
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    """Apply weighting to loss (reference loss.py:31)."""
+    """Scale *loss* by a per-sample array and/or a scalar (ref loss.py:31)."""
+    if weight is not None:
+        if not isinstance(weight, (int, float)):
+            raise TypeError("weight must be a number")
+        loss = weight * loss
     if sample_weight is not None:
         loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        assert isinstance(weight, (float, int)), "weight must be a number"
-        loss = loss * weight
     return loss
 
 
 def _reshape_like(F, x, y):
-    return x.reshape(y.shape) if F is not None else x.reshape(y.shape)
+    return x.reshape(y.shape)
 
 
 class Loss(HybridBlock):
-    """Base class for losses (reference loss.py:49)."""
+    """Loss base: remembers the scalar weight and batch axis (ref loss.py:49)."""
 
     def __init__(self, weight, batch_axis, **kwargs):
-        super(Loss, self).__init__(**kwargs)
-        self._weight = weight
-        self._batch_axis = batch_axis
+        super().__init__(**kwargs)
+        self._weight, self._batch_axis = weight, batch_axis
 
     def __repr__(self):
-        return "{name}(batch_axis={_batch_axis}, w={_weight})".format(
-            name=self.__class__.__name__, **self.__dict__)
+        return "%s(batch_axis=%s, w=%s)" % (
+            type(self).__name__, self._batch_axis, self._weight)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-
-class L2Loss(Loss):
-    r"""``L = 0.5 * w * (pred - label)^2`` (reference loss.py:82)."""
-
-    def __init__(self, weight=1., batch_axis=0, **kwargs):
-        super(L2Loss, self).__init__(weight, batch_axis, **kwargs)
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(pred - label)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class L1Loss(Loss):
-    r"""``L = w * |pred - label|`` (reference loss.py:120)."""
-
-    def __init__(self, weight=None, batch_axis=0, **kwargs):
-        super(L1Loss, self).__init__(weight, batch_axis, **kwargs)
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
+    def _finish(self, F, loss, sample_weight):
+        """Common tail: weighting then mean over every non-batch axis."""
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return F.mean(loss, axis=self._batch_axis, exclude=True)
 
 
-class SigmoidBinaryCrossEntropyLoss(Loss):
-    r"""BCE with optional logits input (reference loss.py:157)."""
+class _PointwiseLoss(Loss):
+    """Template for losses of the form mean(residual(pred, label))."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        return self._finish(F, self._residual(F, pred, label), sample_weight)
+
+    def _residual(self, F, pred, label):
+        raise NotImplementedError
+
+
+class L2Loss(_PointwiseLoss):
+    r"""``0.5 * w * (pred - label)^2`` (ref loss.py:82)."""
+
+    def __init__(self, weight=1., batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def _residual(self, F, pred, label):
+        # fold the 1/2 into the residual so _finish applies weight as-is
+        return F.square(pred - label) * 0.5
+
+
+class L1Loss(_PointwiseLoss):
+    r"""``w * |pred - label|`` (ref loss.py:120)."""
+
+    def _residual(self, F, pred, label):
+        return F.abs(pred - label)
+
+
+class HuberLoss(_PointwiseLoss):
+    r"""Smoothed L1: quadratic inside ``rho``, linear outside."""
+
+    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
+        self._rho = rho
+
+    def _residual(self, F, pred, label):
+        err = F.abs(pred - label)
+        return F.where(err > self._rho,
+                       err - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(err))
+
+
+class HingeLoss(_PointwiseLoss):
+    r"""``max(0, margin - pred * label)`` with labels in {-1, 1}."""
+
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
+        self._margin = margin
+
+    def _residual(self, F, pred, label):
+        return F.relu(self._margin - pred * label)
+
+
+class SquaredHingeLoss(HingeLoss):
+    r"""``max(0, margin - pred * label)^2``."""
+
+    def _residual(self, F, pred, label):
+        return F.square(super()._residual(F, pred, label))
+
+
+class SigmoidBinaryCrossEntropyLoss(_PointwiseLoss):
+    r"""BCE over logits (default) or probabilities (ref loss.py:157)."""
 
     def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
                  **kwargs):
-        super(SigmoidBinaryCrossEntropyLoss, self).__init__(
-            weight, batch_axis, **kwargs)
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            # stable log-sum-exp form: max(x,0) - x*z + log(1+exp(-|x|))
-            loss = F.relu(pred) - pred * label + \
-                F.Activation(-F.abs(pred), act_type="softrelu")
-        else:
-            eps = 1e-12
-            loss = -(F.log(pred + eps) * label +
-                     F.log(1. - pred + eps) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _residual(self, F, pred, label):
+        if self._from_sigmoid:
+            tiny = 1e-12
+            return -(label * F.log(pred + tiny)
+                     + (1. - label) * F.log(1. - pred + tiny))
+        # numerically stable logits form:
+        #   max(x, 0) - x*z + log1p(exp(-|x|))
+        return (F.relu(pred) - pred * label
+                + F.Activation(-F.abs(pred), act_type="softrelu"))
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    r"""Softmax + CE fused (reference loss.py:224)."""
+    r"""log-softmax + negative likelihood in one block (ref loss.py:224).
+
+    ``sparse_label`` picks the target-class log-prob; otherwise the label is
+    a dense distribution over classes.
+    """
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
-        super(SoftmaxCrossEntropyLoss, self).__init__(
-            weight, batch_axis, **kwargs)
-        self._axis = axis
-        self._sparse_label = sparse_label
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis, self._sparse_label = axis, sparse_label
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            nll = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            dist = _reshape_like(F, label, logp)
+            nll = -F.sum(logp * dist, axis=self._axis, keepdims=True)
+        return self._finish(F, nll, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
 class KLDivLoss(Loss):
-    r"""Kullback-Leibler divergence (reference loss.py:291)."""
+    r"""``sum label * (log label - log pred)`` (ref loss.py:291)."""
 
     def __init__(self, from_logits=True, axis=-1, weight=None,
                  batch_axis=0, **kwargs):
-        super(KLDivLoss, self).__init__(weight, batch_axis, **kwargs)
-        self._from_logits = from_logits
-        self._axis = axis
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits, self._axis = from_logits, axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
+        div = label * (F.log(label + 1e-12) - logp)
+        return self._finish(F, div, sample_weight)
 
 
 class CTCLoss(Loss):
-    r"""Connectionist Temporal Classification loss (reference loss.py:334;
-    lowers to the _contrib_CTCLoss op — a lax.scan forward-alpha
-    recursion on TPU)."""
+    r"""Connectionist Temporal Classification (ref loss.py:334).
+
+    Lowers to the ``_contrib_CTCLoss`` op — a lax.scan alpha-recursion on
+    TPU. Layouts: pred NTC/TNC, label NT/TN.
+    """
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None,
                  **kwargs):
-        assert layout in ["NTC", "TNC"], \
-            "Only 'NTC' and 'TNC' layouts for pred are supported."
-        assert label_layout in ["NT", "TN"], \
-            "Only 'NT' and 'TN' layouts for label are supported."
-        self._layout = layout
-        self._label_layout = label_layout
-        batch_axis = label_layout.find("N")
-        super(CTCLoss, self).__init__(weight, batch_axis, **kwargs)
+        if layout not in ("NTC", "TNC"):
+            raise ValueError("pred layout must be 'NTC' or 'TNC'")
+        if label_layout not in ("NT", "TN"):
+            raise ValueError("label layout must be 'NT' or 'TN'")
+        self._layout, self._label_layout = layout, label_layout
+        super().__init__(weight, label_layout.index("N"), **kwargs)
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
-        if self._layout == "NTC":
+        if self._layout == "NTC":                 # op wants time-major
             pred = F.swapaxes(pred, 0, 1)
-        if self._batch_axis == 1:
+        if self._batch_axis == 1:                 # label likewise
             label = F.swapaxes(label, 0, 1)
-        args = [pred, label]
-        kwargs = {}
+        operands, flags = [pred, label], {}
         if pred_lengths is not None:
-            args.append(pred_lengths)
-            kwargs["use_data_lengths"] = True
+            operands.append(pred_lengths)
+            flags["use_data_lengths"] = True
         if label_lengths is not None:
-            args.append(label_lengths)
-            kwargs["use_label_lengths"] = True
-        loss = F.contrib.CTCLoss(*args, **kwargs)
+            operands.append(label_lengths)
+            flags["use_label_lengths"] = True
+        loss = F.contrib.CTCLoss(*operands, **flags)
         return _apply_weighting(F, loss, self._weight, sample_weight)
-
-
-class HuberLoss(Loss):
-    r"""Smoothed L1 loss."""
-
-    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
-        super(HuberLoss, self).__init__(weight, batch_axis, **kwargs)
-        self._rho = rho
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class HingeLoss(Loss):
-    r"""``L = max(0, margin - pred * label)``."""
-
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super(HingeLoss, self).__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class SquaredHingeLoss(Loss):
-    r"""``L = max(0, margin - pred * label)^2``."""
-
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super(SquaredHingeLoss, self).__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)

@@ -1,108 +1,109 @@
-"""Gluon Trainer: applies an Optimizer to a set of Parameters.
+"""Gluon Trainer: one optimizer step over a parameter set.
 
-Parity surface: reference ``python/mxnet/gluon/trainer.py:27`` —
-``_init_kvstore`` (:102), ``step(batch_size)`` (:148: per-param
-kvstore.push(grad) then pull; or local Updater :181-192), stale-grad
-detection, ``save_states/load_states`` (:194-227).
+API parity with the reference ``python/mxnet/gluon/trainer.py:27``
+(``_init_kvstore`` :102, ``step`` :148-192, ``save_states``/``load_states``
+:194-227), built independently around a flat slot list.
 
-TPU-native: a single device holds one logical copy of each parameter
-(sharded/replicated by jax), so the push/pull data movement of the
-reference collapses to running the fused optimizer update op; with a
-'tpu'/'dist' kvstore the gradient is psum'd over the mesh first.
+TPU-native: one device holds one logical copy of each parameter (jax shards
+or replicates it), so the reference's per-device push/pull traffic reduces
+to the fused optimizer update; a 'tpu'/'dist' kvstore psums the gradient
+over the mesh before the update.
 """
 from __future__ import annotations
 
-from .. import optimizer as opt
 from .. import kvstore as kvs
-from .parameter import ParameterDict, Parameter
+from .. import optimizer as opt
+from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
 
 
+def _flatten_params(params):
+    """Accept ParameterDict / dict / list; return a validated flat list."""
+    if isinstance(params, (dict, ParameterDict)):
+        params = list(params.values())
+    if not isinstance(params, (list, tuple)):
+        raise ValueError("First argument must be a list or dict of "
+                         "Parameters, got %s." % type(params))
+    for p in params:
+        if not isinstance(p, Parameter):
+            raise ValueError("First argument must be a list or dict of "
+                             "Parameters, got list of %s." % type(p))
+    return list(params)
+
+
 class Trainer(object):
+    """Couples Parameters with an Optimizer and (optionally) a kvstore.
+
+    Each parameter occupies one integer slot: the slot indexes the kvstore
+    key and the Updater state entry alike.
+    """
+
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device"):
-        if isinstance(params, (dict, ParameterDict)):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
-            raise ValueError(
-                "First argument must be a list or dict of Parameters, "
-                "got %s." % type(params))
-        self._params = []
-        for param in params:
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % type(param))
-            self._params.append(param)
-
-        optimizer_params = optimizer_params if optimizer_params else {}
-        self._scale = optimizer_params.get("rescale_grad", 1.0)
-        self._init_optimizer(optimizer, optimizer_params)
-        self._kv_initialized = False
-        self._kvstore_type = kvstore
+        self._params = _flatten_params(params)
+        hyper = dict(optimizer_params or {})
+        self._scale = hyper.get("rescale_grad", 1.0)
+        self._optimizer = self._make_optimizer(optimizer, hyper)
+        self._updater = opt.get_updater(self._optimizer)
+        self._kvstore_spec = kvstore
         self._kvstore = None
-        self._update_on_kvstore = None
+        self._kv_initialized = False
 
-    def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
+    def _make_optimizer(self, optimizer, hyper):
+        slots = dict(enumerate(self._params))
         if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                "optimizer_params must be None if optimizer is an " \
-                "Optimizer instance"
-            self._optimizer = optimizer
-            self._optimizer.param_dict = param_dict
-        else:
-            self._optimizer = opt.create(optimizer, param_dict=param_dict,
-                                         **optimizer_params)
-        self._updaters = [opt.get_updater(self._optimizer)]
+            if hyper:
+                raise ValueError("optimizer_params must be None when an "
+                                 "Optimizer instance is given")
+            optimizer.param_dict = slots
+            return optimizer
+        return opt.create(optimizer, param_dict=slots, **hyper)
 
     def _init_kvstore(self):
-        if self._kvstore_type:
-            kv = kvs.create(self._kvstore_type) \
-                if isinstance(self._kvstore_type, str) else self._kvstore_type
-            self._kvstore = kv
-            self._update_on_kvstore = False
-            for i, param in enumerate(self._params):
+        """Lazily create the kvstore and register every trainable slot."""
+        spec = self._kvstore_spec
+        if spec:
+            store = kvs.create(spec) if isinstance(spec, str) else spec
+            for slot, param in enumerate(self._params):
                 if param.grad_req != "null":
-                    kv.init(i, param.data())
-        else:
-            self._kvstore = None
-            self._update_on_kvstore = False
+                    store.init(slot, param.data())
+            self._kvstore = store
         self._kv_initialized = True
 
-    @property
-    def learning_rate(self):
-        return self._optimizer.learning_rate
+    learning_rate = property(lambda self: self._optimizer.learning_rate)
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Make one parameter update step (reference trainer.py:148)."""
+        """Gradient-reduce (via kvstore) then update each parameter
+        (ref trainer.py:148). *batch_size* normalises the gradient."""
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = float(self._scale) / batch_size
 
-        for i, param in enumerate(self._params):
+        for slot, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
             grad = param.grad()
             if self._kvstore is not None:
-                # push grad, pull reduced grad (update locally)
-                self._kvstore.push(i, [grad])
-                self._kvstore.pull(i, out=[grad])
-            self._updaters[0](i, grad, param.data())
+                # all-reduce the gradient across workers, update locally
+                self._kvstore.push(slot, [grad])
+                self._kvstore.pull(slot, out=[grad])
+            self._updater(slot, grad, param.data())
 
     def save_states(self, fname):
-        assert self._optimizer is not None
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states())
+        """Serialise Updater state (optimizer moments etc.) to *fname*."""
+        if self._optimizer is None:
+            raise AssertionError("trainer has no optimizer")
+        with open(fname, "wb") as fh:
+            fh.write(self._updater.get_states())
 
     def load_states(self, fname):
+        """Restore Updater state written by :meth:`save_states`."""
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "rb") as f:
-            states = f.read()
-        self._updaters[0].set_states(states)
-        self._optimizer = self._updaters[0].optimizer
+        with open(fname, "rb") as fh:
+            self._updater.set_states(fh.read())
+        self._optimizer = self._updater.optimizer

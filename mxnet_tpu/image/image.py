@@ -1,28 +1,30 @@
-"""Image IO + augmentation pipeline.
+"""Host-side image decode + augmentation pipeline.
 
-Parity surface: reference ``python/mxnet/image/image.py`` — ``imdecode``,
-``scale_down``, ``resize_short``, ``fixed_crop``, ``random_crop``,
-``center_crop``, ``color_normalize``, augmenter classes, and ``ImageIter``
-(python-side image pipeline over .rec / .lst files).
+API parity with the reference ``python/mxnet/image/image.py`` (imdecode,
+resize/crop helpers, the augmenter zoo, CreateAugmenter, ImageIter over
+.rec/.lst sources). Independent design: every augmenter is a thin shell over
+a ``_apply(float32 HWC numpy) -> numpy`` hook, and ImageIter reads samples
+through a pluggable source object (record file vs. image list) instead of
+branching inline.
 
-TPU note: decode/augment run on host (cv2) exactly like the reference's
-OpenCV path (``src/io/image_aug_default.cc``); the device only sees the
-final batched float tensor — one upload per batch.
+TPU note: decode/augment stay on host (cv2), mirroring the reference's
+OpenCV path (``src/io/image_aug_default.cc``); the device receives one
+batched NCHW tensor per step.
 """
 from __future__ import annotations
 
 import os
-import random as pyrandom
+import random as _rng
 
 import numpy as np
 
 try:
     import cv2
-except ImportError:
+except ImportError:      # pragma: no cover - cv2 is present in CI
     cv2 = None
 
-from .. import ndarray as nd
 from .. import io as _io
+from .. import ndarray as nd
 from .. import recordio
 
 __all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
@@ -34,461 +36,537 @@ __all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
            "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
            "HorizontalFlipAug", "CastAug", "ImageIter"]
 
+# ITU-R BT.601 luma weights, used by contrast/saturation jitter.
+_LUMA = np.array([0.299, 0.587, 0.114], dtype=np.float32)
+
+
+def _to_np(img):
+    """Accept NDArray or ndarray; return a numpy view (HWC)."""
+    return img.asnumpy() if isinstance(img, nd.NDArray) else np.asarray(img)
+
+
+def _wrap(arr, dtype=None):
+    """numpy → NDArray, keeping dtype unless overridden."""
+    a = np.ascontiguousarray(arr)
+    return nd.array(a, dtype=dtype or a.dtype)
+
 
 def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an image byte buffer to an NDArray (HWC, BGR→RGB)
-    (reference image.py:imdecode over src/io/image_io.cc)."""
+    """Decode compressed image bytes into an HWC uint8 NDArray.
+
+    Matches reference ``image.py:imdecode`` (backed by src/io/image_io.cc):
+    channel order flips BGR→RGB unless ``to_rgb=False``; grayscale gets a
+    trailing singleton channel.
+    """
     if cv2 is None:
         raise ImportError("imdecode requires cv2")
-    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
-    if img is None:
+    raw = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+    if raw is None:
         raise ValueError("Decoding image failed")
-    if to_rgb and img.ndim == 3:
-        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
-    if img.ndim == 2:
-        img = img[:, :, None]
-    return nd.array(img, dtype=np.uint8)
+    if raw.ndim == 2:
+        raw = raw[:, :, None]
+    elif to_rgb:
+        raw = cv2.cvtColor(raw, cv2.COLOR_BGR2RGB)
+    return _wrap(raw, dtype=np.uint8)
 
 
 def imresize(src, w, h, interp=1):
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
-    out = cv2.resize(arr, (w, h), interpolation=interp)
-    if out.ndim == 2:
-        out = out[:, :, None]
-    return nd.array(out, dtype=out.dtype)
+    """Resize to exactly (w, h) via cv2."""
+    resized = cv2.resize(_to_np(src), (w, h), interpolation=interp)
+    if resized.ndim == 2:
+        resized = resized[:, :, None]
+    return _wrap(resized)
 
 
 def scale_down(src_size, size):
-    """Scale size down to fit in src_size (reference image.py:scale_down)."""
-    w, h = size
+    """Shrink the requested crop (w, h) to fit inside src (w, h), keeping
+    aspect (ref image.py:scale_down)."""
     sw, sh = src_size
-    if sh < h:
-        w, h = float(w * sh) / h, sh
-    if sw < w:
-        w, h = sw, float(h * sw) / w
+    w, h = size
+    if h > sh:
+        w, h = w * sh / h, sh
+    if w > sw:
+        w, h = sw, h * sw / w
     return int(w), int(h)
 
 
 def resize_short(src, size, interp=2):
-    """Resize shorter edge to size (reference image.py:resize_short)."""
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    """Resize so the shorter edge equals *size* (ref image.py:resize_short)."""
+    arr = _to_np(src)
     h, w = arr.shape[:2]
-    if h > w:
-        new_h, new_w = size * h // w, size
+    if w < h:
+        target = (size, size * h // w)      # (w, h)
     else:
-        new_h, new_w = size, size * w // h
-    return imresize(arr, new_w, new_h, interp)
+        target = (size * w // h, size)
+    return imresize(arr, target[0], target[1], interp)
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
-    out = arr[y0:y0 + h, x0:x0 + w]
-    if size is not None and (w, h) != size:
-        return imresize(out, size[0], size[1], interp)
-    return nd.array(out, dtype=out.dtype)
+    """Crop the [y0:y0+h, x0:x0+w] window, optionally resizing to *size*."""
+    window = _to_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and size != (w, h):
+        return imresize(window, size[0], size[1], interp)
+    return _wrap(window)
 
 
 def random_crop(src, size, interp=2):
+    """Uniformly-placed crop of (scaled-down) *size*; returns (img, box)."""
     h, w = src.shape[:2]
-    new_w, new_h = scale_down((w, h), size)
-    x0 = pyrandom.randint(0, w - new_w)
-    y0 = pyrandom.randint(0, h - new_h)
-    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    cw, ch = scale_down((w, h), size)
+    x0 = _rng.randint(0, w - cw)
+    y0 = _rng.randint(0, h - ch)
+    return fixed_crop(src, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
 
 
 def center_crop(src, size, interp=2):
+    """Centered crop of (scaled-down) *size*; returns (img, box)."""
     h, w = src.shape[:2]
-    new_w, new_h = scale_down((w, h), size)
-    x0 = (w - new_w) // 2
-    y0 = (h - new_h) // 2
-    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    cw, ch = scale_down((w, h), size)
+    x0, y0 = (w - cw) // 2, (h - ch) // 2
+    return fixed_crop(src, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
 
 
 def random_size_crop(src, size, min_area, ratio, interp=2):
-    """Random crop w/ size in [min_area*area, area] and aspect in ratio."""
+    """Inception-style crop: random area fraction and aspect ratio, with a
+    center-crop fallback after 10 failed attempts."""
     h, w = src.shape[:2]
-    area = h * w
     for _ in range(10):
-        target_area = pyrandom.uniform(min_area, 1.0) * area
-        new_ratio = pyrandom.uniform(*ratio)
-        new_w = int(round(np.sqrt(target_area * new_ratio)))
-        new_h = int(round(np.sqrt(target_area / new_ratio)))
-        if pyrandom.random() < 0.5:
-            new_h, new_w = new_w, new_h
-        if new_w <= w and new_h <= h:
-            x0 = pyrandom.randint(0, w - new_w)
-            y0 = pyrandom.randint(0, h - new_h)
-            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-            return out, (x0, y0, new_w, new_h)
+        frac = _rng.uniform(min_area, 1.0)
+        aspect = _rng.uniform(*ratio)
+        cw = int(round(np.sqrt(h * w * frac * aspect)))
+        ch = int(round(np.sqrt(h * w * frac / aspect)))
+        if _rng.random() < 0.5:
+            cw, ch = ch, cw
+        if cw <= w and ch <= h:
+            x0 = _rng.randint(0, w - cw)
+            y0 = _rng.randint(0, h - ch)
+            return fixed_crop(src, x0, y0, cw, ch, size, interp), \
+                (x0, y0, cw, ch)
     return center_crop(src, size, interp)
 
 
 def color_normalize(src, mean, std=None):
+    """(src - mean) / std with broadcasting; either part optional."""
+    out = src
     if mean is not None:
-        src = src - mean
+        out = out - mean
     if std is not None:
-        src = src / std
-    return src
+        out = out / std
+    return out
 
+
+# --------------------------------------------------------------------------
+# Augmenter zoo. Each subclass implements _apply(img)->img on NDArray/numpy;
+# __call__ wraps the result in a one-element list per the reference protocol
+# (RandomOrderAug may fan out).
+# --------------------------------------------------------------------------
 
 class Augmenter(object):
-    """Image augmenter base (reference image.py:Augmenter)."""
+    """Base augmenter; records ctor kwargs for ``dumps()`` introspection."""
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
 
     def dumps(self):
-        return [self.__class__.__name__.lower(), self._kwargs]
+        return [type(self).__name__.lower(), self._kwargs]
+
+    def _apply(self, src):
+        raise NotImplementedError
 
     def __call__(self, src):
-        raise NotImplementedError
+        return [self._apply(src)]
 
 
 class ResizeAug(Augmenter):
-    def __init__(self, size, interp=2):
-        super(ResizeAug, self).__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
+    """Shorter-edge resize."""
 
-    def __call__(self, src):
-        return [resize_short(src, self.size, self.interp)]
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def _apply(self, src):
+        return resize_short(src, self.size, self.interp)
 
 
 class ForceResizeAug(Augmenter):
-    def __init__(self, size, interp=2):
-        super(ForceResizeAug, self).__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
+    """Exact (w, h) resize, ignoring aspect."""
 
-    def __call__(self, src):
-        return [imresize(src, self.size[0], self.size[1], self.interp)]
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def _apply(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
 
 
 class RandomCropAug(Augmenter):
     def __init__(self, size, interp=2):
-        super(RandomCropAug, self).__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return [random_crop(src, self.size, self.interp)[0]]
+    def _apply(self, src):
+        return random_crop(src, self.size, self.interp)[0]
 
 
 class RandomSizedCropAug(Augmenter):
     def __init__(self, size, min_area, ratio, interp=2):
-        super(RandomSizedCropAug, self).__init__(
-            size=size, min_area=min_area, ratio=ratio, interp=interp)
-        self.size = size
-        self.min_area = min_area
-        self.ratio = ratio
-        self.interp = interp
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size, self.min_area = size, min_area
+        self.ratio, self.interp = ratio, interp
 
-    def __call__(self, src):
-        return [random_size_crop(src, self.size, self.min_area,
-                                 self.ratio, self.interp)[0]]
+    def _apply(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
 
 
 class CenterCropAug(Augmenter):
     def __init__(self, size, interp=2):
-        super(CenterCropAug, self).__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return [center_crop(src, self.size, self.interp)[0]]
+    def _apply(self, src):
+        return center_crop(src, self.size, self.interp)[0]
 
 
 class RandomOrderAug(Augmenter):
+    """Apply child augmenters in a freshly shuffled order each call."""
+
     def __init__(self, ts):
-        super(RandomOrderAug, self).__init__()
+        super().__init__()
         self.ts = ts
 
     def __call__(self, src):
-        pyrandom.shuffle(self.ts)
-        srcs = [src]
-        for t in self.ts:
-            srcs = [j for i in srcs for j in t(i)]
-        return srcs
+        order = list(self.ts)
+        _rng.shuffle(order)
+        imgs = [src]
+        for aug in order:
+            imgs = [out for img in imgs for out in aug(img)]
+        return imgs
 
 
 class BrightnessJitterAug(Augmenter):
     def __init__(self, brightness):
-        super(BrightnessJitterAug, self).__init__(brightness=brightness)
+        super().__init__(brightness=brightness)
         self.brightness = brightness
 
-    def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
-        return [src.astype(np.float32) * alpha]
+    def _apply(self, src):
+        gain = 1.0 + _rng.uniform(-self.brightness, self.brightness)
+        return _wrap(_to_np(src).astype(np.float32) * gain)
 
 
 class ContrastJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    """Blend toward the image's mean luma."""
 
     def __init__(self, contrast):
-        super(ContrastJitterAug, self).__init__(contrast=contrast)
+        super().__init__(contrast=contrast)
         self.contrast = contrast
 
-    def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
-        arr = src.asnumpy().astype(np.float32)
-        gray = (arr * self._coef).sum() * (3.0 / arr.size)
-        return [nd.array(arr * alpha + gray * (1.0 - alpha),
-                         dtype=np.float32)]
+    def _apply(self, src):
+        gain = 1.0 + _rng.uniform(-self.contrast, self.contrast)
+        arr = _to_np(src).astype(np.float32)
+        mean_luma = float((arr * _LUMA).sum()) * 3.0 / arr.size
+        return _wrap(arr * gain + mean_luma * (1.0 - gain))
 
 
 class SaturationJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    """Blend toward the per-pixel luma (grayscale)."""
 
     def __init__(self, saturation):
-        super(SaturationJitterAug, self).__init__(saturation=saturation)
+        super().__init__(saturation=saturation)
         self.saturation = saturation
 
-    def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
-        arr = src.asnumpy().astype(np.float32)
-        gray = (arr * self._coef).sum(axis=2, keepdims=True)
-        return [nd.array(arr * alpha + gray * (1.0 - alpha),
-                         dtype=np.float32)]
+    def _apply(self, src):
+        gain = 1.0 + _rng.uniform(-self.saturation, self.saturation)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * _LUMA).sum(axis=2, keepdims=True)
+        return _wrap(arr * gain + gray * (1.0 - gain))
 
 
 class HueJitterAug(Augmenter):
-    def __init__(self, hue):
-        super(HueJitterAug, self).__init__(hue=hue)
-        self.hue = hue
+    """Rotate hue in YIQ space by a random angle."""
 
-    def __call__(self, src):
-        alpha = pyrandom.uniform(-self.hue, self.hue)
-        arr = src.asnumpy().astype(np.float32)
-        u = np.cos(alpha * np.pi)
-        w = np.sin(alpha * np.pi)
-        bt = np.array([[1.0, 0.0, 0.0],
-                       [0.0, u, -w],
-                       [0.0, w, u]], dtype=np.float32)
-        tyiq = np.array([[0.299, 0.587, 0.114],
+    # RGB→YIQ and back (NTSC).
+    _RGB2YIQ = np.array([[0.299, 0.587, 0.114],
                          [0.596, -0.274, -0.321],
                          [0.211, -0.523, 0.311]], dtype=np.float32)
-        ityiq = np.array([[1.0, 0.956, 0.621],
-                          [1.0, -0.272, -0.647],
-                          [1.0, -1.107, 1.705]], dtype=np.float32)
-        t = np.dot(np.dot(ityiq, bt), tyiq).T
-        return [nd.array(np.dot(arr, t), dtype=np.float32)]
+    _YIQ2RGB = np.array([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], dtype=np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def _apply(self, src):
+        theta = _rng.uniform(-self.hue, self.hue) * np.pi
+        c, s = np.cos(theta), np.sin(theta)
+        rot = np.array([[1.0, 0.0, 0.0],
+                        [0.0, c, -s],
+                        [0.0, s, c]], dtype=np.float32)
+        full = (self._YIQ2RGB @ rot @ self._RGB2YIQ).T
+        return _wrap(_to_np(src).astype(np.float32) @ full)
 
 
 class ColorJitterAug(RandomOrderAug):
+    """Brightness/contrast/saturation jitter in random order."""
+
     def __init__(self, brightness, contrast, saturation):
-        ts = []
-        if brightness > 0:
-            ts.append(BrightnessJitterAug(brightness))
-        if contrast > 0:
-            ts.append(ContrastJitterAug(contrast))
-        if saturation > 0:
-            ts.append(SaturationJitterAug(saturation))
-        super(ColorJitterAug, self).__init__(ts)
+        members = [cls(v) for cls, v in
+                   ((BrightnessJitterAug, brightness),
+                    (ContrastJitterAug, contrast),
+                    (SaturationJitterAug, saturation)) if v > 0]
+        super().__init__(members)
 
 
 class LightingAug(Augmenter):
-    """PCA-based lighting jitter (AlexNet style)."""
+    """AlexNet PCA lighting noise along RGB eigenvectors."""
 
     def __init__(self, alphastd, eigval, eigvec):
-        super(LightingAug, self).__init__(alphastd=alphastd)
+        super().__init__(alphastd=alphastd)
         self.alphastd = alphastd
-        self.eigval = np.asarray(eigval)
-        self.eigvec = np.asarray(eigvec)
+        self.eigval = np.asarray(eigval, dtype=np.float32)
+        self.eigvec = np.asarray(eigvec, dtype=np.float32)
 
-    def __call__(self, src):
-        alpha = np.random.normal(0, self.alphastd, size=(3,))
-        rgb = np.dot(self.eigvec * alpha, self.eigval)
-        return [src.astype(np.float32) + nd.array(rgb, dtype=np.float32)]
+    def _apply(self, src):
+        alpha = np.random.normal(0.0, self.alphastd, size=(3,))
+        shift = (self.eigvec * alpha) @ self.eigval
+        return _wrap(_to_np(src).astype(np.float32) + shift.astype(np.float32))
 
 
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
-        super(ColorNormalizeAug, self).__init__()
-        self.mean = nd.array(mean) if mean is not None else None
-        self.std = nd.array(std) if std is not None else None
+        super().__init__()
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
 
-    def __call__(self, src):
-        return [color_normalize(src.astype(np.float32), self.mean,
-                                self.std)]
+    def _apply(self, src):
+        arr = _to_np(src).astype(np.float32)
+        return _wrap(color_normalize(arr, self.mean, self.std))
 
 
 class HorizontalFlipAug(Augmenter):
     def __init__(self, p):
-        super(HorizontalFlipAug, self).__init__(p=p)
+        super().__init__(p=p)
         self.p = p
 
-    def __call__(self, src):
-        if pyrandom.random() < self.p:
-            arr = src.asnumpy()[:, ::-1]
-            return [nd.array(np.ascontiguousarray(arr), dtype=arr.dtype)]
-        return [src]
+    def _apply(self, src):
+        if _rng.random() >= self.p:
+            return src
+        return _wrap(_to_np(src)[:, ::-1])
 
 
 class CastAug(Augmenter):
-    def __call__(self, src):
-        return [src.astype(np.float32)]
+    def _apply(self, src):
+        return _wrap(_to_np(src).astype(np.float32))
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, pca_noise=0, inter_method=2):
-    """Create the standard augmenter list (reference image.py:CreateAugmenter)."""
-    auglist = []
+    """Assemble the standard train/val augmentation list
+    (ref image.py:CreateAugmenter). data_shape is CHW."""
+    crop = (data_shape[2], data_shape[1])       # (w, h)
+    pipeline = []
     if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
-    crop_size = (data_shape[2], data_shape[1])
+        pipeline.append(ResizeAug(resize, inter_method))
     if rand_resize:
-        assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
-                                                           4.0 / 3.0),
-                                          inter_method))
+        if not rand_crop:
+            raise ValueError("rand_resize requires rand_crop=True")
+        pipeline.append(
+            RandomSizedCropAug(crop, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                               inter_method))
     elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        pipeline.append(RandomCropAug(crop, inter_method))
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
+        pipeline.append(CenterCropAug(crop, inter_method))
     if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
+        pipeline.append(HorizontalFlipAug(0.5))
+    pipeline.append(CastAug())
     if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+        pipeline.append(ColorJitterAug(brightness, contrast, saturation))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        # ImageNet RGB covariance eigendecomposition (AlexNet paper values).
+        pipeline.append(LightingAug(
+            pca_noise,
+            [55.46, 4.794, 1.148],
+            [[-0.5675, 0.7192, 0.4009],
+             [-0.5808, -0.0045, -0.8140],
+             [-0.5836, -0.6948, 0.4203]]))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
         std = np.array([58.395, 57.12, 57.375])
     if mean is not None or std is not None:
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+        pipeline.append(ColorNormalizeAug(mean, std))
+    return pipeline
+
+
+# --------------------------------------------------------------------------
+# Sample sources for ImageIter: each yields (label, raw_bytes) and supports
+# reset(). Keeping them separate keeps the iterator itself source-agnostic.
+# --------------------------------------------------------------------------
+
+class _RecordSource:
+    """Sequential or index-ordered reads from a .rec (+ optional .idx)."""
+
+    def __init__(self, path_imgrec, path_imgidx=None):
+        if path_imgidx:
+            self.rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self.keys = list(self.rec.keys)
+        else:
+            self.rec = recordio.MXRecordIO(path_imgrec, "r")
+            self.keys = None
+
+    def reset(self):
+        self.rec.reset()
+
+    def read_sequential(self):
+        blob = self.rec.read()
+        if blob is None:
+            raise StopIteration
+        header, img = recordio.unpack(blob)
+        return header.label, img
+
+    def read_key(self, key):
+        header, img = recordio.unpack(self.rec.read_idx(key))
+        return header.label, img
+
+
+class _ListSource:
+    """Samples named by key → (label, filename-or-bytes) mapping."""
+
+    def __init__(self, table, keys, path_root):
+        self.table = table
+        self.keys = keys
+        self.root = path_root or ""
+
+    def reset(self):
+        pass
+
+    def read_key(self, key):
+        label, fname = self.table[key]
+        with open(os.path.join(self.root, fname), "rb") as fh:
+            return label, fh.read()
+
+
+def _parse_lst_file(path):
+    """Parse a .lst file: ``key \\t label... \\t relative-path`` per line."""
+    table, keys = {}, []
+    with open(path) as fh:
+        for line in fh:
+            cells = line.strip().split("\t")
+            if len(cells) < 3:
+                continue
+            key = int(cells[0])
+            table[key] = (np.array([float(v) for v in cells[1:-1]]), cells[-1])
+            keys.append(key)
+    return table, keys
+
+
+def _parse_inline_list(entries):
+    """Normalise a python list of (label, fname) pairs into a keyed table."""
+    table, keys = {}, []
+    for pos, (label, fname) in enumerate(entries, start=1):
+        key = str(pos)
+        label = np.asarray(label) if isinstance(label, (list, np.ndarray)) \
+            else np.array([label])
+        table[key] = (label, fname)
+        keys.append(key)
+    return table, keys
 
 
 class ImageIter(_io.DataIter):
-    """Image data iterator over .rec files or .lst + raw images
-    (reference image.py:ImageIter) with pluggable augmenters."""
+    """Decode-and-augment iterator over .rec files or image lists
+    (ref image.py:ImageIter), emitting NCHW float batches."""
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0,
                  num_parts=1, aug_list=None, imglist=None,
                  data_name="data", label_name="softmax_label", **kwargs):
-        super(ImageIter, self).__init__()
-        assert path_imgrec or path_imglist or (isinstance(imglist, list))
-        self.imgrec = None
-        self.imglist = None
-        if path_imgrec:
-            if path_imgidx:
-                self.imgrec = recordio.MXIndexedRecordIO(
-                    path_imgidx, path_imgrec, "r")
-                self.imgidx = list(self.imgrec.keys)
-            else:
-                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
-                self.imgidx = None
-        if path_imglist:
-            with open(path_imglist) as fin:
-                imglist = {}
-                imgkeys = []
-                for line in iter(fin.readline, ""):
-                    line = line.strip().split("\t")
-                    label = np.array([float(i) for i in line[1:-1]])
-                    key = int(line[0])
-                    imglist[key] = (label, line[-1])
-                    imgkeys.append(key)
-                self.imglist = imglist
-                self.seq = imgkeys
-        elif isinstance(imglist, list):
-            result = {}
-            imgkeys = []
-            index = 1
-            for img in imglist:
-                key = str(index)
-                index += 1
-                label = np.array(img[0]) if isinstance(
-                    img[0], (list, np.ndarray)) else np.array([img[0]])
-                result[key] = (label, img[1])
-                imgkeys.append(str(key))
-            self.imglist = result
-            self.seq = imgkeys
-        else:
-            self.seq = self.imgidx
+        super().__init__()
+        if not (path_imgrec or path_imglist or isinstance(imglist, list)):
+            raise ValueError("need path_imgrec, path_imglist, or imglist")
 
-        self.path_root = path_root
+        self._record = _RecordSource(path_imgrec, path_imgidx) \
+            if path_imgrec else None
+        self._list = None
+        if path_imglist:
+            table, keys = _parse_lst_file(path_imglist)
+            self._list = _ListSource(table, keys, path_root)
+            self._order = keys
+        elif isinstance(imglist, list):
+            table, keys = _parse_inline_list(imglist)
+            self._list = _ListSource(table, keys, path_root)
+            self._order = keys
+        else:
+            self._order = self._record.keys   # None for non-indexed .rec
+
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
-        if num_parts > 1 and self.seq is not None:
-            assert part_index < num_parts
-            n = len(self.seq) // num_parts
-            self.seq = self.seq[part_index * n:(part_index + 1) * n]
-        if aug_list is None:
-            self.auglist = CreateAugmenter(data_shape, **kwargs)
-        else:
-            self.auglist = aug_list
-        self.cur = 0
-        self.provide_data = [
-            _io.DataDesc(data_name, (batch_size,) + self.data_shape)]
-        self.provide_label = [
-            _io.DataDesc(label_name, (batch_size, label_width)
-                         if label_width > 1 else (batch_size,))]
+        if num_parts > 1 and self._order is not None:
+            if part_index >= num_parts:
+                raise ValueError("part_index out of range")
+            span = len(self._order) // num_parts
+            self._order = self._order[part_index * span:
+                                      (part_index + 1) * span]
+        self.auglist = aug_list if aug_list is not None \
+            else CreateAugmenter(data_shape, **kwargs)
+
+        label_shape = (batch_size, label_width) if label_width > 1 \
+            else (batch_size,)
+        self.provide_data = [_io.DataDesc(data_name,
+                                          (batch_size,) + self.data_shape)]
+        self.provide_label = [_io.DataDesc(label_name, label_shape)]
+        self._pos = 0
         self.reset()
 
     def reset(self):
-        if self.shuffle and self.seq is not None:
-            pyrandom.shuffle(self.seq)
-        if self.imgrec is not None:
-            self.imgrec.reset()
-        self.cur = 0
+        if self.shuffle and self._order is not None:
+            _rng.shuffle(self._order)
+        if self._record is not None:
+            self._record.reset()
+        self._pos = 0
 
     def next_sample(self):
-        if self.seq is not None:
-            if self.cur >= len(self.seq):
-                raise StopIteration
-            idx = self.seq[self.cur]
-            self.cur += 1
-            if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
-                header, img = recordio.unpack(s)
-                if self.imglist is None:
-                    return header.label, img
-                return self.imglist[idx][0], img
-            label, fname = self.imglist[idx]
-            with open(os.path.join(self.path_root, fname), "rb") as fin:
-                img = fin.read()
-            return label, img
-        s = self.imgrec.read()
-        if s is None:
+        """Return one (label, raw-bytes) sample, honouring the shuffle order."""
+        if self._order is None:
+            return self._record.read_sequential()
+        if self._pos >= len(self._order):
             raise StopIteration
-        header, img = recordio.unpack(s)
-        return header.label, img
+        key = self._order[self._pos]
+        self._pos += 1
+        if self._record is not None:
+            label, img = self._record.read_key(key)
+            if self._list is not None:      # .lst labels override header
+                label = self._list.table[key][0]
+            return label, img
+        return self._list.read_key(key)
 
     def next(self):
-        batch_size = self.batch_size
         c, h, w = self.data_shape
-        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
-        batch_label = np.zeros((batch_size,) + (
-            (self.label_width,) if self.label_width > 1 else ()),
-            dtype=np.float32)
-        i = 0
-        pad = 0
+        data_buf = np.zeros((self.batch_size, h, w, c), dtype=np.float32)
+        label_shape = (self.batch_size, self.label_width) \
+            if self.label_width > 1 else (self.batch_size,)
+        label_buf = np.zeros(label_shape, dtype=np.float32)
+
+        filled = 0
         try:
-            while i < batch_size:
-                label, s = self.next_sample()
-                data = imdecode(s)
+            while filled < self.batch_size:
+                label, blob = self.next_sample()
+                img = imdecode(blob)
                 for aug in self.auglist:
-                    data = aug(data)[0]
-                batch_data[i] = data.asnumpy().reshape(h, w, c)
-                batch_label[i] = label
-                i += 1
+                    img = aug(img)[0]
+                data_buf[filled] = _to_np(img).reshape(h, w, c)
+                label_buf[filled] = label
+                filled += 1
         except StopIteration:
-            if i == 0:
+            if filled == 0:
                 raise
-            pad = batch_size - i
-        # NCHW for the device
-        arr = nd.array(batch_data.transpose(0, 3, 1, 2))
-        return _io.DataBatch([arr], [nd.array(batch_label)], pad=pad)
+        # host HWC → device NCHW, single upload per batch
+        batch = nd.array(data_buf.transpose(0, 3, 1, 2))
+        return _io.DataBatch([batch], [nd.array(label_buf)],
+                             pad=self.batch_size - filled)

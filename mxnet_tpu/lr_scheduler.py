@@ -1,14 +1,20 @@
-"""Learning-rate schedulers (parity: reference python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedules keyed on the optimizer's update count.
+
+API parity with the reference ``python/mxnet/lr_scheduler.py`` (Factor :21,
+MultiFactor :62) plus the poly/cosine decays commonly used with it.
+"""
 from __future__ import annotations
 
-import math
 import logging
+import math
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
            "PolyScheduler", "CosineScheduler"]
 
 
 class LRScheduler:
+    """Maps ``num_update`` → learning rate; mutates ``base_lr`` as it decays."""
+
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
 
@@ -17,81 +23,85 @@ class LRScheduler:
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (reference lr_scheduler.py:21)."""
+    """Multiply lr by ``factor`` once per ``step`` updates, flooring at
+    ``stop_factor_lr`` (ref lr_scheduler.py:21)."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError("schedule step must be >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
-        self.factor = factor
-        self.stop_factor_lr = stop_factor_lr
-        self.count = 0
+            raise ValueError("factor must be <= 1 so the lr decays")
+        self.step, self.factor = step, factor
+        self.stop_factor_lr, self.count = stop_factor_lr, 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
+        # catch up on every boundary the update counter has crossed
+        while self.count + self.step < num_update:
             self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
+            decayed = self.base_lr * self.factor
+            if decayed < self.stop_factor_lr:
                 self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
+                logging.info("Update[%d]: now learning rate arrived at "
+                             "%0.5e, will not change in the future",
                              num_update, self.base_lr)
+            else:
+                self.base_lr = decayed
+                logging.info("Update[%d]: Change learning rate to %0.5e"
+                             % (num_update, self.base_lr))
         return self.base_lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step in a list (reference lr_scheduler.py:62)."""
+    """Multiply lr by ``factor`` at each boundary in an increasing list
+    (ref lr_scheduler.py:62)."""
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list")
+        for prev, nxt in zip(step, step[1:]):
+            if nxt <= prev:
+                raise ValueError("schedule steps must strictly increase")
+        if step[0] < 1:
+            raise ValueError("schedule step must be >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
-        self.cur_step_ind = 0
-        self.factor = factor
-        self.count = 0
+            raise ValueError("factor must be <= 1 so the lr decays")
+        self.step, self.factor = step, factor
+        self.cur_step_ind, self.count = 0, 0
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
+        while self.cur_step_ind < len(self.step) \
+                and num_update > self.step[self.cur_step_ind]:
+            self.count = self.step[self.cur_step_ind]
+            self.cur_step_ind += 1
+            self.base_lr = self.base_lr * self.factor
+            logging.info("Update[%d]: Change learning rate to %0.5e"
+                         % (num_update, self.base_lr))
         return self.base_lr
 
 
 class PolyScheduler(LRScheduler):
+    """Polynomial decay from base_lr to final_lr over max_update steps."""
+
     def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0):
         super().__init__(base_lr)
         self.max_update = max_update
         self.power = pwr
         self.final_lr = final_lr
-        self.base_lr_orig = self.base_lr
+        self.base_lr_orig = base_lr
 
     def __call__(self, num_update):
         if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 - float(num_update) / self.max_update) ** self.power
+            frac = 1.0 - float(num_update) / self.max_update
+            span = self.base_lr_orig - self.final_lr
+            self.base_lr = self.final_lr + span * frac ** self.power
         return self.base_lr
 
 
 class CosineScheduler(LRScheduler):
+    """Half-cosine decay from base_lr to final_lr over max_update steps."""
+
     def __init__(self, max_update, base_lr=0.01, final_lr=0):
         super().__init__(base_lr)
         self.max_update = max_update
@@ -100,6 +110,7 @@ class CosineScheduler(LRScheduler):
 
     def __call__(self, num_update):
         if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 + math.cos(math.pi * num_update / self.max_update)) / 2
+            phase = math.pi * num_update / self.max_update
+            span = self.base_lr_orig - self.final_lr
+            self.base_lr = self.final_lr + span * (1 + math.cos(phase)) / 2
         return self.base_lr

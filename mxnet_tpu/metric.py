@@ -1,11 +1,19 @@
-"""Evaluation metrics (parity: reference python/mxnet/metric.py:44-1167)."""
+"""Evaluation metrics.
+
+API parity with the reference ``python/mxnet/metric.py:44-1167`` (EvalMetric
+base, registry + ``create``, Accuracy/TopK/F1/Perplexity/regression-error/
+CrossEntropy/Pearson/Loss/Custom families). Independent design: most metrics
+derive from ``_PairAccumulator``, which owns the per-(label, pred) iteration
+and running-sum bookkeeping; each concrete metric contributes a single
+``measure(label, pred) -> (value, count)`` function on numpy arrays.
+"""
 from __future__ import annotations
 
 import math
 
 import numpy as _np
 
-from .base import Registry, MXNetError
+from .base import Registry
 from . import ndarray as nd
 from .ndarray import NDArray
 
@@ -18,69 +26,87 @@ _REG = Registry("metric")
 
 
 def check_label_shapes(labels, preds, shape=False):
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    """Raise when label/pred list lengths (or array shapes) disagree."""
+    got = (labels.shape, preds.shape) if shape else (len(labels), len(preds))
+    if got[0] != got[1]:
         raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+                         "predictions {}".format(got[0], got[1]))
 
 
-def _as_np(x):
+def _numpy(x):
     return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
 
 
+def _column(arr):
+    """Ensure a 2-D (n, k) view for regression metrics."""
+    a = _numpy(arr)
+    return a.reshape(-1, 1) if a.ndim == 1 else a
+
+
 class EvalMetric:
-    """Base metric (reference metric.py:44)."""
+    """Running-average metric base (ref metric.py:44).
+
+    State is a (sum_metric, num_inst) pair; ``get`` reports their ratio.
+    """
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
-        self.output_names = output_names
-        self.label_names = label_names
+        self.output_names, self.label_names = output_names, label_names
         self._kwargs = kwargs
         self.reset()
 
     def __str__(self):
-        return "EvalMetric: {}".format(dict(self.get_name_value()))
+        return "EvalMetric: %s" % dict(self.get_name_value())
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({"metric": self.__class__.__name__, "name": self.name,
-                       "output_names": self.output_names,
-                       "label_names": self.label_names})
-        return config
+        cfg = dict(self._kwargs,
+                   metric=type(self).__name__, name=self.name,
+                   output_names=self.output_names,
+                   label_names=self.label_names)
+        return cfg
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        """Update from name→array dicts, selecting declared names if any."""
+        preds = [pred[n] for n in self.output_names] \
+            if self.output_names is not None else list(pred.values())
+        labels = [label[n] for n in self.label_names] \
+            if self.label_names is not None else list(label.values())
+        self.update(labels, preds)
 
     def update(self, labels, preds):
         raise NotImplementedError()
 
     def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
+        self.sum_metric, self.num_inst = 0.0, 0
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        if not self.num_inst:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
 
     def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names, values = self.get()
+        if not isinstance(names, list):
+            names, values = [names], [values]
+        return list(zip(names, values))
+
+
+class _PairAccumulator(EvalMetric):
+    """Template for metrics that reduce each (label, pred) pair to a
+    (contribution, count) tuple via :meth:`measure`."""
+
+    check_shapes = True
+
+    def update(self, labels, preds):
+        if self.check_shapes:
+            check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            value, count = self.measure(_numpy(label), _numpy(pred))
+            self.sum_metric += value
+            self.num_inst += count
+
+    def measure(self, label, pred):
+        raise NotImplementedError()
 
 
 _ALIASES = {
@@ -91,25 +117,29 @@ _ALIASES = {
 
 
 def register(klass):
-    _REG.register(klass, klass.__name__, aliases=_ALIASES.get(klass.__name__, ()))
+    _REG.register(klass, klass.__name__,
+                  aliases=_ALIASES.get(klass.__name__, ()))
     return klass
 
 
 def create(metric, *args, **kwargs):
+    """Build a metric from a callable, instance, list, or registered name."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite = CompositeEvalMetric()
-        for child in metric:
-            composite.add(create(child, *args, **kwargs))
-        return composite
+        bundle = CompositeEvalMetric()
+        for item in metric:
+            bundle.add(create(item, *args, **kwargs))
+        return bundle
     return _REG.get(metric)(*args, **kwargs)
 
 
 @register
 class CompositeEvalMetric(EvalMetric):
+    """Fan-out wrapper reporting every child metric's name/value."""
+
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names)
@@ -122,111 +152,94 @@ class CompositeEvalMetric(EvalMetric):
         return self.metrics[index]
 
     def update_dict(self, labels, preds):
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+        for child in self.metrics:
+            child.update_dict(labels, preds)
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for child in self.metrics:
+            child.update(labels, preds)
 
     def reset(self):
-        for metric in getattr(self, "metrics", []):
-            metric.reset()
+        for child in getattr(self, "metrics", ()):
+            child.reset()
 
     def get(self):
         names, values = [], []
-        for metric in self.metrics:
-            name, value = metric.get()
-            names.extend(name if isinstance(name, list) else [name])
-            values.extend(value if isinstance(value, list) else [value])
+        for child in self.metrics:
+            n, v = child.get()
+            names += n if isinstance(n, list) else [n]
+            values += v if isinstance(v, list) else [v]
         return names, values
 
 
 @register
-class Accuracy(EvalMetric):
+class Accuracy(_PairAccumulator):
+    """Top-1 classification accuracy; argmaxes preds when ranks differ."""
+
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names, axis=axis)
         self.axis = axis
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred_label = _as_np(pred_label)
-            if pred_label.ndim > _as_np(label).ndim:
-                pred_label = _np.argmax(pred_label, axis=self.axis)
-            pred_label = pred_label.astype("int32").flat
-            label = _as_np(label).astype("int32").flat
-            pred_label = _np.asarray(pred_label)
-            label = _np.asarray(label)
-            check_label_shapes(label, pred_label, shape=True)
-            self.sum_metric += (pred_label == label).sum()
-            self.num_inst += len(pred_label)
+    def measure(self, label, pred):
+        if pred.ndim > label.ndim:
+            pred = pred.argmax(axis=self.axis)
+        hits = pred.astype("int64").ravel() == label.astype("int64").ravel()
+        check_label_shapes(label.ravel(), pred.ravel(), shape=True)
+        return int(hits.sum()), hits.size
 
 
 @register
-class TopKAccuracy(EvalMetric):
+class TopKAccuracy(_PairAccumulator):
+    """Fraction of rows whose label lands in the top-k scored classes."""
+
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names, top_k=top_k)
+        if top_k <= 1:
+            raise ValueError("use Accuracy for top_k <= 1")
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+        self.name = "%s_%d" % (self.name, top_k)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = _np.argsort(_as_np(pred_label).astype("float32"), axis=1)
-            label = _as_np(label).astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flat == label.flat).sum()
-            self.num_inst += num_samples
+    def measure(self, label, pred):
+        if pred.ndim > 2:
+            raise ValueError("Predictions should be no more than 2 dims")
+        label = label.astype("int64").ravel()
+        if pred.ndim == 1:
+            return int((pred.astype("int64") == label).sum()), label.size
+        k = min(self.top_k, pred.shape[1])
+        # indices of the k best classes per row
+        ranked = _np.argsort(pred.astype("float32"), axis=1)[:, -k:]
+        hits = (ranked == label[:, None]).any(axis=1)
+        return int(hits.sum()), label.size
 
 
 @register
-class F1(EvalMetric):
+class F1(_PairAccumulator):
+    """Binary F1 over argmaxed predictions, one score per batch."""
+
     def __init__(self, name="f1", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = _as_np(pred)
-            label = _as_np(label).astype("int32")
-            pred_label = _np.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(_np.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            tp = fp = fn = 0.0
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    tp += 1.0
-                elif y_pred == 1 and y_true == 0:
-                    fp += 1.0
-                elif y_pred == 0 and y_true == 1:
-                    fn += 1.0
-            precision = tp / (tp + fp) if tp + fp > 0 else 0.0
-            recall = tp / (tp + fn) if tp + fn > 0 else 0.0
-            if precision + recall > 0:
-                f1 = 2 * precision * recall / (precision + recall)
-            else:
-                f1 = 0.0
-            self.sum_metric += f1
-            self.num_inst += 1
+    def measure(self, label, pred):
+        label = label.astype("int64").ravel()
+        if _np.unique(label).size > 2:
+            raise ValueError("F1 currently only supports binary classification.")
+        decided = pred.argmax(axis=1)
+        tp = float(((decided == 1) & (label == 1)).sum())
+        fp = float(((decided == 1) & (label == 0)).sum())
+        fn = float(((decided == 0) & (label == 1)).sum())
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        score = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        return score, 1
 
 
 @register
 class Perplexity(EvalMetric):
+    """exp(mean negative log prob of the target class), with an optional
+    ignored label id (padding)."""
+
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, output_names, label_names,
@@ -235,138 +248,103 @@ class Perplexity(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
+        if len(labels) != len(preds):
+            raise ValueError("label/pred list length mismatch")
         for label, pred in zip(labels, preds):
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.as_in_context(pred.context).reshape((label.size,))
-            pred = nd.pick(pred, label.astype(dtype="int32"), axis=self.axis)
-            label_np = label.asnumpy()
-            pred_np = pred.asnumpy()
+            if label.size != pred.size // pred.shape[-1]:
+                raise ValueError("shape mismatch: %s vs. %s"
+                                 % (label.shape, pred.shape))
+            flat = label.as_in_context(pred.context).reshape((label.size,))
+            target_p = nd.pick(pred, flat.astype(dtype="int32"),
+                               axis=self.axis).asnumpy()
+            lab = flat.asnumpy()
+            count = target_p.size
             if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label)
-                num -= ignore.sum()
-                pred_np = pred_np * (1 - ignore) + ignore
-            loss -= _np.sum(_np.log(_np.maximum(1e-10, pred_np)))
-            num += pred_np.size
-        self.sum_metric += loss
-        self.num_inst += num
+                masked = lab == self.ignore_label
+                count -= int(masked.sum())
+                target_p = _np.where(masked, 1.0, target_p)
+            self.sum_metric -= float(
+                _np.log(_np.maximum(target_p, 1e-10)).sum())
+            self.num_inst += count
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+        if not self.num_inst:
+            return self.name, float("nan")
+        return self.name, math.exp(self.sum_metric / self.num_inst)
 
 
 @register
-class MAE(EvalMetric):
+class MAE(_PairAccumulator):
     def __init__(self, name="mae", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_np(label)
-            pred = _as_np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += _np.abs(label - pred).mean()
-            self.num_inst += 1
+    def measure(self, label, pred):
+        return float(_np.abs(_column(label) - _column(pred)).mean()), 1
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_PairAccumulator):
     def __init__(self, name="mse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_np(label)
-            pred = _as_np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def measure(self, label, pred):
+        return float(((_column(label) - _column(pred)) ** 2).mean()), 1
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_PairAccumulator):
     def __init__(self, name="rmse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_np(label)
-            pred = _as_np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += _np.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    def measure(self, label, pred):
+        return float(_np.sqrt(((_column(label) - _column(pred)) ** 2).mean())), 1
 
 
 @register
-class CrossEntropy(EvalMetric):
+class CrossEntropy(_PairAccumulator):
+    """Mean -log p(target) given per-class probability rows."""
+
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names, eps=eps)
         self.eps = eps
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_np(label).ravel()
-            pred = _as_np(pred)
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[_np.arange(label.shape[0]), _np.int64(label)]
-            self.sum_metric += (-_np.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def measure(self, label, pred):
+        idx = label.ravel().astype("int64")
+        if idx.shape[0] != pred.shape[0]:
+            raise ValueError("label/pred row mismatch")
+        target_p = pred[_np.arange(idx.shape[0]), idx]
+        return float(-_np.log(target_p + self.eps).sum()), idx.shape[0]
 
 
 @register
-class NegativeLogLikelihood(EvalMetric):
+class NegativeLogLikelihood(CrossEntropy):
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
-        super().__init__(name, output_names, label_names, eps=eps)
-        self.eps = eps
-
-    update = CrossEntropy.update
+        super().__init__(eps=eps, name=name, output_names=output_names,
+                         label_names=label_names)
 
 
 @register
-class PearsonCorrelation(EvalMetric):
+class PearsonCorrelation(_PairAccumulator):
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, shape=True)
-            label = _as_np(label)
-            pred = _as_np(pred)
-            self.sum_metric += _np.corrcoef(pred.ravel(), label.ravel())[0, 1]
-            self.num_inst += 1
+    def measure(self, label, pred):
+        check_label_shapes(label, pred, shape=True)
+        return float(_np.corrcoef(pred.ravel(), label.ravel())[0, 1]), 1
 
 
 @register
 class Loss(EvalMetric):
-    """Average of the raw outputs (used for loss symbols)."""
+    """Mean of raw outputs — pair with loss-valued heads."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
     def update(self, _, preds):
         for pred in preds:
-            self.sum_metric += _as_np(pred).sum()
+            self.sum_metric += float(_numpy(pred).sum())
             self.num_inst += pred.size
 
 
@@ -384,14 +362,17 @@ class Caffe(Loss):
 
 @register
 class CustomMetric(EvalMetric):
+    """Adapter for a user ``feval(label, pred)`` returning a value or a
+    (sum, count) tuple."""
+
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
-        super().__init__(name, output_names, label_names,
-                         feval=feval, allow_extra_outputs=allow_extra_outputs)
+        super().__init__(name, output_names, label_names, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
@@ -399,20 +380,17 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
         for pred, label in zip(preds, labels):
-            label = _as_np(label)
-            pred = _as_np(pred)
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+            result = self._feval(_numpy(label), _numpy(pred))
+            if isinstance(result, tuple):
+                self.sum_metric += result[0]
+                self.num_inst += result[1]
             else:
-                self.sum_metric += reval
+                self.sum_metric += result
                 self.num_inst += 1
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy eval function into a CustomMetric (reference metric.np)."""
+    """Wrap a numpy eval function as a CustomMetric (ref metric.np)."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__

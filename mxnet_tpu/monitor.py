@@ -1,10 +1,11 @@
-"""Monitor: tap every op output during Executor forward for debugging.
+"""Monitor: sample intermediate op outputs during Executor forward.
 
-Parity surface: reference ``python/mxnet/monitor.py:33`` + executor monitor
-callback (``GraphExecutor::SetMonitorCallback``, graph_executor.cc:120,
-ExecuteMonCallback :1380).  On the TPU build, installing a monitor switches
-the Executor to its eager node-by-node path so every intermediate value is
-observable (the compiled XLA program has no per-op boundaries to tap).
+API parity with the reference ``python/mxnet/monitor.py:33`` + the executor
+monitor callback (``GraphExecutor::SetMonitorCallback`` graph_executor.cc:120,
+``ExecuteMonCallback`` :1380). On the TPU build an installed, *active*
+monitor flips the Executor onto its eager node-by-node path for that batch —
+a compiled XLA program has no per-op boundaries to tap — and off-interval
+batches keep the fast compiled program.
 """
 from __future__ import annotations
 
@@ -16,76 +17,76 @@ from .ndarray import NDArray
 __all__ = ["Monitor"]
 
 
+def _default_stat(x):
+    """mean(|x|) — the reference's default statistic."""
+    return x.abs().mean() if hasattr(x, "abs") else x
+
+
+def _render(value):
+    """Format one stat NDArray (or list thereof) as a tab-joined string."""
+    items = value if isinstance(value, list) else [value]
+    parts = []
+    for v in items:
+        if not isinstance(v, NDArray):
+            raise MXNetError("the argument must be NDArray")
+        if v.shape in ((), (1,)):
+            parts.append(str(v.asnumpy().reshape(-1)[0]))
+        else:
+            parts.append(str(v.asnumpy()))
+    return "\t".join(parts) + "\t"
+
+
 class Monitor(object):
     """Collect per-op output statistics every ``interval`` batches.
 
-    Parameters mirror the reference: ``stat_func`` maps NDArray -> NDArray
-    stat (default: mean of |x|), ``pattern`` filters output names,
-    ``sort`` orders results by name in ``toc()``.
+    ``stat_func`` maps an output NDArray to its statistic; ``pattern``
+    filters by output name; ``sort`` orders ``toc()`` results by name.
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def stat_func(x):
-                return x.abs().mean() if hasattr(x, "abs") else x
-        self.stat_func = stat_func
-        self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
+        self.interval, self.sort = interval, sort
+        self.stat_func = stat_func or _default_stat
         self.re_prog = re.compile(pattern)
-        self.sort = sort
+        self.activated, self.queue = False, []
+        self.step, self.exes = 0, []
+
+        mon = self
 
         def stat_helper(name, arr):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(arr)))
-        # Executor consults is_active to decide whether THIS forward must
-        # take the slow eager per-node path; off-interval batches stay on
-        # the compiled program instead of paying eager speed for nothing.
-        stat_helper.is_active = lambda: self.activated
+            if mon.activated and mon.re_prog.match(name):
+                mon.queue.append((mon.step, name, mon.stat_func(arr)))
+        # The Executor polls is_active to decide whether this forward must
+        # run node-by-node; keeping it a callable avoids a stale snapshot.
+        stat_helper.is_active = lambda: mon.activated
         self.stat_helper = stat_helper
 
     def install(self, exe):
-        """Attach to an Executor (reference monitor.py:install)."""
+        """Attach this monitor's tap to an Executor (ref monitor.py:install)."""
         exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        self.exes += [exe]
 
     def tic(self):
-        """Start collecting if due this step (call before forward)."""
-        if self.step % self.interval == 0:
-            self.queue = []
-            self.activated = True
+        """Arm collection if this step is on the interval; call pre-forward."""
+        due = self.step % self.interval == 0
         self.step += 1
+        if due:
+            self.queue, self.activated = [], True
 
     def toc(self):
-        """Stop collecting; return [(step, name, stat_str), ...]."""
-        if not self.activated:
+        """Disarm and drain: returns [(step, name, stat_string), ...]."""
+        was_armed, self.activated = self.activated, False
+        if not was_armed:
             return []
-        self.activated = False
-        res = []
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                if not isinstance(v, NDArray):
-                    raise MXNetError("the argument must be NDArray")
-                if v.shape == () or v.shape == (1,):
-                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
-        if self.sort:
-            res = sorted(res, key=lambda x: x[1])
+        drained = [(step, name, _render(val))
+                   for step, name, val in self.queue]
         self.queue = []
-        return res
+        if self.sort:
+            drained.sort(key=lambda row: row[1])
+        return drained
 
     def toc_print(self):
-        """Collect and print (reference monitor.py:toc_print)."""
-        res = self.toc()
-        for n, k, v in res:
-            print("Batch: {:7d} {:30s} {:s}".format(n, k, v))
-        return res
+        """Drain and pretty-print (ref monitor.py:toc_print)."""
+        rows = self.toc()
+        for step, name, stat in rows:
+            print("Batch: {:7d} {:30s} {:s}".format(step, name, stat))
+        return rows
