@@ -1,8 +1,13 @@
-"""Weight initializers (parity: reference python/mxnet/initializer.py:34-676)."""
+"""Weight initializers.
+
+API parity with the reference ``python/mxnet/initializer.py:34-676``
+(InitDesc, pattern-dispatch Initializer protocol, the Zero…FusedRNN zoo,
+Load/Mixed). Independent design: name-suffix dispatch is table-driven, and
+structured initializers (Bilinear) are vectorised numpy rather than loops.
+"""
 from __future__ import annotations
 
 import json
-import math
 import re
 
 import numpy as np
@@ -18,59 +23,60 @@ _REG = Registry("initializer")
 
 
 class InitDesc(str):
-    """Name + attrs descriptor passed to initializers."""
+    """Parameter name enriched with symbol attrs + the global initializer."""
+
     def __new__(cls, name, attrs=None, global_init=None):
-        ret = super().__new__(cls, name)
-        ret.attrs = attrs or {}
-        ret.global_init = global_init
-        return ret
+        self = super().__new__(cls, name)
+        self.attrs = attrs or {}
+        self.global_init = global_init
+        return self
+
+
+# (name suffix → handler method) dispatch table, checked in order.
+_SUFFIX_DISPATCH = (
+    (("weight",), "_init_weight"),
+    (("bias",), "_init_bias"),
+    (("gamma",), "_init_gamma"),
+    (("beta",), "_init_beta"),
+    (("moving_mean", "running_mean", "moving_inv_var", "moving_avg",
+      "min", "max"), "_init_zero"),
+    (("moving_var", "running_var"), "_init_one"),
+)
 
 
 class Initializer:
-    """Base initializer with the reference's pattern-dispatch protocol."""
+    """Base initializer implementing the reference dispatch protocol:
+    an ``__init__`` attr on the variable wins, else the name suffix picks
+    the handler (weight/bias/gamma/beta/aux-stat)."""
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
-        self._verbose = False
-        self._print_func = None
+        self._verbose, self._print_func = False, None
 
     def set_verbosity(self, verbose=False, print_func=None):
-        self._verbose = verbose
-        self._print_func = print_func
+        self._verbose, self._print_func = verbose, print_func
         return self
 
     def dumps(self):
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):
             desc = InitDesc(desc)
         if desc.global_init is None:
             desc.global_init = self
-        init = desc.attrs.get("__init__", "")
-        if init:
-            klass, kwargs = json.loads(init)
-            create(klass, **kwargs)._init_weight(desc, arr)
+        attr_init = desc.attrs.get("__init__", "")
+        if attr_init:
+            # variable-level override: serialized [class, kwargs]
+            cls_name, cls_kwargs = json.loads(attr_init)
+            create(cls_name, **cls_kwargs)._init_weight(desc, arr)
             return
-        name = desc.lower()
-        if name.endswith("weight"):
-            self._init_weight(desc, arr)
-        elif name.endswith("bias"):
-            self._init_bias(desc, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(desc, arr)
-        elif name.endswith("beta"):
-            self._init_beta(desc, arr)
-        elif name.endswith("moving_mean") or name.endswith("running_mean"):
-            self._init_zero(desc, arr)
-        elif name.endswith("moving_var") or name.endswith("running_var"):
-            self._init_one(desc, arr)
-        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
-            self._init_zero(desc, arr)
-        elif name.endswith("min") or name.endswith("max"):
-            self._init_zero(desc, arr)
-        else:
-            self._init_default(desc, arr)
+        lowered = desc.lower()
+        for suffixes, handler in _SUFFIX_DISPATCH:
+            if lowered.endswith(suffixes):
+                getattr(self, handler)(desc, arr)
+                return
+        self._init_default(desc, arr)
 
     def _init_zero(self, _, arr):
         arr[:] = 0.0
@@ -78,28 +84,22 @@ class Initializer:
     def _init_one(self, _, arr):
         arr[:] = 1.0
 
-    def _init_bias(self, _, arr):
-        arr[:] = 0.0
-
-    def _init_gamma(self, _, arr):
-        arr[:] = 1.0
-
-    def _init_beta(self, _, arr):
-        arr[:] = 0.0
+    _init_bias = _init_zero
+    _init_beta = _init_zero
+    _init_gamma = _init_one
 
     def _init_weight(self, name, arr):
         raise NotImplementedError()
 
     def _init_default(self, name, arr):
         raise ValueError(
-            "Unknown initialization pattern for %s. Default initialization "
-            "is now limited to \"weight\", \"bias\", \"gamma\", and \"beta\". "
-            "Please use mx.sym.Variable(init=mx.init.*) to set the "
-            "initialization pattern" % name)
+            'Unknown initialization pattern for %s. Default initialization '
+            'is now limited to "weight", "bias", "gamma", and "beta". '
+            'Please use mx.sym.Variable(init=mx.init.*) to set the '
+            'initialization pattern' % name)
 
     def __eq__(self, other):
-        return (isinstance(other, Initializer)
-                and self.__class__ == other.__class__
+        return (type(self) is type(other)
                 and self._kwargs == other._kwargs)
 
     __hash__ = object.__hash__
@@ -123,9 +123,6 @@ class Zero(Initializer):
     _init_default = _init_weight
 
 
-_REG.register(Zero, "zeros")
-
-
 @register
 class One(Initializer):
     def _init_weight(self, _, arr):
@@ -133,6 +130,7 @@ class One(Initializer):
     _init_default = _init_weight
 
 
+_REG.register(Zero, "zeros")
 _REG.register(One, "ones")
 
 
@@ -149,6 +147,8 @@ class Constant(Initializer):
 
 @register
 class Uniform(Initializer):
+    """U(-scale, scale)."""
+
     def __init__(self, scale=0.07):
         super().__init__(scale=scale)
         self.scale = scale
@@ -159,6 +159,8 @@ class Uniform(Initializer):
 
 @register
 class Normal(Initializer):
+    """N(0, sigma^2)."""
+
     def __init__(self, sigma=0.01):
         super().__init__(sigma=sigma)
         self.sigma = sigma
@@ -169,107 +171,114 @@ class Normal(Initializer):
 
 @register
 class Orthogonal(Initializer):
+    """Scaled orthogonal matrix via SVD of a random (nout, nin) draw."""
+
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
-        self.scale = scale
-        self.rand_type = rand_type
+        self.scale, self.rand_type = scale, rand_type
 
     def _init_weight(self, _, arr):
-        nout = arr.shape[0]
-        nin = int(np.prod(arr.shape[1:]))
-        if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
-        else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
-        u, _, v = np.linalg.svd(tmp, full_matrices=False)
-        q = u if u.shape == tmp.shape else v
-        arr[:] = (self.scale * q).reshape(arr.shape)
+        rows = arr.shape[0]
+        cols = int(np.prod(arr.shape[1:]))
+        draw = (np.random.uniform(-1.0, 1.0, (rows, cols))
+                if self.rand_type == "uniform"
+                else np.random.normal(0.0, 1.0, (rows, cols)))
+        u, _s, v = np.linalg.svd(draw, full_matrices=False)
+        basis = u if u.shape == draw.shape else v
+        arr[:] = (self.scale * basis).reshape(arr.shape)
+
+
+def _conv_fans(shape):
+    """(fan_in, fan_out) with trailing spatial dims folded in."""
+    spatial = np.prod(shape[2:]) if len(shape) > 2 else 1.0
+    return shape[1] * spatial, shape[0] * spatial
 
 
 @register
 class Xavier(Initializer):
+    """Glorot init: scale^2 = magnitude / factor(fan_in, fan_out)."""
+
+    _FACTORS = {"avg": lambda fi, fo: (fi + fo) / 2.0,
+                "in": lambda fi, fo: fi,
+                "out": lambda fi, fo: fo}
+
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
                          magnitude=magnitude)
-        self.rnd_type = rnd_type
-        self.factor_type = factor_type
+        self.rnd_type, self.factor_type = rnd_type, factor_type
         self.magnitude = float(magnitude)
 
     def _init_weight(self, name, arr):
-        shape = arr.shape
-        hw_scale = 1.0
-        if len(shape) < 2:
+        if len(arr.shape) < 2:
             raise ValueError("Xavier initializer cannot be applied to vector "
                              "%s. It requires at least 2D." % name)
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.0
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
+        try:
+            factor_fn = self._FACTORS[self.factor_type]
+        except KeyError:
             raise ValueError("Incorrect factor type")
-        scale = np.sqrt(self.magnitude / factor)
+        sigma = np.sqrt(self.magnitude / factor_fn(*_conv_fans(arr.shape)))
         if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-scale, scale, arr.shape)
+            arr[:] = np.random.uniform(-sigma, sigma, arr.shape)
         elif self.rnd_type == "gaussian":
-            arr[:] = np.random.normal(0, scale, arr.shape)
+            arr[:] = np.random.normal(0, sigma, arr.shape)
         else:
             raise ValueError("Unknown random type")
 
 
 @register
 class MSRAPrelu(Xavier):
+    """He init adjusted for PReLU slope."""
+
     def __init__(self, factor_type="avg", slope=0.25):
-        magnitude = 2.0 / (1 + slope ** 2)
-        super().__init__("gaussian", factor_type, magnitude)
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope ** 2))
         self._kwargs = {"factor_type": factor_type, "slope": slope}
 
 
 @register
 class Bilinear(Initializer):
+    """Bilinear-upsampling kernel for Deconvolution (vectorised)."""
+
     def _init_weight(self, _, arr):
-        weight = np.zeros(np.prod(arr.shape), dtype="float32")
         shape = arr.shape
         f = np.ceil(shape[3] / 2.0)
         c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(np.prod(shape)):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        arr[:] = weight.reshape(shape)
+        xs = np.arange(shape[3], dtype="float32")
+        ys = np.arange(shape[2], dtype="float32")
+        kernel = np.outer(1 - np.abs(ys / f - c), 1 - np.abs(xs / f - c))
+        arr[:] = np.broadcast_to(kernel, shape).astype("float32")
 
 
 @register
 class LSTMBias(Initializer):
-    """Initialize forget-gate bias to a custom value, rest to 0."""
+    """Zero bias except the forget gate (slot 2 of i,f,g,o)."""
 
     def __init__(self, forget_bias=1.0):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
     def _init_weight(self, desc, arr):
-        arr[:] = 0.0
-        num_hidden = arr.shape[0] // 4
-        a = arr.asnumpy()
-        a[num_hidden:2 * num_hidden] = self.forget_bias  # gate order i,f,g,o
-        arr[:] = a
+        per_gate = arr.shape[0] // 4
+        host = np.zeros(arr.shape, dtype="float32")
+        host[per_gate:2 * per_gate] = self.forget_bias
+        arr[:] = host
     _init_default = _init_weight
     _init_bias = _init_weight
 
 
 @register
 class FusedRNN(Initializer):
+    """Delegates to a wrapped initializer (fused-RNN param blob layout is
+    flat on TPU, so no re-packing is needed)."""
+
     def __init__(self, init=None, state_size=None, num_layers=None, mode=None,
                  bidirectional=False, forget_bias=1.0):
         super().__init__()
-        self._init = init if isinstance(init, Initializer) else (
-            create(*json.loads(init)) if isinstance(init, str) and init else
-            Uniform(0.1))
+        if isinstance(init, Initializer):
+            self._init = init
+        elif isinstance(init, str) and init:
+            self._init = create(*json.loads(init))
+        else:
+            self._init = Uniform(0.1)
 
     def _init_weight(self, desc, arr):
         self._init._init_weight(desc, arr)
@@ -278,67 +287,61 @@ class FusedRNN(Initializer):
 
 @register
 class Load:
-    """Initialize from a dict of arrays, fall back to default_init."""
+    """Copy parameters from a saved dict, else fall back to default_init."""
 
     def __init__(self, param, default_init=None, verbose=False):
         if isinstance(param, str):
             param = nd.load(param)
-        self.param = {k.split(":", 1)[-1]: v for k, v in param.items()}
+        self.param = {key.split(":", 1)[-1]: val
+                      for key, val in param.items()}
         self.default_init = default_init
         self.verbose = verbose
 
     def __call__(self, name, arr):
-        if name in self.param:
-            if tuple(self.param[name].shape) != tuple(arr.shape):
+        loaded = self.param.get(name)
+        if loaded is not None:
+            if tuple(loaded.shape) != tuple(arr.shape):
                 raise MXNetError(
                     "Parameter %s cannot be initialized from loading. Shape "
                     "mismatch, target %s vs loaded %s"
-                    % (name, arr.shape, self.param[name].shape))
-            self.param[name].copyto(arr)
-        else:
-            if self.default_init is None:
-                raise MXNetError(
-                    "Cannot Initialize parameter %s. Not found in loaded "
-                    "param and no default initializer" % name)
-            self.default_init(name, arr)
+                    % (name, arr.shape, loaded.shape))
+            loaded.copyto(arr)
+            return
+        if self.default_init is None:
+            raise MXNetError(
+                "Cannot Initialize parameter %s. Not found in loaded "
+                "param and no default initializer" % name)
+        self.default_init(name, arr)
 
 
 @register
 class Mixed:
-    """Pattern-matched mixed initializer."""
+    """First-matching-regex dispatch over a list of initializers."""
 
     def __init__(self, patterns, initializers):
         if len(patterns) != len(initializers):
             raise ValueError("patterns and initializers must have same length")
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+        self.map = [(re.compile(p), i)
+                    for p, i in zip(patterns, initializers)]
 
     def __call__(self, name, arr):
-        for prog, i in self.map:
-            if prog.match(name):
-                i(name, arr)
+        for matcher, initializer in self.map:
+            if matcher.match(name):
+                initializer(name, arr)
                 return
         raise ValueError(
-            "Parameter name %s did not match any pattern. Consider adding a "
-            "\".*\" pattern at the end with default Initializer." % name)
+            'Parameter name %s did not match any pattern. Consider adding a '
+            '".*" pattern at the end with default Initializer.' % name)
 
 
 class _InitModule:
-    """`mx.init` namespace shim."""
-    Zero = Zero
-    One = One
-    Constant = Constant
-    Uniform = Uniform
-    Normal = Normal
-    Orthogonal = Orthogonal
-    Xavier = Xavier
-    MSRAPrelu = MSRAPrelu
-    Bilinear = Bilinear
-    LSTMBias = LSTMBias
-    FusedRNN = FusedRNN
-    Load = Load
-    Mixed = Mixed
-    Initializer = Initializer
-    InitDesc = InitDesc
+    """``mx.init`` namespace shim."""
+    Initializer, InitDesc = Initializer, InitDesc
+    Zero, One, Constant = Zero, One, Constant
+    Uniform, Normal, Orthogonal = Uniform, Normal, Orthogonal
+    Xavier, MSRAPrelu, Bilinear = Xavier, MSRAPrelu, Bilinear
+    LSTMBias, FusedRNN = LSTMBias, FusedRNN
+    Load, Mixed = Load, Mixed
 
 
 init = _InitModule()

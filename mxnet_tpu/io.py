@@ -1,10 +1,14 @@
-"""Data iterators (parity: reference python/mxnet/io.py:42-932 + src/io/).
+"""Data iterators.
 
-TPU-native notes: iterators produce host batches that land on device at
-``forward`` time; ``PrefetchingIter`` double-buffers with a background
-thread (the reference's prefetcher thread, ``src/io/iter_prefetcher.h``).
-The heavyweight C++ decode pipeline (ImageRecordIter) lives in
-``image.py``/``recordio.py``.
+API parity with the reference ``python/mxnet/io.py:42-932`` (DataDesc /
+DataBatch / DataIter protocol, ResizeIter, PrefetchingIter, NDArrayIter)
+plus the native-iterator equivalents CSVIter (src/io/iter_csv.cc:150) and
+MNISTIter (src/io/iter_mnist.cc:259). Independent design: prefetching is
+organised around per-source ``_Slot`` producer threads, and NDArrayIter's
+cursor arithmetic lives in two small helpers.
+
+TPU note: iterators build host batches; arrays land on device at ``forward``
+time, one upload per batch.
 """
 from __future__ import annotations
 
@@ -23,19 +27,19 @@ __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
 
 
 class DataDesc:
-    """Name+shape+dtype+layout descriptor (reference io.py:42)."""
+    """name/shape/dtype/layout tuple-alike describing one input
+    (ref io.py:42)."""
 
     def __init__(self, name, shape, dtype=np.float32, layout="NCHW"):
-        self.name = name
-        self.shape = tuple(shape)
-        self.dtype = dtype
-        self.layout = layout
+        self.name, self.shape = name, tuple(shape)
+        self.dtype, self.layout = dtype, layout
 
     def __repr__(self):
-        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
-                                          self.layout)
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape,
+                                          self.dtype, self.layout)
 
-    def __iter__(self):  # tuple-compat: (name, shape)
+    # tuple compatibility: behaves as (name, shape) for legacy callers
+    def __iter__(self):
         return iter((self.name, self.shape))
 
     def __getitem__(self, i):
@@ -52,38 +56,31 @@ class DataDesc:
 
     @staticmethod
     def get_batch_axis(layout):
-        if layout is None:
-            return 0
-        return layout.find("N")
+        return 0 if layout is None else layout.find("N")
 
     @staticmethod
     def get_list(shapes, types=None):
-        if types is not None:
-            type_dict = dict(types)
-            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
-        return [DataDesc(x[0], x[1]) for x in shapes]
+        dtype_of = dict(types) if types is not None else {}
+        return [DataDesc(name, shape, dtype_of.get(name, np.float32))
+                for name, shape in shapes]
 
 
 class DataBatch:
-    """One minibatch (reference io.py:115)."""
+    """One minibatch of data+label arrays (ref io.py:115)."""
 
     def __init__(self, data, label=None, pad=None, index=None,
                  bucket_key=None, provide_data=None, provide_label=None):
-        if data is not None and not isinstance(data, (list, tuple)):
-            data = [data]
-        if label is not None and not isinstance(label, (list, tuple)):
-            label = [label]
-        self.data = data
-        self.label = label
-        self.pad = pad
-        self.index = index
+        def listify(x):
+            return x if x is None or isinstance(x, (list, tuple)) else [x]
+        self.data, self.label = listify(data), listify(label)
+        self.pad, self.index = pad, index
         self.bucket_key = bucket_key
-        self.provide_data = provide_data
-        self.provide_label = provide_label
+        self.provide_data, self.provide_label = provide_data, provide_label
 
 
 class DataIter:
-    """Base iterator (reference io.py:176)."""
+    """Iterator protocol base (ref io.py:176): subclasses implement
+    iter_next/getdata/getlabel/getpad; next() assembles the DataBatch."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -91,17 +88,17 @@ class DataIter:
     def __iter__(self):
         return self
 
+    def __next__(self):
+        return self.next()
+
     def reset(self):
         pass
 
     def next(self):
-        if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
-        raise StopIteration
-
-    def __next__(self):
-        return self.next()
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), index=self.getindex())
 
     def iter_next(self):
         raise NotImplementedError()
@@ -119,19 +116,39 @@ class DataIter:
         raise NotImplementedError()
 
 
-class ResizeIter(DataIter):
-    """Resize an iterator to a fixed number of batches (reference io.py:264)."""
+class _BatchView(DataIter):
+    """Mixin for iterators that expose a held ``current_batch``."""
+
+    current_batch = None
+
+    def _held(self, field):
+        return getattr(self.current_batch, field)
+
+    def getdata(self):
+        return self._held("data")
+
+    def getlabel(self):
+        return self._held("label")
+
+    def getindex(self):
+        return self._held("index")
+
+    def getpad(self):
+        return self._held("pad")
+
+
+class ResizeIter(_BatchView):
+    """Present an underlying iterator as exactly ``size`` batches,
+    rewinding it on exhaustion (ref io.py:264)."""
 
     def __init__(self, data_iter, size, reset_internal=True):
-        super().__init__()
+        super().__init__(data_iter.batch_size)
         self.data_iter = data_iter
         self.size = size
         self.reset_internal = reset_internal
         self.cur = 0
-        self.current_batch = None
         self.provide_data = data_iter.provide_data
         self.provide_label = data_iter.provide_label
-        self.batch_size = data_iter.batch_size
         if hasattr(data_iter, "default_bucket_key"):
             self.default_bucket_key = data_iter.default_bucket_key
 
@@ -141,7 +158,7 @@ class ResizeIter(DataIter):
             self.data_iter.reset()
 
     def iter_next(self):
-        if self.cur == self.size:
+        if self.cur >= self.size:
             return False
         try:
             self.current_batch = self.data_iter.next()
@@ -151,133 +168,128 @@ class ResizeIter(DataIter):
         self.cur += 1
         return True
 
-    def getdata(self):
-        return self.current_batch.data
 
-    def getlabel(self):
-        return self.current_batch.label
+class _Slot:
+    """One producer thread double-buffering one source iterator.
 
-    def getindex(self):
-        return self.current_batch.index
+    The thread fills ``batch`` whenever ``vacant`` is set, then flips
+    ``ready``. StopIteration is represented by batch=None.
+    """
 
-    def getpad(self):
-        return self.current_batch.pad
+    def __init__(self, source):
+        self.source = source
+        self.ready = threading.Event()
+        self.vacant = threading.Event()
+        self.vacant.set()
+        self.batch = None
+        self.live = True
+        self.thread = threading.Thread(target=self._produce, daemon=True)
+        self.thread.start()
+
+    def _produce(self):
+        while True:
+            self.vacant.wait()
+            if not self.live:
+                return
+            try:
+                self.batch = self.source.next()
+            except StopIteration:
+                self.batch = None
+            self.vacant.clear()
+            self.ready.set()
+
+    def release(self):
+        """Consume the held batch; producer refills in the background."""
+        self.ready.clear()
+        self.vacant.set()
+
+    def reset(self):
+        self.ready.wait()          # let any in-flight fill land
+        self.source.reset()
+        self.release()
+
+    def shutdown(self):
+        self.live = False
+        self.vacant.set()
 
 
-class PrefetchingIter(DataIter):
-    """Thread-backed double-buffering prefetcher (reference io.py:343)."""
+class PrefetchingIter(_BatchView):
+    """Background-thread prefetcher over one or more iterators
+    (ref io.py:343 / src/io/iter_prefetcher.h), merging their outputs
+    into a single DataBatch per step."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        sources = iters if isinstance(iters, list) else [iters]
+        if not sources:
+            raise ValueError("need at least one source iterator")
+        self.iters = sources
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
-
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
-
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+        self._slots = [_Slot(src) for src in sources]
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+        for slot in self._slots:
+            slot.shutdown()
+
+    def _described(self, per_iter_descs, renames):
+        if renames is None:
+            return sum(per_iter_descs, [])
+        renamed = []
+        for mapping, descs in zip(renames, per_iter_descs):
+            for d in descs:
+                if isinstance(d, DataDesc):
+                    renamed.append(DataDesc(mapping[d.name], d.shape, d.dtype))
+                else:
+                    renamed.append(DataDesc(mapping[d[0]], d[1]))
+        return renamed
 
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
-                     for x in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        return self._described([it.provide_data for it in self.iters],
+                               self.rename_data)
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
-                     for x in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        return self._described([it.provide_label for it in self.iters],
+                               self.rename_label)
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        for slot in self._slots:
+            slot.reset()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
+        for slot in self._slots:
+            slot.ready.wait()
+        parts = [slot.batch for slot in self._slots]
+        if parts[0] is None:
             return False
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad, self.next_batch[0].index,
-            provide_data=self.provide_data, provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+            sum((b.data for b in parts), []),
+            sum((b.label for b in parts), []),
+            parts[0].pad, parts[0].index,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        for slot in self._slots:
+            slot.release()
         return True
 
     def next(self):
-        if self.iter_next():
-            return self.current_batch
-        raise StopIteration
-
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getindex(self):
-        return self.current_batch.index
-
-    def getpad(self):
-        return self.current_batch.pad
+        if not self.iter_next():
+            raise StopIteration
+        return self.current_batch
 
 
 def _init_data(data, allow_empty, default_name):
+    """Normalise array / list / dict input into [(name, NDArray), ...]."""
     if data is None:
         data = []
     if isinstance(data, (np.ndarray, NDArray)):
         data = [data]
     if isinstance(data, list):
-        if not allow_empty:
-            assert len(data) > 0
+        if not data and not allow_empty:
+            raise ValueError("empty data")
         if len(data) == 1:
             data = {default_name: data[0]}
         else:
@@ -286,51 +298,60 @@ def _init_data(data, allow_empty, default_name):
     if not isinstance(data, dict):
         raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
                         "them or dict with them as values")
-    out = []
-    for k, v in data.items():
-        if not isinstance(v, NDArray):
-            v = nd.array(np.asarray(v), dtype=np.asarray(v).dtype
-                         if np.asarray(v).dtype != np.float64 else np.float32)
-        out.append((k, v))
-    return out
+    pairs = []
+    for name, arr in data.items():
+        if not isinstance(arr, NDArray):
+            raw = np.asarray(arr)
+            if raw.dtype == np.float64:
+                raw = raw.astype(np.float32)
+            arr = nd.array(raw, dtype=raw.dtype)
+        pairs.append((name, arr))
+    return pairs
 
 
 class NDArrayIter(DataIter):
-    """Iterate over in-memory arrays (reference io.py:516)."""
+    """Batched iteration over in-memory arrays (ref io.py:516).
+
+    ``last_batch_handle``: 'pad' wraps the tail batch around and reports
+    pad; 'discard' drops it; 'roll_over' carries it into the next epoch.
+    """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
                  label_name="softmax_label"):
         super().__init__(batch_size)
-        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
         self.label = _init_data(label, allow_empty=True,
                                 default_name=label_name)
-        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+
+        total = self.data[0][1].shape[0]
+        self.idx = np.arange(total)
         if shuffle:
             np.random.shuffle(self.idx)
         if last_batch_handle == "discard":
-            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
-            self.idx = self.idx[:new_n]
-        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
-        self.num_source = len(self.data_list)
+            self.idx = self.idx[:total - total % batch_size]
         self.num_data = self.idx.shape[0]
-        assert self.num_data >= batch_size, \
-            "batch_size needs to be smaller than data size."
+        if self.num_data < batch_size:
+            raise ValueError("batch_size needs to be smaller than data size.")
+        self.data_list = [arr for _, arr in self.data + self.label]
+        self.num_source = len(self.data_list)
         self.cursor = -batch_size
-        self.batch_size = batch_size
-        self.last_batch_handle = last_batch_handle
-        self.shuffle = shuffle
-        self._np_cache = {k: v.asnumpy() for k, v in self.data + self.label}
+        # host-side staging copies so slicing doesn't round-trip the device
+        self._np_cache = {name: arr.asnumpy()
+                         for name, arr in self.data + self.label}
 
     @property
     def provide_data(self):
-        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
-                         v.dtype) for k, v in self.data]
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:], arr.dtype)
+                for name, arr in self.data]
 
     @property
     def provide_label(self):
-        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
-                         v.dtype) for k, v in self.label]
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:], arr.dtype)
+                for name, arr in self.label]
 
     def hard_reset(self):
         self.cursor = -self.batch_size
@@ -338,10 +359,10 @@ class NDArrayIter(DataIter):
     def reset(self):
         if self.shuffle:
             np.random.shuffle(self.idx)
-        if (self.last_batch_handle == "roll_over"
-                and self.cursor > self.num_data):
-            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
-                self.batch_size
+        if self.last_batch_handle == "roll_over" \
+                and self.cursor > self.num_data:
+            overhang = (self.cursor % self.num_data) % self.batch_size
+            self.cursor = overhang - self.batch_size
         else:
             self.cursor = -self.batch_size
 
@@ -350,104 +371,108 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def next(self):
-        if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=None)
-        raise StopIteration
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), index=None)
 
-    def _getdata(self, data_source):
-        assert self.cursor < self.num_data, "DataIter needs reset."
-        out = []
-        for k, _ in data_source:
-            npy = self._np_cache[k]
-            if self.cursor + self.batch_size <= self.num_data:
-                sel = self.idx[self.cursor:self.cursor + self.batch_size]
-                out.append(nd.array(npy[sel], dtype=npy.dtype))
-            else:
-                pad = self.batch_size - self.num_data + self.cursor
-                sel = np.concatenate([self.idx[self.cursor:],
-                                      self.idx[:pad]])
-                out.append(nd.array(npy[sel], dtype=npy.dtype))
-        return out
+    def _window(self):
+        """Index array for the current batch, wrapping the tail if short."""
+        lo = self.cursor
+        hi = lo + self.batch_size
+        if hi <= self.num_data:
+            return self.idx[lo:hi]
+        wrap = hi - self.num_data
+        return np.concatenate([self.idx[lo:], self.idx[:wrap]])
+
+    def _slice(self, source):
+        if self.cursor >= self.num_data:
+            raise RuntimeError("DataIter needs reset.")
+        sel = self._window()
+        picked = []
+        for name, _ in source:
+            host = self._np_cache[name]
+            picked.append(nd.array(host[sel], dtype=host.dtype))
+        return picked
 
     def getdata(self):
-        return self._getdata(self.data)
+        return self._slice(self.data)
 
     def getlabel(self):
-        return self._getdata(self.label)
+        return self._slice(self.label)
 
     def getpad(self):
-        if (self.last_batch_handle == "pad"
-                and self.cursor + self.batch_size > self.num_data):
-            return self.cursor + self.batch_size - self.num_data
+        overrun = self.cursor + self.batch_size - self.num_data
+        if self.last_batch_handle == "pad" and overrun > 0:
+            return overrun
         return 0
 
 
-class CSVIter(DataIter):
-    """CSV file iterator (reference src/io/iter_csv.cc:150)."""
+class _WrappedArrayIter(DataIter):
+    """Shared shell for CSVIter/MNISTIter: parse files once, then delegate
+    to an inner NDArrayIter."""
+
+    def __init__(self, data, label, batch_size, **iter_kwargs):
+        super().__init__(batch_size)
+        self._inner = NDArrayIter(data, label, batch_size, **iter_kwargs)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class CSVIter(_WrappedArrayIter):
+    """Comma-separated-file iterator (ref src/io/iter_csv.cc:150)."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, **kwargs):
-        super().__init__(batch_size)
-        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
-        data = data.reshape((-1,) + tuple(data_shape))
-        label = None
+        table = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        table = table.reshape((-1,) + tuple(data_shape))
         if label_csv is not None:
             label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
             label = label.reshape((-1,) + tuple(label_shape))
             if label_shape == (1,):
                 label = label.reshape(-1)
         else:
-            label = np.zeros((data.shape[0],), dtype=np.float32)
-        self._inner = NDArrayIter(data, label, batch_size,
-                                  last_batch_handle="roll_over"
-                                  if round_batch else "pad")
-        self.provide_data = self._inner.provide_data
-        self.provide_label = self._inner.provide_label
-
-    def reset(self):
-        self._inner.reset()
-
-    def next(self):
-        return self._inner.next()
+            label = np.zeros((table.shape[0],), dtype=np.float32)
+        super().__init__(table, label, batch_size,
+                         last_batch_handle="roll_over" if round_batch
+                         else "pad")
 
 
-def _read_idx_images(path):
-    with open(path, "rb") as f:
-        magic, n = struct.unpack(">ii", f.read(8))
+def _read_idx_file(path):
+    """Parse an MNIST idx file: magic 2051 = images, 2049 = labels."""
+    with open(path, "rb") as fh:
+        magic, count = struct.unpack(">ii", fh.read(8))
         if magic == 2051:
-            rows, cols = struct.unpack(">ii", f.read(8))
-            return np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+            rows, cols = struct.unpack(">ii", fh.read(8))
+            return np.frombuffer(fh.read(), dtype=np.uint8) \
+                .reshape(count, rows, cols)
         if magic == 2049:
-            return np.frombuffer(f.read(), dtype=np.uint8).reshape(n)
+            return np.frombuffer(fh.read(), dtype=np.uint8).reshape(count)
         raise MXNetError("bad idx magic %d in %s" % (magic, path))
 
 
-class MNISTIter(DataIter):
-    """MNIST idx-format iterator (reference src/io/iter_mnist.cc:259).
+class MNISTIter(_WrappedArrayIter):
+    """MNIST idx-format iterator (ref src/io/iter_mnist.cc:259).
 
-    Reads the standard idx files if present; raises otherwise (tests use
-    test_utils.get_mnist_iterator which falls back to synthetic digits).
+    Requires the standard idx files on disk; tests fall back to
+    test_utils.get_mnist_iterator's synthetic digits when absent.
     """
 
     def __init__(self, image="train-images-idx3-ubyte",
                  label="train-labels-idx1-ubyte", batch_size=128, shuffle=True,
                  flat=False, silent=False, seed=0, input_shape=None, **kwargs):
-        super().__init__(batch_size)
         if not os.path.exists(image):
             raise MXNetError("MNIST file %s not found" % image)
-        imgs = _read_idx_images(image).astype(np.float32) / 255.0
-        lbls = _read_idx_images(label).astype(np.float32)
+        pixels = _read_idx_file(image).astype(np.float32) / 255.0
+        digits = _read_idx_file(label).astype(np.float32)
         if flat:
-            imgs = imgs.reshape(imgs.shape[0], -1)
+            pixels = pixels.reshape(pixels.shape[0], -1)
         else:
-            imgs = imgs.reshape(imgs.shape[0], 1, 28, 28)
-        self._inner = NDArrayIter(imgs, lbls, batch_size, shuffle=shuffle)
-        self.provide_data = self._inner.provide_data
-        self.provide_label = self._inner.provide_label
-
-    def reset(self):
-        self._inner.reset()
-
-    def next(self):
-        return self._inner.next()
+            pixels = pixels.reshape(pixels.shape[0], 1, 28, 28)
+        super().__init__(pixels, digits, batch_size, shuffle=shuffle)

@@ -1,21 +1,31 @@
-"""Optimizers (parity: reference python/mxnet/optimizer.py:36-1167).
+"""Optimizers built on a pure functional update core.
 
-Updates dispatch to the fused update *ops* (``mxnet_tpu/ops/optim_ops.py``,
-reference ``src/operator/optimizer_op.cc``) so that under jit the whole
-update fuses into the training-step XLA program; pure-python fallbacks cover
-the optimizers the reference implements in Python (AdaGrad, AdaDelta, ...).
+API parity with the reference ``python/mxnet/optimizer.py:36-1167``
+(Optimizer registry, lr/wd multipliers, per-index update counts, Updater
+state serialisation, the SGD…Nadam zoo). Independent, TPU-first design:
+every optimizer's math lives in one **pure** method
+
+    ``update_step(weight, grad, state, hyper) -> (new_weight, new_state)``
+
+on raw jax arrays (``hyper`` carries lr/wd/t — possibly traced scalars).
+The classic mutating ``update(index, weight, grad, state)`` entry point and
+the sharded SPMD trainer both call the same pure core, so eager, Module,
+and one-program pjit paths are bitwise-identical; under jit the update
+fuses into the training-step XLA program exactly like the reference's
+fused update ops (``src/operator/optimizer_op.cc``).
 """
 from __future__ import annotations
 
-import logging
 import math
 import pickle
 
 import numpy as np
+import jax.numpy as jnp
 
-from .base import Registry, MXNetError
+from .base import Registry
 from . import ndarray as nd
 from .ndarray import NDArray
+from .ops import optim_ops as _kern
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
            "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum",
@@ -29,8 +39,44 @@ def register(klass):
     return klass
 
 
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.get(name)(**kwargs)
+
+
+# ---- state pytree plumbing: NDArray-structured <-> raw jax arrays ----
+
+def _state_raw(state):
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state._data
+    return tuple(_state_raw(s) for s in state)
+
+
+def _state_writeback(state, new_raw):
+    """Mutate the NDArray state structure in place with updated arrays."""
+    if state is None:
+        return
+    if isinstance(state, NDArray):
+        state._set_data(new_raw)
+        return
+    for slot, val in zip(state, new_raw):
+        _state_writeback(slot, val)
+
+
+def _zeros_like_nd(weight, dtype=None):
+    return nd.zeros(weight.shape, ctx=weight.context,
+                    dtype=dtype or weight.dtype)
+
+
 class Optimizer:
-    """Base optimizer (reference optimizer.py:36)."""
+    """Registry base + hyper-parameter bookkeeping (ref optimizer.py:36).
+
+    Subclasses implement ``create_state`` and the pure ``update_step``;
+    the mutating ``update`` wrapper is shared.
+    """
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
@@ -39,34 +85,26 @@ class Optimizer:
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
+            lr_scheduler.base_lr = learning_rate
         self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
+        self.clip_gradient = clip_gradient
         self.begin_num_update = begin_num_update
         self.num_update = begin_num_update
         self._index_update_count = {}
-        self.clip_gradient = clip_gradient
-        if param_idx2name is None:
-            param_idx2name = {}
-        self.idx2name = param_idx2name.copy()
-        self.sym_info = None
-        if sym is not None:
-            self.sym_info = (sym.attr_dict(), sym.list_arguments())
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym \
+            else None
         self.param_dict = param_dict or {}
         self.set_lr_mult({})
         self.set_wd_mult({})
 
-    # -- serialization for kvstore set_optimizer ---------------------------
+    # ---- factory used by kvstore set_optimizer ----
+
     @staticmethod
     def create_optimizer(name, **kwargs):
         return _REG.get(name)(**kwargs)
 
-    def create_state(self, index, weight):
-        return None
-
-    def update(self, index, weight, grad, state):
-        raise NotImplementedError()
+    # ---- hyper-parameter resolution ----
 
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
@@ -74,66 +112,80 @@ class Optimizer:
                               "defined.")
         self.lr = lr
 
-    def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
+    def _mult_from_attrs(self, key):
+        """Collect __lr_mult__/__wd_mult__ attrs from the bound symbol."""
+        found = {}
         if self.sym_info:
-            attr, arg_names = self.sym_info
+            attrs, arg_names = self.sym_info
             for name in arg_names:
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+                if name in attrs and key in attrs[name]:
+                    found[name] = float(attrs[name][key])
+        return found
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = self._mult_from_attrs("__lr_mult__")
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
         self.wd_mult = {}
-        for n in self.idx2name.values():
-            if not (n.endswith("_weight") or n.endswith("_gamma")):
-                self.wd_mult[n] = 0.0
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        for name in self.idx2name.values():
+            if not name.endswith(("_weight", "_gamma")):
+                self.wd_mult[name] = 0.0
+        self.wd_mult.update(self._mult_from_attrs("__wd_mult__"))
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index], self.num_update)
+        count = self._index_update_count.setdefault(index,
+                                                    self.begin_num_update)
+        self._index_update_count[index] = count + 1
+        self.num_update = max(count + 1, self.num_update)
+
+    def _resolve_mult(self, index, table):
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            return p.lr_mult if table is self.lr_mult else p.wd_mult
+        if index in table:
+            return table[index]
+        name = self.idx2name.get(index)
+        return table.get(name, 1.0) if name is not None else 1.0
 
     def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        base = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        return base * self._resolve_mult(index, self.lr_mult)
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._resolve_mult(index, self.wd_mult)
 
-    def _common_kw(self):
-        kw = {"rescale_grad": self.rescale_grad}
-        if self.clip_gradient:
-            kw["clip_gradient"] = self.clip_gradient
-        return kw
+    def _clip(self):
+        """clip_gradient in the kernel convention (-1 = off)."""
+        return self.clip_gradient if self.clip_gradient else -1.0
+
+    # ---- the two update entry points ----
+
+    def create_state(self, index, weight):
+        return None
+
+    def update_step(self, weight, grad, state, hyper):
+        """Pure update on raw jax arrays. hyper: {lr, wd, t[, key]}."""
+        raise NotImplementedError("%s has no pure update_step"
+                                  % type(self).__name__)
+
+    def update(self, index, weight, grad, state):
+        """Classic mutating update: resolves hyper-params for *index*,
+        runs the pure core, writes results back into the NDArrays."""
+        self._update_count(index)
+        hyper = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+                 "t": self._index_update_count[index]}
+        new_w, new_state = self.update_step(weight._data, grad._data,
+                                            _state_raw(state), hyper)
+        weight._set_data(new_w)
+        _state_writeback(state, new_state)
 
 
 @register
 class SGD(Optimizer):
-    """SGD with momentum and optional multi-precision (reference :434)."""
+    """SGD with momentum + optional multi-precision fp16 (ref :434)."""
 
     def __init__(self, momentum=0.0, lazy_update=True,
                  multi_precision=False, **kwargs):
@@ -144,380 +196,314 @@ class SGD(Optimizer):
 
     def create_state(self, index, weight):
         if self.multi_precision and weight.dtype == np.float16:
-            w32 = weight.astype(np.float32)
-            mom = (nd.zeros(weight.shape, ctx=weight.context,
-                            dtype=np.float32) if self.momentum else None)
-            return (mom, w32)
+            mom = _zeros_like_nd(weight, np.float32) if self.momentum \
+                else None
+            return (mom, weight.astype(np.float32))
         if self.momentum != 0.0:
-            return nd.zeros(weight.shape, ctx=weight.context,
-                            dtype=weight.dtype)
+            return _zeros_like_nd(weight)
         return None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kw()
-        if isinstance(state, tuple):  # multi-precision
+    def update_step(self, w, g, state, hyper):
+        kw = dict(lr=hyper["lr"], wd=hyper["wd"],
+                  rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        if isinstance(state, tuple):          # multi-precision
             mom, w32 = state
             if mom is not None:
-                nd._internal.mp_sgd_mom_update(
-                    weight, grad, mom, w32, out=weight, lr=lr, wd=wd,
-                    momentum=self.momentum, **kw)
-            else:
-                nd._internal.mp_sgd_update(weight, grad, w32, out=weight,
-                                           lr=lr, wd=wd, **kw)
-        elif state is not None:
-            nd._internal.sgd_mom_update(weight, grad, state, out=weight,
-                                        lr=lr, wd=wd,
-                                        momentum=self.momentum, **kw)
-        else:
-            nd._internal.sgd_update(weight, grad, out=weight, lr=lr, wd=wd,
-                                    **kw)
+                new_w, new_mom, new_w32 = _kern._mp_sgd_mom_update(
+                    w, g, mom, w32, momentum=self.momentum, **kw)
+                return new_w, (new_mom, new_w32)
+            new_w, new_w32 = _kern._mp_sgd_update(w, g, w32, **kw)
+            return new_w, (None, new_w32)
+        if state is not None:
+            new_w, new_mom = _kern._sgd_mom_update(
+                w, g, state, momentum=self.momentum, **kw)
+            return new_w, new_mom
+        return _kern._sgd_update(w, g, **kw), None
 
 
-register(SGD, )  # default name already registered; keep ccSGD alias:
 _REG.register(SGD, "ccsgd")
 
 
 @register
 class NAG(Optimizer):
-    """Nesterov accelerated SGD (reference :585)."""
+    """Nesterov accelerated SGD (ref :585)."""
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _zeros_like_nd(weight) if self.momentum != 0.0 else None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        if state is not None:
-            state *= self.momentum
-            state += grad
-            grad += self.momentum * state
-            weight -= lr * (grad + wd * weight)
-        else:
-            weight -= lr * (grad + wd * weight)
+    def update_step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = _kern._prep_grad(g, self.rescale_grad, self._clip())
+        if state is None:
+            return w - lr * (g + wd * w), None
+        new_mom = self.momentum * state + g
+        lookahead = g + self.momentum * new_mom
+        return w - lr * (lookahead + wd * w), new_mom
 
 
 @register
 class SGLD(Optimizer):
-    """Stochastic gradient Langevin dynamics (reference :631)."""
+    """Stochastic gradient Langevin dynamics (ref :631): gradient step at
+    lr/2 plus N(0, lr) noise."""
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        weight -= lr / 2 * (grad + wd * weight)
-        weight += nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
-                                   ctx=weight.context, dtype=weight.dtype)
+    def update_step(self, w, g, state, hyper):
+        import jax
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = _kern._prep_grad(g, self.rescale_grad, self._clip())
+        key = hyper.get("key")
+        if key is None:
+            from . import random as _random
+            key = _random.next_key()
+        noise = math.sqrt(lr) if not hasattr(lr, "dtype") else jnp.sqrt(lr)
+        stepped = w - lr / 2 * (g + wd * w)
+        return stepped + noise * jax.random.normal(key, w.shape,
+                                                   dtype=w.dtype), None
 
 
 @register
 class DCASGD(Optimizer):
-    """Delay-compensated async SGD (reference :560)."""
+    """Delay-compensated async SGD (ref :560); state carries the momentum
+    and the weight snapshot from the previous update."""
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
-        self.weight_previous = {}
         self.lamda = lamda
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return (None, weight.copy())
-        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                weight.copy())
+        mom = _zeros_like_nd(weight) if self.momentum != 0.0 else None
+        return (mom, weight.copy())
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        mom, previous_weight = state
+    def update_step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = _kern._prep_grad(g, self.rescale_grad, self._clip())
+        mom, prev_w = state
+        compensated = g + wd * w + self.lamda * g * g * (w - prev_w)
         if mom is not None:
-            mom *= self.momentum
-            mom += -lr * (grad + wd * weight + self.lamda * grad * grad *
-                          (weight - previous_weight))
-            weight.copyto(previous_weight)
-            weight += mom
-        else:
-            weight += -lr * (grad + wd * weight + self.lamda * grad * grad *
-                             (weight - previous_weight))
-            weight.copyto(previous_weight)
-            # previous updated after
+            new_mom = self.momentum * mom - lr * compensated
+            return w + new_mom, (new_mom, w)
+        return w - lr * compensated, (None, w)
 
 
 @register
 class Adam(Optimizer):
-    """Adam (reference :754); dispatches to the fused adam_update op."""
+    """Adam with bias correction folded into lr (ref :754)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        coef1 = 1.0 - self.beta1 ** t
-        coef2 = 1.0 - self.beta2 ** t
-        # ** 0.5, not math.sqrt: ShardedTrainer.apply_updates patches
-        # _index_update_count with traced step counts, so t may be a tracer.
-        lr *= coef2 ** 0.5 / coef1
+    def update_step(self, w, g, state, hyper):
+        t = hyper["t"]
+        # ** 0.5 (not math.sqrt): t may be a traced scalar under jit
+        corrected = hyper["lr"] * (1.0 - self.beta2 ** t) ** 0.5 \
+            / (1.0 - self.beta1 ** t)
         mean, var = state
-        nd._internal.adam_update(weight, grad, mean, var, out=weight, lr=lr,
-                                 wd=wd, beta1=self.beta1, beta2=self.beta2,
-                                 epsilon=self.epsilon, **self._common_kw())
+        new_w, new_mean, new_var = _kern._adam_update(
+            w, g, mean, var, lr=corrected, wd=hyper["wd"],
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        return new_w, (new_mean, new_var)
 
 
 @register
 class AdaGrad(Optimizer):
-    """AdaGrad (reference :902)."""
+    """AdaGrad (ref :902); state is the squared-gradient history."""
 
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _zeros_like_nd(weight)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        history = state
-        history += grad * grad
-        weight -= lr * (grad / nd.sqrt(history + self.float_stable_eps)
-                        + wd * weight)
+    def update_step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = _kern._prep_grad(g, self.rescale_grad, self._clip())
+        hist = state + g * g
+        stepped = w - lr * (g / jnp.sqrt(hist + self.float_stable_eps)
+                            + wd * w)
+        return stepped, hist
 
 
 @register
 class RMSProp(Optimizer):
-    """RMSProp, centered or not (reference :938)."""
+    """RMSProp, plain or centered (ref :938)."""
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
-        self.epsilon = epsilon
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered, self.epsilon = centered, epsilon
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
-                             dtype=weight.dtype)
-        if self.centered:
-            return (z(), z(), z())
-        return (z(),)
+        n = 3 if self.centered else 1
+        return tuple(_zeros_like_nd(weight) for _ in range(n))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kw()
-        if self.clip_weights:
-            kw["clip_weights"] = self.clip_weights
-        if not self.centered:
-            (n,) = state
-            nd._internal.rmsprop_update(weight, grad, n, out=weight, lr=lr,
-                                        wd=wd, gamma1=self.gamma1,
-                                        epsilon=self.epsilon, **kw)
-        else:
-            n, g, delta = state
-            nd._internal.rmspropalex_update(weight, grad, n, g, delta,
-                                            out=weight, lr=lr, wd=wd,
-                                            gamma1=self.gamma1,
-                                            gamma2=self.gamma2,
-                                            epsilon=self.epsilon, **kw)
+    def update_step(self, w, g, state, hyper):
+        kw = dict(lr=hyper["lr"], wd=hyper["wd"], gamma1=self.gamma1,
+                  epsilon=self.epsilon, rescale_grad=self.rescale_grad,
+                  clip_gradient=self._clip(),
+                  clip_weights=self.clip_weights or -1.0)
+        if self.centered:
+            n, avg, delta = state
+            new_w, nn, ng, nd_ = _kern._rmspropalex_update(
+                w, g, n, avg, delta, gamma2=self.gamma2, **kw)
+            return new_w, (nn, ng, nd_)
+        (n,) = state
+        new_w, nn = _kern._rmsprop_update(w, g, n, **kw)
+        return new_w, (nn,)
 
 
 @register
 class AdaDelta(Optimizer):
-    """AdaDelta (reference :1004)."""
+    """AdaDelta (ref :1004); state = (E[g^2], E[dx^2])."""
 
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
-        self.rho = rho
-        self.epsilon = epsilon
+        self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, ctx=weight.context),
-                nd.zeros(weight.shape, ctx=weight.context))
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        acc_g, acc_delta = state
-        acc_g *= self.rho
-        acc_g += (1.0 - self.rho) * grad * grad
-        current_delta = (nd.sqrt(acc_delta + self.epsilon)
-                         / nd.sqrt(acc_g + self.epsilon)) * grad
-        acc_delta *= self.rho
-        acc_delta += (1.0 - self.rho) * current_delta * current_delta
-        weight[:] = weight - current_delta - wd * weight
+    def update_step(self, w, g, state, hyper):
+        wd = hyper["wd"]
+        g = _kern._prep_grad(g, self.rescale_grad, self._clip())
+        acc_g, acc_dx = state
+        acc_g = self.rho * acc_g + (1.0 - self.rho) * g * g
+        dx = jnp.sqrt((acc_dx + self.epsilon) / (acc_g + self.epsilon)) * g
+        acc_dx = self.rho * acc_dx + (1.0 - self.rho) * dx * dx
+        return w - dx - wd * w, (acc_g, acc_dx)
 
 
 @register
 class Ftrl(Optimizer):
-    """FTRL (reference :1040); fused ftrl_update op."""
+    """FTRL-proximal (ref :1040); state = (z, n)."""
 
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.lamda1 = lamda1
-        self.beta = beta
+        self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, ctx=weight.context),  # z
-                nd.zeros(weight.shape, ctx=weight.context))  # n
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def update_step(self, w, g, state, hyper):
         z, n = state
-        nd._internal.ftrl_update(weight, grad, z, n, out=weight, lr=lr,
-                                 wd=wd, lamda1=self.lamda1, beta=self.beta,
-                                 **self._common_kw())
+        new_w, new_z, new_n = _kern._ftrl_update(
+            w, g, z, n, lr=hyper["lr"], wd=hyper["wd"], lamda1=self.lamda1,
+            beta=self.beta, rescale_grad=self.rescale_grad,
+            clip_gradient=self._clip())
+        return new_w, (new_z, new_n)
 
 
 @register
 class Adamax(Optimizer):
-    """AdaMax (reference :1084)."""
+    """AdaMax: infinity-norm variant of Adam (ref :1084)."""
 
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
+        self.beta1, self.beta2 = beta1, beta2
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, ctx=weight.context),
-                nd.zeros(weight.shape, ctx=weight.context))
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        lr /= (1.0 - self.beta1 ** t)
-        grad = grad * self.rescale_grad + wd * weight
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        m_t, u_t = state
-        m_t *= self.beta1
-        m_t += (1.0 - self.beta1) * grad
-        u_t[:] = nd.maximum(self.beta2 * u_t, nd.abs(grad))
-        weight -= lr * m_t / u_t
+    def update_step(self, w, g, state, hyper):
+        lr = hyper["lr"] / (1.0 - self.beta1 ** hyper["t"])
+        g = _kern._prep_grad(g, self.rescale_grad, self._clip()) \
+            + hyper["wd"] * w
+        m, u = state
+        m = self.beta1 * m + (1.0 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        return w - lr * m / u, (m, u)
 
 
 @register
 class Nadam(Optimizer):
-    """Nesterov Adam (reference :1119)."""
+    """Nesterov Adam (ref :1119); the momentum-schedule product rides in
+    the state so the pure core stays stateless."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, ctx=weight.context),
-                nd.zeros(weight.shape, ctx=weight.context))
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight),
+                nd.ones((1,), ctx=weight.context))     # running mu product
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        grad = grad * self.rescale_grad + wd * weight
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
-        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
-                                     ((t + 1) * self.schedule_decay))
-        self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t_1
-        m_t, v_t = state
-        m_t *= self.beta1
-        m_t += (1.0 - self.beta1) * grad
-        v_t *= self.beta2
-        v_t += (1.0 - self.beta2) * grad * grad
-        grad_prime = grad / (1.0 - self.m_schedule)
-        m_t_prime = m_t / (1.0 - m_schedule_next)
-        v_t_prime = v_t / (1.0 - self.beta2 ** t)
-        m_t_bar = ((1.0 - momentum_t) * grad_prime
-                   + momentum_t_1 * m_t_prime)
-        weight -= lr * m_t_bar / (nd.sqrt(v_t_prime) + self.epsilon)
+    def update_step(self, w, g, state, hyper):
+        lr, wd, t = hyper["lr"], hyper["wd"], hyper["t"]
+        g = _kern._prep_grad(g, self.rescale_grad, self._clip()) + wd * w
+        mu_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_next = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                ((t + 1) * self.schedule_decay))
+        m, v, sched = state
+        sched = sched * mu_t
+        sched_next = sched * mu_next
+        m = self.beta1 * m + (1.0 - self.beta1) * g
+        v = self.beta2 * v + (1.0 - self.beta2) * g * g
+        g_hat = g / (1.0 - sched)
+        m_hat = m / (1.0 - sched_next)
+        v_hat = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - mu_t) * g_hat + mu_next * m_hat
+        return w - lr * m_bar / (jnp.sqrt(v_hat) + self.epsilon), \
+            (m, v, sched)
 
 
 @register
 class Signum(Optimizer):
+    """Sign-of-gradient SGD with momentum (signum_update kernels)."""
+
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.momentum = momentum
-        self.wd_lh = wd_lh
+        self.momentum, self.wd_lh = momentum, wd_lh
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, ctx=weight.context,
-                            dtype=weight.dtype)
-        return None
+        return _zeros_like_nd(weight) if self.momentum != 0.0 else None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kw()
+    def update_step(self, w, g, state, hyper):
+        kw = dict(lr=hyper["lr"], wd=hyper["wd"],
+                  rescale_grad=self.rescale_grad, clip_gradient=self._clip())
         if state is not None:
-            nd._internal.signum_update(weight, grad, state, out=weight, lr=lr,
-                                       wd=wd, momentum=self.momentum,
-                                       wd_lh=self.wd_lh, **kw)
-        else:
-            nd._internal.signsgd_update(weight, grad, out=weight, lr=lr,
-                                        wd=wd, **kw)
+            new_w, new_mom = _kern._signum_update(
+                w, g, state, momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+            return new_w, new_mom
+        return _kern._signsgd_update(w, g, **kw), None
 
 
 @register
 class Test(Optimizer):
-    """Test optimizer: w -= rescale_grad * grad (reference :1110)."""
+    """w -= rescale_grad * g; state mirrors the weight (ref :1110)."""
 
     def create_state(self, index, weight):
-        return nd.zeros(weight.shape, ctx=weight.context)
+        return _zeros_like_nd(weight)
+
+    def update_step(self, w, g, state, hyper):
+        new_w = w - self.rescale_grad * g
+        return new_w, new_w
 
     def update(self, index, weight, grad, state):
-        weight -= grad * self.rescale_grad
-        state[:] = weight
-
-
-def create(name, **kwargs):
-    if isinstance(name, Optimizer):
-        return name
-    return _REG.get(name)(**kwargs)
+        # no hyper resolution needed; keep the reference's exact behavior
+        new_w, new_s = self.update_step(weight._data, grad._data,
+                                        _state_raw(state), {})
+        weight._set_data(new_w)
+        _state_writeback(state, new_s)
 
 
 class Updater:
-    """Stateful per-index updater (reference optimizer.py:1124 get_updater)."""
+    """Per-slot stateful wrapper (ref optimizer.py:1124 get_updater):
+    lazily creates optimizer state per index and serialises it for
+    checkpointing."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer
@@ -531,18 +517,19 @@ class Updater:
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def set_states(self, states):
-        data = pickle.loads(states)
-        if isinstance(data, tuple) and len(data) == 2:
-            self.states, opt = data
-            if opt is not None:
-                self.optimizer = opt
+        payload = pickle.loads(states)
+        if isinstance(payload, tuple) and len(payload) == 2:
+            self.states, maybe_opt = payload
+            if maybe_opt is not None:
+                self.optimizer = maybe_opt
         else:
-            self.states = data
-        self.states_synced = dict.fromkeys(self.states.keys(), False)
+            self.states = payload
+        self.states_synced = dict.fromkeys(self.states, False)
 
     def get_states(self, dump_optimizer=False):
-        return pickle.dumps((self.states, self.optimizer)
-                            if dump_optimizer else self.states)
+        payload = (self.states, self.optimizer) if dump_optimizer \
+            else self.states
+        return pickle.dumps(payload)
 
 
 def get_updater(optimizer):

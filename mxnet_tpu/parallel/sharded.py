@@ -91,23 +91,6 @@ def _state_get(state):
     return tuple(_state_get(s) for s in state)
 
 
-def _state_wrap(tree):
-    """Pytree of jax arrays → NDArray structure for optimizer.update."""
-    if tree is None:
-        return None
-    if isinstance(tree, tuple):
-        return tuple(_state_wrap(t) for t in tree)
-    return _wrap(tree)
-
-
-def _state_unwrap(state):
-    if state is None:
-        return None
-    if isinstance(state, tuple):
-        return tuple(_state_unwrap(s) for s in state)
-    return state._data
-
-
 def sharded_data(x, mesh, spec=None, axis="data"):
     """Place a host batch on the mesh, sharded over the batch axis."""
     if spec is None:
@@ -198,30 +181,14 @@ class ShardedTrainer:
             return jnp.mean(l._data), new_aux
 
         def apply_updates(params, grads, states, lrs, wds, ts):
+            # Pure functional core: the same update_step the eager Updater
+            # runs, traced here with lr/wd/t entering as scalars so one
+            # cached program serves every step of the schedule.
             new_p, new_s = {}, {}
-            saved = (opt._get_lr, opt._get_wd, opt._update_count,
-                     opt._index_update_count)
-            name_of = {i: n for n, i in index.items()}
-            try:
-                opt._get_lr = lambda i: lrs[name_of[i]]
-                opt._get_wd = lambda i: wds[name_of[i]]
-                opt._update_count = lambda i: None
-                # Adam-family reads _index_update_count[i] for bias
-                # correction; feed the traced step count so the cached
-                # program stays correct across steps.
-                opt._index_update_count = {index[n]: ts[n]
-                                           for n in grad_names}
-                for n in grad_names:
-                    w = _wrap(params[n])
-                    g = _wrap(grads[n])
-                    st = _state_wrap(states[n])
-                    with autograd.pause():
-                        opt.update(index[n], w, g, st)
-                    new_p[n] = w._data
-                    new_s[n] = _state_unwrap(st)
-            finally:
-                (opt._get_lr, opt._get_wd, opt._update_count,
-                 opt._index_update_count) = saved
+            for n in grad_names:
+                hyper = {"lr": lrs[n], "wd": wds[n], "t": ts[n]}
+                new_p[n], new_s[n] = opt.update_step(
+                    params[n], grads[n], states[n], hyper)
             return new_p, new_s
 
         def step(params, states, aux, data, label, key, lrs, wds, ts):
