@@ -1,4 +1,9 @@
-"""AlexNet (reference python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet ("One weird trick" variant).
+
+API parity with the reference model zoo
+(``python/mxnet/gluon/model_zoo/vision/alexnet.py:33``); the feature
+extractor is built from a conv-spec table rather than inline adds.
+"""
 from __future__ import annotations
 
 from ....context import cpu
@@ -7,44 +12,44 @@ from ... import nn
 
 __all__ = ["AlexNet", "alexnet"]
 
+# (channels, kernel, stride, pad, max-pool-after?)
+_CONV_PLAN = [
+    (64, 11, 4, 2, True),
+    (192, 5, 1, 2, True),
+    (384, 3, 1, 1, False),
+    (256, 3, 1, 1, False),
+    (256, 3, 1, 1, True),
+]
+
 
 class AlexNet(HybridBlock):
-    r"""AlexNet model from "One weird trick" (reference alexnet.py:33)."""
+    r"""AlexNet: 5 conv stages + 2 dropout-regularised FC layers."""
 
     def __init__(self, classes=1000, **kwargs):
-        super(AlexNet, self).__init__(**kwargs)
+        super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                for ch, k, s, p, pool in _CONV_PLAN:
+                    self.features.add(nn.Conv2D(ch, kernel_size=k, strides=s,
+                                                padding=p, activation="relu"))
+                    if pool:
+                        self.features.add(nn.MaxPool2D(pool_size=3,
+                                                       strides=2))
                 self.features.add(nn.Flatten())
             self.classifier = nn.HybridSequential(prefix="")
             with self.classifier.name_scope():
-                self.classifier.add(nn.Dense(4096, activation="relu"))
-                self.classifier.add(nn.Dropout(0.5))
-                self.classifier.add(nn.Dense(4096, activation="relu"))
-                self.classifier.add(nn.Dropout(0.5))
+                for _ in range(2):
+                    self.classifier.add(nn.Dense(4096, activation="relu"))
+                    self.classifier.add(nn.Dropout(0.5))
                 self.classifier.add(nn.Dense(classes))
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.classifier(x)
-        return x
+        return self.classifier(self.features(x))
 
 
 def alexnet(pretrained=False, ctx=cpu(), **kwargs):
+    """Constructor; ``pretrained`` loads zoo weights."""
     net = AlexNet(**kwargs)
     if pretrained:
         from ..model_store import get_model_file

@@ -1,4 +1,10 @@
-"""DenseNet (reference python/mxnet/gluon/model_zoo/vision/densenet.py)."""
+"""DenseNet-BC 121/161/169/201.
+
+API parity with the reference model zoo
+(``python/mxnet/gluon/model_zoo/vision/densenet.py:65``). The BN-relu-conv
+motif is factored into one helper shared by dense layers and transitions;
+constructors are generated from the depth table.
+"""
 from __future__ import annotations
 
 from ....context import cpu
@@ -9,67 +15,70 @@ __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
 
-class _DenseBlockLayer(HybridBlock):
+def _bn_relu_conv(seq, channels, kernel, padding=0):
+    """Append the pre-activation conv motif to *seq*."""
+    seq.add(nn.BatchNorm())
+    seq.add(nn.Activation("relu"))
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, padding=padding,
+                      use_bias=False))
+
+
+class _GrowthUnit(HybridBlock):
+    """One dense layer: 1x1 bottleneck → 3x3 conv, output concatenated
+    onto the running feature map."""
+
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
-        super(_DenseBlockLayer, self).__init__(**kwargs)
+        super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                use_bias=False))
+        _bn_relu_conv(self.body, bn_size * growth_rate, 1)
+        _bn_relu_conv(self.body, growth_rate, 3, padding=1)
         if dropout:
             self.body.add(nn.Dropout(dropout))
 
     def hybrid_forward(self, F, x):
-        out = self.body(x)
-        return F.concat(x, out, dim=1)
+        return F.concat(x, self.body(x), dim=1)
 
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout,
-                      stage_index):
-    out = nn.HybridSequential(prefix="stage%d_" % stage_index)
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_DenseBlockLayer(growth_rate, bn_size, dropout))
-    return out
+def _dense_stage(count, bn_size, growth_rate, dropout, stage_index):
+    stage = nn.HybridSequential(prefix="stage%d_" % stage_index)
+    with stage.name_scope():
+        for _ in range(count):
+            stage.add(_GrowthUnit(growth_rate, bn_size, dropout))
+    return stage
 
 
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+def _transition(channels):
+    """Halve channels (1x1 conv) and resolution (2x2 avg pool)."""
+    tr = nn.HybridSequential(prefix="")
+    _bn_relu_conv(tr, channels, 1)
+    tr.add(nn.AvgPool2D(pool_size=2, strides=2))
+    return tr
 
 
 class DenseNet(HybridBlock):
-    r"""DenseNet-BC (reference densenet.py:65)."""
+    r"""DenseNet-BC trunk (ref densenet.py:65)."""
 
     def __init__(self, num_init_features, growth_rate, block_config,
                  bn_size=4, dropout=0, classes=1000, **kwargs):
-        super(DenseNet, self).__init__(**kwargs)
+        super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3,
-                                        use_bias=False))
+                                        strides=2, padding=3, use_bias=False))
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                           padding=1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(
-                    num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features = num_features // 2
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+
+            width = num_init_features
+            last = len(block_config) - 1
+            for stage, count in enumerate(block_config):
+                self.features.add(_dense_stage(count, bn_size, growth_rate,
+                                               dropout, stage + 1))
+                width += count * growth_rate
+                if stage != last:
+                    width //= 2
+                    self.features.add(_transition(width))
+
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.AvgPool2D(pool_size=7))
@@ -77,11 +86,10 @@ class DenseNet(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
+# depth → (stem width, growth rate, per-stage layer counts)
 densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  161: (96, 48, [6, 12, 36, 24]),
                  169: (64, 32, [6, 12, 32, 32]),
@@ -89,26 +97,22 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
 
 
 def get_densenet(num_layers, pretrained=False, ctx=cpu(), **kwargs):
-    num_init_features, growth_rate, block_config = \
-        densenet_spec[num_layers]
-    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    stem, growth, stages = densenet_spec[num_layers]
+    net = DenseNet(stem, growth, stages, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
         net.load_params(get_model_file("densenet%d" % num_layers), ctx=ctx)
     return net
 
 
-def densenet121(**kwargs):
-    return get_densenet(121, **kwargs)
+def _make_constructor(depth):
+    def ctor(**kwargs):
+        return get_densenet(depth, **kwargs)
+    ctor.__name__ = "densenet%d" % depth
+    ctor.__doc__ = "DenseNet-%d constructor." % depth
+    return ctor
 
 
-def densenet161(**kwargs):
-    return get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return get_densenet(201, **kwargs)
+for _d in sorted(densenet_spec):
+    globals()["densenet%d" % _d] = _make_constructor(_d)
+del _d

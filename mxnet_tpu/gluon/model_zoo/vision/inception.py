@@ -1,4 +1,11 @@
-"""Inception V3 (reference python/mxnet/gluon/model_zoo/vision/inception.py)."""
+"""Inception V3.
+
+API parity with the reference model zoo
+(``python/mxnet/gluon/model_zoo/vision/inception.py:140``). Independent
+construction: branches are described by explicit kwargs dicts (one per
+conv unit) instead of positional tuples, and the five module types share
+one parallel-concat container.
+"""
 from __future__ import annotations
 
 from ....context import cpu
@@ -8,163 +15,157 @@ from ... import nn
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _unit(channels, kernel, stride=1, pad=0):
+    """conv-BN-relu unit with the inception BN epsilon."""
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                      padding=pad, use_bias=False))
+    seq.add(nn.BatchNorm(epsilon=0.001))
+    seq.add(nn.Activation("relu"))
+    return seq
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ["channels", "kernel_size", "strides", "padding"]
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def _branch(pool=None, *convs):
+    """A sequential branch: optional pool head, then conv units
+    (each described by a kwargs dict for :func:`_unit`)."""
+    seq = nn.HybridSequential(prefix="")
+    if pool == "avg":
+        seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif pool == "max":
+        seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for kw in convs:
+        seq.add(_unit(**kw))
+    return seq
 
 
-class _Concurrent(HybridBlock):
-    """Runs children on the same input, concats outputs on channel axis
-    (the reference uses HybridConcurrent from gluon.contrib)."""
-
-    def __init__(self, **kwargs):
-        super(_Concurrent, self).__init__(**kwargs)
+class _Parallel(HybridBlock):
+    """Feed the same input to every child; concat outputs on channels
+    (reference uses gluon.contrib HybridConcurrent)."""
 
     def add(self, block):
         self.register_child(block)
 
     def hybrid_forward(self, F, x):
-        return F.concat(*[block(x) for block in self._children], dim=1)
+        return F.concat(*[child(x) for child in self._children], dim=1)
 
 
-def _make_A(pool_features, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None),
-                             (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None),
-                             (96, 3, None, 1), (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
+def _parallel(prefix, *branches):
+    box = _Parallel(prefix=prefix)
+    with box.name_scope():
+        for b in branches:
+            box.add(b)
+    return box
 
 
-def _make_B(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None),
-                             (96, 3, None, 1), (96, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _module_a(pool_features, prefix):
+    return _parallel(
+        prefix,
+        _branch(None, dict(channels=64, kernel=1)),
+        _branch(None, dict(channels=48, kernel=1),
+                dict(channels=64, kernel=5, pad=2)),
+        _branch(None, dict(channels=64, kernel=1),
+                dict(channels=96, kernel=3, pad=1),
+                dict(channels=96, kernel=3, pad=1)),
+        _branch("avg", dict(channels=pool_features, kernel=1)))
 
 
-def _make_C(channels_7x7, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _module_b(prefix):
+    return _parallel(
+        prefix,
+        _branch(None, dict(channels=384, kernel=3, stride=2)),
+        _branch(None, dict(channels=64, kernel=1),
+                dict(channels=96, kernel=3, pad=1),
+                dict(channels=96, kernel=3, stride=2)),
+        _branch("max"))
 
 
-def _make_D(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _module_c(width, prefix):
+    row = dict(kernel=(1, 7), pad=(0, 3))
+    col = dict(kernel=(7, 1), pad=(3, 0))
+    return _parallel(
+        prefix,
+        _branch(None, dict(channels=192, kernel=1)),
+        _branch(None, dict(channels=width, kernel=1),
+                dict(channels=width, **row),
+                dict(channels=192, **col)),
+        _branch(None, dict(channels=width, kernel=1),
+                dict(channels=width, **col),
+                dict(channels=width, **row),
+                dict(channels=width, **col),
+                dict(channels=192, **row)),
+        _branch("avg", dict(channels=192, kernel=1)))
 
 
-class _SplitConcat(HybridBlock):
-    def __init__(self, trunk_settings, **kwargs):
-        super(_SplitConcat, self).__init__(**kwargs)
-        self.b1 = _make_branch(None, (trunk_settings[0], (1, 3), None,
-                                      (0, 1)))
-        self.b2 = _make_branch(None, (trunk_settings[0], (3, 1), None,
-                                      (1, 0)))
+def _module_d(prefix):
+    return _parallel(
+        prefix,
+        _branch(None, dict(channels=192, kernel=1),
+                dict(channels=320, kernel=3, stride=2)),
+        _branch(None, dict(channels=192, kernel=1),
+                dict(channels=192, kernel=(1, 7), pad=(0, 3)),
+                dict(channels=192, kernel=(7, 1), pad=(3, 0)),
+                dict(channels=192, kernel=3, stride=2)),
+        _branch("max"))
+
+
+class _Fork13(HybridBlock):
+    """1x3 / 3x1 conv pair over the same input, channel-concatenated."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        self.row = _branch(None, dict(channels=channels, kernel=(1, 3),
+                                      pad=(0, 1)))
+        self.col = _branch(None, dict(channels=channels, kernel=(3, 1),
+                                      pad=(1, 0)))
 
     def hybrid_forward(self, F, x):
-        return F.concat(self.b1(x), self.b2(x), dim=1)
+        return F.concat(self.row(x), self.col(x), dim=1)
 
 
-def _make_E(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
-
-        b2 = nn.HybridSequential(prefix="")
-        b2.add(_make_branch(None, (384, 1, None, None)))
-        b2.add(_SplitConcat((384,)))
-        out.add(b2)
-
-        b3 = nn.HybridSequential(prefix="")
-        b3.add(_make_branch(None, (448, 1, None, None),
-                            (384, 3, None, 1)))
-        b3.add(_SplitConcat((384,)))
-        out.add(b3)
-
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _module_e(prefix):
+    stem2 = nn.HybridSequential(prefix="")
+    stem2.add(_unit(384, 1))
+    stem2.add(_Fork13(384))
+    stem3 = nn.HybridSequential(prefix="")
+    stem3.add(_unit(448, 1))
+    stem3.add(_unit(384, 3, pad=1))
+    stem3.add(_Fork13(384))
+    return _parallel(
+        prefix,
+        _branch(None, dict(channels=320, kernel=1)),
+        stem2, stem3,
+        _branch("avg", dict(channels=192, kernel=1)))
 
 
 class Inception3(HybridBlock):
-    r"""Inception v3 (reference inception.py:140)."""
+    r"""Inception v3 trunk (ref inception.py:140)."""
 
     def __init__(self, classes=1000, **kwargs):
-        super(Inception3, self).__init__(**kwargs)
+        super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192,
-                                               kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
-            self.features.add(nn.AvgPool2D(pool_size=8))
-            self.features.add(nn.Dropout(0.5))
+            f = nn.HybridSequential(prefix="")
+            f.add(_unit(32, 3, stride=2))
+            f.add(_unit(32, 3))
+            f.add(_unit(64, 3, pad=1))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2))
+            f.add(_unit(80, 1))
+            f.add(_unit(192, 3))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2))
+            for i, pool_features in enumerate((32, 64, 64)):
+                f.add(_module_a(pool_features, "A%d_" % (i + 1)))
+            f.add(_module_b("B_"))
+            for i, width in enumerate((128, 160, 160, 192)):
+                f.add(_module_c(width, "C%d_" % (i + 1)))
+            f.add(_module_d("D_"))
+            f.add(_module_e("E1_"))
+            f.add(_module_e("E2_"))
+            f.add(nn.AvgPool2D(pool_size=8))
+            f.add(nn.Dropout(0.5))
+            self.features = f
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, ctx=cpu(), **kwargs):

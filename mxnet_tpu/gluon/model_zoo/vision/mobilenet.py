@@ -1,7 +1,11 @@
-"""MobileNet (reference python/mxnet/gluon/model_zoo/vision/mobilenet.py).
+"""MobileNet v1 with width multipliers 0.25/0.5/0.75/1.0.
 
-Depthwise conv = grouped Convolution with num_group == channels; XLA
-lowers it to a feature-group-count convolution on the MXU.
+API parity with the reference model zoo
+(``python/mxnet/gluon/model_zoo/vision/mobilenet.py:33``); the depthwise-
+separable stack is a single (out-channels, stride) plan list.
+
+TPU note: depthwise conv = grouped Convolution with num_group == channels;
+XLA lowers it to a feature-group-count convolution on the MXU.
 """
 from __future__ import annotations
 
@@ -12,73 +16,66 @@ from ... import nn
 __all__ = ["MobileNet", "mobilenet1_0", "mobilenet0_75", "mobilenet0_5",
            "mobilenet0_25", "get_mobilenet"]
 
+# (pointwise output channels, depthwise stride) for the 13 separable blocks
+_SEPARABLE_PLAN = [
+    (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+    (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1),
+]
 
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+
+def _conv_bn_relu(seq, channels, kernel=1, stride=1, pad=0, groups=1):
+    seq.add(nn.Conv2D(channels, kernel, stride, pad, groups=groups,
                       use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    out.add(nn.Activation("relu"))
-
-
-def _add_conv_dw(out, dw_channels, channels, stride):
-    _add_conv(out, channels=dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels)
-    _add_conv(out, channels=channels)
+    seq.add(nn.BatchNorm(scale=True))
+    seq.add(nn.Activation("relu"))
 
 
 class MobileNet(HybridBlock):
-    r"""MobileNet (reference mobilenet.py:33)."""
+    r"""Depthwise-separable trunk (ref mobilenet.py:33)."""
 
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
-        super(MobileNet, self).__init__(**kwargs)
+        super().__init__(**kwargs)
+        scale = lambda ch: int(ch * multiplier)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                _add_conv(self.features, channels=int(32 * multiplier),
-                          kernel=3, pad=1, stride=2)
-                dw_channels = [int(x * multiplier) for x in
-                               [32, 64] + [128] * 2 + [256] * 2 +
-                               [512] * 6 + [1024]]
-                channels = [int(x * multiplier) for x in
-                            [64] + [128] * 2 + [256] * 2 + [512] * 6 +
-                            [1024] * 2]
-                strides = [1, 2] * 3 + [1] * 5 + [2, 1]
-                for dwc, c, s in zip(dw_channels, channels, strides):
-                    _add_conv_dw(self.features, dw_channels=dwc,
-                                 channels=c, stride=s)
+                _conv_bn_relu(self.features, scale(32), kernel=3, stride=2,
+                              pad=1)
+                width = scale(32)
+                for out_ch, stride in _SEPARABLE_PLAN:
+                    # depthwise 3x3 at current width, then pointwise 1x1
+                    _conv_bn_relu(self.features, width, kernel=3,
+                                  stride=stride, pad=1, groups=width)
+                    width = scale(out_ch)
+                    _conv_bn_relu(self.features, width)
                 self.features.add(nn.GlobalAvgPool2D())
                 self.features.add(nn.Flatten())
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=cpu(), **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        version_suffix = "{0:.2f}".format(multiplier)
-        if version_suffix in ("1.00", "0.50"):
-            version_suffix = version_suffix[:-1]
-        net.load_params(get_model_file("mobilenet%s" % version_suffix),
-                        ctx=ctx)
+        tag = "%.2f" % multiplier
+        if tag.endswith("0") and tag != "0.00":
+            tag = tag[:-1]
+        net.load_params(get_model_file("mobilenet%s" % tag), ctx=ctx)
     return net
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
+def _make_constructor(multiplier, suffix):
+    def ctor(**kwargs):
+        return get_mobilenet(multiplier, **kwargs)
+    ctor.__name__ = "mobilenet%s" % suffix
+    ctor.__doc__ = "MobileNet with width multiplier %s." % multiplier
+    return ctor
 
 
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
+mobilenet1_0 = _make_constructor(1.0, "1_0")
+mobilenet0_75 = _make_constructor(0.75, "0_75")
+mobilenet0_5 = _make_constructor(0.5, "0_5")
+mobilenet0_25 = _make_constructor(0.25, "0_25")

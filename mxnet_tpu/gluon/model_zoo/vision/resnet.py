@@ -1,8 +1,15 @@
-"""ResNet V1/V2 (reference python/mxnet/gluon/model_zoo/vision/resnet.py).
+"""ResNet V1 (post-activation) and V2 (pre-activation) families.
 
-The flagship benchmark model (BASELINE.md: resnet-50 inference/training
-throughput).  bfloat16-friendly: all compute is conv/BN/relu, which XLA
-fuses and tiles on the MXU.
+API parity with the reference model zoo
+(``python/mxnet/gluon/model_zoo/vision/resnet.py``: ResNetV1/V2, the four
+block types, ``get_resnet`` and the resnet{18..152}_v{1,2} constructors).
+Independent design: both residual-block generations derive from shared
+templates whose conv stacks come from spec tuples, the two trunk classes
+share one ``_stack_stages`` helper, and the public constructors are
+generated from the depth table.
+
+This is the flagship benchmark model (BASELINE.md resnet-50): all compute
+is conv/BN/relu, which XLA fuses and tiles onto the MXU; bfloat16-safe.
 """
 from __future__ import annotations
 
@@ -17,230 +24,185 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
+def _conv(channels, kernel, stride=1, pad=None, in_channels=0):
+    if pad is None:
+        pad = kernel // 2
+    return nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                     padding=pad, use_bias=False, in_channels=in_channels)
+
+
 def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+    return _conv(channels, 3, stride, 1, in_channels)
 
 
-class BasicBlockV1(HybridBlock):
-    r"""BasicBlock V1 from "Deep Residual Learning" (18/34-layer)."""
+class _ResidualV1(HybridBlock):
+    """V1 template: body(x) + shortcut, then relu. Subclasses define the
+    body via ``conv_plan(channels, stride)`` → [(ch, kernel, stride), ...];
+    BN follows every conv, relu all but the last."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
-        super(BasicBlockV1, self).__init__(**kwargs)
+        super().__init__(**kwargs)
+        plan = self.conv_plan(channels, stride)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
+        for pos, (ch, kernel, s) in enumerate(plan):
+            self.body.add(_conv(ch, kernel, s,
+                                in_channels=in_channels if pos == 0 else 0))
+            self.body.add(nn.BatchNorm())
+            if pos + 1 < len(plan):
+                self.body.add(nn.Activation("relu"))
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
+            self.downsample.add(_conv(channels, 1, stride, 0, in_channels))
             self.downsample.add(nn.BatchNorm())
         else:
             self.downsample = None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
+        shortcut = x if self.downsample is None else self.downsample(x)
+        return F.Activation(self.body(x) + shortcut, act_type="relu")
 
 
-class BottleneckV1(HybridBlock):
-    r"""Bottleneck V1 (50/101/152-layer)."""
+class BasicBlockV1(_ResidualV1):
+    r"""Two 3x3 convs ("Deep Residual Learning", 18/34-layer nets)."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super(BottleneckV1, self).__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1,
-                                strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+    @staticmethod
+    def conv_plan(channels, stride):
+        return [(channels, 3, stride), (channels, 3, 1)]
 
 
-class BasicBlockV2(HybridBlock):
-    r"""BasicBlock V2 ("Identity Mappings" pre-activation)."""
+class BottleneckV1(_ResidualV1):
+    r"""1x1 → 3x3 → 1x1 bottleneck (50/101/152-layer nets)."""
+
+    @staticmethod
+    def conv_plan(channels, stride):
+        return [(channels // 4, 1, stride), (channels // 4, 3, 1),
+                (channels, 1, 1)]
+
+
+class _ResidualV2(HybridBlock):
+    """V2 template ("Identity Mappings"): BN-relu precedes each conv; the
+    shortcut taps the pre-activated input when downsampling."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
-        super(BasicBlockV2, self).__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride,
-                                        use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+        super().__init__(**kwargs)
+        plan = self.conv_plan(channels, stride)
+        self._bns = []
+        self._convs = []
+        for pos, (ch, kernel, s) in enumerate(plan):
+            bn = nn.BatchNorm()
+            conv = _conv(ch, kernel, s,
+                         in_channels=in_channels if pos == 0 else 0)
+            setattr(self, "bn%d" % (pos + 1), bn)
+            setattr(self, "conv%d" % (pos + 1), conv)
+            self._bns.append(bn)
+            self._convs.append(conv)
+        self.downsample = _conv(channels, 1, stride, 0, in_channels) \
+            if downsample else None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+        shortcut = x
+        for pos, (bn, conv) in enumerate(zip(self._bns, self._convs)):
+            x = F.Activation(bn(x), act_type="relu")
+            if pos == 0 and self.downsample is not None:
+                shortcut = self.downsample(x)
+            x = conv(x)
+        return x + shortcut
 
 
-class BottleneckV2(HybridBlock):
-    r"""Bottleneck V2 (pre-activation)."""
+class BasicBlockV2(_ResidualV2):
+    r"""Pre-activation basic block."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super(BottleneckV2, self).__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride,
-                                        use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+    @staticmethod
+    def conv_plan(channels, stride):
+        return [(channels, 3, stride), (channels, 3, 1)]
 
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
+
+class BottleneckV2(_ResidualV2):
+    r"""Pre-activation bottleneck."""
+
+    @staticmethod
+    def conv_plan(channels, stride):
+        return [(channels // 4, 1, 1), (channels // 4, 3, stride),
+                (channels, 1, 1)]
+
+
+def _stack_stages(features, block, layers, channels, make_prefix):
+    """Append the four residual stages; returns the final channel count."""
+    width_in = channels[0]
+    for stage, count in enumerate(layers):
+        width = channels[stage + 1]
+        stride = 1 if stage == 0 else 2
+        group = nn.HybridSequential(prefix=make_prefix(stage + 1))
+        with group.name_scope():
+            group.add(block(width, stride, width != width_in,
+                            in_channels=width_in, prefix=""))
+            for _ in range(count - 1):
+                group.add(block(width, 1, False, in_channels=width,
+                                prefix=""))
+        features.add(group)
+        width_in = width
+    return width_in
+
+
+def _stem(features, channels0, thumbnail):
+    """7x7/pool ImageNet stem, or a bare 3x3 for 32x32 inputs."""
+    if thumbnail:
+        features.add(_conv3x3(channels0, 1, 0))
+    else:
+        features.add(nn.Conv2D(channels0, 7, 2, 3, use_bias=False))
+        features.add(nn.BatchNorm())
+        features.add(nn.Activation("relu"))
+        features.add(nn.MaxPool2D(3, 2, 1))
 
 
 class ResNetV1(HybridBlock):
-    r"""ResNet V1 model (reference resnet.py:ResNetV1)."""
+    r"""Post-activation ResNet trunk (ref resnet.py:ResNetV1)."""
 
     def __init__(self, block, layers, channels, classes=1000,
                  thumbnail=False, **kwargs):
-        super(ResNetV1, self).__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
+        super().__init__(**kwargs)
+        if len(layers) != len(channels) - 1:
+            raise ValueError("channels must have one more entry than layers")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
+            _stem(self.features, channels[0], thumbnail)
+            _stack_stages(self.features, block, layers, channels,
+                          lambda i: "stage%d_" % i)
             self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 class ResNetV2(HybridBlock):
-    r"""ResNet V2 model (reference resnet.py:ResNetV2)."""
+    r"""Pre-activation ResNet trunk (ref resnet.py:ResNetV2): leading
+    data BN, trailing BN-relu before pooling."""
 
     def __init__(self, block, layers, channels, classes=1000,
                  thumbnail=False, **kwargs):
-        super(ResNetV2, self).__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
+        super().__init__(**kwargs)
+        if len(layers) != len(channels) - 1:
+            raise ValueError("channels must have one more entry than layers")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
+            _stem(self.features, channels[0], thumbnail)
+            final = _stack_stages(self.features, block, layers, channels,
+                                  lambda i: "stage%d_" % i)
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.GlobalAvgPool2D())
             self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
+            self.output = nn.Dense(classes, in_units=final)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
+# depth → (block kind, per-stage counts, per-stage channels)
 resnet_spec = {
     18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
     34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
@@ -254,18 +216,18 @@ resnet_block_versions = [
     {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2}]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=cpu(),
-               **kwargs):
-    """Get a ResNet (reference resnet.py:get_resnet)."""
-    assert num_layers in resnet_spec, \
-        "Invalid number of layers: %d. Options are %s" % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert version >= 1 and version <= 2, \
-        "Invalid resnet version: %d. Options are 1 and 2." % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+def get_resnet(version, num_layers, pretrained=False, ctx=cpu(), **kwargs):
+    """Build a ResNet by (version, depth) (ref resnet.py:get_resnet)."""
+    if num_layers not in resnet_spec:
+        raise ValueError("Invalid number of layers: %d. Options are %s"
+                         % (num_layers, sorted(resnet_spec)))
+    if version not in (1, 2):
+        raise ValueError("Invalid resnet version: %d. Options are 1 and 2."
+                         % version)
+    kind, layers, channels = resnet_spec[num_layers]
+    trunk = resnet_net_versions[version - 1]
+    block = resnet_block_versions[version - 1][kind]
+    net = trunk(block, layers, channels, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
         net.load_params(get_model_file("resnet%d_v%d"
@@ -273,41 +235,15 @@ def get_resnet(version, num_layers, pretrained=False, ctx=cpu(),
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _make_constructor(version, depth):
+    def ctor(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    ctor.__name__ = "resnet%d_v%d" % (depth, version)
+    ctor.__doc__ = "ResNet-%d V%d constructor." % (depth, version)
+    return ctor
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+for _v in (1, 2):
+    for _d in sorted(resnet_spec):
+        globals()["resnet%d_v%d" % (_d, _v)] = _make_constructor(_v, _d)
+del _v, _d

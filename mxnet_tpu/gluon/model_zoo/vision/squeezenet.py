@@ -1,4 +1,10 @@
-"""SqueezeNet (reference python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0 / 1.1.
+
+API parity with the reference model zoo
+(``python/mxnet/gluon/model_zoo/vision/squeezenet.py:60``); the feature
+stack is driven by a per-version plan list where "P" marks a pool and a
+tuple marks a fire module.
+"""
 from __future__ import annotations
 
 from ....context import cpu
@@ -8,76 +14,59 @@ from ... import nn
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-
-    paths = _FireExpand(expand1x1_channels, expand3x3_channels)
-    out.add(paths)
-    return out
+def _relu_conv(channels, kernel, padding=0):
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(channels, kernel, padding=padding))
+    seq.add(nn.Activation("relu"))
+    return seq
 
 
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation("relu"))
-    return out
+class _Fire(HybridBlock):
+    """Squeeze 1x1 → parallel 1x1/3x3 expands, channel-concatenated."""
 
-
-class _FireExpand(HybridBlock):
-    def __init__(self, expand1x1_channels, expand3x3_channels, **kwargs):
-        super(_FireExpand, self).__init__(**kwargs)
-        self.p1 = _make_fire_conv(expand1x1_channels, 1)
-        self.p3 = _make_fire_conv(expand3x3_channels, 3, 1)
+    def __init__(self, squeeze, expand1, expand3, **kwargs):
+        super().__init__(**kwargs)
+        self.squeeze = _relu_conv(squeeze, 1)
+        self.left = _relu_conv(expand1, 1)
+        self.right = _relu_conv(expand3, 3, 1)
 
     def hybrid_forward(self, F, x):
-        return F.concat(self.p1(x), self.p3(x), dim=1)
+        x = self.squeeze(x)
+        return F.concat(self.left(x), self.right(x), dim=1)
+
+
+def _pool():
+    return nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True)
+
+
+# Per-version plans after the stem conv: "P" = pool, tuple = fire module.
+_PLANS = {
+    "1.0": ["P", (16, 64, 64), (16, 64, 64), (32, 128, 128), "P",
+            (32, 128, 128), (48, 192, 192), (48, 192, 192), (64, 256, 256),
+            "P", (64, 256, 256)],
+    "1.1": ["P", (16, 64, 64), (16, 64, 64), "P", (32, 128, 128),
+            (32, 128, 128), "P", (48, 192, 192), (48, 192, 192),
+            (64, 256, 256), (64, 256, 256)],
+}
+_STEMS = {"1.0": (96, 7), "1.1": (64, 3)}
 
 
 class SqueezeNet(HybridBlock):
-    r"""SqueezeNet 1.0/1.1 (reference squeezenet.py:60)."""
+    r"""SqueezeNet (ref squeezenet.py:60): fire modules + conv classifier."""
 
     def __init__(self, version, classes=1000, **kwargs):
-        super(SqueezeNet, self).__init__(**kwargs)
-        assert version in ["1.0", "1.1"], \
-            "Unsupported SqueezeNet version %s: 1.0 or 1.1 expected" \
-            % version
+        super().__init__(**kwargs)
+        if version not in _PLANS:
+            raise ValueError("Unsupported SqueezeNet version %s: "
+                             "1.0 or 1.1 expected" % version)
+        stem_ch, stem_k = _STEMS[version]
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+            self.features.add(nn.Conv2D(stem_ch, kernel_size=stem_k,
+                                        strides=2))
+            self.features.add(nn.Activation("relu"))
+            for item in _PLANS[version]:
+                self.features.add(_pool() if item == "P" else _Fire(*item))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.HybridSequential(prefix="")
             self.output.add(nn.Conv2D(classes, kernel_size=1))
@@ -86,9 +75,7 @@ class SqueezeNet(HybridBlock):
             self.output.add(nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_squeezenet(version, pretrained=False, ctx=cpu(), **kwargs):

@@ -1,4 +1,9 @@
-"""VGG (reference python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19, plain and batch-normed.
+
+API parity with the reference model zoo
+(``python/mxnet/gluon/model_zoo/vision/vgg.py:34``); constructors are
+generated from the depth table.
+"""
 from __future__ import annotations
 
 from ....context import cpu
@@ -9,50 +14,42 @@ from ... import nn
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn",
            "vgg13_bn", "vgg16_bn", "vgg19_bn", "get_vgg"]
 
+_CONV_INIT = dict(weight_initializer=Xavier(rnd_type="gaussian",
+                                            factor_type="out", magnitude=2),
+                  bias_initializer="zeros")
+_FC_INIT = dict(weight_initializer="normal", bias_initializer="zeros")
+
 
 class VGG(HybridBlock):
-    r"""VGG model (reference vgg.py:34)."""
+    r"""Stacked 3x3-conv stages + two 4096-wide FC layers (ref vgg.py:34)."""
 
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
                  **kwargs):
-        super(VGG, self).__init__(**kwargs)
-        assert len(layers) == len(filters)
+        super().__init__(**kwargs)
+        if len(layers) != len(filters):
+            raise ValueError("layers and filters must pair up")
         with self.name_scope():
-            self.features = self._make_features(layers, filters,
-                                                batch_norm)
-            self.features.add(nn.Dense(
-                4096, activation="relu",
-                weight_initializer="normal",
-                bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(
-                4096, activation="relu",
-                weight_initializer="normal",
-                bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.output = nn.Dense(classes, weight_initializer="normal",
-                                   bias_initializer="zeros")
+            self.features = nn.HybridSequential(prefix="")
+            for repeat, width in zip(layers, filters):
+                self._add_stage(repeat, width, batch_norm)
+            for _ in range(2):
+                self.features.add(nn.Dense(4096, activation="relu",
+                                           **_FC_INIT))
+                self.features.add(nn.Dropout(rate=0.5))
+            self.output = nn.Dense(classes, **_FC_INIT)
 
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(
-                    filters[i], kernel_size=3, padding=1,
-                    weight_initializer=Xavier(rnd_type="gaussian",
-                                              factor_type="out",
-                                              magnitude=2),
-                    bias_initializer="zeros"))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
+    def _add_stage(self, repeat, width, batch_norm):
+        """One resolution stage: `repeat` convs then a stride-2 pool."""
+        for _ in range(repeat):
+            self.features.add(nn.Conv2D(width, kernel_size=3, padding=1,
+                                        **_CONV_INIT))
+            if batch_norm:
+                self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+        self.features.add(nn.MaxPool2D(strides=2))
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
@@ -62,48 +59,29 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 
 def get_vgg(num_layers, pretrained=False, ctx=cpu(), **kwargs):
+    """Build a VGG by depth (ref vgg.py:get_vgg)."""
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        batch_norm_suffix = "_bn" if kwargs.get("batch_norm") else ""
-        net.load_params(get_model_file("vgg%d%s" % (num_layers,
-                                                    batch_norm_suffix)),
+        suffix = "_bn" if kwargs.get("batch_norm") else ""
+        net.load_params(get_model_file("vgg%d%s" % (num_layers, suffix)),
                         ctx=ctx)
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _make_constructor(depth, batch_norm):
+    def ctor(**kwargs):
+        if batch_norm:
+            kwargs["batch_norm"] = True
+        return get_vgg(depth, **kwargs)
+    ctor.__name__ = "vgg%d%s" % (depth, "_bn" if batch_norm else "")
+    ctor.__doc__ = "VGG-%d%s constructor." % (depth,
+                                              " (BN)" if batch_norm else "")
+    return ctor
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
+for _d in sorted(vgg_spec):
+    globals()["vgg%d" % _d] = _make_constructor(_d, False)
+    globals()["vgg%d_bn" % _d] = _make_constructor(_d, True)
+del _d

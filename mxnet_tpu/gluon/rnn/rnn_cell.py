@@ -1,72 +1,69 @@
 """Gluon recurrent cells.
 
-Parity surface: reference ``python/mxnet/gluon/rnn/rnn_cell.py`` —
-RecurrentCell (unroll/begin_state), RNNCell, LSTMCell, GRUCell,
-SequentialRNNCell, DropoutCell, ZoneoutCell, ResidualCell,
-BidirectionalCell.
+API parity with the reference ``python/mxnet/gluon/rnn/rnn_cell.py``
+(RecurrentCell protocol with unroll/begin_state, RNN/LSTM/GRU cells,
+Sequential/Dropout/Zoneout/Residual/Bidirectional wrappers). Independent
+design: the three gated cells derive from one ``_GatedCell`` template that
+owns parameter allocation and the fused i2h/h2h projections; each concrete
+cell contributes only its gate count and the state-transition math.
 
-TPU note: ``unroll`` here builds the python-unrolled graph (length is
-static under jit, so XLA still fuses it); the fused ``rnn_layer``
-variants use ``lax.scan`` and are the fast path.
+TPU note: ``unroll`` builds a python-unrolled graph (length is static
+under jit, so XLA fuses it); the fused ``rnn_layer`` variants use
+``lax.scan`` and are the fast path for long sequences.
 """
 from __future__ import annotations
 
-from ..block import Block, HybridBlock
 from ... import ndarray as nd
+from ..block import Block, HybridBlock
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
            "ResidualCell", "BidirectionalCell"]
 
 
-def _cells_state_info(cells, batch_size):
-    return sum([c.state_info(batch_size) for c in cells], [])
+def _stack_state_info(cells, batch_size):
+    infos = []
+    for c in cells:
+        infos += c.state_info(batch_size)
+    return infos
 
 
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+def _stack_begin_state(cells, **kwargs):
+    states = []
+    for c in cells:
+        states += c.begin_state(**kwargs)
+    return states
 
 
-def _get_begin_state(cell, F, begin_state, inputs, batch_size):
-    if begin_state is None:
-        begin_state = cell.begin_state(batch_size=batch_size)
-    return begin_state
-
-
-def _format_sequence(length, inputs, layout, merge, in_layout=None):
-    """Normalize inputs to a list of per-step tensors or a merged tensor."""
-    assert inputs is not None
-    axis = layout.find("T")
-    batch_axis = layout.find("N")
+def _as_step_list(length, inputs, layout):
+    """Split a merged [*, T, *] tensor (or pass through a list) into
+    per-timestep tensors; returns (steps, time_axis, batch_size)."""
+    t_axis = layout.find("T")
+    n_axis = layout.find("N")
     if isinstance(inputs, (list, tuple)):
-        in_axis = in_layout.find("T") if in_layout else axis
-        if merge is True:
-            inputs = [nd.expand_dims(i, axis=in_axis) for i in inputs]
-            inputs = nd.concat(*inputs, dim=in_axis)
-            seq = inputs
-            batch_size = seq.shape[batch_axis]
-            return seq, axis, batch_size
-        batch_size = inputs[0].shape[0 if layout.find("N") == 0 else
-                                     batch_axis]
-        return list(inputs), axis, inputs[0].shape[batch_axis - 1
-                                                   if batch_axis > axis
-                                                   else batch_axis]
-    batch_size = inputs.shape[batch_axis]
-    if merge is False:
-        outs = nd.SliceChannel(inputs, axis=axis,
-                               num_outputs=inputs.shape[axis],
-                               squeeze_axis=1)
-        if not isinstance(outs, (list, tuple)):
-            outs = [outs]
-        return list(outs), axis, batch_size
-    return inputs, axis, batch_size
+        return list(inputs), t_axis, inputs[0].shape[0 if n_axis == 0 else
+                                                     n_axis - 1]
+    batch_size = inputs.shape[n_axis]
+    steps = nd.SliceChannel(inputs, axis=t_axis,
+                            num_outputs=inputs.shape[t_axis],
+                            squeeze_axis=1)
+    if not isinstance(steps, (list, tuple)):
+        steps = [steps]
+    return list(steps), t_axis, batch_size
+
+
+def _merge_steps(outputs, t_axis):
+    """Stack per-step outputs back into one tensor along the time axis."""
+    expanded = [nd.expand_dims(o, axis=t_axis) for o in outputs]
+    return nd.concat(*expanded, dim=t_axis)
 
 
 class RecurrentCell(Block):
-    """Abstract base class for RNN cells (reference rnn_cell.py:81)."""
+    """Recurrent-cell protocol (ref rnn_cell.py:81): step via __call__,
+    whole sequences via :meth:`unroll`, states via :meth:`begin_state`."""
 
     def __init__(self, prefix=None, params=None):
-        super(RecurrentCell, self).__init__(prefix=prefix, params=params)
+        super().__init__(prefix=prefix, params=params)
         self._modified = False
         self.reset()
 
@@ -78,37 +75,35 @@ class RecurrentCell(Block):
         raise NotImplementedError
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
-        """Initial states for this cell (reference rnn_cell.py:129)."""
-        assert not self._modified, \
-            "After applying modifier cells the base cell cannot be called " \
-            "directly. Call the modifier cell instead."
-        if func is None:
-            func = nd.zeros
+        """Allocate initial states per :meth:`state_info`
+        (ref rnn_cell.py:129)."""
+        if self._modified:
+            raise AssertionError(
+                "After applying modifier cells the base cell cannot be "
+                "called directly. Call the modifier cell instead.")
+        make = func if func is not None else nd.zeros
         states = []
         for info in self.state_info(batch_size):
             self._init_counter += 1
-            info = dict(info or {})
-            info.pop("__layout__", None)
-            info.update(kwargs)
-            states.append(func(**info))
+            spec = dict(info or {})
+            spec.pop("__layout__", None)
+            spec.update(kwargs)
+            states.append(make(**spec))
         return states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
-        """Unroll the cell for ``length`` steps (reference rnn_cell.py:177)."""
+        """Step the cell ``length`` times (ref rnn_cell.py:177)."""
         self.reset()
-        inputs, axis, batch_size = _format_sequence(
-            length, inputs, layout, False)
-        begin_state = _get_begin_state(self, nd, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
+        steps, t_axis, batch_size = _as_step_list(length, inputs, layout)
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch_size=batch_size)
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        for x in steps[:length]:
+            out, states = self(x, states)
+            outputs.append(out)
         if merge_outputs:
-            outputs = [nd.expand_dims(o, axis=axis) for o in outputs]
-            outputs = nd.concat(*outputs, dim=axis)
+            return _merge_steps(outputs, t_axis), states
         return outputs, states
 
     def _get_activation(self, F, inputs, activation, **kwargs):
@@ -118,15 +113,11 @@ class RecurrentCell(Block):
 
     def forward(self, inputs, states):
         self._counter += 1
-        return super(RecurrentCell, self).forward(inputs, states)
+        return super().forward(inputs, states)
 
 
 class HybridRecurrentCell(RecurrentCell, HybridBlock):
-    """RecurrentCell with hybrid_forward (reference rnn_cell.py:270)."""
-
-    def __init__(self, prefix=None, params=None):
-        super(HybridRecurrentCell, self).__init__(prefix=prefix,
-                                                  params=params)
+    """RecurrentCell whose step is a hybrid_forward (ref rnn_cell.py:270)."""
 
     def forward(self, inputs, states):
         self._counter += 1
@@ -136,192 +127,155 @@ class HybridRecurrentCell(RecurrentCell, HybridBlock):
         raise NotImplementedError
 
 
-class RNNCell(HybridRecurrentCell):
-    """Elman RNN cell: ``h' = act(W_i x + b_i + W_h h + b_h)``
-    (reference rnn_cell.py:290)."""
+class _GatedCell(HybridRecurrentCell):
+    """Shared template for RNN/LSTM/GRU cells.
 
-    def __init__(self, hidden_size, activation="tanh",
-                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+    Owns the four parameter tensors (i2h/h2h × weight/bias), sized by the
+    subclass's ``num_gates``, and computes the fused input/hidden
+    projections; subclasses implement ``_transition``.
+    """
+
+    num_gates = 1
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  input_size=0, prefix=None, params=None):
-        super(RNNCell, self).__init__(prefix=prefix, params=params)
+        super().__init__(prefix=prefix, params=params)
         self._hidden_size = hidden_size
-        self._activation = activation
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        wide = self.num_gates * hidden_size
+        for tag, shape, init in (
+                ("i2h_weight", (wide, input_size), i2h_weight_initializer),
+                ("h2h_weight", (wide, hidden_size), h2h_weight_initializer),
+                ("i2h_bias", (wide,), i2h_bias_initializer),
+                ("h2h_bias", (wide,), h2h_bias_initializer)):
+            setattr(self, tag, self.params.get(tag, shape=shape, init=init,
+                                               allow_deferred_init=True))
 
     def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        one = {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}
+        return [dict(one) for _ in range(self.num_states)]
+
+    num_states = 1
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        wide = self.num_gates * self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=wide)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=wide)
+        return self._transition(F, i2h, h2h, states)
+
+    def _transition(self, F, i2h, h2h, states):
+        raise NotImplementedError
+
+
+class RNNCell(_GatedCell):
+    """Elman cell: ``h' = act(W_i x + b_i + W_h h + b_h)``
+    (ref rnn_cell.py:290)."""
+
+    num_gates = 1
+
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        super().__init__(hidden_size, **kwargs)
+        self._activation = activation
 
     def _alias(self):
         return "rnn"
 
-    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
-                       i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size)
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size)
-        output = self._get_activation(F, i2h + h2h, self._activation)
-        return output, [output]
+    def _transition(self, F, i2h, h2h, states):
+        out = self._get_activation(F, i2h + h2h, self._activation)
+        return out, [out]
 
 
-class LSTMCell(HybridRecurrentCell):
-    """LSTM cell (reference rnn_cell.py:374); gate order i,f,g,o matches
-    the fused RNN op's packed layout."""
+class LSTMCell(_GatedCell):
+    """LSTM cell (ref rnn_cell.py:374); packed gate order i,f,g,o matches
+    the fused RNN op layout."""
 
-    def __init__(self, hidden_size, i2h_weight_initializer=None,
-                 h2h_weight_initializer=None,
-                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 input_size=0, prefix=None, params=None):
-        super(LSTMCell, self).__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(4 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(4 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(4 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(4 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"},
-                {"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+    num_gates = 4
+    num_states = 2
 
     def _alias(self):
         return "lstm"
 
-    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
-                       i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=4 * self._hidden_size)
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=4 * self._hidden_size)
-        gates = i2h + h2h
-        slices = F.SliceChannel(gates, num_outputs=4)
-        in_gate = F.Activation(slices[0], act_type="sigmoid")
-        forget_gate = F.Activation(slices[1], act_type="sigmoid")
-        in_transform = F.Activation(slices[2], act_type="tanh")
-        out_gate = F.Activation(slices[3], act_type="sigmoid")
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * F.Activation(next_c, act_type="tanh")
-        return next_h, [next_h, next_c]
+    def _transition(self, F, i2h, h2h, states):
+        pre = i2h + h2h
+        gi, gf, gc, go = F.SliceChannel(pre, num_outputs=4)
+        i = F.Activation(gi, act_type="sigmoid")
+        f = F.Activation(gf, act_type="sigmoid")
+        c_tilde = F.Activation(gc, act_type="tanh")
+        o = F.Activation(go, act_type="sigmoid")
+        c = f * states[1] + i * c_tilde
+        h = o * F.Activation(c, act_type="tanh")
+        return h, [h, c]
 
 
-class GRUCell(HybridRecurrentCell):
-    """GRU cell (reference rnn_cell.py:460); gate order r,z,n."""
+class GRUCell(_GatedCell):
+    """GRU cell (ref rnn_cell.py:460); packed gate order r,z,n."""
 
-    def __init__(self, hidden_size, i2h_weight_initializer=None,
-                 h2h_weight_initializer=None,
-                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 input_size=0, prefix=None, params=None):
-        super(GRUCell, self).__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(3 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(3 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(3 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(3 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+    num_gates = 3
 
     def _alias(self):
         return "gru"
 
-    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
-                       i2h_bias, h2h_bias):
-        prev_h = states[0]
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=3 * self._hidden_size)
-        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
-                               num_hidden=3 * self._hidden_size)
-        i2h_r, i2h_z, i2h_n = F.SliceChannel(i2h, num_outputs=3)
-        h2h_r, h2h_z, h2h_n = F.SliceChannel(h2h, num_outputs=3)
-        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
-        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
-        next_h_tmp = F.Activation(i2h_n + reset_gate * h2h_n,
-                                  act_type="tanh")
-        next_h = (1. - update_gate) * next_h_tmp + update_gate * prev_h
-        return next_h, [next_h]
+    def _transition(self, F, i2h, h2h, states):
+        prev = states[0]
+        ir, iz, in_ = F.SliceChannel(i2h, num_outputs=3)
+        hr, hz, hn = F.SliceChannel(h2h, num_outputs=3)
+        r = F.Activation(ir + hr, act_type="sigmoid")
+        z = F.Activation(iz + hz, act_type="sigmoid")
+        candidate = F.Activation(in_ + r * hn, act_type="tanh")
+        h = (1. - z) * candidate + z * prev
+        return h, [h]
 
 
 class SequentialRNNCell(RecurrentCell):
-    """Stacks multiple cells (reference rnn_cell.py:543)."""
-
-    def __init__(self, prefix=None, params=None):
-        super(SequentialRNNCell, self).__init__(prefix=prefix, params=params)
+    """Vertically stacked cells (ref rnn_cell.py:543); states of the
+    children are concatenated in order."""
 
     def add(self, cell):
         self.register_child(cell)
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children, batch_size)
+        return _stack_state_info(self._children, batch_size)
 
     def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._children, **kwargs)
+        if self._modified:
+            raise AssertionError("call the modifier cell instead")
+        return _stack_begin_state(self._children, **kwargs)
+
+    def _split_states(self, states):
+        """Yield (cell, its slice of the flat state list)."""
+        at = 0
+        for cell in self._children:
+            width = len(cell.state_info())
+            yield cell, states[at:at + width]
+            at += width
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._children:
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.extend(state)
-        return inputs, next_states
+        collected = []
+        for cell, sub in self._split_states(states):
+            inputs, sub = cell(inputs, sub)
+            collected += sub
+        return inputs, collected
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, _, batch_size = _format_sequence(length, inputs, layout,
-                                                 None)
-        num_cells = len(self._children)
-        begin_state = _get_begin_state(self, nd, begin_state, inputs,
-                                       batch_size)
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._children):
-            n = len(cell.state_info())
-            states = begin_state[p:p + n]
-            p += n
-            inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return inputs, next_states
+        steps, _, batch_size = _as_step_list(length, inputs, layout)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size)
+        seq = steps
+        collected = []
+        last = len(self._children) - 1
+        for pos, (cell, sub) in enumerate(self._split_states(begin_state)):
+            seq, sub = cell.unroll(
+                length, inputs=seq, begin_state=sub, layout=layout,
+                merge_outputs=merge_outputs if pos == last else None)
+            collected += sub
+        return seq, collected
 
     def __getitem__(self, i):
         return self._children[i]
@@ -334,15 +288,14 @@ class SequentialRNNCell(RecurrentCell):
 
 
 class ModifierCell(HybridRecurrentCell):
-    """Base class for cells that wrap another cell
-    (reference rnn_cell.py:637)."""
+    """Wraps a base cell, sharing its parameters (ref rnn_cell.py:637)."""
 
     def __init__(self, base_cell):
-        assert not base_cell._modified, \
-            "Cell %s is already modified." % base_cell.name
+        if base_cell._modified:
+            raise AssertionError("Cell %s is already modified."
+                                 % base_cell.name)
         base_cell._modified = True
-        super(ModifierCell, self).__init__(prefix=base_cell.prefix + "_",
-                                           params=None)
+        super().__init__(prefix=base_cell.prefix + "_", params=None)
         self.base_cell = base_cell
 
     @property
@@ -353,23 +306,27 @@ class ModifierCell(HybridRecurrentCell):
         return self.base_cell.state_info(batch_size)
 
     def begin_state(self, func=None, **kwargs):
-        assert not self._modified
+        if self._modified:
+            raise AssertionError("call the outermost modifier cell")
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func, **kwargs) \
-            if func is not None else self.base_cell.begin_state(**kwargs)
-        self.base_cell._modified = True
-        return begin
+        try:
+            if func is not None:
+                kwargs["func"] = func
+            return self.base_cell.begin_state(**kwargs)
+        finally:
+            self.base_cell._modified = True
 
     def hybrid_forward(self, F, inputs, states):
         raise NotImplementedError
 
 
 class DropoutCell(HybridRecurrentCell):
-    """Applies dropout on input (reference rnn_cell.py:594)."""
+    """Stateless input-dropout pseudo-cell (ref rnn_cell.py:594)."""
 
     def __init__(self, rate, prefix=None, params=None):
-        super(DropoutCell, self).__init__(prefix, params)
-        assert isinstance(rate, (int, float))
+        super().__init__(prefix, params)
+        if not isinstance(rate, (int, float)):
+            raise TypeError("rate must be a number")
         self.rate = rate
 
     def state_info(self, batch_size=0):
@@ -385,13 +342,15 @@ class DropoutCell(HybridRecurrentCell):
 
 
 class ZoneoutCell(ModifierCell):
-    """Applies Zoneout on base cell (reference rnn_cell.py:701)."""
+    """Zoneout regularisation over the base cell (ref rnn_cell.py:701):
+    randomly keep previous outputs/states in place of new ones."""
 
     def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
-        assert not isinstance(base_cell, BidirectionalCell), \
-            "BidirectionalCell doesn't support zoneout. " \
-            "Please add ZoneoutCell to the cells underneath instead."
-        super(ZoneoutCell, self).__init__(base_cell)
+        if isinstance(base_cell, BidirectionalCell):
+            raise TypeError(
+                "BidirectionalCell doesn't support zoneout. "
+                "Please add ZoneoutCell to the cells underneath instead.")
+        super().__init__(base_cell)
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states
         self._prev_output = None
@@ -400,58 +359,58 @@ class ZoneoutCell(ModifierCell):
         return "zoneout"
 
     def reset(self):
-        super(ZoneoutCell, self).reset()
+        super().reset()
         self._prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
-        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
-                                     self.zoneout_states)
-        next_output, next_states = cell(inputs, states)
-        mask = lambda p, like: F.Dropout(F.ones_like(like), p=p)
-        prev_output = self._prev_output
-        if prev_output is None:
-            prev_output = F.zeros_like(next_output)
-        output = (F.where(mask(p_outputs, next_output), next_output,
-                          prev_output)
-                  if p_outputs != 0. else next_output)
-        new_states = ([F.where(mask(p_states, new_s), new_s, old_s)
-                       for new_s, old_s in zip(next_states, states)]
-                      if p_states != 0. else next_states)
-        self._prev_output = output
-        return output, new_states
+        new_out, new_states = self.base_cell(inputs, states)
+
+        def keep_mask(p, like):
+            return F.Dropout(F.ones_like(like), p=p)
+
+        prior = self._prev_output
+        if prior is None:
+            prior = F.zeros_like(new_out)
+        out = new_out if self.zoneout_outputs == 0. else \
+            F.where(keep_mask(self.zoneout_outputs, new_out), new_out, prior)
+        if self.zoneout_states != 0.:
+            new_states = [F.where(keep_mask(self.zoneout_states, ns), ns, os)
+                          for ns, os in zip(new_states, states)]
+        self._prev_output = out
+        return out, new_states
 
 
 class ResidualCell(ModifierCell):
-    """Adds residual connection (reference rnn_cell.py:764)."""
+    """output = base_cell(input) + input (ref rnn_cell.py:764)."""
 
     def hybrid_forward(self, F, inputs, states):
-        output, states = self.base_cell(inputs, states)
-        output = output + inputs
-        return output, states
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
         self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state, layout=layout,
-            merge_outputs=merge_outputs)
-        self.base_cell._modified = True
+        try:
+            outputs, states = self.base_cell.unroll(
+                length, inputs=inputs, begin_state=begin_state,
+                layout=layout, merge_outputs=merge_outputs)
+        finally:
+            self.base_cell._modified = True
+        steps, t_axis, _ = _as_step_list(length, inputs, layout)
         if isinstance(outputs, (list, tuple)):
-            inputs, _, _ = _format_sequence(length, inputs, layout, False)
-            outputs = [o + i for o, i in zip(outputs, inputs)]
+            outputs = [o + x for o, x in zip(outputs, steps)]
         else:
-            inputs, _, _ = _format_sequence(length, inputs, layout, True)
-            outputs = outputs + inputs
+            outputs = outputs + _merge_steps(steps, t_axis)
         return outputs, states
 
 
 class BidirectionalCell(HybridRecurrentCell):
-    """Runs two cells over the sequence in both directions
-    (reference rnn_cell.py:825)."""
+    """Forward + reversed cell over the sequence, outputs concatenated
+    (ref rnn_cell.py:825). Only ``unroll`` makes sense here."""
 
     def __init__(self, l_cell, r_cell, output_prefix="bi_"):
-        super(BidirectionalCell, self).__init__(prefix="", params=None)
+        super().__init__(prefix="", params=None)
         self.register_child(l_cell)
         self.register_child(r_cell)
         self._output_prefix = output_prefix
@@ -461,33 +420,29 @@ class BidirectionalCell(HybridRecurrentCell):
             "Bidirectional cannot be stepped. Please use unroll")
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children, batch_size)
+        return _stack_state_info(self._children, batch_size)
 
     def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._children, **kwargs)
+        if self._modified:
+            raise AssertionError("call the modifier cell instead")
+        return _stack_begin_state(self._children, **kwargs)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
-                                                    False)
-        begin_state = _get_begin_state(self, nd, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
-        l_cell, r_cell = self._children
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info())],
+        steps, t_axis, batch_size = _as_step_list(length, inputs, layout)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size)
+        fwd_cell, bwd_cell = self._children
+        split = len(fwd_cell.state_info())
+        fwd_out, fwd_states = fwd_cell.unroll(
+            length, inputs=steps, begin_state=begin_state[:split],
             layout=layout, merge_outputs=False)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info()):],
+        bwd_out, bwd_states = bwd_cell.unroll(
+            length, inputs=steps[::-1], begin_state=begin_state[split:],
             layout=layout, merge_outputs=False)
-        outputs = [nd.concat(l_o, r_o, dim=1)
-                   for l_o, r_o in zip(l_outputs, reversed(r_outputs))]
+        joined = [nd.concat(f, b, dim=1)
+                  for f, b in zip(fwd_out, bwd_out[::-1])]
         if merge_outputs:
-            outputs = [nd.expand_dims(o, axis=axis) for o in outputs]
-            outputs = nd.concat(*outputs, dim=axis)
-        states = l_states + r_states
-        return outputs, states
+            return _merge_steps(joined, t_axis), fwd_states + bwd_states
+        return joined, fwd_states + bwd_states

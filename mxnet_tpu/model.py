@@ -25,72 +25,67 @@ __all__ = ["_create_kvstore", "_initialize_kvstore",
 
 
 class BatchEndParam:
+    """Bundle handed to batch-end callbacks (ref model.py namedtuple)."""
+
     def __init__(self, epoch, nbatch, eval_metric, locals=None):
-        self.epoch = epoch
-        self.nbatch = nbatch
-        self.eval_metric = eval_metric
-        self.locals = locals
+        self.epoch, self.nbatch = epoch, nbatch
+        self.eval_metric, self.locals = eval_metric, locals
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
-    """Decide kvstore + update_on_kvstore (reference model.py:57)."""
-    update_on_kvstore = True
-    if kvstore is None:
-        kv = None
-    elif isinstance(kvstore, kvs.KVStore):
+    """Resolve the kvstore spec and the update-placement decision
+    (ref model.py:57): single-device non-dist runs skip the store entirely;
+    'local' moves updates onto workers when the largest param exceeds 16M
+    elements (server-side optimizer would serialise on that key)."""
+    if kvstore is None or isinstance(kvstore, kvs.KVStore):
         kv = kvstore
+        update_on_kvstore = kv is not None
     elif isinstance(kvstore, str):
         if num_device == 1 and "dist" not in kvstore:
-            kv = None
-        else:
-            kv = kvs.create(kvstore)
-            if kvstore == "local":
-                max_size = max(np.prod(param.shape)
-                               for param in arg_params.values())
-                if max_size > 1024 * 1024 * 16:
-                    update_on_kvstore = False
+            return None, False
+        kv = kvs.create(kvstore)
+        update_on_kvstore = True
+        if kvstore == "local":
+            biggest = max(np.prod(p.shape) for p in arg_params.values())
+            update_on_kvstore = biggest <= 1024 * 1024 * 16
     else:
         raise TypeError("kvstore must be KVStore, str or None")
-    if kv is None:
-        update_on_kvstore = False
-    return (kv, update_on_kvstore)
+    return kv, update_on_kvstore
 
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
-    """Init kvstore keys from params (reference model.py:96)."""
-    for idx, param_on_devs in enumerate(param_arrays):
-        name = param_names[idx]
+    """Register every parameter with the store (ref model.py:96); in
+    update-on-kvstore mode also broadcast the initial values out."""
+    for slot, (name, devs) in enumerate(zip(param_names, param_arrays)):
         kvstore.init(name, arg_params[name])
         if update_on_kvstore:
-            kvstore.pull(name, param_on_devs, priority=-idx)
+            kvstore.pull(name, devs, priority=-slot)
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
                               param_names):
-    """Push grads, pull updated weights (reference model.py:105)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list is None or grad_list[0] is None:
+    """Optimizer-on-server step (ref model.py:105): push the gradient,
+    pull the freshly-updated weight back to every device."""
+    for slot, (weights, grads) in enumerate(zip(param_arrays, grad_arrays)):
+        if grads is None or grads[0] is None:
             continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+        kvstore.push(param_names[slot], grads, priority=-slot)
+        kvstore.pull(param_names[slot], weights, priority=-slot)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
-    """Reduce via kvstore, update locally per device (reference model.py:117)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list is None or grad_list[0] is None:
+    """Optimizer-on-worker step (ref model.py:117): optionally reduce the
+    gradient through the store, then run the local Updater per device."""
+    for slot, (weights, grads) in enumerate(zip(param_arrays, grad_arrays)):
+        if grads is None or grads[0] is None:
             continue
         if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
-        for k, p, g in zip(range(num_device), arg_list, grad_list):
-            updater(index * num_device + k, g, p)
+            kvstore.push(param_names[slot], grads, priority=-slot)
+            kvstore.pull(param_names[slot], grads, priority=-slot)
+        for dev, (w, g) in enumerate(zip(weights, grads)):
+            updater(slot * num_device + dev, g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
@@ -98,26 +93,25 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     model.py save_checkpoint; format per §5.4)."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    blob = {}
+    for tag, group in (("arg:", arg_params), ("aux:", aux_params)):
+        for name, arr in group.items():
+            blob[tag + name] = arr
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
-    logging.info("Saved checkpoint to \"%s\"", param_name)
+    nd.save(param_name, blob)
+    logging.info('Saved checkpoint to "%s"', param_name)
 
 
 def load_checkpoint(prefix, epoch):
     """Load a checkpoint saved by save_checkpoint."""
     symbol = sym.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        if tp == "aux":
-            aux_params[name] = v
-    return (symbol, arg_params, aux_params)
+    arg_params, aux_params = {}, {}
+    groups = {"arg": arg_params, "aux": aux_params}
+    for key, val in nd.load("%s-%04d.params" % (prefix, epoch)).items():
+        kind, _, name = key.partition(":")
+        if kind in groups:
+            groups[kind][name] = val
+    return symbol, arg_params, aux_params
 
 
 class FeedForward:
