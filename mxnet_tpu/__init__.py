@@ -37,6 +37,7 @@ from .initializer import init
 from . import optimizer
 from . import optimizer as opt
 from . import metric
+from . import operator
 from . import lr_scheduler
 from . import callback
 from . import io

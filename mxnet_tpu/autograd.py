@@ -208,15 +208,83 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     return _backward_impl(heads, head_grads, retain_graph, train_mode)
 
 
+class _GradOp:
+    """Sentinel op for tape nodes produced by grad(create_graph=True)."""
+    name = "_grad"
+
+
+def _pure_replay(variables, heads, train_mode):
+    """Rebuild the recorded computation as a pure jax function
+    ``f(var_vals tuple) -> head_vals tuple`` by replaying the tape.
+
+    Stochastic ops replay with fresh RNG keys — second-order grads through
+    dropout-style ops use a new mask, like re-running the forward would.
+    """
+    from . import random as _random
+    tape = list(_STATE.tape)
+
+    def f(var_vals):
+        env = {id(v): val for v, val in zip(variables, var_vals)}
+        for node in tape:
+            ins = [env.get(id(i), i._data) for i in node.inputs]
+            rng = _random.next_key() if getattr(node.op, "needs_rng", False) \
+                else None
+            outs = node.op.traceable(node.attrs, train_mode=train_mode,
+                                     rng=rng)(*ins)
+            for o, val in zip(node.outputs, outs):
+                env[id(o)] = val
+        return tuple(env.get(id(h), h._data) for h in heads)
+
+    return f
+
+
+def _grad_create_graph(heads, variables, head_grads, train_mode):
+    """Higher-order path: gradients come out as recorded tape outputs, so
+    they can be differentiated again (ref autograd.py:274 create_graph)."""
+    import jax
+    from .ndarray import _wrap
+
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    cotangents = tuple(
+        jnp.ones_like(h._data) if hg is None else hg._data
+        for h, hg in zip(heads, head_grads))
+
+    forward = _pure_replay(variables, heads, train_mode)
+
+    def grad_of_vars(var_vals):
+        _, pullback = jax.vjp(forward, var_vals)
+        return pullback(cotangents)[0]
+
+    var_vals = tuple(v._data for v in variables)
+    grad_vals, pullback2 = jax.vjp(grad_of_vars, var_vals)
+    outputs = [_wrap(g, v.context) for g, v in zip(grad_vals, variables)]
+
+    if is_recording():
+        node = TapeNode(_GradOp(), {}, list(variables), outputs,
+                        list(range(len(variables))),
+                        vjp_fn=lambda gouts: pullback2(tuple(gouts))[0])
+        for o in outputs:
+            o._tape_node = node
+        append_node(node)
+    return outputs
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Compute grads of heads w.r.t. variables (reference autograd.py:274)."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order autograd) is not supported yet")
+    """Compute grads of heads w.r.t. variables (reference autograd.py:274).
+
+    ``create_graph=True`` returns gradients that are themselves recorded,
+    so a further ``backward()``/``grad()`` differentiates through them.
+    """
     variables = variables if isinstance(variables, (list, tuple)) else [variables]
     if retain_graph is None:
         retain_graph = create_graph
+    if create_graph:
+        return _grad_create_graph(heads, variables, head_grads, train_mode)
     return _backward_impl(heads, head_grads, retain_graph, train_mode,
                           variables=variables)
 

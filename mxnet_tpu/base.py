@@ -128,3 +128,21 @@ class Registry:
         if isinstance(spec, str):
             return self.get(spec)(*args, **kwargs)
         return spec
+
+
+def env_flag(name, default=False):
+    """Read a boolean MXNET_* environment flag (ref dmlc::GetEnv use-sites;
+    canonical list in docs/faq/env_var.md)."""
+    import os
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+def mirror_enabled():
+    """MXNET_BACKWARD_DO_MIRROR: trade compute for memory by
+    rematerialising forward activations during backward
+    (ref src/executor/graph_executor.cc:281-304 mirror pass; here it maps
+    to jax.checkpoint around the block's pure function)."""
+    return env_flag("MXNET_BACKWARD_DO_MIRROR")

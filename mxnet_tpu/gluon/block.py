@@ -385,7 +385,14 @@ class _CachedOp(object):
         recording = autograd.is_recording()
 
         if train not in self._jit:
-            self._jit[train] = jax.jit(self._pure(train))
+            pure = self._pure(train)
+            from ..base import mirror_enabled
+            if mirror_enabled():
+                # MXNET_BACKWARD_DO_MIRROR (ref graph_executor.cc:281-304):
+                # rematerialise forward activations in backward instead of
+                # keeping them live — jax.checkpoint is the XLA-native form
+                pure = jax.checkpoint(pure)
+            self._jit[train] = jax.jit(pure)
         jitted = self._jit[train]
 
         if recording:

@@ -24,11 +24,11 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-def _conv(channels, kernel, stride=1, pad=None, in_channels=0):
+def _conv(channels, kernel, stride=1, pad=None, in_channels=0, bias=False):
     if pad is None:
         pad = kernel // 2
     return nn.Conv2D(channels, kernel_size=kernel, strides=stride,
-                     padding=pad, use_bias=False, in_channels=in_channels)
+                     padding=pad, use_bias=bias, in_channels=in_channels)
 
 
 def _conv3x3(channels, stride, in_channels):
@@ -46,8 +46,10 @@ class _ResidualV1(HybridBlock):
         plan = self.conv_plan(channels, stride)
         self.body = nn.HybridSequential(prefix="")
         for pos, (ch, kernel, s) in enumerate(plan):
+            # reference V1 keeps biases on the bottleneck 1x1 convs
             self.body.add(_conv(ch, kernel, s,
-                                in_channels=in_channels if pos == 0 else 0))
+                                in_channels=in_channels if pos == 0 else 0,
+                                bias=(kernel == 1)))
             self.body.add(nn.BatchNorm())
             if pos + 1 < len(plan):
                 self.body.add(nn.Activation("relu"))

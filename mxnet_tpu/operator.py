@@ -1,0 +1,134 @@
+"""User-defined operators: CustomOp / CustomOpProp / register.
+
+API parity with the reference ``python/mxnet/operator.py:1-880`` (the
+CustomOp protocol behind the ``Custom`` graph op,
+``src/operator/custom/custom.cc:49-250``). The TPU execution story differs
+by design — see ``mxnet_tpu/ops/custom.py``: the numpy callbacks run on
+host behind ``jax.pure_callback`` so Custom ops compose with jit/grad,
+while performance-critical user kernels should register pure-jax or
+Pallas functions with ``mxnet_tpu.ops.register`` instead (that path runs
+on-chip and fuses; ``ops/pallas_kernels.py`` shows the recipe).
+
+Usage (identical to the reference)::
+
+    import mxnet_tpu as mx
+
+    class Sigmoid(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            y = 1.0 / (1.0 + mx.nd.exp(-in_data[0]))
+            self.assign(out_data[0], req[0], y)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sigmoid()
+
+    out = mx.nd.Custom(x, op_type="sigmoid")
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ops.custom import CUSTOM_PROP_REGISTRY, register_prop
+
+__all__ = ["CustomOp", "CustomOpProp", "register",
+           "get_all_registered_operators"]
+
+
+class CustomOp(object):
+    """Base class for the per-instance forward/backward callbacks
+    (ref operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs from ``in_data`` into ``out_data``."""
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into ``in_grad``."""
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Store *src* into *dst* honouring the write request."""
+        if req in ("null",):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError("unknown req %r" % req)
+
+
+class CustomOpProp(object):
+    """Declares a custom op's interface: names, shapes, dtypes, and the
+    operator factory (ref operator.py CustomOpProp).
+
+    ``need_top_grad`` records whether backward consumes the output
+    gradient (loss-layer ops set it False); kept for API parity — the
+    TPU build always supplies out_grad.
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs/aux shaped like the first input."""
+        n_out = len(self.list_outputs())
+        n_aux = len(self.list_auxiliary_states())
+        return (in_shape, [in_shape[0]] * n_out, [in_shape[0]] * n_aux)
+
+    def infer_type(self, in_type):
+        """Default: everything takes the first input's dtype."""
+        lead = in_type[0]
+        return (in_type, [lead] * len(self.list_outputs()),
+                [lead] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Which arrays backward reads (ref operator.py:
+        used for dependency pruning; informational here)."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under *reg_name*
+    (ref operator.py:register); afterwards
+    ``nd.Custom(..., op_type=reg_name)`` / ``sym.Custom(...)`` work."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        register_prop(reg_name, prop_cls)
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    return sorted(CUSTOM_PROP_REGISTRY)

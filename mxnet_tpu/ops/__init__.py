@@ -7,3 +7,4 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optim_ops  # noqa: F401
 from . import contrib  # noqa: F401
+from . import custom  # noqa: F401

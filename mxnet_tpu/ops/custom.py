@@ -1,0 +1,139 @@
+"""The ``Custom`` operator: user-defined Python ops inside the graph.
+
+Reference analogue: ``src/operator/custom/custom.cc:49-250`` (the C++
+Custom op that trampolines into Python callbacks registered through
+``python/mxnet/operator.py``'s CustomOpProp table). TPU-first redesign:
+the user's numpy ``forward``/``backward`` run on the *host* behind
+``jax.pure_callback`` — so a Custom op can sit anywhere in a jitted or
+differentiated XLA program, with shapes/dtypes resolved at trace time
+from the prop's ``infer_shape``/``infer_type``. Users who want the op to
+run *on-chip* should instead register a pure-jax/Pallas function via
+``mxnet_tpu.ops.register`` (see ops/pallas_kernels.py for the pattern).
+
+The user-facing classes (CustomOp / CustomOpProp / register) live in
+``mxnet_tpu/operator.py``; this module holds the prop registry and the
+graph-op plumbing so the op exists before the nd/sym namespaces are
+generated.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..base import MXNetError
+from .registry import register
+
+# op_type -> CustomOpProp subclass
+CUSTOM_PROP_REGISTRY = {}
+
+
+def register_prop(reg_name, prop_cls):
+    CUSTOM_PROP_REGISTRY[reg_name] = prop_cls
+
+
+def _instantiate(attrs):
+    """Build the user's CustomOpProp from the op attrs (kwargs arrive as
+    strings, matching the reference contract)."""
+    spec = {k: v for k, v in attrs.items() if k != "op_type"}
+    op_type = attrs.get("op_type")
+    if not op_type:
+        raise MXNetError("Custom op requires op_type=")
+    if op_type not in CUSTOM_PROP_REGISTRY:
+        raise MXNetError("Custom op type %r is not registered "
+                         "(use mxnet_tpu.operator.register)" % op_type)
+    return CUSTOM_PROP_REGISTRY[op_type](**{k: str(v)
+                                            for k, v in spec.items()})
+
+
+def _resolve(prop, arrays):
+    """Shapes/dtypes of args, outputs, aux from the prop's inference."""
+    n_args = len(prop.list_arguments())
+    in_shapes = [list(a.shape) for a in arrays[:n_args]]
+    shaped = prop.infer_shape(in_shapes)
+    arg_shapes, out_shapes = shaped[0], shaped[1]
+    aux_shapes = shaped[2] if len(shaped) > 2 else []
+    in_types = [np.dtype(a.dtype) for a in arrays[:n_args]]
+    typed = prop.infer_type(in_types)
+    out_types = typed[1]
+    aux_types = typed[2] if len(typed) > 2 else []
+    return (n_args, arg_shapes, out_shapes, aux_shapes,
+            in_types, out_types, aux_types)
+
+
+def _nd_wrap_list(host_arrays):
+    """numpy buffers → framework NDArrays (host ctx) for user callbacks."""
+    from .. import ndarray as nd
+    return [nd.array(np.asarray(a)) for a in host_arrays]
+
+
+def _n_outputs(attrs):
+    return len(_instantiate(attrs).list_outputs())
+
+
+def _custom_forward(*arrays, train_mode=False, **attrs):
+    prop = _instantiate(attrs)
+    if prop.list_auxiliary_states():
+        import warnings
+        warnings.warn(
+            "Custom op %r declares auxiliary states; they are passed to the "
+            "callbacks read-only — in-place aux mutation does not propagate "
+            "back to the graph on the TPU build" % attrs.get("op_type"),
+            stacklevel=2)
+    (n_args, _arg_shapes, out_shapes, _aux_shapes,
+     in_types, out_types, _aux_types) = _resolve(prop, arrays)
+    result_spec = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+        for s, t in zip(out_shapes, out_types))
+
+    def host_forward(*host_arrays):
+        from .. import ndarray as nd
+        ins = _nd_wrap_list(host_arrays[:n_args])
+        auxs = _nd_wrap_list(host_arrays[n_args:])
+        outs = [nd.zeros(tuple(s), dtype=np.dtype(t))
+                for s, t in zip(out_shapes, out_types)]
+        op = prop.create_operator(None, [list(a.shape) for a in ins],
+                                  [a.dtype for a in ins])
+        op.forward(is_train=train_mode, req=["write"] * len(outs),
+                   in_data=ins, out_data=outs, aux=auxs)
+        return tuple(np.asarray(o.asnumpy(), dtype=np.dtype(t))
+                     for o, t in zip(outs, out_types))
+
+    out = jax.pure_callback(host_forward, result_spec, *arrays,
+                            vmap_method="sequential")
+    return out if len(result_spec) > 1 else (out[0]
+                                             if isinstance(out, (tuple, list))
+                                             else out)
+
+
+def _custom_backward(gout, arrs, out, attrs):
+    prop = _instantiate(attrs)
+    n_args = len(prop.list_arguments())
+    grad_spec = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in arrs[:n_args])
+    n_out = len(out)
+
+    def host_backward(*flat):
+        from .. import ndarray as nd
+        grads_in = _nd_wrap_list(flat[:n_out])            # out_grad
+        ins = _nd_wrap_list(flat[n_out:n_out + n_args])   # in_data
+        auxs = _nd_wrap_list(flat[n_out + n_args:n_out + len(arrs)])
+        outs = _nd_wrap_list(flat[n_out + len(arrs):])    # out_data
+        igrads = [nd.zeros(a.shape, dtype=a.dtype) for a in ins]
+        op = prop.create_operator(None, [list(a.shape) for a in ins],
+                                  [a.dtype for a in ins])
+        op.backward(req=["write"] * len(igrads), out_grad=grads_in,
+                    in_data=ins, out_data=outs, in_grad=igrads, aux=auxs)
+        return tuple(np.asarray(g.asnumpy()) for g in igrads)
+
+    grads = jax.pure_callback(host_backward, grad_spec, *gout, *arrs, *out,
+                              vmap_method="sequential")
+    if not isinstance(grads, (tuple, list)):
+        grads = (grads,)
+    # auxiliary-state inputs receive zero gradient
+    import jax.numpy as jnp
+    aux_zero = tuple(jnp.zeros_like(a) for a in arrs[n_args:])
+    return tuple(grads) + aux_zero
+
+
+register("Custom", num_outputs=_n_outputs, takes_mode=True,
+         custom_vjp=_custom_backward)(_custom_forward)

@@ -128,6 +128,11 @@ class ShardedTrainer:
                        for pat, spec in (param_rules or [])]
 
         self._fn, self._grad_names, self._aux_names = block_pure_fn(block)
+        from ..base import mirror_enabled
+        if mirror_enabled():
+            # MXNET_BACKWARD_DO_MIRROR → remat the whole block in backward
+            # (train flag is arg 4, a static python bool)
+            self._fn = jax.checkpoint(self._fn, static_argnums=(4,))
         pd = {p.name: p for p in block.collect_params().values()}
         self._pd = pd
         if not getattr(optimizer, "idx2name", None):

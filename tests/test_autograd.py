@@ -154,3 +154,30 @@ def test_rnn_op_grad_flows():
         loss = (out * out).sum()
     loss.backward()
     assert np.abs(params.grad.asnumpy()).sum() > 0
+
+
+def test_grad_create_graph_second_order():
+    """d2/dx2 of x^3 = 6x via grad-of-grad (ref autograd.py:274)."""
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * x * x
+        (dy_dx,) = mx.autograd.grad(y, [x], create_graph=True)
+        z = dy_dx.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6.0 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_mixed_expression():
+    """Differentiate an expression that mixes first-order grads with the
+    forward values: d/dx [ (dy/dx) * x ] with y = x^2 -> d/dx [2x^2] = 4x."""
+    x = nd.array(np.array([0.5, -1.5], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * x
+        (g,) = mx.autograd.grad(y, [x], create_graph=True)
+        w = (g * x).sum()
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 4.0 * x.asnumpy(),
+                               rtol=1e-5)

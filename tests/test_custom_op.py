@@ -1,0 +1,133 @@
+"""CustomOp API tests (reference tests/python/unittest/test_operator.py
+test_custom_op + example/numpy-ops patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + np.exp(-in_data[0].asnumpy()))
+        self.assign(out_data[0], req[0], nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy() * y * (1.0 - y)
+        self.assign(in_grad[0], req[0], nd.array(g))
+
+
+@mx.operator.register("t_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+class _NumpySoftmax(mx.operator.CustomOp):
+    """The canonical example/numpy-ops/numpy_softmax.py op."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], nd.array(e / e.sum(axis=1,
+                                                            keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lbl = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(lbl.shape[0]), lbl] -= 1.0
+        self.assign(in_grad[0], req[0], nd.array(y))
+
+
+@mx.operator.register("t_numpy_softmax")
+class _NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _NumpySoftmax()
+
+
+def test_custom_registered():
+    assert "t_sigmoid" in mx.operator.get_all_registered_operators()
+
+
+def test_custom_forward_eager():
+    x = nd.array(np.array([[0.0, 1.0], [-1.0, 2.0]], np.float32))
+    y = nd.Custom(x, op_type="t_sigmoid")
+    expect = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), expect, rtol=1e-6)
+
+
+def test_custom_backward():
+    x = nd.array(np.array([[0.5, -0.25]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="t_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    s = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_custom_softmax_two_inputs():
+    data = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array(np.array([0, 1, 2, 3], np.float32))
+    out = nd.Custom(data, label, op_type="t_numpy_softmax")
+    got = out.asnumpy()
+    np.testing.assert_allclose(got.sum(axis=1), np.ones(4), rtol=1e-5)
+
+    data.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(data, label, op_type="t_numpy_softmax")
+    y.backward()
+    expect = got.copy()
+    expect[np.arange(4), label.asnumpy().astype(np.int64)] -= 1.0
+    np.testing.assert_allclose(data.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_custom_in_symbol():
+    sym_x = mx.sym.Variable("data")
+    sym_y = mx.sym.Custom(sym_x, op_type="t_sigmoid", name="sig")
+    exe = sym_y.bind(mx.cpu(), {"data": nd.array(
+        np.array([[0.0, 1.0]], np.float32))})
+    out = exe.forward()[0]
+    expect = 1.0 / (1.0 + np.exp(-np.array([[0.0, 1.0]])))
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_custom_train_small_net():
+    """A tiny net with a Custom head trains (the numpy-ops demo's point)."""
+    np.random.seed(0)
+    w = nd.array(np.random.randn(3, 4).astype(np.float32) * 0.1)
+    w.attach_grad()
+    data = nd.array(np.random.randn(8, 3).astype(np.float32))
+    label = nd.array(np.random.randint(0, 4, (8,)).astype(np.float32))
+    first = None
+    for _ in range(5):
+        with mx.autograd.record():
+            logits = nd.dot(data, w)
+            prob = nd.Custom(logits, label, op_type="t_numpy_softmax")
+        prob.backward()
+        idx = label.asnumpy().astype(np.int64)
+        loss = -np.log(prob.asnumpy()[np.arange(8), idx] + 1e-9).mean()
+        if first is None:
+            first = loss
+        w -= 0.5 * w.grad
+        w.grad[:] = 0
+    assert loss < first, (first, loss)

@@ -1,0 +1,70 @@
+"""MXNET_BACKWARD_DO_MIRROR → jax.checkpoint rematerialisation
+(ref src/executor/graph_executor.cc:281-304 mirror pass)."""
+import os
+
+import numpy as np
+import jax
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+
+
+def _fresh_mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.collect_params().initialize()
+    net.hybridize()
+    return net
+
+
+def _grad_jaxpr_of_block(net):
+    """jaxpr of grad-of-sum through the block's cached pure function."""
+    net(nd.zeros((2, 8)))          # builds the cache
+    cached = net._cached_op
+    pure = cached._jit[False].__wrapped__
+
+    gvals = tuple(p._data._data for p in cached._grad_params)
+    avals = tuple(p._data._data for p in cached._aux_params)
+    x = jax.numpy.zeros((2, 8))
+    key = jax.random.PRNGKey(0)
+
+    def loss(gv):
+        out, _ = pure(gv, avals, (x,), key)
+        return sum(o.sum() for o in out)
+
+    return str(jax.make_jaxpr(jax.grad(loss))(gvals))
+
+
+def test_mirror_flag_inserts_remat(monkeypatch):
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    plain = _grad_jaxpr_of_block(_fresh_mlp())
+    assert "remat" not in plain and "checkpoint" not in plain
+
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    mirrored = _grad_jaxpr_of_block(_fresh_mlp())
+    assert "remat" in mirrored or "checkpoint" in mirrored
+
+
+def test_mirror_numerics_unchanged(monkeypatch):
+    """Remat changes memory/compute, never values."""
+    np.random.seed(0)
+    x_np = np.random.randn(4, 8).astype(np.float32)
+
+    grads = []
+    for flag in ("0", "1"):
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", flag)
+        np.random.seed(1)
+        mx.random.seed(1)
+        net = _fresh_mlp()
+        x = nd.array(x_np)
+        x.attach_grad()
+        with mx.autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        grads.append(x.grad.asnumpy())
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-6)
