@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""CustomOp demo: a numpy softmax loss layer inside a Module-trained net.
+
+Parity target: reference ``example/numpy-ops/numpy_softmax.py`` — the
+canonical CustomOp walkthrough (python/mxnet/operator.py). The op's
+forward/backward are plain numpy; they run on host behind
+``jax.pure_callback`` while the rest of the graph compiles to XLA.
+
+    python examples/numpy_ops_softmax.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], e / e.sum(axis=1, keepdims=True))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        label = in_data[1].asnumpy().ravel().astype(np.int64)
+        grad = out_data[0].asnumpy().copy()
+        grad[np.arange(label.shape[0]), label] -= 1.0
+        self.assign(in_grad[0], req[0], grad)
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    from mxnet_tpu.test_utils import get_mnist_iterator
+    import logging
+    logging.basicConfig(level=logging.INFO)
+
+    train_iter, val_iter = get_mnist_iterator(batch_size=64, flat=True)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    net = mx.sym.Custom(h, label, op_type="numpy_softmax", name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.context.current_context())
+    mod.fit(train_iter, eval_data=val_iter, eval_metric="acc",
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            num_epoch=3)
+    acc = mod.score(val_iter, "acc")[0][1]
+    print("final validation accuracy with numpy CustomOp head: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Train a Gluon ResNet on CIFAR-10-shaped data.
+
+Parity target: reference ``example/gluon/image_classification.py`` +
+``example/image-classification/train_cifar10.py`` (BASELINE workload:
+resnet on cifar10, Gluon ``--mode imperative|hybrid`` duality).
+
+Real CIFAR-10 is not bundled; without ``--data-dir`` pointing at the
+binary batches the script trains on a synthetic separable set so it runs
+hermetically.
+
+    python examples/train_cifar10.py --mode hybrid --num-epochs 3
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def synthetic_cifar(n_train=2048, n_val=512):
+    """Class-dependent colour/texture pattern, learnable by a small net."""
+    rng = np.random.RandomState(7)
+    protos = rng.rand(10, 3, 32, 32).astype(np.float32)
+
+    def make(n):
+        y = rng.randint(0, 10, n)
+        x = protos[y] + rng.normal(0, 0.35, (n, 3, 32, 32)).astype(
+            np.float32)
+        return np.clip(x, 0, 1), y.astype(np.float32)
+
+    return make(n_train), make(n_val)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("imperative", "hybrid"),
+                    default="hybrid")
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.io import NDArrayIter
+
+    (tr_x, tr_y), (va_x, va_y) = synthetic_cifar()
+    train_iter = NDArrayIter(tr_x, tr_y, args.batch_size, shuffle=True)
+    val_iter = NDArrayIter(va_x, va_y, args.batch_size)
+
+    net = vision.get_model(args.model, classes=10, thumbnail=True)
+    net.collect_params().initialize(mx.init.Xavier())
+    if args.mode == "hybrid":
+        net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.num_epochs):
+        tic = time.time()
+        metric.reset()
+        train_iter.reset()
+        for batch in train_iter:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+        name, acc = metric.get()
+        logging.info("epoch %d: train-%s=%.4f (%.1fs)", epoch, name, acc,
+                     time.time() - tic)
+
+    metric.reset()
+    val_iter.reset()
+    for batch in val_iter:
+        out = net(batch.data[0])
+        metric.update([batch.label[0]], [out])
+    _, val_acc = metric.get()
+    print("final validation accuracy: %.4f" % val_acc)
+    return val_acc
+
+
+if __name__ == "__main__":
+    main()

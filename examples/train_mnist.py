@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Train an MLP (or LeNet) on MNIST with the symbolic Module API.
+
+Parity target: reference ``example/image-classification/train_mnist.py``
+(BASELINE workload #1: LeNet/MNIST via mx.mod.Module). Uses the real MNIST
+idx files when present, else a synthetic-digits fallback so the script runs
+hermetically.
+
+    python examples/train_mnist.py --network mlp --num-epochs 5
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def build_mlp(mx, num_classes=10):
+    data = mx.sym.Variable("data")
+    flat = mx.sym.Flatten(data)
+    h1 = mx.sym.FullyConnected(flat, num_hidden=128, name="fc1")
+    a1 = mx.sym.Activation(h1, act_type="relu")
+    h2 = mx.sym.FullyConnected(a1, num_hidden=64, name="fc2")
+    a2 = mx.sym.Activation(h2, act_type="relu")
+    out = mx.sym.FullyConnected(a2, num_hidden=num_classes, name="fc3")
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def build_lenet(mx, num_classes=10):
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50, name="conv2")
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flat = mx.sym.Flatten(p2)
+    f1 = mx.sym.FullyConnected(flat, num_hidden=500, name="fc1")
+    a3 = mx.sym.Activation(f1, act_type="tanh")
+    out = mx.sym.FullyConnected(a3, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def get_iters(mx, batch_size, flat):
+    """Real MNIST if the idx files are on disk, else synthetic digits."""
+    from mxnet_tpu.test_utils import get_mnist_iterator
+    return get_mnist_iterator(batch_size=batch_size, flat=flat)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=("mlp", "lenet"), default="mlp")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    import mxnet_tpu as mx
+
+    net = build_mlp(mx) if args.network == "mlp" else build_lenet(mx)
+    train_iter, val_iter = get_iters(mx, args.batch_size,
+                                     flat=(args.network == "mlp"))
+
+    mod = mx.mod.Module(net, context=mx.context.current_context())
+    callbacks = [mx.callback.Speedometer(args.batch_size, 50)]
+    epoch_cb = (mx.callback.do_checkpoint(args.model_prefix)
+                if args.model_prefix else None)
+    mod.fit(train_iter, eval_data=val_iter, eval_metric="acc",
+            optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            kvstore=args.kv_store, num_epoch=args.num_epochs,
+            batch_end_callback=callbacks, epoch_end_callback=epoch_cb)
+    score = mod.score(val_iter, "acc")[0][1]
+    print("final validation accuracy: %.4f" % score)
+    return score
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ from . import optimizer
 from . import optimizer as opt
 from . import metric
 from . import operator
+from . import rnn
 from . import lr_scheduler
 from . import callback
 from . import io

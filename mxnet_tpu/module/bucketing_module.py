@@ -186,6 +186,9 @@ class BucketingModule(BaseModule):
                                                 leader._aux_params)
             mod._exec_group.set_params(leader._arg_params, leader._aux_params)
             mod.params_initialized = True
+        if self.optimizer_initialized and \
+                not self._active.optimizer_initialized:
+            self._lend_optimizer(self._active)
 
     # ---- optimizer ----
 

@@ -60,10 +60,95 @@ def _resolve(prop, arrays):
             in_types, out_types, aux_types)
 
 
+class HostArray(object):
+    """numpy-backed NDArray stand-in handed to CustomOp callbacks.
+
+    The callbacks run on XLA's callback thread while the enclosing program
+    is still in flight; dispatching device ops from there can deadlock the
+    runtime, so user code sees a pure-host array (the reference's
+    numpy-ops contract: read via ``asnumpy()``, write via ``assign``/
+    slicing). Anything jax stays out of the callback.
+    """
+
+    def __init__(self, buf):
+        self._np = np.asarray(buf)
+
+    # ---- NDArray-surface the numpy-ops examples rely on ----
+    @property
+    def shape(self):
+        return self._np.shape
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    @property
+    def size(self):
+        return self._np.size
+
+    @property
+    def ndim(self):
+        return self._np.ndim
+
+    def asnumpy(self):
+        return self._np
+
+    def __array__(self, dtype=None):
+        return self._np if dtype is None else self._np.astype(dtype)
+
+    def copy(self):
+        return HostArray(self._np.copy())
+
+    def astype(self, dtype):
+        return HostArray(self._np.astype(dtype))
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return HostArray(self._np.reshape(shape))
+
+    def __getitem__(self, key):
+        return HostArray(self._np[key])
+
+    def __setitem__(self, key, value):
+        if hasattr(value, "asnumpy"):
+            value = value.asnumpy()
+        self._np[key] = value
+
+    def __repr__(self):
+        return "HostArray(%r)" % (self._np,)
+
+    def _binary(self, other, fn):
+        if hasattr(other, "asnumpy"):
+            other = other.asnumpy()
+        return HostArray(fn(self._np, other))
+
+    def __add__(self, o):
+        return self._binary(o, np.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, np.subtract)
+
+    def __rsub__(self, o):
+        return self._binary(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._binary(o, np.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, np.divide)
+
+    def __neg__(self):
+        return HostArray(-self._np)
+
+
 def _nd_wrap_list(host_arrays):
-    """numpy buffers → framework NDArrays (host ctx) for user callbacks."""
-    from .. import ndarray as nd
-    return [nd.array(np.asarray(a)) for a in host_arrays]
+    """numpy buffers → HostArray shims for user callbacks (jax-free)."""
+    return [HostArray(np.asarray(a)) for a in host_arrays]
 
 
 def _n_outputs(attrs):
@@ -86,10 +171,9 @@ def _custom_forward(*arrays, train_mode=False, **attrs):
         for s, t in zip(out_shapes, out_types))
 
     def host_forward(*host_arrays):
-        from .. import ndarray as nd
         ins = _nd_wrap_list(host_arrays[:n_args])
         auxs = _nd_wrap_list(host_arrays[n_args:])
-        outs = [nd.zeros(tuple(s), dtype=np.dtype(t))
+        outs = [HostArray(np.zeros(tuple(s), dtype=np.dtype(t)))
                 for s, t in zip(out_shapes, out_types)]
         op = prop.create_operator(None, [list(a.shape) for a in ins],
                                   [a.dtype for a in ins])
@@ -113,12 +197,12 @@ def _custom_backward(gout, arrs, out, attrs):
     n_out = len(out)
 
     def host_backward(*flat):
-        from .. import ndarray as nd
         grads_in = _nd_wrap_list(flat[:n_out])            # out_grad
         ins = _nd_wrap_list(flat[n_out:n_out + n_args])   # in_data
         auxs = _nd_wrap_list(flat[n_out + n_args:n_out + len(arrs)])
         outs = _nd_wrap_list(flat[n_out + len(arrs):])    # out_data
-        igrads = [nd.zeros(a.shape, dtype=a.dtype) for a in ins]
+        igrads = [HostArray(np.zeros(a.shape, dtype=a.dtype))
+                  for a in ins]
         op = prop.create_operator(None, [list(a.shape) for a in ins],
                                   [a.dtype for a in ins])
         op.backward(req=["write"] * len(igrads), out_grad=grads_in,

@@ -1,0 +1,17 @@
+"""``mx.rnn``: symbolic RNN cells + bucketed sequence IO.
+
+Parity surface: reference ``python/mxnet/rnn/`` (rnn_cell.py, io.py,
+rnn.py checkpoint helpers) — the toolkit behind
+``example/rnn/lstm_bucketing.py`` (BASELINE workload #3).
+"""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ResidualCell, ZoneoutCell)
+from .io import BucketSentenceIter, encode_sentences
+from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ResidualCell", "ZoneoutCell",
+           "BucketSentenceIter", "encode_sentences",
+           "save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]

@@ -376,3 +376,57 @@ def retry(n):
                     np.random.seed(np.random.randint(0, 100000))
         return wrapper
     return decorate
+
+
+def _synthetic_digits(n, rng, protos):
+    """Procedural MNIST stand-in: one shared noisy prototype per class.
+
+    The reference's get_mnist downloads the real dataset
+    (ref test_utils.py dataset helpers); this build has no egress, so tests
+    and examples fall back to a same-shape synthetic set that an MLP can
+    learn to >97%. The prototypes are shared between train and test splits.
+    """
+    labels = rng.randint(0, 10, n)
+    images = protos[labels] + rng.normal(0, 0.3, (n, 28, 28)).astype(
+        np.float32)
+    return np.clip(images, 0.0, 1.0)[:, None, :, :], labels.astype(
+        np.float32)
+
+
+def get_mnist(path="data"):
+    """MNIST arrays: real idx files under *path* if present, else synthetic.
+
+    Returns dict(train_data, train_label, test_data, test_label), images
+    NCHW float32 in [0, 1].
+    """
+    import os
+    names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    files = [os.path.join(path, n) for n in names]
+    if all(os.path.exists(f) for f in files):
+        from .io import _read_idx_file
+        tr_x = _read_idx_file(files[0]).astype(np.float32) / 255.0
+        tr_y = _read_idx_file(files[1]).astype(np.float32)
+        te_x = _read_idx_file(files[2]).astype(np.float32) / 255.0
+        te_y = _read_idx_file(files[3]).astype(np.float32)
+        return {"train_data": tr_x[:, None, :, :], "train_label": tr_y,
+                "test_data": te_x[:, None, :, :], "test_label": te_y}
+    rng = np.random.RandomState(42)
+    protos = rng.rand(10, 28, 28).astype(np.float32)
+    tr_x, tr_y = _synthetic_digits(4096, rng, protos)
+    te_x, te_y = _synthetic_digits(1024, rng, protos)
+    return {"train_data": tr_x, "train_label": tr_y,
+            "test_data": te_x, "test_label": te_y}
+
+
+def get_mnist_iterator(batch_size, flat=False, path="data"):
+    """(train_iter, val_iter) over get_mnist arrays (ref get_mnist_iterator)."""
+    from .io import NDArrayIter
+    blob = get_mnist(path)
+    tr_x, te_x = blob["train_data"], blob["test_data"]
+    if flat:
+        tr_x = tr_x.reshape(tr_x.shape[0], -1)
+        te_x = te_x.reshape(te_x.shape[0], -1)
+    train = NDArrayIter(tr_x, blob["train_label"], batch_size, shuffle=True)
+    val = NDArrayIter(te_x, blob["test_label"], batch_size)
+    return train, val

@@ -10,12 +10,12 @@ from mxnet_tpu import nd
 class _Sigmoid(mx.operator.CustomOp):
     def forward(self, is_train, req, in_data, out_data, aux):
         y = 1.0 / (1.0 + np.exp(-in_data[0].asnumpy()))
-        self.assign(out_data[0], req[0], nd.array(y))
+        self.assign(out_data[0], req[0], y)
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
         y = out_data[0].asnumpy()
         g = out_grad[0].asnumpy() * y * (1.0 - y)
-        self.assign(in_grad[0], req[0], nd.array(g))
+        self.assign(in_grad[0], req[0], g)
 
 
 @mx.operator.register("t_sigmoid")
@@ -33,14 +33,13 @@ class _NumpySoftmax(mx.operator.CustomOp):
     def forward(self, is_train, req, in_data, out_data, aux):
         x = in_data[0].asnumpy()
         e = np.exp(x - x.max(axis=1, keepdims=True))
-        self.assign(out_data[0], req[0], nd.array(e / e.sum(axis=1,
-                                                            keepdims=True)))
+        self.assign(out_data[0], req[0], e / e.sum(axis=1, keepdims=True))
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
         lbl = in_data[1].asnumpy().ravel().astype(np.int64)
         y = out_data[0].asnumpy().copy()
         y[np.arange(lbl.shape[0]), lbl] -= 1.0
-        self.assign(in_grad[0], req[0], nd.array(y))
+        self.assign(in_grad[0], req[0], y)
 
 
 @mx.operator.register("t_numpy_softmax")

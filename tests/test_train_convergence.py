@@ -1,0 +1,114 @@
+"""Small end-to-end convergence gates.
+
+Reference analogue: ``tests/python/train/`` (test_mlp.py, test_conv.py,
+test_bucketing.py) — train tiny models to an accuracy/perplexity threshold
+as integration tests (SURVEY §4 testing doctrine, tier 4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.test_utils import get_mnist_iterator
+
+
+def test_mlp_mnist_module_fit():
+    """MLP through Module.fit reaches >=97% validation accuracy
+    (ref tests/python/train/test_mlp.py threshold)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    train_iter, val_iter = get_mnist_iterator(batch_size=64, flat=True)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train_iter, num_epoch=3, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    acc = mod.score(val_iter, "acc")[0][1]
+    assert acc >= 0.97, "MLP validation accuracy %.4f < 0.97" % acc
+
+
+def test_conv_gluon_trainer():
+    """Small conv net via Gluon Trainer converges
+    (ref tests/python/train/test_conv.py)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    train_iter, val_iter = get_mnist_iterator(batch_size=64, flat=False)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, activation="relu"))
+    net.add(gluon.nn.MaxPool2D(2))
+    net.add(gluon.nn.Conv2D(16, kernel_size=3, activation="relu"))
+    net.add(gluon.nn.MaxPool2D(2))
+    net.add(gluon.nn.Flatten())
+    net.add(gluon.nn.Dense(10))
+    net.collect_params().initialize(mx.init.Xavier())
+    net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(2):
+        train_iter.reset()
+        for batch in train_iter:
+            with autograd.record():
+                out = net(batch.data[0])
+                loss = loss_fn(out, batch.label[0])
+            loss.backward()
+            trainer.step(batch.data[0].shape[0])
+
+    metric = mx.metric.Accuracy()
+    val_iter.reset()
+    for batch in val_iter:
+        metric.update([batch.label[0]], [net(batch.data[0])])
+    acc = metric.get()[1]
+    assert acc >= 0.95, "conv validation accuracy %.4f < 0.95" % acc
+
+
+def test_lstm_bucketing_convergence():
+    """BucketingModule + symbolic LSTM drives perplexity far below the
+    uniform baseline (ref tests/python/train/test_bucketing.py)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    vocab = 21
+    sents = []
+    rng = np.random.RandomState(5)
+    for _ in range(300):
+        length = rng.randint(4, 17)
+        start = rng.randint(1, vocab - 1)
+        sents.append([(start + t) % (vocab - 1) + 1 for t in range(length)])
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=16, buckets=[8, 12, 16],
+                                   invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=16,
+                                 name="embed")
+        cell = mx.rnn.LSTMCell(num_hidden=32, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 32))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, lab, use_ignore=True,
+                                    ignore_label=0, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    mod.fit(it, eval_metric=mx.metric.Perplexity(ignore_label=0),
+            num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    it.reset()
+    ppl = mod.score(it, mx.metric.Perplexity(ignore_label=0))[0][1]
+    # deterministic next-token corpus: uniform baseline is ~vocab (21)
+    assert ppl < 5.0, "perplexity %.2f not < 5.0" % ppl
