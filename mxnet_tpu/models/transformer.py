@@ -78,6 +78,18 @@ def _filter_spec(spec, mesh):
     return P(*[a if a in mesh.axis_names else None for a in spec])
 
 
+def global_put(value, sharding):
+    """Place a host value under *sharding*, working in multi-process SPMD
+    too: each process materializes only its addressable shards
+    (jax.make_array_from_callback), so the same call serves one host or a
+    jax.distributed fleet."""
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    host = np.asarray(value)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
 def init_transformer_params(key, cfg, mesh=None):
     """Initialize params; placed with TP shardings when a mesh is given."""
     specs = _param_specs(cfg)
@@ -99,7 +111,7 @@ def init_transformer_params(key, cfg, mesh=None):
             v = (jax.random.normal(sub, shape, cfg.dtype)
                  * (1.0 / math.sqrt(max(fan_in, 1))))
         if mesh is not None:
-            v = jax.device_put(v, NamedSharding(mesh, spec))
+            v = global_put(np.asarray(v), NamedSharding(mesh, spec))
         params[name] = v
     return params
 
@@ -195,4 +207,4 @@ def make_train_step(cfg, mesh, lr=0.1, seq_axis="seq"):
 def place_batch(tokens, labels, mesh, seq_axis="seq"):
     """Shard a [B, S] token batch over (data, seq)."""
     spec = NamedSharding(mesh, _filter_spec(P("data", seq_axis), mesh))
-    return (jax.device_put(tokens, spec), jax.device_put(labels, spec))
+    return global_put(tokens, spec), global_put(labels, spec)

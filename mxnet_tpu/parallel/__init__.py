@@ -16,6 +16,9 @@ distribution stack (SURVEY §2.5, §5.8):
 - ``ring_attention`` — sequence/context parallelism via ppermute rings
                     (beyond the reference, which only had bucketing;
                     SURVEY §5.7).
+- ``multihost``   — jax.distributed bring-up from the launcher env
+                    contract; replaces the dmlc tracker rendezvous
+                    (reference ``tools/launch.py:22-30``).
 """
 from .mesh import make_mesh, auto_mesh, factor_devices, current_mesh, using_mesh
 from .collectives import (psum, pmean, pmax, all_gather, reduce_scatter,
@@ -23,6 +26,8 @@ from .collectives import (psum, pmean, pmax, all_gather, reduce_scatter,
                           barrier, host_allreduce)
 from .sharded import ShardedTrainer, block_pure_fn, sharded_data
 from .ring_attention import ring_attention, local_attention
+from . import multihost
+from .multihost import init_from_env
 
 __all__ = [
     "make_mesh", "auto_mesh", "factor_devices", "current_mesh", "using_mesh",
@@ -30,4 +35,5 @@ __all__ = [
     "all_to_all", "axis_index", "axis_size", "barrier", "host_allreduce",
     "ShardedTrainer", "block_pure_fn", "sharded_data",
     "ring_attention", "local_attention",
+    "multihost", "init_from_env",
 ]

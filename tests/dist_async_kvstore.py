@@ -1,0 +1,63 @@
+"""Worker script for the dist_async kvstore test.
+
+Reference counterpart: the async mode of the dist server
+(``src/kvstore/kvstore.cc:49-51`` selects it; ``kvstore_dist_server.h``
+applies each push immediately, no per-iteration barrier). The invariant
+is eventual, not exact: after every worker pushes ``ITERS`` gradients of
++1 per element through the server-side SGD updater (lr so each push adds
++1) and a final barrier, the pulled value must equal
+``1 + nworkers * ITERS`` on every worker — asynchrony changes the order,
+never the total.
+
+Run via the local launcher:
+
+    python tools/launch.py -n 4 -s 2 python tests/dist_async_kvstore.py
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+ITERS = 5
+SHAPES = {"a": (4, 4), "big": (100, 60)}
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    assert "async" in kv.type
+    rank, nworkers = kv.rank, kv.num_workers
+
+    # server-side updater: w += -lr * grad with lr=-1 → each push of ones
+    # adds exactly +1 per element regardless of arrival order
+    opt = mx.optimizer.create("test", rescale_grad=-1.0)
+    kv.set_optimizer(opt)
+
+    for key, shape in SHAPES.items():
+        kv.init(key, mx.nd.ones(shape))
+
+    for _ in range(ITERS):
+        for key, shape in SHAPES.items():
+            kv.push(key, mx.nd.ones(shape))
+
+    # async: no implicit sync — barrier makes every push visible first
+    kv.barrier()
+
+    expected = 1.0 + nworkers * ITERS
+    for key, shape in SHAPES.items():
+        out = mx.nd.zeros(shape)
+        kv.pull(key, out=out)
+        got = out.asnumpy()
+        assert np.allclose(got, expected), \
+            "rank %d key %s: got %r expected %r" % (rank, key,
+                                                    got.ravel()[:4], expected)
+    print("dist_async rank %d/%d OK (value %.1f)"
+          % (rank, nworkers, expected))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,3 +39,25 @@ def test_launch_cli_help():
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 0
     assert "local" in out.stdout
+
+
+ASYNC_WORKER = os.path.join(REPO, "tests", "dist_async_kvstore.py")
+PJIT_WORKER = os.path.join(REPO, "tools", "dist_pjit_worker.py")
+
+
+def test_dist_async_invariants():
+    """Async PS: eventual-total invariant after barrier
+    (ref kvstore.cc:49-51 async mode)."""
+    rcs = launch(2, 1, [sys.executable, ASYNC_WORKER],
+                 env_extra=ENV, timeout=300)
+    assert rcs == [0, 0], "worker exit codes: %r" % (rcs,)
+
+
+def test_multiprocess_pjit():
+    """2 jax.distributed processes x 2 virtual devices run one SPMD pjit
+    step over the global mesh with identical losses (SURVEY §5.8)."""
+    env = dict(ENV, MX_LOCAL_DEVICES="2")
+    env.pop("JAX_PLATFORMS", None)
+    rcs = launch(2, 0, [sys.executable, PJIT_WORKER],
+                 env_extra=env, timeout=400)
+    assert rcs == [0, 0], "worker exit codes: %r" % (rcs,)

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Allreduce bandwidth + scaling microbenchmark.
+
+Reference counterpart: ``tools/bandwidth/measure.py:20-60`` — the kvstore
+push/pull bandwidth harness used to validate the >90% 8→256-device scaling
+north star (BASELINE.md). TPU-native: the measured primitive is the XLA
+``psum`` a data-parallel train step actually executes over ICI/DCN, not a
+parameter-server round trip.
+
+Two measurements, printed as one JSON line:
+
+- ``allreduce``: effective algorithm bandwidth GB/s for psum over the
+  mesh at several payload sizes (bytes * 2*(n-1)/n / time — the standard
+  ring-allreduce accounting).
+- ``scaling``: weak-scaling efficiency of a data-parallel matmul train
+  step at 1 device vs the full mesh (per-device batch held constant) —
+  the single-host estimator of the 8→256 target.
+
+Usage:
+    python tools/bandwidth.py                 # 8 virtual CPU devices
+    python tools/bandwidth.py --devices 4
+    MX_REAL_CHIP=1 python tools/bandwidth.py  # whatever jax.devices() has
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+if not os.environ.get("MX_REAL_CHIP"):
+    ap_pre = argparse.ArgumentParser(add_help=False)
+    ap_pre.add_argument("--devices", type=int, default=8)
+    pre, _ = ap_pre.parse_known_args()
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % pre.devices).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+if not os.environ.get("MX_REAL_CHIP"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _timeit(fn, warmup=2, iters=10):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_allreduce(mesh, sizes_mb=(1, 4, 16, 64)):
+    """psum over the 'x' axis at several payload sizes; returns
+    [{mb, seconds, algo_gbps}]."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    n = mesh.devices.size
+    results = []
+    for mb in sizes_mb:
+        elems = mb * (1 << 20) // 4
+        x = jnp.zeros((n, elems), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+
+        @jax.jit
+        def allreduce(v):
+            return _shard_map(
+                lambda s: jax.lax.psum(s, "x"),
+                mesh=mesh, in_specs=P("x", None), out_specs=P("x", None))(v)
+
+        def run():
+            jax.block_until_ready(allreduce(x))
+
+        sec = _timeit(run)
+        payload = elems * 4
+        algo_bytes = payload * 2 * (n - 1) / max(n, 1)
+        results.append({"mb": mb, "seconds": round(sec, 6),
+                        "algo_gbps": round(algo_bytes / sec / 1e9, 3)})
+    return results
+
+
+def bench_weak_scaling(mesh, per_device_batch=32, dim=1024, iters=10):
+    """Data-parallel matmul train step at 1 device vs the full mesh with
+    constant per-device batch; efficiency = t1 / tn (weak scaling)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    def step_time(sub_mesh):
+        n = sub_mesh.devices.size
+        w = jax.device_put(jnp.zeros((dim, dim), jnp.float32),
+                           NamedSharding(sub_mesh, P(None, None)))
+        x = jax.device_put(
+            jnp.ones((per_device_batch * n, dim), jnp.float32),
+            NamedSharding(sub_mesh, P("x", None)))
+
+        @jax.jit
+        def step(w, x):
+            def loss(w):
+                return jnp.sum(jnp.tanh(x @ w) ** 2) / x.shape[0]
+            g = jax.grad(loss)(w)
+            return w - 0.01 * g
+
+        def run():
+            jax.block_until_ready(step(w, x))
+
+        return _timeit(run, iters=iters)
+
+    devs = mesh.devices.reshape(-1)
+    one = Mesh(devs[:1].reshape(1), ("x",))
+    t1 = step_time(one)
+    tn = step_time(Mesh(devs.reshape(-1), ("x",)))
+    eff = t1 / tn if tn > 0 else float("nan")
+    return {"n_devices": int(devs.size), "t_1dev": round(t1, 6),
+            "t_ndev": round(tn, 6), "weak_scaling_eff": round(eff, 4)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--sizes-mb", type=int, nargs="+", default=[1, 4, 16])
+    args = ap.parse_args()
+
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(-1), ("x",))
+
+    report = {
+        "backend": devs[0].platform,
+        "n_devices": int(devs.size),
+        "allreduce": bench_allreduce(mesh, args.sizes_mb),
+        "scaling": bench_weak_scaling(mesh),
+        "note": ("virtual CPU mesh: numbers exercise the harness, not the "
+                 "interconnect" if devs[0].platform == "cpu" else
+                 "real accelerator mesh"),
+    }
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,13 @@ def free_port():
 
 
 def launch(num_workers, num_servers, cmd, env_extra=None, timeout=None):
-    """Spawn scheduler + servers + workers; return worker exit codes."""
+    """Spawn scheduler + servers + workers; return worker exit codes.
+
+    Besides the DMLC_* parameter-server contract, every worker also gets
+    the MXNET_* jax.distributed contract (its own coordinator port) so a
+    script may call ``mx.parallel.multihost.init_from_env()`` and run
+    multi-process pjit instead of (or alongside) the kvstore PS.
+    """
     base = dict(os.environ)
     base.update(env_extra or {})
     base.update({
@@ -36,6 +42,9 @@ def launch(num_workers, num_servers, cmd, env_extra=None, timeout=None):
         "DMLC_PS_ROOT_PORT": str(free_port()),
         "DMLC_NUM_WORKER": str(num_workers),
         "DMLC_NUM_SERVER": str(num_servers),
+        # jax.distributed rendezvous (distinct port from the PS scheduler)
+        "MXNET_COORDINATOR": "127.0.0.1:%d" % free_port(),
+        "MXNET_NUM_PROCESSES": str(num_workers),
     })
 
     procs = []
@@ -44,6 +53,7 @@ def launch(num_workers, num_servers, cmd, env_extra=None, timeout=None):
         env = dict(base, DMLC_ROLE=rol)
         if rank is not None:
             env["DMLC_WORKER_RANK"] = str(rank)
+            env["MXNET_PROCESS_ID"] = str(rank)
         return subprocess.Popen(cmd, env=env)
 
     procs.append(("scheduler", spawn("scheduler")))
