@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Toy single-scale SSD detector on synthetic images.
+
+Parity target: reference ``example/ssd`` (BASELINE workload #5) reduced to
+its skeleton: conv backbone → (cls, loc) heads over MultiBoxPrior anchors,
+trained with MultiBoxTarget and decoded with MultiBoxDetection. Synthetic
+data: each image contains one bright axis-aligned rectangle; the detector
+learns to localise it.
+
+    python examples/train_ssd_toy.py --num-epochs 4
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def synthetic_detection_set(n, img=32, rng=None):
+    """Images with one bright rectangle; labels (1, 5): [cls, x0,y0,x1,y1]."""
+    rng = rng or np.random.RandomState(11)
+    xs = rng.rand(n, 1, img, img).astype(np.float32) * 0.2
+    labels = np.zeros((n, 1, 5), np.float32)
+    for i in range(n):
+        w = rng.randint(img // 4, img // 2)
+        h = rng.randint(img // 4, img // 2)
+        x0 = rng.randint(0, img - w)
+        y0 = rng.randint(0, img - h)
+        xs[i, 0, y0:y0 + h, x0:x0 + w] += 0.8
+        labels[i, 0] = [0, x0 / img, y0 / img, (x0 + w) / img,
+                        (y0 + h) / img]
+    return xs, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    num_cls = 1                       # one foreground class
+    sizes, ratios = (0.4, 0.6), (1.0, 2.0, 0.5)
+    n_anchor = len(sizes) + len(ratios) - 1
+
+    class ToySSD(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.backbone = gluon.nn.HybridSequential(prefix="")
+                for ch in (16, 32, 32):
+                    self.backbone.add(gluon.nn.Conv2D(
+                        ch, 3, padding=1, activation="relu"))
+                    self.backbone.add(gluon.nn.MaxPool2D(2))
+                self.cls_head = gluon.nn.Conv2D(
+                    n_anchor * (num_cls + 1), 3, padding=1)
+                self.loc_head = gluon.nn.Conv2D(n_anchor * 4, 3, padding=1)
+
+        def hybrid_forward(self, F, x):
+            feat = self.backbone(x)
+            anchors = F.contrib.MultiBoxPrior(feat, sizes=sizes,
+                                              ratios=ratios)
+            # (N, A*(C+1), h, w) -> (N, C+1, A_total)
+            cls = self.cls_head(feat)
+            n = cls.shape[0]
+            cls = F.transpose(cls, axes=(0, 2, 3, 1)).reshape(
+                (n, -1, num_cls + 1))
+            cls = F.transpose(cls, axes=(0, 2, 1))
+            loc = F.transpose(self.loc_head(feat),
+                              axes=(0, 2, 3, 1)).reshape((n, -1))
+            return anchors, cls, loc
+
+    net = ToySSD()
+    net.collect_params().initialize(mx.init.Xavier())
+
+    train_x, train_y = synthetic_detection_set(256)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    cls_loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    loc_loss_fn = gluon.loss.HuberLoss()
+
+    bs = args.batch_size
+    for epoch in range(args.num_epochs):
+        total = 0.0
+        for i in range(0, len(train_x), bs):
+            x = nd.array(train_x[i:i + bs])
+            y = nd.array(train_y[i:i + bs])
+            with autograd.record():
+                anchors, cls_preds, loc_preds = net(x)
+                loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+                    anchors, y, cls_preds, overlap_threshold=0.5)
+                cls_l = cls_loss_fn(cls_preds, cls_t)
+                loc_l = loc_loss_fn(loc_preds * loc_m, loc_t)
+                loss = cls_l + loc_l
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.mean().asnumpy())
+        logging.info("epoch %d loss %.4f", epoch, total / (len(train_x) / bs))
+
+    # ---- evaluate mean IoU of the top detection ----
+    val_x, val_y = synthetic_detection_set(64, rng=np.random.RandomState(99))
+    anchors, cls_preds, loc_preds = net(nd.array(val_x))
+    probs = nd.softmax(cls_preds, axis=1)
+    dets = nd.contrib.MultiBoxDetection(probs, loc_preds, anchors,
+                                        threshold=0.01,
+                                        nms_threshold=0.45).asnumpy()
+    ious = []
+    for det, lab in zip(dets, val_y):
+        valid = det[det[:, 0] >= 0]
+        if not len(valid):
+            ious.append(0.0)
+            continue
+        best = valid[np.argmax(valid[:, 1])]
+        bx, gt = best[2:6], lab[0, 1:5]
+        ix0, iy0 = max(bx[0], gt[0]), max(bx[1], gt[1])
+        ix1, iy1 = min(bx[2], gt[2]), min(bx[3], gt[3])
+        inter = max(ix1 - ix0, 0) * max(iy1 - iy0, 0)
+        union = ((bx[2] - bx[0]) * (bx[3] - bx[1])
+                 + (gt[2] - gt[0]) * (gt[3] - gt[1]) - inter)
+        ious.append(inter / union if union > 0 else 0.0)
+    miou = float(np.mean(ious))
+    print("mean IoU of top detection: %.3f" % miou)
+    return miou
+
+
+if __name__ == "__main__":
+    main()

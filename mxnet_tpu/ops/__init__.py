@@ -8,3 +8,4 @@ from . import random_ops  # noqa: F401
 from . import optim_ops  # noqa: F401
 from . import contrib  # noqa: F401
 from . import custom  # noqa: F401
+from . import ssd  # noqa: F401

@@ -1,0 +1,293 @@
+"""SSD detection ops: MultiBoxPrior / MultiBoxTarget / MultiBoxDetection.
+
+Reference analogue: ``src/operator/contrib/multibox_prior{-inl.h,.cc}``,
+``multibox_target-inl.h``, ``multibox_detection-inl.h`` — the op trio
+behind ``example/ssd`` (BASELINE workload #5).
+
+TPU-first redesign: the reference kernels are per-anchor scalar loops with
+data-dependent control flow; here everything is fixed-shape vectorised
+jax — IoU matrices, argmax matching, and mask arithmetic — so the whole
+detector head jits into one XLA program. NMS and bipartite matching use
+``lax`` loops with static trip counts.
+
+Boxes are corner-format (xmin, ymin, xmax, ymax), normalised to [0, 1].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _as_floats(v):
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", nondiff_inputs=(0,))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Anchor boxes for every feature-map cell (ref multibox_prior-inl.h).
+
+    data: (N, C, H, W). Output: (1, H*W*A, 4) with
+    A = len(sizes) + len(ratios) - 1 — sizes[k] each paired with
+    ratios[0], plus sizes[0] with every extra ratio.
+    """
+    sizes = _as_floats(sizes)
+    ratios = _as_floats(ratios)
+    h, w = data.shape[2], data.shape[3]
+    step_y = float(steps[1]) if steps[1] > 0 else 1.0 / h
+    step_x = float(steps[0]) if steps[0] > 0 else 1.0 / w
+
+    cy = (jnp.arange(h, dtype=jnp.float32) + float(offsets[1])) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + float(offsets[0])) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")        # (H, W)
+
+    # per-anchor half extents
+    half_w, half_h = [], []
+    r0 = jnp.sqrt(jnp.float32(ratios[0]))
+    for s in sizes:
+        half_w.append(s * r0 / 2.0)
+        half_h.append(s / r0 / 2.0)
+    for r in ratios[1:]:
+        rs = jnp.sqrt(jnp.float32(r))
+        half_w.append(sizes[0] * rs / 2.0)
+        half_h.append(sizes[0] / rs / 2.0)
+    half_w = jnp.stack([jnp.asarray(v, jnp.float32) for v in half_w])   # (A,)
+    half_h = jnp.stack([jnp.asarray(v, jnp.float32) for v in half_h])
+
+    boxes = jnp.stack([
+        cx[..., None] - half_w, cy[..., None] - half_h,
+        cx[..., None] + half_w, cy[..., None] + half_h], axis=-1)
+    boxes = boxes.reshape(1, h * w * half_w.shape[0], 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Shared geometry
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(anchors, gt_boxes):
+    """IoU between (A, 4) anchors and (G, 4) boxes → (A, G)."""
+    ax0, ay0, ax1, ay1 = jnp.split(anchors, 4, axis=-1)      # (A, 1)
+    gx0, gy0, gx1, gy1 = [g[None, :, 0] for g in
+                          jnp.split(gt_boxes, 4, axis=-1)]   # (1, G)
+    ix0 = jnp.maximum(ax0, gx0)
+    iy0 = jnp.maximum(ay0, gy0)
+    ix1 = jnp.minimum(ax1, gx1)
+    iy1 = jnp.minimum(ay1, gy1)
+    inter = jnp.clip(ix1 - ix0, 0) * jnp.clip(iy1 - iy0, 0)
+    area_a = jnp.clip(ax1 - ax0, 0) * jnp.clip(ay1 - ay0, 0)
+    area_g = jnp.clip(gx1 - gx0, 0) * jnp.clip(gy1 - gy0, 0)
+    union = area_a + area_g - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_offsets(anchors, matched_gt, variances):
+    """Corner boxes → (dx, dy, dw, dh) regression targets."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = matched_gt[:, 2] - matched_gt[:, 0]
+    gh = matched_gt[:, 3] - matched_gt[:, 1]
+    gcx = (matched_gt[:, 0] + matched_gt[:, 2]) / 2
+    gcy = (matched_gt[:, 1] + matched_gt[:, 3]) / 2
+    eps = 1e-8
+    dx = (gcx - acx) / jnp.maximum(aw, eps) / variances[0]
+    dy = (gcy - acy) / jnp.maximum(ah, eps) / variances[1]
+    dw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) / variances[2]
+    dh = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) / variances[3]
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+def _decode_offsets(anchors, deltas, variances):
+    """Inverse of :func:`_encode_offsets` → corner boxes."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = deltas[:, 0] * variances[0] * aw + acx
+    cy = deltas[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(deltas[:, 2] * variances[2]) * aw
+    h = jnp.exp(deltas[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+
+def _match_anchors(ious, valid_gt, overlap_threshold):
+    """SSD matching: every valid gt claims its best anchor (bipartite,
+    greedy by IoU), then anchors with IoU >= threshold join in.
+
+    Returns (match: (A,) int32 gt index or -1, matched_iou: (A,))."""
+    n_anchor, n_gt = ious.shape
+    ious = jnp.where(valid_gt[None, :], ious, -1.0)
+
+    # stage 2 first: threshold matches to each anchor's best gt
+    best_gt = jnp.argmax(ious, axis=1)
+    best_iou = jnp.take_along_axis(ious, best_gt[:, None], axis=1)[:, 0]
+    match = jnp.where(best_iou >= overlap_threshold, best_gt, -1)
+
+    # stage 1 overrides: greedy bipartite — iterate gts, each claims the
+    # globally-best unclaimed anchor (static trip count = n_gt)
+    def claim(carry, _):
+        match, pool = carry
+        flat = jnp.argmax(pool)
+        a_idx, g_idx = flat // n_gt, flat % n_gt
+        good = pool[a_idx, g_idx] > 0
+        match = jnp.where(good, match.at[a_idx].set(g_idx), match)
+        pool = jnp.where(good,
+                         pool.at[a_idx, :].set(-1.0).at[:, g_idx].set(-1.0),
+                         pool)
+        return (match, pool), None
+
+    (match, _), _ = lax.scan(claim, (match, ious), None, length=n_gt)
+    matched_iou = jnp.where(match >= 0,
+                            ious[jnp.arange(n_anchor),
+                                 jnp.clip(match, 0, n_gt - 1)], 0.0)
+    return match, matched_iou
+
+
+def _target_one(anchors, label, cls_pred_t, overlap_threshold, ignore_label,
+                negative_mining_ratio, negative_mining_thresh, variances):
+    """Targets for one sample. label: (G, 5) [cls, x0, y0, x1, y1],
+    cls < 0 marks padding rows."""
+    gt_cls = label[:, 0]
+    gt_boxes = label[:, 1:5]
+    valid = gt_cls >= 0
+
+    ious = _iou_matrix(anchors, gt_boxes)
+    match, _ = _match_anchors(ious, valid, overlap_threshold)
+    is_fg = match >= 0
+    safe_match = jnp.clip(match, 0, label.shape[0] - 1)
+
+    cls_target = jnp.where(is_fg, gt_cls[safe_match] + 1.0, 0.0)
+    loc = _encode_offsets(anchors, gt_boxes[safe_match], variances)
+    loc_target = jnp.where(is_fg[:, None], loc, 0.0).reshape(-1)
+    loc_mask = jnp.where(is_fg[:, None],
+                         jnp.ones_like(loc), 0.0).reshape(-1)
+
+    if negative_mining_ratio > 0:
+        # hard negative mining by background confidence deficit
+        # cls_pred_t: (num_classes+1, A) scores; negatives where max
+        # non-background prob is high are "hard"
+        bg_scores = cls_pred_t[0]
+        neg_mask = ~is_fg
+        hardness = jnp.where(neg_mask, -bg_scores, -jnp.inf)
+        n_fg = jnp.sum(is_fg)
+        quota = jnp.maximum((negative_mining_ratio * n_fg).astype(jnp.int32),
+                            1)
+        order = jnp.argsort(-hardness)
+        rank = jnp.zeros_like(order).at[order].set(
+            jnp.arange(order.shape[0]))
+        keep_neg = neg_mask & (rank < quota)
+        cls_target = jnp.where(is_fg, cls_target,
+                               jnp.where(keep_neg, 0.0,
+                                         float(ignore_label)))
+    return loc_target, loc_mask, cls_target
+
+
+@register("_contrib_MultiBoxTarget", num_outputs=3,
+          nondiff_inputs=(0, 1, 2))
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5,
+                     minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """Anchor-to-ground-truth matching (ref multibox_target-inl.h).
+
+    anchor (1, A, 4); label (N, G, 5); cls_pred (N, num_cls+1, A).
+    Outputs: loc_target (N, 4A), loc_mask (N, 4A), cls_target (N, A).
+    """
+    variances = _as_floats(variances)
+    anchors = anchor.reshape(-1, 4)
+
+    fn = lambda lbl, cp: _target_one(
+        anchors, lbl, cp, float(overlap_threshold), float(ignore_label),
+        float(negative_mining_ratio), float(negative_mining_thresh),
+        variances)
+    loc_t, loc_m, cls_t = jax.vmap(fn)(label, cls_pred)
+    return (loc_t.astype(anchor.dtype), loc_m.astype(anchor.dtype),
+            cls_t.astype(anchor.dtype))
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+# ---------------------------------------------------------------------------
+
+def _nms_one(dets, nms_threshold, force_suppress, topk):
+    """Greedy NMS over (A, 6) [cls, score, x0, y0, x1, y1]; suppressed
+    rows get cls = -1. Static trip count = topk."""
+    n = dets.shape[0]
+    order = jnp.argsort(-dets[:, 1])
+    dets = dets[order]
+    boxes = dets[:, 2:6]
+    cls = dets[:, 0]
+    alive = cls >= 0
+
+    def body(i, alive):
+        keep_i = alive[i]
+        ious = _iou_matrix(boxes[i][None, :], boxes)[0]      # (A,)
+        same_cls = (cls == cls[i]) | bool(force_suppress)
+        kill = (ious > nms_threshold) & same_cls & \
+            (jnp.arange(n) > i) & keep_i
+        return alive & ~kill
+
+    alive = lax.fori_loop(0, min(topk, n) if topk > 0 else n, body, alive)
+    out = jnp.where(alive[:, None], dets,
+                    dets.at[:, 0].set(-1.0)[:, :])
+    out = out.at[:, 0].set(jnp.where(alive, dets[:, 0], -1.0))
+    return out
+
+
+def _detect_one(cls_prob_t, loc_pred, anchors, threshold, background_id,
+                nms_threshold, force_suppress, variances, nms_topk, clip):
+    """One sample: cls_prob_t (num_cls+1, A), loc_pred (4A,)."""
+    boxes = _decode_offsets(anchors, loc_pred.reshape(-1, 4), variances)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    scores = cls_prob_t                                   # (C+1, A)
+    # best non-background class per anchor
+    masked = scores.at[background_id].set(-jnp.inf)
+    best_cls = jnp.argmax(masked, axis=0)                 # (A,)
+    best_score = jnp.max(masked, axis=0)
+    keep = best_score > threshold
+    cls_id = jnp.where(keep, best_cls.astype(jnp.float32) - 1.0, -1.0)
+    score = jnp.where(keep, best_score, 0.0)
+    dets = jnp.concatenate([cls_id[:, None], score[:, None], boxes], axis=1)
+    return _nms_one(dets, nms_threshold, force_suppress,
+                    nms_topk if nms_topk > 0 else dets.shape[0])
+
+
+@register("_contrib_MultiBoxDetection", nondiff_inputs=(0, 1, 2))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):
+    """Decode + per-class NMS (ref multibox_detection-inl.h).
+
+    cls_prob (N, C+1, A); loc_pred (N, 4A); anchor (1, A, 4).
+    Output (N, A, 6): [class_id, score, x0, y0, x1, y1], -1 class = void.
+    """
+    variances = _as_floats(variances)
+    anchors = anchor.reshape(-1, 4)
+    fn = lambda cp, lp: _detect_one(
+        cp, lp, anchors, float(threshold), int(background_id),
+        float(nms_threshold), bool(force_suppress), variances,
+        int(nms_topk), bool(clip))
+    return jax.vmap(fn)(cls_prob, loc_pred).astype(cls_prob.dtype)

@@ -1,0 +1,97 @@
+"""SSD contrib op tests (reference src/operator/contrib/multibox_*)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_prior_shapes_and_geometry():
+    x = nd.zeros((1, 3, 2, 2))
+    out = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    # A = len(sizes) + len(ratios) - 1 = 3
+    assert out.shape == (1, 2 * 2 * 3, 4)
+    boxes = out.asnumpy()[0]
+    # first anchor: center (0.25, 0.25), size 0.5, ratio 1 -> half 0.25
+    np.testing.assert_allclose(boxes[0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # second anchor: size 0.25 -> half 0.125
+    np.testing.assert_allclose(boxes[1], [0.125, 0.125, 0.375, 0.375],
+                               atol=1e-6)
+    # ratio-2 anchor: w = s*sqrt(2), h = s/sqrt(2)
+    w = boxes[2][2] - boxes[2][0]
+    h = boxes[2][3] - boxes[2][1]
+    np.testing.assert_allclose(w / h, 2.0, rtol=1e-5)
+
+
+def test_multibox_prior_clip():
+    x = nd.zeros((1, 3, 1, 1))
+    out = nd.contrib.MultiBoxPrior(x, sizes=(1.5,), clip=True).asnumpy()
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+def _toy_setup():
+    # two anchors: one matching the gt box well, one far away
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.6, 0.6, 0.9, 0.9],
+                         [0.0, 0.0, 0.05, 0.05]]], np.float32)
+    # one gt: class 0 box overlapping anchor 0
+    label = np.array([[[0.0, 0.1, 0.1, 0.45, 0.5],
+                       [-1.0, 0.0, 0.0, 0.0, 0.0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)  # (N, C+1, A)
+    return nd.array(anchors), nd.array(label), nd.array(cls_pred)
+
+
+def test_multibox_target_matching():
+    anchor, label, cls_pred = _toy_setup()
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchor, label, cls_pred, overlap_threshold=0.5)
+    cls_np = cls_t.asnumpy()[0]
+    assert cls_np[0] == 1.0          # matched -> class 0 + 1
+    assert cls_np[1] == 0.0          # background
+    assert cls_np[2] == 0.0
+    mask = loc_m.asnumpy()[0].reshape(3, 4)
+    assert mask[0].sum() == 4 and mask[1].sum() == 0
+
+
+def test_multibox_target_encode_decode_roundtrip():
+    from mxnet_tpu.ops.ssd import _encode_offsets, _decode_offsets
+    import jax.numpy as jnp
+    anchors = jnp.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.8, 0.9]])
+    gt = jnp.array([[0.15, 0.12, 0.55, 0.48], [0.25, 0.35, 0.75, 0.85]])
+    var = (0.1, 0.1, 0.2, 0.2)
+    dec = _decode_offsets(anchors, _encode_offsets(anchors, gt, var), var)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(gt), atol=1e-5)
+
+
+def test_multibox_detection_end_to_end():
+    anchor, label, cls_pred = _toy_setup()
+    # class probs: anchor 0 confident class-1 (fg idx 1), others background
+    probs = np.array([[[0.05, 0.9, 0.9],    # background row
+                       [0.9, 0.05, 0.05],   # class 0 row
+                       [0.05, 0.05, 0.05]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)   # zero offsets -> anchors
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(probs), nd.array(loc_pred), anchor,
+        threshold=0.1, nms_threshold=0.5)
+    dets = out.asnumpy()[0]
+    # one valid detection: class 0, score 0.9, box == anchor 0
+    valid = dets[dets[:, 0] >= 0]
+    assert valid.shape[0] == 1
+    np.testing.assert_allclose(valid[0, :2], [0.0, 0.9], atol=1e-5)
+    np.testing.assert_allclose(valid[0, 2:], [0.1, 0.1, 0.5, 0.5], atol=1e-5)
+
+
+def test_multibox_detection_nms_suppression():
+    # two overlapping confident anchors, same class -> NMS keeps one
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.12, 0.52, 0.52]]], np.float32)
+    probs = np.array([[[0.1, 0.2],
+                       [0.9, 0.8]]], np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(probs), nd.array(loc_pred), nd.array(anchors),
+        threshold=0.1, nms_threshold=0.5)
+    dets = out.asnumpy()[0]
+    valid = dets[dets[:, 0] >= 0]
+    assert valid.shape[0] == 1
+    assert abs(valid[0, 1] - 0.9) < 1e-5
