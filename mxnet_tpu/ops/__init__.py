@@ -9,3 +9,4 @@ from . import optim_ops  # noqa: F401
 from . import contrib  # noqa: F401
 from . import custom  # noqa: F401
 from . import ssd  # noqa: F401
+from . import rcnn  # noqa: F401

@@ -1,0 +1,104 @@
+"""RCNN contrib op tests (reference src/operator/contrib/proposal*,
+psroi_pooling, deformable_convolution)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_proposal_shapes_and_validity():
+    np.random.seed(0)
+    N, A, H, W = 2, 3, 4, 4
+    cls = nd.array(np.random.rand(N, 2 * A, H, W).astype("float32"))
+    bbox = nd.array((np.random.randn(N, 4 * A, H, W) * 0.1)
+                    .astype("float32"))
+    info = nd.array(np.array([[64, 64, 1.0], [64, 64, 1.0]], "float32"))
+    rois = nd.contrib.Proposal(cls, bbox, info, rpn_pre_nms_top_n=20,
+                               rpn_post_nms_top_n=6, feature_stride=16,
+                               scales=(8,), ratios=(0.5, 1, 2))
+    assert rois.shape == (N * 6, 5)
+    r = rois.asnumpy()
+    # batch indices: first 6 rows sample 0, next 6 sample 1
+    assert set(r[:6, 0]) <= {0.0}
+    assert set(r[6:, 0]) <= {1.0}
+    # boxes clipped into the image
+    assert r[:, 1:].min() >= 0.0 and r[:, 1:].max() <= 63.0
+
+
+def test_multi_proposal_matches_proposal():
+    np.random.seed(1)
+    cls = nd.array(np.random.rand(1, 6, 3, 3).astype("float32"))
+    bbox = nd.array((np.random.randn(1, 12, 3, 3) * 0.05).astype("float32"))
+    info = nd.array(np.array([[48, 48, 1.0]], "float32"))
+    kw = dict(rpn_pre_nms_top_n=10, rpn_post_nms_top_n=4,
+              feature_stride=16, scales=(8,), ratios=(0.5, 1, 2))
+    a = nd.contrib.Proposal(cls, bbox, info, **kw).asnumpy()
+    b = nd.contrib.MultiProposal(cls, bbox, info, **kw).asnumpy()
+    np.testing.assert_allclose(a, b)
+
+
+def test_psroi_pooling_position_sensitivity():
+    """Each output bin reads its own channel group: uniform per-channel
+    planes make the expected output exactly the channel index pattern."""
+    D, g = 1, 2
+    C = D * g * g
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c + 1                      # constant plane per channel
+    rois = np.array([[0, 0, 0, 31, 31]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=0.25, output_dim=D,
+                                  pooled_size=2, group_size=g)
+    got = out.asnumpy()[0, 0]
+    # bin (i, j) reads channel i*g + j -> values [[1, 2], [3, 4]]
+    np.testing.assert_allclose(got, [[1.0, 2.0], [3.0, 4.0]], atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    from mxnet_tpu.ops.rcnn import _deform_conv_one
+    np.random.seed(2)
+    img = jnp.asarray(np.random.rand(3, 6, 6), jnp.float32)
+    wgt = jnp.asarray(np.random.rand(4, 3, 3, 3), jnp.float32)
+    offs = jnp.zeros((2 * 1 * 3 * 3, 4, 4), jnp.float32)
+    out = _deform_conv_one(img, offs, wgt, None, (3, 3), (1, 1), (0, 0),
+                           (1, 1), 1)
+    ref = lax.conv_general_dilated(img[None], wgt, (1, 1), "VALID")[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_deformable_conv_op_with_shift():
+    """Integer offset (0, 1) must equal convolving the x-shifted image."""
+    from mxnet_tpu.ops.rcnn import _deform_conv_one
+    np.random.seed(3)
+    img_np = np.random.rand(1, 7, 7).astype(np.float32)
+    img = jnp.asarray(img_np)
+    wgt = jnp.asarray(np.random.rand(2, 1, 3, 3), jnp.float32)
+    offs = np.zeros((2 * 9, 5, 5), np.float32)
+    offs[1::2] = 1.0                           # dx = +1 everywhere
+    out = _deform_conv_one(img, jnp.asarray(offs), wgt, None, (3, 3),
+                           (1, 1), (0, 0), (1, 1), 1)
+    shifted = np.zeros_like(img_np)
+    shifted[:, :, :-1] = img_np[:, :, 1:]
+    ref = lax.conv_general_dilated(jnp.asarray(shifted)[None], wgt,
+                                   (1, 1), "VALID")[0]
+    # interior columns agree exactly (border sees clamp-vs-zero padding)
+    np.testing.assert_allclose(np.asarray(out)[:, :, :-1],
+                               np.asarray(ref)[:, :, :-1], atol=1e-4)
+
+
+def test_deformable_psroi_no_trans_matches_psroi():
+    np.random.seed(4)
+    D, g = 2, 2
+    data = np.random.rand(1, D * g * g, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 28, 28]], np.float32)
+    a = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                spatial_scale=0.25, output_dim=D,
+                                pooled_size=2, group_size=g).asnumpy()
+    b = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=0.25, output_dim=D,
+        pooled_size=2, group_size=g, no_trans=True).asnumpy()
+    np.testing.assert_allclose(a, b, atol=1e-6)
