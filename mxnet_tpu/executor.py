@@ -160,10 +160,9 @@ class Executor:
                 raise MXNetError("unknown argument %s" % k)
             src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
             self.arg_dict[k]._set_data(src.astype(self.arg_dict[k].dtype))
-        dev = self._ctx.jax_device
-        arg_vals = [self._pin(self.arg_dict[n], dev) for n in self.arg_names]
-        aux_vals = [self._pin(self.aux_dict[n], dev) for n in self.aux_names]
-        rng = _random.next_key()
+        arg_vals = [self._place(n, self.arg_dict[n]) for n in self.arg_names]
+        aux_vals = [self._place(n, self.aux_dict[n]) for n in self.aux_names]
+        rng = self._place_rng(_random.next_key())
 
         if self._monitor is not None and \
                 getattr(self._monitor, "is_active", lambda: True)():
@@ -204,11 +203,16 @@ class Executor:
         self._outputs = [_wrap(o, self._ctx) for o in outs]
         return self._outputs
 
-    @staticmethod
-    def _pin(arr, dev):
+    def _place_rng(self, key):
+        """Hook: sharded executors re-place the PRNG key on their mesh."""
+        return key
+
+    def _place(self, name, arr):
         """Ensure the buffer is committed to this executor's device (cross-
         device inputs arrive when the user loads data on another context —
-        reference engine would insert a CrossDeviceCopy node)."""
+        reference engine would insert a CrossDeviceCopy node). Sharded
+        executors override this per-name to spread batches over a mesh."""
+        dev = self._ctx.jax_device
         data = arr._data
         arr_dev = getattr(data, "devices", lambda: {None})()
         if arr_dev != {dev}:

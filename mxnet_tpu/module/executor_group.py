@@ -40,6 +40,7 @@ class DataParallelExecutorGroup:
                  grad_req="write", state_names=None):
         self.symbol = symbol
         self.contexts = contexts
+        self.num_device = len(contexts)
         self.workload = workload or [1] * len(contexts)
         self.param_names = param_names
         self.for_training = for_training

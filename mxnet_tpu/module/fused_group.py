@@ -1,0 +1,173 @@
+"""FusedExecutorGroup: multi-device Module as ONE SPMD program.
+
+The reference's DataParallelExecutorGroup runs one executor per device and
+reduces gradients through the kvstore afterwards
+(``module/executor_group.py:233-430`` + ``comm.h``). TPU-native fast path:
+bind a single executor whose data/label inputs are sharded over a
+``Mesh(ctx_list, ("data",))`` and whose parameters are replicated — the
+XLA SPMD partitioner splits the forward across devices and inserts the
+gradient all-reduce itself, so forward+backward is one fused program and
+the kvstore reduce disappears (there is one logical gradient already
+summed over the global batch).
+
+Numerics match the slow path exactly for stateless graphs: the fused
+gradient equals the sum of per-device slice gradients the kvstore would
+have produced. BatchNorm differs *by design*: the fused program computes
+global (synchronised) batch statistics where per-device executors use
+local slices — sync-BN semantics.
+
+Enabled automatically for multi-device Module binds; opt out with
+``MXNET_MODULE_FUSED=0``.
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..executor import Executor
+from .. import ndarray as nd
+
+__all__ = ["FusedExecutorGroup", "fused_enabled"]
+
+
+def fused_enabled():
+    import os
+    return os.environ.get("MXNET_MODULE_FUSED", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+class _ShardedExecutor(Executor):
+    """Executor whose inputs spread over a data-parallel mesh."""
+
+    def __init__(self, symbol, ctx, mesh, batch_arg_names, **kwargs):
+        self._mesh = mesh
+        self._batch_args = set(batch_arg_names)
+        self._data_sharding = NamedSharding(mesh, P("data"))
+        self._replicated = NamedSharding(mesh, P())
+        super().__init__(symbol, ctx, **kwargs)
+
+    def _place(self, name, arr):
+        sharding = self._data_sharding if name in self._batch_args \
+            else self._replicated
+        data = arr._data
+        if getattr(data, "sharding", None) != sharding:
+            data = jax.device_put(data, sharding)
+            arr._set_data(data)
+        return data
+
+    def _place_rng(self, key):
+        return jax.device_put(key, self._replicated)
+
+
+class FusedExecutorGroup(object):
+    """Drop-in executor-group with the DataParallelExecutorGroup surface,
+    backed by one sharded executor (``num_device`` is 1: there is a single
+    logical parameter/gradient copy)."""
+
+    num_device = 1
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.param_names = list(param_names)
+        self.batch_size = data_shapes[0].shape[0]
+        if self.batch_size % len(contexts):
+            raise ValueError(
+                "fused group: batch size %d not divisible by %d devices"
+                % (self.batch_size, len(contexts)))
+        self._contexts = contexts
+        devices = np.array([c.jax_device for c in contexts])
+        self._mesh = Mesh(devices, ("data",))
+
+        fixed = set(fixed_param_names or [])
+        batch_args = [d.name for d in data_shapes] + \
+            [d.name for d in (label_shapes or [])]
+        self._label_names = [d.name for d in (label_shapes or [])]
+
+        arg_dict, grad_dict = {}, {}
+        shapes = {d.name: d.shape for d in data_shapes}
+        shapes.update({d.name: d.shape for d in (label_shapes or [])})
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        for name, shape in zip(arg_names, arg_shapes):
+            arg_dict[name] = nd.zeros(shape, ctx=contexts[0])
+            wants_grad = (for_training and name in self.param_names
+                          and name not in fixed)
+            if name in batch_args:
+                wants_grad = for_training and inputs_need_grad
+            if wants_grad and grad_req != "null":
+                grad_dict[name] = nd.zeros(shape, ctx=contexts[0])
+        aux_dict = {name: nd.zeros(shape, ctx=contexts[0])
+                    for name, shape in zip(aux_names, aux_shapes)}
+
+        req = {n: ("write" if n in grad_dict else "null")
+               for n in arg_names}
+        self._exec = _ShardedExecutor(
+            symbol, contexts[0], self._mesh, batch_args,
+            arg_dict=arg_dict, grad_dict=grad_dict, grad_req=req,
+            aux_dict=aux_dict)
+        self.execs = [self._exec]
+        self._inputs_need_grad = inputs_need_grad
+        self._data_names = [d.name for d in data_shapes]
+
+        # one logical copy per param: the interface's per-device lists
+        # degenerate to singletons
+        self.param_arrays = [[arg_dict[n]] for n in self.param_names
+                             if n in arg_dict]
+        self.grad_arrays = [[grad_dict[n]] if n in grad_dict else [None]
+                            for n in self.param_names]
+
+    # ---- parameter movement ----
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for name, arr in (arg_params or {}).items():
+            if name in self._exec.arg_dict:
+                arr.copyto(self._exec.arg_dict[name])
+            elif not allow_extra:
+                raise ValueError("unknown parameter %s" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self._exec.aux_dict:
+                arr.copyto(self._exec.aux_dict[name])
+            elif not allow_extra:
+                raise ValueError("unknown aux state %s" % name)
+
+    def get_params(self, arg_params, aux_params):
+        for name, dst in arg_params.items():
+            if name in self._exec.arg_dict:
+                self._exec.arg_dict[name].copyto(dst)
+        for name, dst in aux_params.items():
+            if name in self._exec.aux_dict:
+                self._exec.aux_dict[name].copyto(dst)
+
+    # ---- computation ----
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self._exec.grad_req and any(
+                r != "null" for r in self._exec.grad_req.values())
+        feed = dict(zip(self._data_names, data_batch.data))
+        if data_batch.label:
+            feed.update(zip(self._label_names, data_batch.label))
+        self._exec.forward(is_train=bool(is_train), **feed)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads=out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        outs = self._exec.outputs
+        return outs if merge_multi_context else [[o] for o in outs]
+
+    def get_input_grads(self, merge_multi_context=True):
+        grads = [self._exec.grad_dict.get(n) for n in self._data_names]
+        return grads if merge_multi_context else [[g] for g in grads]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self._exec.outputs)
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)

@@ -254,7 +254,16 @@ class Module(BaseModule):
 
     def _make_exec_group(self, for_training, inputs_need_grad,
                          grad_req="write"):
-        return DataParallelExecutorGroup(
+        group_cls = DataParallelExecutorGroup
+        if len(self._context) > 1:
+            from .fused_group import FusedExecutorGroup, fused_enabled
+            same_kind = len({c.device_type for c in self._context}) == 1
+            batch = self._data_shapes[0].shape[0]
+            if fused_enabled() and same_kind                     and batch % len(self._context) == 0:
+                # one SPMD program over a device mesh instead of per-device
+                # executors + kvstore reduce (the TPU-native fast path)
+                group_cls = FusedExecutorGroup
+        return group_cls(
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group=None,
@@ -284,8 +293,12 @@ class Module(BaseModule):
         if self._params_dirty:
             self._sync_params_from_devices()
 
+        # the fused SPMD group holds ONE logical param/grad copy: the
+        # gradient is already globally reduced inside the XLA program, so
+        # a single-device kvstore decision applies
+        n_dev = getattr(self._exec_group, "num_device", len(self._context))
         kvstore, update_on_kvstore = _create_kvstore(
-            kvstore, len(self._context), self._arg_params)
+            kvstore, n_dev, self._arg_params)
 
         effective_batch = self._exec_group.batch_size
         if kvstore and "dist" in kvstore.type and "_async" in kvstore.type:
@@ -321,7 +334,7 @@ class Module(BaseModule):
                          rescale_grad):
         """Instantiate a named optimizer with the per-slot name mapping the
         Updater uses for lr/wd multipliers."""
-        n_dev = len(self._context)
+        n_dev = getattr(self._exec_group, "num_device", len(self._context))
         idx2name = {}
         for i, pname in enumerate(self._exec_group.param_names):
             if update_on_kvstore:
@@ -382,7 +395,8 @@ class Module(BaseModule):
         else:
             _update_params(group.param_arrays, group.grad_arrays,
                            updater=self._updater, kvstore=self._kvstore,
-                           num_device=len(self._context),
+                           num_device=getattr(group, "num_device",
+                                              len(self._context)),
                            param_names=group.param_names)
 
     def get_outputs(self, merge_multi_context=True):

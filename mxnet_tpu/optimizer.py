@@ -67,8 +67,11 @@ def _state_writeback(state, new_raw):
 
 
 def _zeros_like_nd(weight, dtype=None):
-    return nd.zeros(weight.shape, ctx=weight.context,
-                    dtype=dtype or weight.dtype)
+    """Zeros shaped (and *sharded*) like the weight: states must live on
+    the same device/mesh placement or eager updates mix devices."""
+    from .ndarray.ndarray import _wrap
+    data = jnp.zeros_like(weight._data, dtype=dtype or weight.dtype)
+    return _wrap(data, weight.context)
 
 
 class Optimizer:

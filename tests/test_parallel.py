@@ -2,6 +2,7 @@
 xla_force_host_platform_device_count=8; SURVEY §4 doctrine: multi-device
 paths exercised without accelerator hardware)."""
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -196,3 +197,70 @@ def test_sharded_adam_bias_correction_not_frozen():
                                 sorted(net2.collect_params().items())):
         assert np.allclose(pa.data().asnumpy(), pb.data().asnumpy(),
                            atol=1e-4)
+
+
+@pytest.mark.parametrize("opt_name,opt_kw,tol", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}, 1e-5),
+    # adam divides by sqrt(v)+eps with v ~ 0 early on, amplifying
+    # fusion-order float32 rounding; tolerance reflects that
+    ("adam", {"learning_rate": 0.01}, 3e-4),
+])
+def test_sharded_optimizer_matches_eager(opt_name, opt_kw, tol):
+    """ShardedTrainer and the eager Updater run the SAME pure
+    update_step core: after identical steps the parameters agree
+    (VERDICT r2 task 10 'done' criterion)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.parallel.sharded import ShardedTrainer
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(16, 6).astype(np.float32)
+    Y = np.random.randint(0, 3, 16).astype(np.float32)
+
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8, activation="tanh"))
+        net.add(gluon.nn.Dense(3))
+        net.collect_params().initialize(mx.init.Xavier(), force_reinit=True)
+        net(nd.array(X))        # materialise deferred shapes
+        return net
+
+    np.random.seed(7)               # initializers draw from numpy RNG
+    net_eager = build()
+    np.random.seed(7)
+    net_sharded = build()
+    for (n1, p1), (n2, p2) in zip(
+            sorted(net_eager.collect_params().items()),
+            sorted(net_sharded.collect_params().items())):
+        np.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy())
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # eager path: gluon Trainer (Updater -> optimizer.update -> update_step)
+    trainer = gluon.Trainer(net_eager.collect_params(), opt_name,
+                            dict(opt_kw), kvstore=None)
+    for _ in range(3):
+        with autograd.record():
+            loss = loss_fn(net_eager(nd.array(X)), nd.array(Y))
+        loss.backward()
+        trainer.step(16)
+
+    # sharded path: one jitted step over the (single-device) mesh.
+    # ShardedTrainer's loss is already a batch MEAN (the eager Trainer
+    # divides the summed grad by batch_size via rescale_grad instead), so
+    # rescale_grad stays 1.
+    st = ShardedTrainer(net_sharded, loss_fn, opt_name,
+                        optimizer_params=dict(opt_kw, rescale_grad=1.0))
+    for _ in range(3):
+        st.step(nd.array(X), nd.array(Y))
+    st.sync_to_block()
+
+    for (n1, p1), (n2, p2) in zip(
+            sorted(net_eager.collect_params().items()),
+            sorted(net_sharded.collect_params().items())):
+        # same pure update core; residual diffs are XLA fusion-order
+        # float32 rounding (the eager path runs per-op programs)
+        np.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                                   rtol=tol, atol=tol, err_msg=n1)
