@@ -164,7 +164,25 @@ class Executor:
         aux_vals = [self._place(n, self.aux_dict[n]) for n in self.aux_names]
         rng = self._place_rng(_random.next_key())
 
-        if self._monitor is not None and \
+        if self._group2ctx:
+            # manual model parallelism (__ctx_group__ + group2ctx, ref
+            # graph_executor.cc:403 PlaceDevice): run node-by-node with
+            # per-group device placement; eager dispatch inserts the
+            # cross-device copies the reference's _CrossDeviceCopy did
+            outs, new_aux = self._forward_grouped(arg_vals, aux_vals, rng,
+                                                  is_train)
+            if is_train and self._grad_names:
+                gpos = [self.arg_names.index(n) for n in self._grad_names]
+
+                def f_grp(grad_vals):
+                    full = list(arg_vals)
+                    for p, v in zip(gpos, grad_vals):
+                        full[p] = v
+                    return self._train_jit(full, aux_vals, rng)
+
+                _o, self._vjp, _na = jax.vjp(
+                    f_grp, [arg_vals[p] for p in gpos], has_aux=True)
+        elif self._monitor is not None and \
                 getattr(self._monitor, "is_active", lambda: True)():
             outs, new_aux = self._forward_monitored(arg_vals, aux_vals, rng,
                                                     is_train)
@@ -249,6 +267,50 @@ class Executor:
                 self._monitor(node.output_name(i) if i < node.num_outputs()
                               else "%s_aux%d" % (node.name, i),
                               _wrap(o, self._ctx))
+            for aux_in, oidx in (node.op.aux_updates or {}).items():
+                if aux_in < len(node.inputs):
+                    src, _ = node.inputs[aux_in]
+                    if id(src) in aux_new:
+                        aux_new[id(src)] = outs[oidx]
+        outs = tuple(env[(id(n), oi)] for n, oi in self._symbol._outputs)
+        new_aux = tuple(aux_new[id(n)] if aux_new[id(n)] is not None
+                        else env[(id(n), 0)] for n in self._aux_nodes)
+        return outs, new_aux
+
+    def _forward_grouped(self, arg_vals, aux_vals, rng, is_train):
+        """Node-by-node forward honouring ``__ctx_group__`` placement."""
+        from .symbol.symbol import _topo as topo
+        nodes = topo(self._symbol._outputs)
+        env = {}
+        ai = {id(n): i for i, n in enumerate(self._arg_nodes)}
+        xi = {id(n): i for i, n in enumerate(self._aux_nodes)}
+
+        def device_of(node):
+            group = (node.attrs or {}).get("__ctx_group__")
+            ctx = self._group2ctx.get(group) if group else None
+            return (ctx or self._ctx).jax_device
+
+        for n in nodes:
+            if n.op is None:
+                val = arg_vals[ai[id(n)]] if id(n) in ai \
+                    else aux_vals[xi[id(n)]]
+                env[(id(n), 0)] = jax.device_put(val, device_of(n))
+        key = rng
+        aux_new = {id(n): None for n in self._aux_nodes}
+        for node in nodes:
+            if node.op is None:
+                continue
+            dev = device_of(node)
+            ins = [jax.device_put(env[(id(s), oi)], dev)
+                   for s, oi in node.inputs]
+            sub = None
+            if node.op.needs_rng:
+                key, sub = jax.random.split(key)
+            outs = node.op.traceable(node.attrs, train_mode=is_train,
+                                     rng=sub)(*ins)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
             for aux_in, oidx in (node.op.aux_updates or {}).items():
                 if aux_in < len(node.inputs):
                     src, _ = node.inputs[aux_in]

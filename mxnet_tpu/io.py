@@ -23,7 +23,8 @@ from . import ndarray as nd
 from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
-           "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter"]
+           "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter",
+           "LibSVMIter"]
 
 
 class DataDesc:
@@ -476,3 +477,61 @@ class MNISTIter(_WrappedArrayIter):
         else:
             pixels = pixels.reshape(pixels.shape[0], 1, 28, 28)
         super().__init__(pixels, digits, batch_size, shuffle=shuffle)
+
+
+def _parse_libsvm(path, expect_dim=None):
+    """Parse a libsvm file → (dense feature matrix, labels).
+
+    Format per line: ``label idx:val idx:val ...`` (ref
+    src/io/iter_libsvm.cc:200). Indices are 0-based like the reference's
+    LibSVMIter contract.
+    """
+    labels, rows = [], []
+    max_idx = -1
+    with open(path) as fh:
+        for line in fh:
+            cells = line.split()
+            if not cells:
+                continue
+            labels.append(float(cells[0]))
+            row = {}
+            for tok in cells[1:]:
+                idx, _, val = tok.partition(":")
+                idx = int(idx)
+                row[idx] = float(val)
+                max_idx = max(max_idx, idx)
+            rows.append(row)
+    dim = expect_dim if expect_dim is not None else max_idx + 1
+    data = np.zeros((len(rows), dim), np.float32)
+    for i, row in enumerate(rows):
+        for idx, val in row.items():
+            if idx < dim:
+                data[i, idx] = val
+    return data, np.asarray(labels, np.float32)
+
+
+class LibSVMIter(_WrappedArrayIter):
+    """Sparse-format text iterator (ref src/io/iter_libsvm.cc:200).
+
+    Batches come out as CSRNDArray data (the framework's sparse handle);
+    an optional separate label file supplies multi-dim labels.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True, **kwargs):
+        dim = int(np.prod(data_shape))
+        data, labels = _parse_libsvm(data_libsvm, expect_dim=dim)
+        if label_libsvm is not None:
+            lab_dim = int(np.prod(label_shape)) if label_shape else None
+            lab_data, _ = _parse_libsvm(label_libsvm, expect_dim=lab_dim)
+            labels = lab_data.reshape(
+                (-1,) + tuple(label_shape)) if label_shape else lab_data
+        super().__init__(data, labels, batch_size,
+                         last_batch_handle="roll_over" if round_batch
+                         else "pad")
+
+    def next(self):
+        batch = self._inner.next()
+        from .ndarray import sparse as _sp
+        batch.data = [_sp.csr_matrix(d) for d in batch.data]
+        return batch

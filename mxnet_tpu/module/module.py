@@ -137,8 +137,17 @@ class Module(BaseModule):
     def output_shapes(self):
         self._require_bound()
         execs = self._exec_group.execs
-        outs = execs[0].outputs if execs else []
-        return list(zip(self._output_names, (o.shape for o in outs)))
+        try:
+            outs = execs[0].outputs if execs else []
+            return list(zip(self._output_names, (o.shape for o in outs)))
+        except Exception:
+            # before the first forward: infer symbolically from the bound
+            # input shapes (the reference caches these at bind time)
+            shapes = {d.name: d.shape for d in self._data_shapes}
+            if self._label_shapes:
+                shapes.update({d.name: d.shape for d in self._label_shapes})
+            _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+            return list(zip(self._output_names, out_shapes))
 
     def _require_bound(self):
         if not self.binded:

@@ -159,3 +159,67 @@ def test_feedforward_legacy_api():
     model.fit(X, y)
     acc = model.score(io.NDArrayIter(X, y, batch_size=50))
     assert acc > 0.8
+
+
+def test_sequential_module_trains():
+    """SequentialModule chains two symbol stages end-to-end
+    (ref module/sequential_module.py:29)."""
+    np.random.seed(0)
+    X = np.random.randn(64, 8).astype(np.float32)
+    Y = np.random.randint(0, 3, 64).astype(np.float32)
+    X[np.arange(64), Y.astype(int)] += 2.5
+    it = io.NDArrayIter(X, Y, batch_size=16)
+
+    d1 = mx.sym.Variable("data")
+    stage1 = mx.sym.Activation(
+        mx.sym.FullyConnected(d1, num_hidden=16, name="s1fc"),
+        act_type="tanh")
+    d2 = mx.sym.Variable("data")
+    stage2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(d2, num_hidden=3, name="s2fc"),
+        name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(stage1, label_names=()), auto_wiring=True)
+    seq.add(mx.mod.Module(stage2), take_labels=True)
+    seq.fit(it, num_epoch=12, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.2})
+    acc = seq.score(io.NDArrayIter(X, Y, batch_size=16), "acc")[0][1]
+    assert acc > 0.8, acc
+
+
+def test_python_loss_module_chain():
+    """PythonLossModule supplies a hand-written gradient at the end of a
+    SequentialModule chain (ref module/python_module.py:185)."""
+    np.random.seed(1)
+    X = np.random.randn(32, 6).astype(np.float32)
+    Y = np.random.randint(0, 2, 32).astype(np.float32)
+    X[:, 0] += (Y * 2 - 1) * 2.0
+    it = io.NDArrayIter(X, Y, batch_size=8)
+
+    d = mx.sym.Variable("data")
+    logits = mx.sym.FullyConnected(d, num_hidden=2, name="fc")
+
+    def softmax_grad(scores, labels):
+        s = scores.asnumpy()
+        e = np.exp(s - s.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        p[np.arange(p.shape[0]), labels.asnumpy().astype(np.int64)] -= 1.0
+        return mx.nd.array(p / p.shape[0])
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(logits, label_names=()), auto_wiring=True)
+    seq.add(mx.mod.PythonLossModule(grad_func=softmax_grad),
+            take_labels=True)
+    seq.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    # logits argmax accuracy
+    seq_out = []
+    it.reset()
+    for batch in it:
+        seq.forward(batch, is_train=False)
+        seq_out.append(seq.get_outputs()[0].asnumpy())
+    pred = np.concatenate(seq_out).argmax(axis=1)
+    acc = (pred == Y).mean()
+    assert acc > 0.8, acc
