@@ -1,3 +1,5 @@
 """Image IO + augmentation (reference python/mxnet/image/)."""
 from .image import *  # noqa: F401,F403
 from . import image  # noqa: F401
+from .detection import *  # noqa: F401,F403
+from . import detection  # noqa: F401

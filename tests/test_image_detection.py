@@ -1,0 +1,71 @@
+"""Detection augmenter tests (reference python/mxnet/image/detection.py +
+src/io/image_det_aug_default.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.image import detection as det
+
+
+def _img(h=40, w=60):
+    return nd.array((np.random.rand(h, w, 3) * 255).astype(np.uint8))
+
+
+def _label():
+    # one object: class 0 box (0.25, 0.25)-(0.5, 0.75)
+    return np.array([[0.0, 0.25, 0.25, 0.5, 0.75],
+                     [-1.0, 0, 0, 0, 0]], np.float32)
+
+
+def test_det_horizontal_flip_flips_boxes():
+    aug = det.DetHorizontalFlipAug(p=1.0)
+    img, lab = aug(_img(), _label())
+    np.testing.assert_allclose(lab[0, [1, 3]], [0.5, 0.75], atol=1e-6)
+    np.testing.assert_allclose(lab[0, [2, 4]], [0.25, 0.75], atol=1e-6)
+    assert lab[1, 0] == -1.0
+
+
+def test_det_borrow_aug_passes_label():
+    from mxnet_tpu.image.image import CastAug
+    aug = det.DetBorrowAug(CastAug())
+    img, lab = aug(_img(), _label())
+    np.testing.assert_allclose(lab, _label())
+    assert img.dtype == np.float32
+
+
+def test_det_random_pad_shrinks_boxes():
+    np.random.seed(0)
+    import random
+    random.seed(0)
+    aug = det.DetRandomPadAug(area_range=(2.0, 2.0))
+    img, lab = aug(_img(40, 60), _label())
+    # canvas grew by sqrt(2): box extent shrinks by the same factor
+    w_new = lab[0, 3] - lab[0, 1]
+    assert w_new == pytest.approx(0.25 / np.sqrt(2), rel=0.1)
+    assert 40 < img.shape[0] <= 57
+
+
+def test_det_random_crop_keeps_object():
+    import random
+    random.seed(3)
+    aug = det.DetRandomCropAug(min_object_covered=0.5,
+                               area_range=(0.5, 0.9), max_attempts=30)
+    img, lab = aug(_img(), _label())
+    valid = lab[lab[:, 0] >= 0]
+    assert len(valid) >= 1
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+
+
+def test_create_det_augmenter_pipeline_runs():
+    import random
+    random.seed(1)
+    augs = det.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                  rand_mirror=True, mean=True, std=True,
+                                  brightness=0.1, contrast=0.1,
+                                  saturation=0.1)
+    img, lab = _img(), _label()
+    for aug in augs:
+        img, lab = aug(img, lab)
+    assert tuple(img.shape) == (32, 32, 3)
+    assert lab.shape[1] == 5
