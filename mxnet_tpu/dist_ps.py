@@ -171,6 +171,8 @@ class Scheduler:
         self._barrier_waiters = []
         self._barrier_gen = 0
         self._finalized = 0
+        self._finalized_ranks = set()
+        self.dead_workers = set()
         self._done = threading.Event()
 
     def run(self):
@@ -218,7 +220,20 @@ class Scheduler:
             try:
                 msg = conn.recv()
             except ConnectionError:
+                # liveness surface (ref kvstore.h:328 get_num_dead_node):
+                # a worker whose control connection dropped without
+                # finalizing counts as dead
+                with self._lock:
+                    if rank in self.worker_conns \
+                            and self.worker_conns[rank] is conn \
+                            and rank not in getattr(self, "_finalized_ranks",
+                                                    set()):
+                        self.dead_workers.add(rank)
                 break
+            if msg[0] == "num_dead":
+                with self._lock:
+                    conn.send(("num_dead", len(self.dead_workers)))
+                continue
             if msg[0] == "barrier":
                 with self._lock:
                     gen = self._barrier_gen
@@ -235,6 +250,9 @@ class Scheduler:
                 continue
             if msg[0] == "finalize":
                 with self._lock:
+                    if not hasattr(self, "_finalized_ranks"):
+                        self._finalized_ranks = set()
+                    self._finalized_ranks.add(rank)
                     self._finalized += 1
                     if self._finalized == self.nworkers:
                         self._done.set()
@@ -470,6 +488,14 @@ class WorkerTransport:
         self.sched.send(("barrier",))
         msg = self.sched.recv()
         assert msg[0] == "barrier_done"
+
+    def num_dead_nodes(self):
+        """Workers whose control link dropped without finalizing
+        (ref kvstore.h:328 get_num_dead_node)."""
+        self.sched.send(("num_dead",))
+        msg = self.sched.recv()
+        assert msg[0] == "num_dead"
+        return int(msg[1])
 
     def finalize(self):
         try:

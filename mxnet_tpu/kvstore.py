@@ -129,14 +129,18 @@ class KVStore:
             k = str(k)
             src = self._store[k]
             olist = o if isinstance(o, (list, tuple)) else [o]
-            rows = rid.asnumpy().astype(np.int64)
-            full = src.asnumpy()
-            sparse = np.zeros_like(full)
-            sparse[rows] = full[rows]
+            # device-side gather/scatter (SURVEY §7 index+values design):
+            # no host round trip of the full parameter
+            import jax.numpy as jnp
+            rows = jnp.asarray(rid._data).astype(jnp.int64)
+            full = src._data
+            picked = jnp.take(full, rows, axis=0)
+            sparse = jnp.zeros_like(full).at[rows].set(picked)
             for dst in olist:
-                dst._set_data(nd.array(sparse, ctx=dst.context,
-                                       dtype=dst.dtype)._data)
+                dst._set_data(sparse.astype(dst.dtype))
                 dst._stype = "row_sparse"
+                if hasattr(dst, "_seed_sparse"):
+                    dst._seed_sparse(rows, picked)
 
     def set_updater(self, updater):
         self._updater = updater
@@ -164,6 +168,11 @@ class KVStore:
 
     def barrier(self):
         self._barrier_count += 1
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Dead-node count (ref kvstore.h:328); single-process stores have
+        no failure surface — always 0."""
+        return 0
 
     def _send_command_to_servers(self, head, body):
         pass
@@ -311,6 +320,11 @@ class KVStoreDist(KVStore):
     def barrier(self):
         self._barrier_count += 1
         self._trans.barrier()
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Workers whose link to the scheduler dropped without a clean
+        finalize (ref kvstore.h:328)."""
+        return self._trans.num_dead_nodes()
 
     def _finalize(self):
         t, self._trans = getattr(self, "_trans", None), None

@@ -29,7 +29,7 @@ __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ()
+    __slots__ = ("_idx_cache", "_val_cache")
 
     def __repr__(self):
         return "\n<%s %s @%s>" % (type(self).__name__,
@@ -44,19 +44,48 @@ class BaseSparseNDArray(NDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Row-sparse array: most rows are zero; ``indices`` lists non-zero rows."""
+    """Row-sparse array: index + values metadata over a dense backing store.
+
+    The SURVEY §7 design: device compute stays dense (XLA-friendly), but
+    the sparse identity — which rows are active — is carried as explicit
+    device arrays: constructors from (data, indices) seed the metadata,
+    mutation drops it, and ``indices``/``data`` recompute on DEVICE
+    (jnp mask/take) only when no metadata is cached. kvstore
+    row_sparse_pull and the dist server's pull_rows ride the same gather
+    path instead of materialising host copies.
+    """
     __slots__ = ()
+
+    def _set_data(self, jarr):
+        # any mutation invalidates the sparse metadata
+        self._idx_cache = None
+        self._val_cache = None
+        super()._set_data(jarr)
+
+    def _seed_sparse(self, indices, values):
+        self._idx_cache = jnp.asarray(indices, jnp.int64)
+        self._val_cache = None if values is None else jnp.asarray(values)
+
+    def _active_rows(self):
+        if getattr(self, "_idx_cache", None) is not None:
+            return self._idx_cache
+        flat = self._data.reshape(self.shape[0], -1)
+        mask = jnp.any(flat != 0, axis=1)           # device-side reduction
+        rows = jnp.nonzero(mask)[0].astype(jnp.int64)
+        self._idx_cache = rows
+        return rows
 
     @property
     def indices(self):
-        rows = np.nonzero(np.any(self.asnumpy().reshape(self.shape[0], -1) != 0,
-                                 axis=1))[0]
-        return array(rows.astype(np.int64), ctx=self.context, dtype=np.int64)
+        return _wrap(self._active_rows(), self.context)
 
     @property
     def data(self):
-        idx = self.indices.asnumpy().astype(np.int64)
-        return _wrap(jnp.take(self._data, jnp.asarray(idx), axis=0), self.context)
+        if getattr(self, "_val_cache", None) is not None:
+            return _wrap(self._val_cache, self.context)
+        vals = jnp.take(self._data, self._active_rows(), axis=0)
+        self._val_cache = vals
+        return _wrap(vals, self.context)
 
     def tostype(self, stype):
         return cast_storage(self, stype)
@@ -98,6 +127,9 @@ def _retag(arr, stype):
            "csr": CSRNDArray}[stype]
     out = cls(arr._data, arr.context)
     out._stype = stype
+    if stype != "default":
+        out._idx_cache = None
+        out._val_cache = None
     return out
 
 
@@ -122,7 +154,9 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         if indices.size:
             dense[indices] = data
         out = array(dense, ctx=ctx, dtype=data.dtype)
-        return _retag(out, "row_sparse")
+        out = _retag(out, "row_sparse")
+        out._seed_sparse(indices, data)
+        return out
     if isinstance(arg1, NDArray):
         return cast_storage(arg1, "row_sparse")
     out = array(np.asarray(arg1, dtype=dtype_np(dtype)), ctx=ctx)

@@ -78,6 +78,8 @@ def main():
     test_dense(kv, nworkers, rank)
     test_row_sparse(kv, nworkers, rank)
     kv.barrier()
+    # liveness surface: everyone is still here (ref kvstore.h:328)
+    assert kv.get_num_dead_node() == 0
     print("worker %d/%d: dist_sync invariants OK" % (rank, nworkers))
 
 

@@ -167,3 +167,32 @@ def test_sparse_csr():
     assert m.stype == "csr"
     np.testing.assert_allclose(m.asnumpy(), [[1, 0, 2], [0, 3, 0]])
     np.testing.assert_allclose(m.indptr.asnumpy(), [0, 2, 3])
+
+
+def test_row_sparse_metadata_device_path():
+    """RowSparse carries explicit index+values metadata (SURVEY §7):
+    constructor-seeded, mutation-invalidated, device-recomputed."""
+    r = nd.sparse.row_sparse_array((np.full((2, 3), 5.0, np.float32),
+                                    [1, 4]), shape=(6, 3))
+    np.testing.assert_array_equal(r.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(r.data.asnumpy(), 5.0)
+    # mutation invalidates cached metadata and recomputes correctly
+    r[:] = r * 3
+    np.testing.assert_array_equal(r.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(r.data.asnumpy(), 15.0)
+    # dense write adding a new active row shows up
+    r[0, 0] = 1.0
+    np.testing.assert_array_equal(r.indices.asnumpy(), [0, 1, 4])
+
+
+def test_kvstore_row_sparse_pull_seeds_metadata():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.array(np.arange(12, dtype=np.float32).reshape(6, 2)))
+    out = nd.sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=nd.array(
+        np.array([1, 3], np.int64)))
+    np.testing.assert_array_equal(out.indices.asnumpy(), [1, 3])
+    got = out.asnumpy()
+    assert got[0].sum() == 0 and got[2].sum() == 0
+    np.testing.assert_allclose(got[1], [2, 3])
+    np.testing.assert_allclose(got[3], [6, 7])
