@@ -39,6 +39,8 @@ from . import optimizer as opt
 from . import metric
 from . import operator
 from . import rnn
+from . import contrib
+from . import torch
 from . import lr_scheduler
 from . import callback
 from . import io

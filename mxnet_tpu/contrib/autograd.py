@@ -1,0 +1,19 @@
+"""Old-style contrib autograd API (reference python/mxnet/contrib/autograd.py).
+
+Thin aliases over the first-class ``mxnet_tpu.autograd`` scopes so code
+written against the 2017 contrib surface keeps running.
+"""
+from ..autograd import (backward, grad, is_recording as _is_recording,
+                        mark_variables, pause, record,
+                        set_recording as set_is_training)
+from ..autograd import record as train_section          # noqa: F401
+from ..autograd import pause as test_section            # noqa: F401
+
+__all__ = ["set_is_training", "mark_variables", "backward", "grad",
+           "train_section", "test_section", "compute_gradient"]
+
+
+def compute_gradient(outputs):
+    """Compute gradients of outputs w.r.t. marked variables
+    (ref contrib/autograd.py:compute_gradient)."""
+    backward(outputs)

@@ -1,0 +1,62 @@
+"""TensorBoard logging callback (reference python/mxnet/contrib/tensorboard.py).
+
+``LogMetricsCallback`` mirrors the reference API. When a SummaryWriter
+implementation is importable (``torch.utils.tensorboard`` or the
+standalone ``tensorboardX``) scalars go to real event files; otherwise
+they append to ``<logging_dir>/scalars.jsonl`` (one
+``{"step", "tag", "value"}`` object per line) so the callback works in
+hermetic environments.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _make_writer(logging_dir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except Exception:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except Exception:
+        return None
+
+
+class _JsonlWriter:
+    def __init__(self, logging_dir):
+        os.makedirs(logging_dir, exist_ok=True)
+        self._path = os.path.join(logging_dir, "scalars.jsonl")
+
+    def add_scalar(self, tag, value, global_step=None):
+        with open(self._path, "a") as fh:
+            fh.write(json.dumps({"time": time.time(), "step": global_step,
+                                 "tag": tag, "value": float(value)}) + "\n")
+
+    def flush(self):
+        pass
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback streaming the eval metric to TensorBoard
+    (ref contrib/tensorboard.py:LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self._writer = _make_writer(logging_dir) or _JsonlWriter(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self._writer.add_scalar(name, value, self.step)

@@ -1,0 +1,54 @@
+"""Torch interop bridge (reference python/mxnet/torch.py + plugin/torch).
+
+The reference bridged Torch7 tensor functions into the op universe
+(``TorchModule``/``TorchCriterion`` ops). Here the bridge is pytorch:
+zero-copy-ish conversion between NDArray and ``torch.Tensor`` plus a
+``pytorch_fn`` wrapper that runs any torch callable as a host op on
+NDArrays. Gated on torch being importable (cpu torch ships in the
+environment).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+
+__all__ = ["to_torch", "from_torch", "pytorch_fn"]
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as exc:               # pragma: no cover
+        raise ImportError("the torch bridge requires pytorch") from exc
+
+
+def to_torch(arr):
+    """NDArray → torch.Tensor (host copy)."""
+    torch = _torch()
+    return torch.from_numpy(np.ascontiguousarray(arr.asnumpy()))
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor → NDArray."""
+    _torch()
+    return nd.array(tensor.detach().cpu().numpy(), ctx=ctx)
+
+
+def pytorch_fn(fn):
+    """Wrap a torch callable so it consumes/produces NDArrays.
+
+    >>> relu = pytorch_fn(torch.nn.functional.relu)
+    >>> y = relu(x_ndarray)
+    """
+    def wrapped(*args, **kwargs):
+        torch = _torch()
+        conv = [to_torch(a) if isinstance(a, nd.NDArray) else a
+                for a in args]
+        out = fn(*conv, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return [from_torch(o) if torch.is_tensor(o) else o for o in out]
+        return from_torch(out) if torch.is_tensor(out) else out
+    wrapped.__name__ = getattr(fn, "__name__", "pytorch_fn")
+    return wrapped

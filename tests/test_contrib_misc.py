@@ -1,0 +1,78 @@
+"""contrib package, torch bridge, tool scripts."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_contrib_autograd_old_api():
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    g = nd.zeros((2,))
+    mx.contrib.autograd.mark_variables([x], [g])
+    with mx.contrib.autograd.train_section():
+        y = x * x
+    mx.contrib.autograd.compute_gradient([y])
+    np.testing.assert_allclose(g.asnumpy(), 2 * x.asnumpy())
+
+
+def test_tensorboard_callback_jsonl(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback, _JsonlWriter
+    cb = LogMetricsCallback(str(tmp_path), prefix="train")
+    cb._writer = _JsonlWriter(str(tmp_path))   # force the hermetic writer
+    metric = mx.metric.Accuracy()
+    metric.update([nd.array(np.array([1.0]))],
+                  [nd.array(np.array([[0.2, 0.8]]))])
+    from mxnet_tpu.model import BatchEndParam
+    cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=metric))
+    rows = [json.loads(l) for l in
+            open(tmp_path / "scalars.jsonl").read().splitlines()]
+    assert rows and rows[0]["tag"] == "train-accuracy"
+    assert rows[0]["value"] == 1.0
+
+
+def test_torch_bridge_roundtrip():
+    torch = pytest.importorskip("torch")
+    x = nd.array(np.random.randn(3, 4).astype(np.float32))
+    t = mx.torch.to_torch(x)
+    assert torch.is_tensor(t)
+    back = mx.torch.from_torch(t)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+
+    relu = mx.torch.pytorch_fn(torch.nn.functional.relu)
+    y = relu(x)
+    np.testing.assert_allclose(y.asnumpy(), np.maximum(x.asnumpy(), 0))
+
+
+def test_parse_log_tool(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Batch [50]\tSpeed: 1000.00 samples/sec\n"
+        "INFO:root:Epoch[0] Train-accuracy=0.80\n"
+        "INFO:root:Epoch[0] Time cost=1.500\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.75\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.90\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         str(log), "--format", "csv"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    lines = out.stdout.strip().splitlines()
+    assert lines[0].startswith("epoch,")
+    assert "0.8" in lines[1] and "0.75" in lines[1]
+
+
+def test_diagnose_tool():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py")],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0
+    assert "mxnet_tpu" in out.stdout and "operators" in out.stdout

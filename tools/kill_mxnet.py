@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Kill leftover distributed-training processes.
+
+Reference counterpart: ``tools/kill-mxnet.py`` — cleanup after a crashed
+launch: find every process whose environment carries the launcher's
+DMLC_/MXNET_ rendezvous contract (or whose command line matches the
+given pattern) and terminate it.
+
+    python tools/kill_mxnet.py            # kill by env contract
+    python tools/kill_mxnet.py train.py   # kill by cmdline substring
+"""
+import os
+import signal
+import sys
+
+
+def _iter_procs():
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as fh:
+                cmd = fh.read().replace(b"\0", b" ").decode(errors="replace")
+            with open("/proc/%s/environ" % pid, "rb") as fh:
+                env = fh.read().decode(errors="replace")
+        except (OSError, PermissionError):
+            continue
+        yield int(pid), cmd, env
+
+
+def main():
+    pattern = sys.argv[1] if len(sys.argv) > 1 else None
+    victims = []
+    for pid, cmd, env in _iter_procs():
+        if pattern is not None:
+            if pattern in cmd:
+                victims.append((pid, cmd))
+        elif "DMLC_ROLE=" in env or "MXNET_COORDINATOR=" in env:
+            victims.append((pid, cmd))
+    for pid, cmd in victims:
+        print("killing %d: %s" % (pid, cmd[:100]))
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError as exc:
+            print("  failed: %s" % exc)
+    print("%d process(es) signalled" % len(victims))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Parse training logs into a metric table.
+
+Reference counterpart: ``tools/parse_log.py`` — extracts per-epoch
+train/validation metrics and throughput from the logging output of
+Module.fit / Speedometer.
+
+    python tools/parse_log.py train.log [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+
+EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+EPOCH_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([0-9.]+)")
+SPEED = re.compile(r"Epoch\[(\d+)\]\s+Batch\s*\[\d+\]\s+Speed:\s*([0-9.]+)")
+
+
+def parse(lines):
+    """Return {epoch: {column: value}} from log lines."""
+    table = {}
+
+    def row(epoch):
+        return table.setdefault(int(epoch), {})
+
+    for line in lines:
+        m = EPOCH_METRIC.search(line)
+        if m:
+            epoch, phase, name, value = m.groups()
+            row(epoch)["%s-%s" % (phase.lower(), name)] = float(value)
+            continue
+        m = EPOCH_TIME.search(line)
+        if m:
+            row(m.group(1))["time"] = float(m.group(2))
+            continue
+        m = SPEED.search(line)
+        if m:
+            r = row(m.group(1))
+            r.setdefault("_speeds", []).append(float(m.group(2)))
+    for r in table.values():
+        speeds = r.pop("_speeds", None)
+        if speeds:
+            r["speed"] = sum(speeds) / len(speeds)
+    return table
+
+
+def render(table, fmt="markdown"):
+    columns = sorted({c for r in table.values() for c in r})
+    header = ["epoch"] + columns
+    rows = [[str(e)] + ["%.6g" % table[e].get(c, float("nan"))
+                        for c in columns]
+            for e in sorted(table)]
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [header] + rows)
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    line = "| " + " | ".join(h.ljust(w) for h, w in zip(header, widths)) + " |"
+    sep = "|" + "|".join("-" * (w + 2) for w in widths) + "|"
+    body = ["| " + " | ".join(c.ljust(w) for c, w in zip(r, widths)) + " |"
+            for r in rows]
+    return "\n".join([line, sep] + body)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("markdown", "csv"),
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as fh:
+        table = parse(fh)
+    print(render(table, args.format))
+
+
+if __name__ == "__main__":
+    main()
