@@ -1,0 +1,105 @@
+"""ImageRecordIter: the native threaded decode pipeline's Python face.
+
+Reference analogue: the registered native iterator
+``src/io/iter_image_recordio_2.cc:723`` (M decode threads + prefetcher).
+This binds native/image_loader.cc over ctypes: record indexing, JPEG
+decode, resize, mirror and batch assembly all happen in C++ worker
+threads, one batch prefetched ahead; Python sees ready float32 NCHW
+buffers (scaled to [0, 1]) and uploads once per batch.
+
+Falls back with ImportError when the shared object is absent (build with
+``make -C native``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from .. import io as _io
+from .. import ndarray as nd
+
+__all__ = ["ImageRecordIter"]
+
+_SO = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_native", "libimageloader.so")
+
+
+def _lib():
+    if not os.path.exists(_SO):
+        raise ImportError("libimageloader.so not built (make -C native)")
+    lib = ctypes.CDLL(_SO)
+    lib.mx_imgloader_create.restype = ctypes.c_void_p
+    lib.mx_imgloader_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint,
+        ctypes.c_int]
+    lib.mx_imgloader_num_samples.restype = ctypes.c_int64
+    lib.mx_imgloader_num_samples.argtypes = [ctypes.c_void_p]
+    lib.mx_imgloader_next.restype = ctypes.c_int
+    lib.mx_imgloader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float)]
+    lib.mx_imgloader_reset.argtypes = [ctypes.c_void_p]
+    lib.mx_imgloader_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class ImageRecordIter(_io.DataIter):
+    """Threaded native .rec image iterator (ref iter_image_recordio_2.cc).
+
+    Emits (data NCHW float32 in [0,1] — optionally mean/scale adjusted —
+    and scalar labels).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 shuffle=False, preprocess_threads=4, rand_mirror=False,
+                 seed=0, mean_rgb=None, scale=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        c, h, w = data_shape
+        self._lib = _lib()
+        self._handle = self._lib.mx_imgloader_create(
+            str(path_imgrec).encode(), batch_size, h, w, c,
+            int(preprocess_threads), int(bool(shuffle)), int(seed),
+            int(bool(rand_mirror)))
+        if not self._handle:
+            raise IOError("cannot open record file %s" % path_imgrec)
+        self.data_shape = (c, h, w)
+        self._data_buf = np.empty((batch_size, c, h, w), np.float32)
+        self._label_buf = np.empty((batch_size,), np.float32)
+        self._mean = None if mean_rgb is None else \
+            (np.asarray(mean_rgb, np.float32) / 255.0).reshape(1, -1, 1, 1)
+        self._scale = scale
+        self.provide_data = [_io.DataDesc(data_name,
+                                          (batch_size,) + self.data_shape)]
+        self.provide_label = [_io.DataDesc(label_name, (batch_size,))]
+
+    @property
+    def num_samples(self):
+        return int(self._lib.mx_imgloader_num_samples(self._handle))
+
+    def reset(self):
+        self._lib.mx_imgloader_reset(self._handle)
+
+    def next(self):
+        n = self._lib.mx_imgloader_next(
+            self._handle,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n == 0:
+            raise StopIteration
+        data = self._data_buf
+        if self._mean is not None:
+            data = data - self._mean
+        if self._scale is not None:
+            data = data * self._scale
+        return _io.DataBatch([nd.array(data)],
+                             [nd.array(self._label_buf.copy())],
+                             pad=self.batch_size - n)
+
+    def __del__(self):
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            self._lib.mx_imgloader_destroy(handle)

@@ -1,0 +1,288 @@
+// Native threaded image-record loader: the TPU build's equivalent of the
+// reference's multithreaded decode pipeline (src/io/iter_image_recordio_2.cc
+// — M decoder threads + prefetcher, SURVEY §2.1 "Data IO (native)").
+//
+// One pass at create() indexes the .rec file (record offsets/lengths).
+// next() hands back the batch assembled in the background and immediately
+// starts decoding the following batch: N worker threads each pread() their
+// records, parse the IRHeader (recordio.py layout: <I flag><f label>
+// <Q id><Q id2>[flag * float extra labels]<jpeg bytes>), JPEG-decode via
+// libjpeg, bilinear-resize to the target geometry, optionally mirror, and
+// write float32 CHW rows scaled to [0, 1].
+//
+// C ABI (ctypes-consumed by mxnet_tpu/image/native_iter.py):
+//   mx_imgloader_create(rec, batch, h, w, c, threads, shuffle, seed, mirror)
+//   mx_imgloader_num_samples(h)
+//   mx_imgloader_next(h, float* data, float* labels) -> n valid (0 = epoch end)
+//   mx_imgloader_reset(h)
+//   mx_imgloader_destroy(h)
+//
+// Build: make -C native  →  mxnet_tpu/_native/libimageloader.so
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <future>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <csetjmp>
+#include <jpeglib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void jpeg_bail(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jump, 1);
+}
+
+// Decode JPEG bytes to packed RGB; returns false on corrupt input.
+bool decode_jpeg(const unsigned char* buf, size_t len,
+                 std::vector<unsigned char>* rgb, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_bail;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  rgb->resize(static_cast<size_t>(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = rgb->data() +
+        static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear src(RGB, sh x sw) → dst float CHW (c x dh x dw), scaled 1/255.
+void resize_to_chw(const unsigned char* src, int sw, int sh, float* dst,
+                   int dw, int dh, int channels, bool mirror) {
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = fy < 0 ? 0 : static_cast<int>(fy);
+    if (y0 > sh - 1) y0 = sh - 1;
+    int y1 = y0 + 1 > sh - 1 ? sh - 1 : y0 + 1;
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      int xe = mirror ? (dw - 1 - x) : x;
+      float fx = (xe + 0.5f) * sx - 0.5f;
+      int x0 = fx < 0 ? 0 : static_cast<int>(fx);
+      if (x0 > sw - 1) x0 = sw - 1;
+      int x1 = x0 + 1 > sw - 1 ? sw - 1 : x0 + 1;
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int ch = 0; ch < channels; ++ch) {
+        int c3 = ch < 3 ? ch : 2;
+        float v00 = src[(static_cast<size_t>(y0) * sw + x0) * 3 + c3];
+        float v01 = src[(static_cast<size_t>(y0) * sw + x1) * 3 + c3];
+        float v10 = src[(static_cast<size_t>(y1) * sw + x0) * 3 + c3];
+        float v11 = src[(static_cast<size_t>(y1) * sw + x1) * 3 + c3];
+        float v = (1 - wy) * ((1 - wx) * v00 + wx * v01) +
+                  wy * ((1 - wx) * v10 + wx * v11);
+        dst[(static_cast<size_t>(ch) * dh + y) * dw + x] = v / 255.0f;
+      }
+    }
+  }
+}
+
+struct Rec {
+  int64_t off;
+  uint32_t len;
+};
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> labels;
+  int n = 0;
+};
+
+struct Loader {
+  int fd = -1;
+  int batch, h, w, c, threads, shuffle, mirror;
+  std::mt19937 rng;
+  std::vector<Rec> recs;
+  std::vector<uint32_t> order;
+  size_t cursor = 0;
+  Batch bufs[2];
+  int cur = 0;
+  std::future<void> pending;
+
+  ~Loader() {
+    if (pending.valid()) pending.wait();
+    if (fd >= 0) close(fd);
+  }
+
+  void index_records() {
+    FILE* f = fdopen(dup(fd), "rb");
+    if (!f) return;
+    setvbuf(f, nullptr, _IOFBF, 1 << 20);
+    int64_t pos = 0;
+    uint32_t head[2];
+    while (fread(head, sizeof(uint32_t), 2, f) == 2) {
+      if (head[0] != kMagic) break;
+      uint32_t len = head[1] & ((1u << 29) - 1);
+      recs.push_back({pos + 8, len});
+      uint32_t pad = (4 - (len % 4)) % 4;
+      pos += 8 + len + pad;
+      if (fseek(f, pos, SEEK_SET) != 0) break;
+    }
+    fclose(f);
+    order.resize(recs.size());
+    for (uint32_t i = 0; i < order.size(); ++i) order[i] = i;
+  }
+
+  void decode_one(uint32_t rec_idx, Batch* out, int slot, bool flip) {
+    const Rec& r = recs[rec_idx];
+    std::vector<unsigned char> raw(r.len);
+    if (pread(fd, raw.data(), r.len, r.off) != static_cast<ssize_t>(r.len))
+      return;
+    if (r.len < 24) return;
+    uint32_t flag;
+    float label;
+    std::memcpy(&flag, raw.data(), 4);
+    std::memcpy(&label, raw.data() + 4, 4);
+    size_t skip = 24 + static_cast<size_t>(flag > 0 ? flag : 0) * 4;
+    if (flag > 0 && r.len >= skip)
+      std::memcpy(&label, raw.data() + 24, 4);   // first extended label
+    if (r.len <= skip) return;
+    std::vector<unsigned char> rgb;
+    int sw = 0, sh = 0;
+    if (!decode_jpeg(raw.data() + skip, r.len - skip, &rgb, &sw, &sh))
+      return;
+    float* dst = out->data.data() +
+        static_cast<size_t>(slot) * c * h * w;
+    resize_to_chw(rgb.data(), sw, sh, dst, w, h, c, flip);
+    out->labels[slot] = label;
+  }
+
+  // Assemble one batch into *out (parallel across `threads` workers).
+  void fill(Batch* out) {
+    out->data.assign(static_cast<size_t>(batch) * c * h * w, 0.0f);
+    out->labels.assign(batch, 0.0f);
+    size_t take = std::min<size_t>(batch, recs.size() - cursor);
+    out->n = static_cast<int>(take);
+    if (take == 0) return;
+    std::vector<uint32_t> picked(order.begin() + cursor,
+                                 order.begin() + cursor + take);
+    std::vector<char> flips(take, 0);
+    if (mirror) {
+      std::bernoulli_distribution coin(0.5);
+      for (auto& fl : flips) fl = coin(rng) ? 1 : 0;
+    }
+    cursor += take;
+    std::atomic<size_t> next_slot{0};
+    auto work = [&]() {
+      for (;;) {
+        size_t slot = next_slot.fetch_add(1);
+        if (slot >= take) return;
+        decode_one(picked[slot], out, static_cast<int>(slot),
+                   flips[slot] != 0);
+      }
+    };
+    int nthreads = std::max(1, threads);
+    std::vector<std::thread> pool;
+    for (int i = 1; i < nthreads; ++i) pool.emplace_back(work);
+    work();
+    for (auto& t : pool) t.join();
+  }
+
+  void start_prefetch() {
+    Batch* target = &bufs[1 - cur];
+    pending = std::async(std::launch::async,
+                         [this, target]() { fill(target); });
+  }
+
+  void reset() {
+    if (pending.valid()) pending.wait();
+    cursor = 0;
+    if (shuffle) std::shuffle(order.begin(), order.end(), rng);
+    cur = 0;
+    fill(&bufs[cur]);
+    start_prefetch();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mx_imgloader_create(const char* rec_path, int batch, int h, int w,
+                          int c, int threads, int shuffle, unsigned seed,
+                          int mirror) {
+  int fd = open(rec_path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  auto* L = new Loader();
+  L->fd = fd;
+  L->batch = batch;
+  L->h = h;
+  L->w = w;
+  L->c = c;
+  L->threads = threads;
+  L->shuffle = shuffle;
+  L->mirror = mirror;
+  L->rng.seed(seed);
+  L->index_records();
+  if (L->recs.empty()) {
+    delete L;
+    return nullptr;
+  }
+  L->reset();
+  return L;
+}
+
+int64_t mx_imgloader_num_samples(void* handle) {
+  return static_cast<Loader*>(handle)->recs.size();
+}
+
+int mx_imgloader_next(void* handle, float* data, float* labels) {
+  auto* L = static_cast<Loader*>(handle);
+  Batch& b = L->bufs[L->cur];
+  if (b.n == 0) return 0;
+  std::memcpy(data, b.data.data(), b.data.size() * sizeof(float));
+  std::memcpy(labels, b.labels.data(), b.labels.size() * sizeof(float));
+  int n = b.n;
+  // rotate: the prefetched batch becomes current, refill the other
+  if (L->pending.valid()) L->pending.wait();
+  L->cur = 1 - L->cur;
+  L->start_prefetch();
+  return n;
+}
+
+void mx_imgloader_reset(void* handle) {
+  static_cast<Loader*>(handle)->reset();
+}
+
+void mx_imgloader_destroy(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+}  // extern "C"

@@ -38,9 +38,11 @@ def pytest_configure(config):
     import os
     import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    so = os.path.join(repo, "mxnet_tpu", "_native", "librecordio.so")
-    binary = os.path.join(repo, "native", "bin", "im2rec")
-    if not (os.path.exists(so) and os.path.exists(binary)):
+    wanted = [os.path.join(repo, "mxnet_tpu", "_native", "librecordio.so"),
+              os.path.join(repo, "mxnet_tpu", "_native",
+                           "libimageloader.so"),
+              os.path.join(repo, "native", "bin", "im2rec")]
+    if not all(os.path.exists(p) for p in wanted):
         try:
             subprocess.run(["make", "-C", os.path.join(repo, "native")],
                            check=True, capture_output=True, timeout=300)

@@ -1,0 +1,84 @@
+"""Native threaded image loader tests (native/image_loader.cc, the
+reference iter_image_recordio_2.cc decode-pipeline analogue)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+
+cv2 = pytest.importorskip("cv2")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.path.join(REPO, "mxnet_tpu", "_native", "libimageloader.so")
+pytestmark = pytest.mark.skipif(not os.path.exists(SO),
+                                reason="libimageloader.so not built")
+
+
+def _write_rec(path, n=12, hw=24):
+    """n JPEG records; label i; image i is a solid gray level."""
+    rec = recordio.MXRecordIO(str(path), "w")
+    levels = []
+    for i in range(n):
+        level = int(255 * (i + 1) / (n + 1))
+        levels.append(level)
+        img = np.full((hw, hw, 3), level, np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 98])
+        assert ok
+        header = recordio.IRHeader(0, float(i), i, 0)
+        rec.write(recordio.pack(header, enc.tobytes()))
+    rec.close()
+    return levels
+
+
+def test_loader_batches_and_values(tmp_path):
+    from mxnet_tpu.image import ImageRecordIter
+    path = tmp_path / "toy.rec"
+    levels = _write_rec(path, n=12, hw=24)
+    it = ImageRecordIter(path_imgrec=str(path), data_shape=(3, 16, 16),
+                         batch_size=4, preprocess_threads=3)
+    assert it.num_samples == 12
+    seen = []
+    total = 0
+    while True:
+        try:
+            batch = it.next()
+        except StopIteration:
+            break
+        arr = batch.data[0].asnumpy()
+        labels = batch.label[0].asnumpy()
+        n = batch.data[0].shape[0] - (batch.pad or 0)
+        total += n
+        for j in range(n):
+            i = int(labels[j])
+            seen.append(i)
+            # solid-gray JPEG decodes back to its level (±2/255)
+            np.testing.assert_allclose(arr[j].mean(), levels[i] / 255.0,
+                                       atol=0.02)
+    assert total == 12
+    assert sorted(seen) == list(range(12))
+
+
+def test_loader_shuffle_and_reset(tmp_path):
+    from mxnet_tpu.image import ImageRecordIter
+    path = tmp_path / "toy.rec"
+    _write_rec(path, n=16)
+    it = ImageRecordIter(path_imgrec=str(path), data_shape=(3, 8, 8),
+                         batch_size=8, shuffle=True, seed=3)
+    first = it.next().label[0].asnumpy().copy()
+    it.reset()
+    again = it.next().label[0].asnumpy().copy()
+    # same seeded stream still yields a permutation of labels overall
+    assert set(first) <= set(range(16))
+    assert set(again) <= set(range(16))
+
+
+def test_loader_mean_scale(tmp_path):
+    from mxnet_tpu.image import ImageRecordIter
+    path = tmp_path / "toy.rec"
+    _write_rec(path, n=4)
+    it = ImageRecordIter(path_imgrec=str(path), data_shape=(3, 8, 8),
+                         batch_size=4, mean_rgb=(0, 0, 0), scale=2.0)
+    arr = it.next().data[0].asnumpy()
+    assert arr.max() <= 2.0 and arr.max() > 1.0   # scaled past [0, 1]
