@@ -238,79 +238,49 @@ class Executor:
             arr._set_data(data)
         return data
 
-    def _forward_monitored(self, arg_vals, aux_vals, rng, is_train):
-        """Eager node-by-node path so the monitor callback sees every
-        intermediate (reference: ExecuteMonCallback, graph_executor.cc:1380)."""
+    def _eager_walk(self, arg_vals, aux_vals, rng, is_train,
+                    place_fn=None, observe_fn=None):
+        """Node-by-node eager execution of the bound graph.
+
+        Shared by the monitor path (observe_fn taps every output,
+        ref ExecuteMonCallback graph_executor.cc:1380) and the group2ctx
+        path (place_fn pins each node's compute to its __ctx_group__
+        device, ref PlaceDevice graph_executor.cc:403). RNG keys follow
+        the SAME split-by-rng-node-index scheme as the jitted graph_fn so
+        stochastic ops agree between this walk and the vjp's replay.
+        """
         from .symbol.symbol import _topo as topo
         nodes = topo(self._symbol._outputs)
         env = {}
         ai = {id(n): i for i, n in enumerate(self._arg_nodes)}
         xi = {id(n): i for i, n in enumerate(self._aux_nodes)}
-        for n in nodes:
-            if n.op is None:
-                env[(id(n), 0)] = (arg_vals[ai[id(n)]] if id(n) in ai
-                                   else aux_vals[xi[id(n)]])
-        key = rng
-        aux_new = {id(n): None for n in self._aux_nodes}
-        for node in nodes:
-            if node.op is None:
-                continue
-            ins = [env[(id(s), oi)] for s, oi in node.inputs]
-            sub = None
-            if node.op.needs_rng:
-                key, sub = jax.random.split(key)
-            fn = node.op.traceable(node.attrs, train_mode=is_train, rng=sub)
-            outs = fn(*ins)
-            outs = outs if isinstance(outs, tuple) else (outs,)
-            for i, o in enumerate(outs):
-                env[(id(node), i)] = o
-                self._monitor(node.output_name(i) if i < node.num_outputs()
-                              else "%s_aux%d" % (node.name, i),
-                              _wrap(o, self._ctx))
-            for aux_in, oidx in (node.op.aux_updates or {}).items():
-                if aux_in < len(node.inputs):
-                    src, _ = node.inputs[aux_in]
-                    if id(src) in aux_new:
-                        aux_new[id(src)] = outs[oidx]
-        outs = tuple(env[(id(n), oi)] for n, oi in self._symbol._outputs)
-        new_aux = tuple(aux_new[id(n)] if aux_new[id(n)] is not None
-                        else env[(id(n), 0)] for n in self._aux_nodes)
-        return outs, new_aux
-
-    def _forward_grouped(self, arg_vals, aux_vals, rng, is_train):
-        """Node-by-node forward honouring ``__ctx_group__`` placement."""
-        from .symbol.symbol import _topo as topo
-        nodes = topo(self._symbol._outputs)
-        env = {}
-        ai = {id(n): i for i, n in enumerate(self._arg_nodes)}
-        xi = {id(n): i for i, n in enumerate(self._aux_nodes)}
-
-        def device_of(node):
-            group = (node.attrs or {}).get("__ctx_group__")
-            ctx = self._group2ctx.get(group) if group else None
-            return (ctx or self._ctx).jax_device
+        rng_nodes = [n for n in nodes if n.op is not None and n.op.needs_rng]
+        rng_pos = {id(n): i for i, n in enumerate(rng_nodes)}
+        keys = jax.random.split(rng, len(rng_nodes)) if rng_nodes else None
 
         for n in nodes:
             if n.op is None:
                 val = arg_vals[ai[id(n)]] if id(n) in ai \
                     else aux_vals[xi[id(n)]]
-                env[(id(n), 0)] = jax.device_put(val, device_of(n))
-        key = rng
+                if place_fn is not None:
+                    val = jax.device_put(val, place_fn(n))
+                env[(id(n), 0)] = val
         aux_new = {id(n): None for n in self._aux_nodes}
         for node in nodes:
             if node.op is None:
                 continue
-            dev = device_of(node)
-            ins = [jax.device_put(env[(id(s), oi)], dev)
-                   for s, oi in node.inputs]
-            sub = None
-            if node.op.needs_rng:
-                key, sub = jax.random.split(key)
+            ins = [env[(id(s), oi)] for s, oi in node.inputs]
+            if place_fn is not None:
+                dev = place_fn(node)
+                ins = [jax.device_put(v, dev) for v in ins]
+            sub = keys[rng_pos[id(node)]] if node.op.needs_rng else None
             outs = node.op.traceable(node.attrs, train_mode=is_train,
                                      rng=sub)(*ins)
             outs = outs if isinstance(outs, tuple) else (outs,)
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o
+                if observe_fn is not None:
+                    observe_fn(node, i, o)
             for aux_in, oidx in (node.op.aux_updates or {}).items():
                 if aux_in < len(node.inputs):
                     src, _ = node.inputs[aux_in]
@@ -320,6 +290,24 @@ class Executor:
         new_aux = tuple(aux_new[id(n)] if aux_new[id(n)] is not None
                         else env[(id(n), 0)] for n in self._aux_nodes)
         return outs, new_aux
+
+    def _forward_monitored(self, arg_vals, aux_vals, rng, is_train):
+        """Monitor path: eager walk tapping every intermediate."""
+        def observe(node, i, o):
+            name = node.output_name(i) if i < node.num_outputs() \
+                else "%s_aux%d" % (node.name, i)
+            self._monitor(name, _wrap(o, self._ctx))
+        return self._eager_walk(arg_vals, aux_vals, rng, is_train,
+                                observe_fn=observe)
+
+    def _forward_grouped(self, arg_vals, aux_vals, rng, is_train):
+        """group2ctx path: eager walk with per-group device placement."""
+        def place(node):
+            group = (node.attrs or {}).get("__ctx_group__")
+            ctx = self._group2ctx.get(group) if group else None
+            return (ctx or self._ctx).jax_device
+        return self._eager_walk(arg_vals, aux_vals, rng, is_train,
+                                place_fn=place)
 
     def backward(self, out_grads=None, is_train=True):
         if self._vjp is None:

@@ -137,10 +137,12 @@ class KVStore:
             picked = jnp.take(full, rows, axis=0)
             sparse = jnp.zeros_like(full).at[rows].set(picked)
             for dst in olist:
-                dst._set_data(sparse.astype(dst.dtype))
+                placed = jax.device_put(sparse.astype(dst.dtype),
+                                        dst.context.jax_device)
+                dst._set_data(placed)
                 dst._stype = "row_sparse"
                 if hasattr(dst, "_seed_sparse"):
-                    dst._seed_sparse(rows, picked)
+                    dst._seed_sparse(rows, jnp.take(placed, rows, axis=0))
 
     def set_updater(self, updater):
         self._updater = updater

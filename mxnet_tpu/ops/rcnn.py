@@ -274,7 +274,7 @@ def _bilinear_sample_chw(img, ys, xs):
 
 
 def _deform_conv_one(img, offs, weight, bias, kernel, stride, pad, dilate,
-                     num_deformable_group):
+                     num_deformable_group, num_group=1):
     """Deformable conv for one sample.
 
     img (Cin, H, W); offs (2*dg*kh*kw, Ho, Wo); weight (Cout, Cin, kh, kw).
@@ -303,7 +303,18 @@ def _deform_conv_one(img, offs, weight, bias, kernel, stride, pad, dilate,
             ys.astype(jnp.float32), xs.astype(jnp.float32))
         cols.append(sampled)                             # (cpg, kh,kw,Ho,Wo)
     col = jnp.concatenate(cols, axis=0)                  # (Cin, kh,kw,Ho,Wo)
-    out = jnp.einsum("ckrhw,ockr->ohw", col, weight)
+    cout = weight.shape[0]
+    if num_group > 1:
+        # grouped conv: weight is (Cout, Cin/groups, kh, kw); contract
+        # each output group against its input-channel slice
+        cpg_in = cin // num_group
+        cpg_out = cout // num_group
+        col_g = col.reshape(num_group, cpg_in, kh, kw, -1)
+        w_g = weight.reshape(num_group, cpg_out, cpg_in, kh, kw)
+        out = jnp.einsum("gckrx,gockr->gox", col_g, w_g)
+        out = out.reshape(cout, *col.shape[3:])
+    else:
+        out = jnp.einsum("ckrhw,ockr->ohw", col, weight)
     if bias is not None:
         out = out + bias[:, None, None]
     return out
@@ -326,7 +337,7 @@ def _deformable_convolution(data, offset, weight, *maybe_bias,
     dilate = tuple(int(d) for d in dilate)
     fn = lambda img, offs: _deform_conv_one(
         img, offs, weight, bias, kernel, stride, pad, dilate,
-        int(num_deformable_group))
+        int(num_deformable_group), int(num_group))
     return jax.vmap(fn)(data, offset)
 
 

@@ -45,11 +45,12 @@ def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
     sizes = _as_floats(sizes)
     ratios = _as_floats(ratios)
     h, w = data.shape[2], data.shape[3]
-    step_y = float(steps[1]) if steps[1] > 0 else 1.0 / h
-    step_x = float(steps[0]) if steps[0] > 0 else 1.0 / w
+    # reference param order is (step_y, step_x) / (offset_y, offset_x)
+    step_y = float(steps[0]) if steps[0] > 0 else 1.0 / h
+    step_x = float(steps[1]) if steps[1] > 0 else 1.0 / w
 
-    cy = (jnp.arange(h, dtype=jnp.float32) + float(offsets[1])) * step_y
-    cx = (jnp.arange(w, dtype=jnp.float32) + float(offsets[0])) * step_x
+    cy = (jnp.arange(h, dtype=jnp.float32) + float(offsets[0])) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + float(offsets[1])) * step_x
     cy, cx = jnp.meshgrid(cy, cx, indexing="ij")        # (H, W)
 
     # per-anchor half extents
@@ -172,6 +173,7 @@ def _target_one(anchors, label, cls_pred_t, overlap_threshold, ignore_label,
     valid = gt_cls >= 0
 
     ious = _iou_matrix(anchors, gt_boxes)
+    best_iou_any = jnp.max(jnp.where(valid[None, :], ious, 0.0), axis=1)
     match, _ = _match_anchors(ious, valid, overlap_threshold)
     is_fg = match >= 0
     safe_match = jnp.clip(match, 0, label.shape[0] - 1)
@@ -187,7 +189,9 @@ def _target_one(anchors, label, cls_pred_t, overlap_threshold, ignore_label,
         # cls_pred_t: (num_classes+1, A) scores; negatives where max
         # non-background prob is high are "hard"
         bg_scores = cls_pred_t[0]
-        neg_mask = ~is_fg
+        # near-positives (IoU above the mining threshold) are excluded
+        # from the negative pool, per the reference semantics
+        neg_mask = ~is_fg & (best_iou_any < negative_mining_thresh)
         hardness = jnp.where(neg_mask, -bg_scores, -jnp.inf)
         n_fg = jnp.sum(is_fg)
         quota = jnp.maximum((negative_mining_ratio * n_fg).astype(jnp.int32),

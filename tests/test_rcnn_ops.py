@@ -102,3 +102,17 @@ def test_deformable_psroi_no_trans_matches_psroi():
         nd.array(data), nd.array(rois), spatial_scale=0.25, output_dim=D,
         pooled_size=2, group_size=g, no_trans=True).asnumpy()
     np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_deformable_conv_grouped():
+    """num_group > 1 contracts each output group against its input slice."""
+    from mxnet_tpu.ops.rcnn import _deform_conv_one
+    np.random.seed(5)
+    img = jnp.asarray(np.random.rand(4, 6, 6), jnp.float32)
+    wgt = jnp.asarray(np.random.rand(4, 2, 3, 3), jnp.float32)  # groups=2
+    offs = jnp.zeros((2 * 9, 4, 4), jnp.float32)
+    out = _deform_conv_one(img, offs, wgt, None, (3, 3), (1, 1), (0, 0),
+                           (1, 1), 1, num_group=2)
+    ref = lax.conv_general_dilated(img[None], wgt, (1, 1), "VALID",
+                                   feature_group_count=2)[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)

@@ -95,3 +95,15 @@ def test_multibox_detection_nms_suppression():
     valid = dets[dets[:, 0] >= 0]
     assert valid.shape[0] == 1
     assert abs(valid[0, 1] - 0.9) < 1e-5
+
+
+def test_multibox_prior_steps_offsets_are_y_then_x():
+    """steps/offsets follow the reference (y, x) order."""
+    x = nd.zeros((1, 3, 2, 4))     # H=2, W=4
+    out = nd.contrib.MultiBoxPrior(x, sizes=(0.2,),
+                                   steps=(0.5, 0.25),      # (y, x)
+                                   offsets=(0.0, 0.5)).asnumpy()[0]
+    # first anchor center: cy = (0+0.0)*0.5 = 0, cx = (0+0.5)*0.25 = 0.125
+    cy = (out[0, 1] + out[0, 3]) / 2
+    cx = (out[0, 0] + out[0, 2]) / 2
+    assert abs(cy - 0.0) < 1e-6 and abs(cx - 0.125) < 1e-6
