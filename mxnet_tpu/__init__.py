@@ -41,6 +41,8 @@ from . import operator
 from . import rnn
 from . import contrib
 from . import torch
+from . import predict
+from .predict import Predictor
 from . import lr_scheduler
 from . import callback
 from . import io

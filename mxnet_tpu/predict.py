@@ -1,0 +1,81 @@
+"""Predict-only inference API.
+
+Reference analogue: the amalgamation build's C predict API
+(``include/mxnet/c_predict_api.h`` / ``src/c_api/c_predict_api.cc`` —
+MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutput): a
+minimal deployment surface that loads a ``-symbol.json`` + ``.params``
+checkpoint and runs forward passes, nothing else.
+
+TPU-native: the whole graph compiles to one jitted XLA program at
+``Predictor`` creation; repeated ``forward`` calls reuse it.
+
+    pred = Predictor.load("model-prefix", epoch=3,
+                          input_shapes={"data": (1, 3, 224, 224)})
+    probs = pred.forward(data=batch)[0]
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .context import cpu
+
+__all__ = ["Predictor"]
+
+
+class Predictor(object):
+    """A bound inference-only executor over a saved checkpoint."""
+
+    def __init__(self, symbol, arg_params, aux_params, input_shapes,
+                 ctx=None):
+        ctx = ctx or cpu()
+        self._ctx = ctx
+        self._input_names = list(input_shapes)
+        args = {}
+        shapes = dict(input_shapes)
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        for name, shape in zip(symbol.list_arguments(), arg_shapes):
+            if name in input_shapes:
+                args[name] = nd.zeros(input_shapes[name], ctx=ctx)
+            elif name in arg_params:
+                args[name] = arg_params[name].as_in_context(ctx)
+            else:
+                raise MXNetError("checkpoint is missing parameter %r" % name)
+        auxs = {}
+        for name, shape in zip(symbol.list_auxiliary_states(), aux_shapes):
+            if name not in aux_params:
+                raise MXNetError("checkpoint is missing aux state %r" % name)
+            auxs[name] = aux_params[name].as_in_context(ctx)
+        self._exe = symbol.bind(ctx, args, aux_states=auxs, grad_req="null")
+        self.output_names = symbol.list_outputs()
+
+    @classmethod
+    def load(cls, prefix, epoch, input_shapes, ctx=None):
+        """Build a predictor from ``prefix-symbol.json`` +
+        ``prefix-{epoch:04d}.params`` (ref MXPredCreate)."""
+        from .model import load_checkpoint
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(symbol, arg_params, aux_params, input_shapes, ctx=ctx)
+
+    def set_input(self, **inputs):
+        """Load input arrays by name (ref MXPredSetInput)."""
+        for name, value in inputs.items():
+            if name not in self._input_names:
+                raise MXNetError("unknown input %r (have %s)"
+                                 % (name, self._input_names))
+            arr = value if isinstance(value, nd.NDArray) \
+                else nd.array(np.asarray(value, np.float32))
+            arr.copyto(self._exe.arg_dict[name])
+
+    def forward(self, **inputs):
+        """Set inputs (optional) and run inference; returns the output
+        list (ref MXPredForward + MXPredGetOutput)."""
+        if inputs:
+            self.set_input(**inputs)
+        return self._exe.forward(is_train=False)
+
+    def get_output(self, index=0):
+        if self._exe.outputs is None:
+            raise MXNetError("run forward() first")
+        return self._exe.outputs[index]

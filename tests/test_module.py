@@ -223,3 +223,27 @@ def test_python_loss_module_chain():
     pred = np.concatenate(seq_out).argmax(axis=1)
     acc = (pred == Y).mean()
     assert acc > 0.8, acc
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    """Predict-only surface (ref c_predict_api.cc MXPredCreate/Forward):
+    save a trained Module, reload through Predictor, outputs match."""
+    np.random.seed(0)
+    X = np.random.randn(32, 6).astype(np.float32)
+    Y = np.random.randint(0, 3, 32).astype(np.float32)
+    it = io.NDArrayIter(X, Y, batch_size=8)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=3, name="pfc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "pred")
+    mod.save_checkpoint(prefix, 2)
+
+    pred = mx.Predictor.load(prefix, 2,
+                             input_shapes={"data": (8, 6),
+                                           "softmax_label": (8,)})
+    out = pred.forward(data=X[:8])[0].asnumpy()
+    mod_out = mod.predict(io.NDArrayIter(X[:8], Y[:8], batch_size=8))
+    np.testing.assert_allclose(out, mod_out.asnumpy(), rtol=1e-5, atol=1e-6)
