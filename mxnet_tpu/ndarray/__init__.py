@@ -63,7 +63,7 @@ def _make_op_func(name, op):
         res = invoke(op, ndargs, kwargs, out=out)
         return res[0] if len(res) == 1 else res
     op_func.__name__ = name
-    op_func.__doc__ = (op.fn.__doc__ or "") + "\n(op: %s)" % op.name
+    op_func.__doc__ = op.describe()
     return op_func
 
 

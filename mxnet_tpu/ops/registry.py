@@ -153,6 +153,40 @@ class Op:
         f.defvjp(fwd, bwd)
         return f
 
+    def param_table(self):
+        """Typed parameter reflection (the dmlc-Parameter analogue,
+        ref DMLC_DECLARE_PARAMETER / SURVEY §5.6): [(name, type, default)]
+        derived from the kernel signature."""
+        import inspect
+        rows = []
+        try:
+            sig = inspect.signature(self.fn)
+        except (TypeError, ValueError):
+            return rows
+        for p in sig.parameters.values():
+            if p.default is inspect.Parameter.empty:
+                continue
+            if p.name in ("train_mode", "rng") or p.kind == p.VAR_KEYWORD:
+                continue
+            default = self.attr_defaults.get(p.name, p.default)
+            rows.append((p.name, type(default).__name__, default))
+        return rows
+
+    def describe(self):
+        """Human-readable op description with its parameter table."""
+        lines = ["Operator %s" % self.name]
+        doc = (self.fn.__doc__ or "").strip()
+        if doc:
+            lines.append(doc)
+        rows = self.param_table()
+        if rows:
+            lines.append("")
+            lines.append("Parameters")
+            lines.append("----------")
+            for name, tname, default in rows:
+                lines.append("%s : %s, default %r" % (name, tname, default))
+        return "\n".join(lines)
+
     def __repr__(self):
         return "Op(%s)" % self.name
 

@@ -287,7 +287,7 @@ class FusedRNNCell(BaseRNNCell):
             anchor = sym.sum(inputs * 0.0, axis=[0, 2], keepdims=False)
             # anchor: (N,) zeros → (L*d, N, H) zeros
             state0 = sym.broadcast_add(
-                sym.reshape(anchor, (1, -1, 1)),
+                sym.reshape(anchor, shape=(1, -1, 1)),
                 sym.zeros((d * self._num_layers, 1, width)))
             states = [state0, state0] if self._mode == "lstm" else [state0]
         else:

@@ -1,0 +1,92 @@
+"""Symbolic mx.rnn package tests (reference tests/python/unittest/test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _run_sym(sym, shapes, seed=0):
+    np.random.seed(seed)
+    args = {}
+    arg_shapes, out_shapes, _ = sym.infer_shape(**shapes)
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        args[name] = nd.array(np.random.randn(*shape).astype(np.float32)
+                              * 0.1)
+    exe = sym.bind(mx.cpu(), args)
+    return exe.forward()[0], out_shapes
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(num_hidden=16, prefix="r_")
+    data = mx.sym.Variable("data")
+    outs, states = cell.unroll(3, data, merge_outputs=True)
+    out, shapes = _run_sym(outs, {"data": (2, 3, 8)})
+    assert out.shape == (2, 3, 16)
+
+
+def test_lstm_gru_unroll_and_states():
+    for make, n_states in ((lambda: mx.rnn.LSTMCell(12, prefix="l_"), 2),
+                           (lambda: mx.rnn.GRUCell(12, prefix="g_"), 1)):
+        cell = make()
+        data = mx.sym.Variable("data")
+        outs, states = cell.unroll(4, data, merge_outputs=True)
+        assert len(states) == n_states
+        out, _ = _run_sym(outs, {"data": (3, 4, 6)})
+        assert out.shape == (3, 4, 12)
+        assert np.isfinite(out.asnumpy()).all()
+
+
+def test_sequential_and_residual_cells():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="s0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(8, prefix="s1_")))
+    data = mx.sym.Variable("data")
+    outs, states = stack.unroll(3, data, merge_outputs=True)
+    out, _ = _run_sym(outs, {"data": (2, 3, 8)})
+    assert out.shape == (2, 3, 8)
+    assert len(states) == 4
+
+
+def test_bidirectional_cell():
+    cell = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(8, prefix="fw_"),
+                                    mx.rnn.LSTMCell(8, prefix="bw_"))
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(3, data, merge_outputs=True)
+    out, _ = _run_sym(outs, {"data": (2, 3, 5)})
+    assert out.shape == (2, 3, 16)     # fwd+bwd concat
+
+
+def test_fused_rnn_cell_unroll_and_unfuse():
+    fused = mx.rnn.FusedRNNCell(10, num_layers=2, mode="lstm",
+                                prefix="f_")
+    data = mx.sym.Variable("data")
+    outs, _ = fused.unroll(5, data, layout="NTC", merge_outputs=True)
+    out, _ = _run_sym(outs, {"data": (3, 5, 7)})
+    assert out.shape == (3, 5, 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+    stack = fused.unfuse()
+    outs2, _ = stack.unroll(5, data, merge_outputs=True)
+    out2, _ = _run_sym(outs2, {"data": (3, 5, 7)})
+    assert out2.shape == (3, 5, 10)
+
+
+def test_bucket_sentence_iter_contract():
+    sents = [[1, 2, 3], [4, 5, 6, 7, 8], [1, 2], [3, 4, 5, 6]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[4, 8],
+                                   invalid_label=0)
+    batch = it.next()
+    assert batch.bucket_key in (4, 8)
+    data = batch.data[0].asnumpy()
+    label = batch.label[0].asnumpy()
+    assert data.shape == (2, batch.bucket_key)
+    # label is data shifted one step left
+    np.testing.assert_array_equal(label[:, :-1], data[:, 1:])
+
+
+def test_encode_sentences_grows_vocab():
+    enc, vocab = mx.rnn.encode_sentences([["a", "b"], ["b", "c"]],
+                                         start_label=1)
+    assert sorted(set(sum(enc, []))) == [1, 2, 3]
+    assert set(vocab) >= {"a", "b", "c"}
