@@ -408,3 +408,70 @@ def _diag(x, k=0, **kw):
 def _cast_storage(x, stype=None, **kw):
     # dense backing for all stypes; the NDArray wrapper re-tags the stype.
     return x
+
+
+def _region(shape, begin, end, step=None):
+    """Slice objects for the reference begin/end(/step) attr convention."""
+    begin = tuple(begin)
+    end = tuple(end)
+    step = tuple(step) if step else (None,) * len(begin)
+    out = []
+    for i in range(len(shape)):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        st = step[i] if i < len(step) else None
+        out.append(slice(b, e, st if st not in (0,) else None))
+    return tuple(out)
+
+
+@register("_slice_assign", aliases=["_crop_assign"], nondiff_inputs=())
+def _slice_assign(lhs, rhs, begin=(), end=(), step=(), **kw):
+    """Write rhs into lhs[begin:end:step] (ref tensor/matrix_op.cc
+    _slice_assign): returns the updated array (functional in-place)."""
+    return lhs.at[_region(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register("_crop_assign_scalar", nondiff_inputs=())
+def _crop_assign_scalar(data, scalar=0.0, begin=(), end=(), **kw):
+    """Fill data[begin:end] with a scalar (ref _crop_assign_scalar)."""
+    return data.at[_region(data.shape, begin, end)].set(scalar)
+
+
+def _no_gradient_bwd(gout, arrs, out, attrs):
+    return (jnp.zeros_like(arrs[0]),)
+
+
+@register("_NoGradient", custom_vjp=_no_gradient_bwd)
+def _no_gradient(data, **kw):
+    """Identity whose gradient is defined as zero (ref _NoGradient node —
+    distinct from BlockGrad only in how the reference graph passes used it)."""
+    return data
+
+
+@register("_CrossDeviceCopy")
+def _cross_device_copy(data, **kw):
+    """Explicit device-boundary copy node (ref PlaceDevice inserts these,
+    graph_executor.cc:403). Placement on this build is handled by the
+    executor's group2ctx walk / shardings, so the op itself is identity."""
+    return data
+
+
+def _kl_sparse_bwd(gout, arrs, out, attrs):
+    data = arrs[0]
+    target = float(attrs.get("sparseness_target", 0.1))
+    penalty = float(attrs.get("penalty", 0.001))
+    momentum = float(attrs.get("momentum", 0.9))  # noqa: F841 (API parity)
+    # mean activation per unit over the batch axis
+    rho_hat = jnp.clip(jnp.mean(data, axis=0, keepdims=True), 1e-6, 1 - 1e-6)
+    kl_grad = (-target / rho_hat + (1.0 - target) / (1.0 - rho_hat)) \
+        / data.shape[0]
+    return (gout[0] + penalty * kl_grad,)
+
+
+@register("IdentityAttachKLSparseReg", custom_vjp=_kl_sparse_bwd)
+def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                   penalty=0.001, momentum=0.9, **kw):
+    """Identity forward; backward adds the KL sparseness penalty gradient
+    (ref src/operator/regression_output... identity_attach_KL_sparse_reg:
+    drives mean activations toward sparseness_target)."""
+    return data
