@@ -22,10 +22,40 @@ Compute runs in bfloat16 (the MXU design point); the driver executes this
 on the real TPU chip.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _tpu_reachable(timeout=240):
+    """Probe the accelerator backend in a subprocess.
+
+    The axon tunnel is single-client and can wedge indefinitely if a
+    previous client died uncleanly; probing out-of-process keeps THIS
+    process able to fall back to CPU (pinning must happen before any
+    backend touch, which is why the probe cannot run inline).
+    """
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform != 'cpu'"],
+            timeout=timeout, capture_output=True)
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+if not _tpu_reachable():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 BATCH = 32
