@@ -61,3 +61,14 @@ def test_multiprocess_pjit():
     rcs = launch(2, 0, [sys.executable, PJIT_WORKER],
                  env_extra=env, timeout=400)
     assert rcs == [0, 0], "worker exit codes: %r" % (rcs,)
+
+
+LENET_WORKER = os.path.join(REPO, "tests", "dist_lenet.py")
+
+
+def test_dist_lenet_end_to_end():
+    """Real Module.fit over dist_sync across 2 workers: parameters agree
+    fleet-wide and the model converges (ref tests/nightly/dist_lenet.py)."""
+    rcs = launch(2, 1, [sys.executable, LENET_WORKER],
+                 env_extra=ENV, timeout=600)
+    assert rcs == [0, 0], "worker exit codes: %r" % (rcs,)

@@ -1,0 +1,129 @@
+"""Indexing / ordering / sequence operator tests vs numpy oracles
+(widening toward reference test_operator.py's take/one_hot/topk/sort/
+sequence-op coverage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_take_axis0_matches_numpy():
+    src = np.random.randn(6, 4).astype(np.float32)
+    idx = np.array([0, 5, 2], np.float32)
+    out = nd.take(nd.array(src), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(out, src[[0, 5, 2]])
+
+
+def test_batch_take():
+    src = np.random.randn(3, 5).astype(np.float32)
+    idx = np.array([1, 0, 4], np.float32)
+    out = nd.batch_take(nd.array(src), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(out, src[np.arange(3), [1, 0, 4]])
+
+
+def test_gather_scatter_nd_roundtrip():
+    data = np.random.randn(4, 5).astype(np.float32)
+    indices = np.array([[0, 2, 3], [1, 4, 0]], np.float32)  # (2, M)
+    picked = nd.gather_nd(nd.array(data), nd.array(indices)).asnumpy()
+    np.testing.assert_allclose(picked, data[[0, 2, 3], [1, 4, 0]])
+    scattered = nd.scatter_nd(nd.array(picked), nd.array(indices),
+                              shape=(4, 5)).asnumpy()
+    expect = np.zeros((4, 5), np.float32)
+    expect[[0, 2, 3], [1, 4, 0]] = picked
+    np.testing.assert_allclose(scattered, expect)
+
+
+def test_one_hot_and_pick_inverse():
+    labels = np.array([0, 3, 1], np.float32)
+    oh = nd.one_hot(nd.array(labels), depth=4).asnumpy()
+    np.testing.assert_allclose(oh.argmax(axis=1), labels)
+    probs = np.random.rand(3, 4).astype(np.float32)
+    picked = nd.pick(nd.array(probs), nd.array(labels), axis=1).asnumpy()
+    np.testing.assert_allclose(picked,
+                               probs[np.arange(3), labels.astype(int)])
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_topk_matches_numpy(k):
+    x = np.random.randn(4, 7).astype(np.float32)
+    vals = nd.topk(nd.array(x), k=k, ret_typ="value").asnumpy()
+    expect = np.sort(x, axis=1)[:, ::-1][:, :k]
+    np.testing.assert_allclose(vals, expect, rtol=1e-6)
+
+
+def test_sort_and_argsort():
+    x = np.random.randn(3, 6).astype(np.float32)
+    np.testing.assert_allclose(nd.sort(nd.array(x)).asnumpy(),
+                               np.sort(x, axis=-1), rtol=1e-6)
+    np.testing.assert_array_equal(
+        nd.argsort(nd.array(x)).asnumpy().astype(np.int64),
+        np.argsort(x, axis=-1, kind="stable"))
+
+
+def test_where_broadcast_and_grad():
+    cond = np.array([[1, 0], [0, 1]], np.float32)
+    a = np.random.randn(2, 2).astype(np.float32)
+    b = np.random.randn(2, 2).astype(np.float32)
+    out = nd.where(nd.array(cond), nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, np.where(cond > 0, a, b))
+
+
+def test_sequence_mask_last_reverse():
+    # (T, N, C) = (4, 2, 3)
+    x = np.arange(24, dtype=np.float32).reshape(4, 2, 3)
+    lengths = np.array([2, 4], np.float32)
+
+    masked = nd.SequenceMask(nd.array(x), nd.array(lengths),
+                             use_sequence_length=True, value=-1.0).asnumpy()
+    np.testing.assert_allclose(masked[:2, 0], x[:2, 0])
+    assert (masked[2:, 0] == -1.0).all()
+    np.testing.assert_allclose(masked[:, 1], x[:, 1])
+
+    last = nd.SequenceLast(nd.array(x), nd.array(lengths),
+                           use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x[1, 0])     # length 2 -> step 1
+    np.testing.assert_allclose(last[1], x[3, 1])
+
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lengths),
+                             use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(rev[0, 0], x[1, 0])
+    np.testing.assert_allclose(rev[1, 0], x[0, 0])
+    np.testing.assert_allclose(rev[2:, 0], x[2:, 0])  # beyond length: keep
+    np.testing.assert_allclose(rev[:, 1], x[::-1, 1])
+
+
+def test_reverse_tile_repeat():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_allclose(nd.reverse(nd.array(x), axis=1).asnumpy(),
+                               x[:, ::-1])
+    np.testing.assert_allclose(nd.tile(nd.array(x), reps=(2, 1)).asnumpy(),
+                               np.tile(x, (2, 1)))
+    np.testing.assert_allclose(nd.repeat(nd.array(x), repeats=2,
+                                         axis=0).asnumpy(),
+                               np.repeat(x, 2, axis=0))
+
+
+def test_embedding_grad_is_row_scatter():
+    """Embedding backward accumulates per-row gradients (the row_sparse
+    gradient pattern, ref indexing_op.cc Embedding)."""
+    weight = nd.array(np.random.randn(5, 3).astype(np.float32))
+    weight.attach_grad()
+    idx = nd.array(np.array([1, 1, 4], np.float32))
+    with mx.autograd.record():
+        out = nd.Embedding(idx, weight, input_dim=5, output_dim=3)
+        loss = out.sum()
+    loss.backward()
+    g = weight.grad.asnumpy()
+    np.testing.assert_allclose(g[1], 2.0)     # row 1 used twice
+    np.testing.assert_allclose(g[4], 1.0)
+    np.testing.assert_allclose(g[[0, 2, 3]], 0.0)
+
+
+def test_bilinear_sampler_identity_grid():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)   # (1, 2, 4, 4)
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
