@@ -1,10 +1,11 @@
-"""ctypes bindings to the native runtime (native/ → librecordio.so).
+"""ctypes bindings to the native runtime (native/ → lib*.so).
 
 Reference analogue: the ctypes bridge in ``python/mxnet/base.py`` loading
-``libmxnet.so``.  Here the native surface is the IO substrate (RecordIO
-codec; SURVEY §2.1 "Data IO (native)").  Binding is optional: when the
-shared object hasn't been built (``make -C native``), callers fall back to
-the pure-python implementation of the identical wire format.
+``libmxnet.so``.  Here the native surface is split per subsystem
+(RecordIO codec, threaded image loader, dependency engine; SURVEY §2.1).
+Binding is optional: when a shared object hasn't been built
+(``make -C native``), callers fall back to pure-python implementations of
+the identical contract.
 """
 from __future__ import annotations
 
@@ -13,52 +14,59 @@ import os
 import subprocess
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "librecordio.so")
-_lib = None
-_tried = False
 
 
-def _try_build():
-    """Best-effort lazy build with the in-image toolchain (g++).
-
-    Serialized via a lock file so concurrent DataLoader workers don't race
-    the same `make`; logs one line so the (up to ~min) compile isn't a
-    silent stall.
+def load_shared(so_name):
+    """Load ``so_name`` from the package dir, lazily building it with the
+    in-image toolchain on first miss (serialized via a per-target lock
+    file so concurrent workers don't race the same ``make``).  Returns a
+    CDLL or None.
     """
+    so_path = os.path.join(_DIR, so_name)
+    if not os.path.exists(so_path) and \
+            os.environ.get("MXNET_TPU_BUILD_NATIVE", "1") == "1":
+        _try_build(so_path)
+    if not os.path.exists(so_path):
+        return None
+    return ctypes.CDLL(so_path)
+
+
+def _try_build(so_path):
     native_dir = os.path.join(os.path.dirname(_DIR), "..", "native")
     if not os.path.isdir(native_dir):
         return False
     import logging
     logging.getLogger("mxnet_tpu").info(
-        "building native recordio codec (one-time; set "
-        "MXNET_TPU_BUILD_NATIVE=0 to skip)")
-    lock_path = os.path.join(_DIR, ".build.lock")
+        "building %s (one-time; set MXNET_TPU_BUILD_NATIVE=0 to skip)",
+        os.path.basename(so_path))
+    lock_path = so_path + ".build.lock"
     try:
         import fcntl
         with open(lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
-            if os.path.exists(_SO):      # another process built it
+            if os.path.exists(so_path):      # another process built it
                 return True
             subprocess.run(["make", "-C", native_dir,
-                            os.path.relpath(_SO, native_dir)],
+                            os.path.relpath(so_path, native_dir)],
                            check=True, capture_output=True, timeout=120)
-        return os.path.exists(_SO)
+        return os.path.exists(so_path)
     except Exception:
         return False
 
 
+_lib = None
+_tried = False
+
+
 def lib():
-    """The loaded CDLL, or None when unavailable."""
+    """The RecordIO codec CDLL, or None when unavailable."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and \
-            os.environ.get("MXNET_TPU_BUILD_NATIVE", "1") == "1":
-        _try_build()
-    if not os.path.exists(_SO):
+    l = load_shared("librecordio.so")
+    if l is None:
         return None
-    l = ctypes.CDLL(_SO)
     l.MXRIOWriterCreate.restype = ctypes.c_void_p
     l.MXRIOWriterCreate.argtypes = [ctypes.c_char_p]
     l.MXRIOWrite.restype = ctypes.c_int

@@ -1,25 +1,44 @@
-"""Engine facade: observable async semantics over XLA/PJRT dispatch.
+"""Engine: dependency-scheduled host tasks + observable async semantics
+over XLA/PJRT dispatch.
 
 Reference analogue: the threaded dependency engine
 (``include/mxnet/engine.h:95-280``, ``src/engine/threaded_engine.cc``) whose
-*observable* contract is: ops issue asynchronously; ``WaitForVar`` blocks
+observable contract is: ops issue asynchronously; ``WaitForVar`` blocks
 until pending writes land; ``WaitForAll`` drains everything; writes to one
-buffer serialize, reads run in parallel (SURVEY §3.3).
+buffer serialize in push order, reads run in parallel (SURVEY §3.3).
 
-On TPU the entire scheduler is XLA/PJRT: jax dispatch is already async, jax
-arrays are immutable (so write-serialization is by construction — each
-mutation produces a new buffer), and ``block_until_ready`` is WaitForVar.
-This facade keeps the API (and the NaiveEngine-style ``--sync_dispatch``
-debug mode, reference ``MXNET_ENGINE_TYPE=NaiveEngine``) for parity tests.
+TPU-native split of responsibilities:
+
+* **Device side** — XLA/PJRT *is* the engine: jax dispatch is already
+  async, jax arrays are immutable (write-serialization by construction —
+  each mutation rebinds to a new buffer) and ``block_until_ready`` is
+  WaitForVar.  ``wait_for_var``/``wait_for_all``/``push`` below keep that
+  facade, including the NaiveEngine-style sync-dispatch debug mode
+  (reference ``MXNET_ENGINE_TYPE=NaiveEngine``).
+
+* **Host side** — the reference also routes IO, checkpoint, and kvstore
+  transport through the engine.  ``ThreadedEngine`` below is a real native
+  scheduler (C++ worker pool + per-variable dependency queues,
+  ``native/engine.cc`` via ctypes) with the same protocol: tasks declare
+  ``const_vars`` (reads) and ``mutable_vars`` (writes); the engine
+  guarantees serialized writes and parallel reads per variable.
+
+Env vars (docs/env_var.md): ``MXNET_ENGINE_TYPE=NaiveEngine`` forces
+synchronous execution everywhere (usable backtraces);
+``MXNET_CPU_WORKER_NTHREADS`` sizes the native worker pool.
 """
 from __future__ import annotations
 
+import atexit
+import ctypes
+import itertools
 import os
+import threading
 
 import jax
 
 __all__ = ["wait_for_var", "wait_for_all", "push", "is_sync_dispatch",
-           "set_sync_dispatch"]
+           "set_sync_dispatch", "ThreadedEngine", "engine"]
 
 _SYNC = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
 
@@ -33,17 +52,34 @@ def set_sync_dispatch(flag):
     NaiveEngine idea — crashes surface with a usable backtrace)."""
     global _SYNC
     _SYNC = bool(flag)
+    eng = _SINGLETON
+    if eng is not None:
+        eng.set_sync(flag)
 
+
+# ---------------------------------------------------------------------------
+# Device-side facade (XLA/PJRT is the scheduler)
+# ---------------------------------------------------------------------------
 
 def wait_for_var(arr):
-    """Block until all pending computation producing ``arr`` is done."""
+    """Block until all pending computation producing ``arr`` is done.
+
+    Accepts a jax/NDArray value (PJRT future) or an ``int`` variable
+    handle from :meth:`ThreadedEngine.new_variable`.
+    """
+    if isinstance(arr, int):
+        engine().wait_for_var(arr)
+        return
     jax.block_until_ready(arr)
 
 
 def wait_for_all():
     """Engine::WaitForAll — drain every outstanding computation."""
-    # PJRT has no global barrier; sync all live committed arrays is
-    # unnecessary — an empty device sync per backend suffices.
+    eng = _SINGLETON
+    if eng is not None:
+        eng.wait_for_all()
+    # PJRT has no global barrier; an empty device sync per backend
+    # suffices for the device side.
     for dev in jax.devices():
         try:
             jax.device_put(0, dev).block_until_ready()
@@ -57,3 +93,231 @@ def push(fn, *args, **kwargs):
     if _SYNC:
         jax.block_until_ready(out)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side native engine
+# ---------------------------------------------------------------------------
+
+# One immortal ctypes trampoline shared by every task: the C side receives
+# (trampoline, key) and the key resolves to the Python callable at run
+# time.  This avoids per-task CFUNCTYPE closures entirely — nothing to
+# keep alive per task, nothing to free while a C stack frame might still
+# reference it.
+_TASKS_LOCK = threading.Lock()
+_LIVE_TASKS = {}          # key -> (engine, callable)
+_KEY_SEQ = itertools.count(1)
+_TRAMPOLINE = None        # created on first native engine
+
+
+def _make_trampoline(fn_type):
+    global _TRAMPOLINE
+    if _TRAMPOLINE is None:
+        def _run(arg):
+            key = int(arg or 0)
+            with _TASKS_LOCK:
+                entry = _LIVE_TASKS.pop(key, None)
+            if entry is None:     # pragma: no cover - defensive
+                return
+            eng, fn = entry
+            try:
+                fn()
+            except BaseException as e:      # noqa: BLE001
+                with _TASKS_LOCK:
+                    eng._errors.append(e)
+        _TRAMPOLINE = fn_type(_run)
+    return _TRAMPOLINE
+
+
+class ThreadedEngine:
+    """Host-task scheduler with the reference engine's dependency protocol.
+
+    Backed by ``native/engine.cc`` (C++ worker pool, per-variable FIFO
+    dependency queues).  When the native library is unavailable the same
+    API degrades to synchronous inline execution — the observable
+    contract (completion order per variable) is preserved, only the
+    parallelism is lost.
+    """
+
+    def __init__(self, num_workers=None, sync=None):
+        from ._native import engine as nat
+        if num_workers is None:
+            num_workers = int(os.environ.get(
+                "MXNET_CPU_WORKER_NTHREADS",
+                str(min(8, os.cpu_count() or 1))))
+        if sync is None:
+            sync = _SYNC
+        self._nat = nat.lib()
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._inflight = 0          # native calls in progress (close gate)
+        self._errors = []
+        self._pyvar_seq = itertools.count(1)
+        if self._nat is not None:
+            self._h = self._nat.MXEngineCreate(int(num_workers),
+                                               1 if sync else 0)
+            self._trampoline = _make_trampoline(nat.TASK_FN)
+        else:
+            self._h = None
+
+    # -- variables ---------------------------------------------------------
+
+    def new_variable(self):
+        """A scheduling variable (an ``int`` handle)."""
+        h = self._enter_native()
+        if h is None:
+            return next(self._pyvar_seq)
+        try:
+            return int(self._nat.MXEngineNewVariable(h))
+        finally:
+            self._exit_native()
+
+    def delete_variable(self, var):
+        """GC the variable once every pending task touching it completes."""
+        h = self._enter_native()
+        if h is not None:
+            try:
+                self._nat.MXEngineDeleteVariable(h, int(var))
+            finally:
+                self._exit_native()
+
+    # -- tasks -------------------------------------------------------------
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        """Schedule ``fn()`` after its dependencies resolve.
+
+        ``const_vars`` are read-dependencies (may run concurrently with
+        other readers); ``mutable_vars`` are write-dependencies
+        (serialized in push order per variable).  Exceptions raised by
+        ``fn`` are captured and re-raised at the next wait point.
+        """
+        if self._h is None:
+            try:
+                fn()
+            except BaseException as e:      # noqa: BLE001
+                with _TASKS_LOCK:
+                    self._errors.append(e)
+            return
+
+        key = next(_KEY_SEQ)
+        with _TASKS_LOCK:
+            _LIVE_TASKS[key] = (self, fn)
+        h = self._enter_native()
+        if h is None:                        # closed concurrently
+            with _TASKS_LOCK:
+                _LIVE_TASKS.pop(key, None)
+            # Degrade like the no-native fallback: the task still runs.
+            try:
+                fn()
+            except BaseException as e:      # noqa: BLE001
+                with _TASKS_LOCK:
+                    self._errors.append(e)
+            return
+        try:
+            cv = (ctypes.c_int64 * max(1, len(const_vars)))(*const_vars)
+            mv = (ctypes.c_int64 * max(1, len(mutable_vars)))(*mutable_vars)
+            self._nat.MXEnginePushAsync(
+                h, self._trampoline, ctypes.c_void_p(key),
+                cv, len(const_vars), mv, len(mutable_vars), int(priority))
+        finally:
+            self._exit_native()
+
+    # -- synchronization ---------------------------------------------------
+
+    def _enter_native(self):
+        """Claim the handle for one native call; None when closed."""
+        with self._lock:
+            if self._h is None:
+                return None
+            self._inflight += 1
+            return self._h
+
+    def _exit_native(self):
+        with self._lock:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.notify_all()
+
+    def _raise_pending(self):
+        with _TASKS_LOCK:
+            if self._errors:
+                err = self._errors[0]
+                self._errors.clear()
+                raise err
+
+    def wait_for_var(self, var):
+        """Block until every write pushed on ``var`` so far has landed."""
+        h = self._enter_native()
+        if h is not None:
+            try:
+                self._nat.MXEngineWaitForVar(h, int(var))
+            finally:
+                self._exit_native()
+        self._raise_pending()
+
+    def wait_for_all(self):
+        h = self._enter_native()
+        if h is not None:
+            try:
+                self._nat.MXEngineWaitForAll(h)
+            finally:
+                self._exit_native()
+        self._raise_pending()
+
+    def num_pending(self):
+        h = self._enter_native()
+        if h is None:
+            return 0
+        try:
+            return int(self._nat.MXEnginePendingTasks(h))
+        finally:
+            self._exit_native()
+
+    def set_sync(self, flag):
+        h = self._enter_native()
+        if h is not None:
+            try:
+                self._nat.MXEngineSetSync(h, 1 if flag else 0)
+            finally:
+                self._exit_native()
+
+    def close(self):
+        """Drain and free the native engine (waits out concurrent calls)."""
+        with self._lock:
+            if self._h is None:
+                return
+            h, self._h = self._h, None   # new calls now see 'closed'
+            while self._inflight:
+                self._idle.wait()
+        self._nat.MXEngineWaitForAll(h)
+        self._nat.MXEngineFree(h)
+
+    @property
+    def native(self):
+        """True when backed by the C++ scheduler (not the sync fallback)."""
+        return self._h is not None
+
+
+_SINGLETON = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def engine():
+    """The process-wide host-task engine (created on first use)."""
+    global _SINGLETON
+    if _SINGLETON is None:
+        with _SINGLETON_LOCK:
+            if _SINGLETON is None:
+                _SINGLETON = ThreadedEngine()
+    return _SINGLETON
+
+
+@atexit.register
+def _shutdown():  # pragma: no cover - interpreter teardown
+    global _SINGLETON
+    if _SINGLETON is not None:
+        try:
+            _SINGLETON.close()
+        except Exception:
+            pass
+        _SINGLETON = None

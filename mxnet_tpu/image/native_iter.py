@@ -13,7 +13,6 @@ Falls back with ImportError when the shared object is absent (build with
 from __future__ import annotations
 
 import ctypes
-import os
 
 import numpy as np
 
@@ -22,14 +21,11 @@ from .. import ndarray as nd
 
 __all__ = ["ImageRecordIter"]
 
-_SO = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "_native", "libimageloader.so")
-
-
 def _lib():
-    if not os.path.exists(_SO):
+    from .._native import load_shared
+    lib = load_shared("libimageloader.so")
+    if lib is None:
         raise ImportError("libimageloader.so not built (make -C native)")
-    lib = ctypes.CDLL(_SO)
     lib.mx_imgloader_create.restype = ctypes.c_void_p
     lib.mx_imgloader_create.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,

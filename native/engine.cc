@@ -1,0 +1,375 @@
+// Threaded dependency engine for host-side task scheduling.
+//
+// Reference analogue: the dependency engine of
+// include/mxnet/engine.h:95-280 and src/engine/threaded_engine.{h,cc} —
+// every async task declares const (read) and mutable (write) variables;
+// the engine keeps a per-variable FIFO of pending blocks and dispatches a
+// task once all of its dependencies resolve.  Observable contract
+// (SURVEY §3.3): tasks issue asynchronously; writes to one variable
+// serialize in push order; reads between writes run in parallel;
+// WaitForVar blocks until pending writes land; WaitForAll drains; deleted
+// variables are garbage-collected only after their last pending task.
+//
+// TPU-native scope: device-side scheduling belongs to XLA/PJRT (async
+// dispatch, buffer liveness).  This engine schedules *host-side* work —
+// prefetch/decode pipelines, checkpoint IO, parameter-server transport —
+// under the same protocol, replacing the reference's use of the engine for
+// IO and kvstore tasks.  Exposed as a flat C ABI (the C-API layer of
+// SURVEY §1 row 9) and bound from Python via ctypes.
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+typedef void (*EngineTaskFn)(void* arg);
+
+struct Task;
+
+// One scheduling block in a variable's pending queue.
+struct VarBlock {
+  Task* task;
+  bool write;
+};
+
+// A scheduling variable.  `reads_live` counts dispatched-but-unfinished
+// readers at the queue head; `write_live` marks a dispatched writer.
+struct Var {
+  std::deque<VarBlock> pending;
+  int reads_live = 0;
+  bool write_live = false;
+  bool doomed = false;  // delete requested; GC once drained
+};
+
+struct Task {
+  EngineTaskFn fn = nullptr;
+  void* arg = nullptr;
+  std::vector<int64_t> reads;
+  std::vector<int64_t> writes;
+  int deps = 0;        // unresolved dependency count (+1 setup sentinel)
+  int priority = 0;
+  uint64_t seq = 0;    // FIFO tiebreak
+  bool is_waiter = false;          // internal WaitForVar marker task
+  std::condition_variable* done_cv = nullptr;
+  bool* done_flag = nullptr;
+};
+
+struct TaskOrder {
+  bool operator()(const Task* a, const Task* b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;  // lower seq first
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers, bool sync)
+      : sync_(sync) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this]() { WorkerLoop(); });
+    workers_.emplace_back([this]() { InlineLoop(); });
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+      ready_cv_.notify_all();
+      inline_cv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+  }
+
+  int64_t NewVar() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    vars_.emplace(id, Var());
+    return id;
+  }
+
+  // Queue deletion behind everything already pushed on the variable.
+  void DeleteVar(int64_t var) {
+    Task* t = new Task();
+    t->writes.push_back(var);
+    t->fn = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = vars_.find(var);
+      if (it == vars_.end()) { delete t; return; }
+      it->second.doomed = true;
+    }
+    Push(t);
+  }
+
+  void PushTask(EngineTaskFn fn, void* arg,
+                const int64_t* reads, int nreads,
+                const int64_t* writes, int nwrites, int priority) {
+    if (sync_) {
+      // NaiveEngine semantics (ref naive_engine.cc:95-130): execute
+      // inline, serially, in push order.  Drain any async backlog first
+      // — except when pushed from inside a running task, where waiting
+      // on ourselves would deadlock; serial order is preserved anyway
+      // because the parent task runs inline too.
+      if (!tls_in_worker_) WaitForAll();
+      if (fn) fn(arg);
+      return;
+    }
+    Task* t = new Task();
+    t->fn = fn;
+    t->arg = arg;
+    t->reads.assign(reads, reads + nreads);
+    t->writes.assign(writes, writes + nwrites);
+    t->priority = priority;
+    Push(t);
+  }
+
+  void WaitForVar(int64_t var) {
+    std::condition_variable cv;
+    bool done = false;
+    Task* t = new Task();
+    t->reads.push_back(var);  // runs only after queued writes complete
+    t->is_waiter = true;
+    t->done_cv = &cv;
+    t->done_flag = &done;
+    Push(t);
+    std::unique_lock<std::mutex> lk(mu_);
+    cv.wait(lk, [&]() { return done; });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    drained_cv_.wait(lk, [this]() { return live_tasks_ == 0; });
+  }
+
+  int PendingTasks() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return live_tasks_;
+  }
+
+  void SetSync(bool sync) { sync_ = sync; }
+
+ private:
+  // Resolve dependencies and hand the task to the scheduler.  A +1
+  // sentinel on `deps` keeps the task from firing while its own
+  // dependency list is still being walked.  Dependency lists are
+  // normalized first (the reference's Engine::DeduplicateVarHandle,
+  // engine.h:251-269): duplicate vars collapse, and a var that appears
+  // in both lists counts only as a write — otherwise the task would
+  // deadlock waiting on its own read.
+  void Push(Task* t) {
+    Dedupe(&t->writes);
+    Dedupe(&t->reads);
+    t->reads.erase(
+        std::remove_if(t->reads.begin(), t->reads.end(),
+                       [&](int64_t r) {
+                         return std::find(t->writes.begin(), t->writes.end(),
+                                          r) != t->writes.end();
+                       }),
+        t->reads.end());
+    std::unique_lock<std::mutex> lk(mu_);
+    ++live_tasks_;
+    t->seq = next_seq_++;
+    t->deps = 1;
+    for (int64_t v : t->reads) AddRead(v, t);
+    for (int64_t v : t->writes) AddWrite(v, t);
+    if (--t->deps == 0) Ready(t);
+  }
+
+  static void Dedupe(std::vector<int64_t>* v) {
+    std::sort(v->begin(), v->end());
+    v->erase(std::unique(v->begin(), v->end()), v->end());
+  }
+
+  void AddRead(int64_t vid, Task* t) {
+    auto it = vars_.find(vid);
+    if (it == vars_.end()) return;  // unknown/GC'd var: no dependency
+    Var& v = it->second;
+    if (v.pending.empty() && !v.write_live) {
+      ++v.reads_live;  // no write ahead: read proceeds immediately
+    } else {
+      ++t->deps;
+      v.pending.push_back({t, false});
+    }
+  }
+
+  void AddWrite(int64_t vid, Task* t) {
+    auto it = vars_.find(vid);
+    if (it == vars_.end()) return;  // unknown/GC'd var: no dependency
+    Var& v = it->second;
+    if (v.pending.empty() && !v.write_live && v.reads_live == 0) {
+      v.write_live = true;
+    } else {
+      ++t->deps;
+      v.pending.push_back({t, true});
+    }
+  }
+
+  void Ready(Task* t) {  // mu_ held
+    if (t->is_waiter || t->fn == nullptr) {
+      // Waiter/GC tasks carry no user work: a dedicated completion thread
+      // handles them so a saturated worker pool can never stall WaitForVar.
+      inline_ready_.push_back(t);
+      inline_cv_.notify_one();
+      return;
+    }
+    ready_.push(t);
+    ready_cv_.notify_one();
+  }
+
+  // Dependency completion: mirror of the reference's
+  // CompleteReadDependency / CompleteWriteDependency.
+  void FinishRead(int64_t vid) {
+    auto it = vars_.find(vid);
+    if (it == vars_.end()) return;
+    Var& v = it->second;
+    --v.reads_live;
+    if (v.reads_live == 0 && !v.pending.empty() && v.pending.front().write) {
+      Task* nxt = v.pending.front().task;
+      v.pending.pop_front();
+      v.write_live = true;
+      if (--nxt->deps == 0) Ready(nxt);
+    }
+    if (v.doomed && v.pending.empty() && !v.write_live && v.reads_live == 0)
+      vars_.erase(it);
+  }
+
+  void FinishWrite(int64_t vid) {
+    auto it = vars_.find(vid);
+    if (it == vars_.end()) return;
+    Var& v = it->second;
+    v.write_live = false;
+    // Release the run of reads at the head; stop at (or dispatch) the
+    // next write.
+    while (!v.pending.empty()) {
+      VarBlock blk = v.pending.front();
+      if (blk.write) {
+        if (v.reads_live == 0) {
+          v.pending.pop_front();
+          v.write_live = true;
+          if (--blk.task->deps == 0) Ready(blk.task);
+        }
+        break;
+      }
+      v.pending.pop_front();
+      ++v.reads_live;
+      if (--blk.task->deps == 0) Ready(blk.task);
+    }
+    if (v.doomed && v.pending.empty() && !v.write_live && v.reads_live == 0)
+      vars_.erase(it);
+  }
+
+  void Complete(Task* t) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (int64_t v : t->reads) FinishRead(v);
+    for (int64_t v : t->writes) FinishWrite(v);
+    if (t->done_flag) {
+      *t->done_flag = true;
+      t->done_cv->notify_all();
+    }
+    --live_tasks_;
+    if (live_tasks_ == 0) drained_cv_.notify_all();
+    delete t;
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Task* t;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        ready_cv_.wait(lk, [this]() { return stop_ || !ready_.empty(); });
+        if (stop_ && ready_.empty()) return;
+        t = ready_.top();
+        ready_.pop();
+      }
+      tls_in_worker_ = true;
+      if (t->fn) t->fn(t->arg);
+      tls_in_worker_ = false;
+      Complete(t);
+    }
+  }
+
+  // Waiter/GC tasks complete here so a full worker pool can never
+  // deadlock a WaitForVar behind user tasks it depends on.
+  void InlineLoop() {
+    for (;;) {
+      Task* t;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        inline_cv_.wait(lk,
+                        [this]() { return stop_ || !inline_ready_.empty(); });
+        if (stop_ && inline_ready_.empty()) return;
+        t = inline_ready_.front();
+        inline_ready_.pop_front();
+      }
+      Complete(t);
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable ready_cv_, drained_cv_, inline_cv_;
+  std::priority_queue<Task*, std::vector<Task*>, TaskOrder> ready_;
+  std::deque<Task*> inline_ready_;
+  std::unordered_map<int64_t, Var> vars_;
+  std::vector<std::thread> workers_;
+  int64_t next_var_ = 1;
+  uint64_t next_seq_ = 0;
+  int live_tasks_ = 0;
+  bool stop_ = false;
+  std::atomic<bool> sync_;
+  static thread_local bool tls_in_worker_;
+};
+
+thread_local bool Engine::tls_in_worker_ = false;
+
+}  // namespace
+
+extern "C" {
+
+void* MXEngineCreate(int num_workers, int sync) {
+  return new Engine(num_workers, sync != 0);
+}
+
+void MXEngineFree(void* h) { delete static_cast<Engine*>(h); }
+
+int64_t MXEngineNewVariable(void* h) {
+  return static_cast<Engine*>(h)->NewVar();
+}
+
+void MXEngineDeleteVariable(void* h, int64_t var) {
+  static_cast<Engine*>(h)->DeleteVar(var);
+}
+
+void MXEnginePushAsync(void* h, EngineTaskFn fn, void* arg,
+                       const int64_t* const_vars, int n_const,
+                       const int64_t* mutable_vars, int n_mutable,
+                       int priority) {
+  static_cast<Engine*>(h)->PushTask(fn, arg, const_vars, n_const,
+                                    mutable_vars, n_mutable, priority);
+}
+
+void MXEngineWaitForVar(void* h, int64_t var) {
+  static_cast<Engine*>(h)->WaitForVar(var);
+}
+
+void MXEngineWaitForAll(void* h) { static_cast<Engine*>(h)->WaitForAll(); }
+
+int MXEnginePendingTasks(void* h) {
+  return static_cast<Engine*>(h)->PendingTasks();
+}
+
+void MXEngineSetSync(void* h, int sync) {
+  static_cast<Engine*>(h)->SetSync(sync != 0);
+}
+
+}  // extern "C"

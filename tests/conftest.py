@@ -41,6 +41,7 @@ def pytest_configure(config):
     wanted = [os.path.join(repo, "mxnet_tpu", "_native", "librecordio.so"),
               os.path.join(repo, "mxnet_tpu", "_native",
                            "libimageloader.so"),
+              os.path.join(repo, "mxnet_tpu", "_native", "libengine.so"),
               os.path.join(repo, "native", "bin", "im2rec")]
     if not all(os.path.exists(p) for p in wanted):
         try:

@@ -104,3 +104,300 @@ def test_delete_variable_while_pending_is_safe():
     del y          # no sync before deletion
     z = nd.ones((2, 2))
     np.testing.assert_allclose(z.asnumpy(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Native threaded engine (host-task scheduler, native/engine.cc)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def native_engine():
+    eng = engine.ThreadedEngine(num_workers=4, sync=False)
+    if not eng.native:
+        pytest.skip("native engine library not built")
+    yield eng
+    eng.close()
+
+
+def test_native_writes_serialize_in_push_order(native_engine):
+    """Writers on one variable run one at a time, in push order
+    (AppendWriteDependency FIFO, ref threaded_engine.h:96-136)."""
+    eng = native_engine
+    v = eng.new_variable()
+    order = []
+
+    def writer(i):
+        def run():
+            time.sleep(0.001)
+            order.append(i)
+        return run
+
+    for i in range(40):
+        eng.push(writer(i), mutable_vars=[v])
+    eng.wait_for_all()
+    assert order == list(range(40))
+
+
+def test_native_reads_run_in_parallel(native_engine):
+    """Readers between writes overlap: N sleeping readers finish in far
+    less than N * sleep (parallel-read dispatch, SURVEY §3.3)."""
+    eng = native_engine
+    v = eng.new_variable()
+    barrier = threading.Barrier(4, timeout=5)
+
+    def reader():
+        barrier.wait()        # deadlocks unless all 4 run concurrently
+
+    t0 = time.perf_counter()
+    for _ in range(4):
+        eng.push(reader, const_vars=[v])
+    eng.wait_for_all()
+    assert time.perf_counter() - t0 < 5.0
+
+
+def test_native_write_excludes_reads(native_engine):
+    """Reads pushed after a write only observe the written state; the
+    write waits for earlier reads (ThreadedVar protocol)."""
+    eng = native_engine
+    v = eng.new_variable()
+    state = {"x": 0}
+    seen = []
+
+    def slow_read_before():
+        time.sleep(0.05)
+        seen.append(("pre", state["x"]))
+
+    def write():
+        state["x"] = 1
+
+    def read_after():
+        seen.append(("post", state["x"]))
+
+    eng.push(slow_read_before, const_vars=[v])
+    eng.push(write, mutable_vars=[v])
+    for _ in range(3):
+        eng.push(read_after, const_vars=[v])
+    eng.wait_for_all()
+    assert ("pre", 0) in seen
+    assert seen.count(("post", 1)) == 3
+    assert ("post", 0) not in seen
+
+
+def test_native_wait_for_var_blocks_until_writes_land(native_engine):
+    eng = native_engine
+    v, other = eng.new_variable(), eng.new_variable()
+    log = []
+    eng.push(lambda: (time.sleep(0.05), log.append("w1"))[-1],
+             mutable_vars=[v])
+    eng.push(lambda: (time.sleep(0.2), log.append("slow"))[-1],
+             mutable_vars=[other])
+    eng.wait_for_var(v)
+    assert "w1" in log            # target var's writes done...
+    eng.wait_for_all()
+    assert "slow" in log
+
+
+def test_native_disjoint_vars_run_concurrently(native_engine):
+    """Tasks with disjoint mutable vars overlap (per-device-queue
+    parallelism in the reference; worker pool here)."""
+    eng = native_engine
+    vs = [eng.new_variable() for _ in range(4)]
+    barrier = threading.Barrier(4, timeout=5)
+    for v in vs:
+        eng.push(lambda: barrier.wait(), mutable_vars=[v])
+    eng.wait_for_all()      # would deadlock if writes were serialized
+
+
+def test_native_mixed_dependency_chain(native_engine):
+    """A read-modify-write fan: w(a); r(a)+w(b) x2; r(b) — completion
+    respects the dependency DAG."""
+    eng = native_engine
+    a, b = eng.new_variable(), eng.new_variable()
+    log = []
+    eng.push(lambda: log.append("init_a"), mutable_vars=[a])
+    for i in range(2):
+        eng.push(lambda i=i: log.append(f"a_to_b{i}"),
+                 const_vars=[a], mutable_vars=[b])
+    eng.push(lambda: log.append("read_b"), const_vars=[b])
+    eng.wait_for_all()
+    assert log[0] == "init_a"
+    assert log[-1] == "read_b"
+    assert {"a_to_b0", "a_to_b1"} == set(log[1:3])
+
+
+def test_native_exception_surfaces_at_wait(native_engine):
+    eng = native_engine
+    v = eng.new_variable()
+
+    def boom():
+        raise ValueError("task failed")
+
+    eng.push(boom, mutable_vars=[v])
+    with pytest.raises(ValueError, match="task failed"):
+        eng.wait_for_all()
+
+
+def test_native_delete_variable_after_pending(native_engine):
+    eng = native_engine
+    v = eng.new_variable()
+    ran = []
+    for i in range(5):
+        eng.push(lambda i=i: ran.append(i), mutable_vars=[v])
+    eng.delete_variable(v)
+    eng.wait_for_all()
+    assert ran == list(range(5))
+
+
+def test_native_sync_mode_completes_inline(native_engine):
+    """NaiveEngine mode: push returns only after the task ran
+    (ref naive_engine.cc:95-130)."""
+    eng = native_engine
+    eng.set_sync(True)
+    try:
+        v = eng.new_variable()
+        ran = []
+        eng.push(lambda: ran.append(1), mutable_vars=[v])
+        assert ran == [1]
+    finally:
+        eng.set_sync(False)
+
+
+def test_native_priority_prefers_urgent_tasks():
+    """Higher-priority ready tasks dispatch first (FnProperty priority
+    classes, ref engine.h:77-90)."""
+    eng = engine.ThreadedEngine(num_workers=1, sync=False)
+    if not eng.native:
+        pytest.skip("native engine library not built")
+    try:
+        gate = eng.new_variable()
+        order = []
+        # Hold the single worker so subsequent pushes queue up.
+        eng.push(lambda: time.sleep(0.1), mutable_vars=[gate])
+        for i in range(3):
+            eng.push(lambda i=i: order.append(("lo", i)), priority=0)
+        eng.push(lambda: order.append(("hi", 0)), priority=10)
+        eng.wait_for_all()
+        assert order[0] == ("hi", 0)
+    finally:
+        eng.close()
+
+
+def test_native_stress_many_tasks_random_deps(native_engine):
+    """Randomized stress (ref threaded_engine_test.cc): per-variable
+    write counters must land exactly once per write, in order."""
+    rng = np.random.RandomState(0)
+    eng = native_engine
+    nvars = 8
+    vs = [eng.new_variable() for _ in range(nvars)]
+    logs = [[] for _ in range(nvars)]
+    counts = [0] * nvars
+    for _ in range(300):
+        wi = int(rng.randint(nvars))
+        reads = [vs[i] for i in np.nonzero(rng.rand(nvars) < 0.3)[0]
+                 if i != wi]
+        seqno = counts[wi]
+        counts[wi] += 1
+        eng.push(lambda wi=wi, s=seqno: logs[wi].append(s),
+                 const_vars=reads, mutable_vars=[vs[wi]])
+    eng.wait_for_all()
+    for i in range(nvars):
+        assert logs[i] == list(range(counts[i]))
+
+
+def test_module_level_engine_singleton():
+    eng = engine.engine()
+    v = eng.new_variable()
+    done = []
+    eng.push(lambda: done.append(1), mutable_vars=[v])
+    engine.wait_for_var(v)          # module facade dispatches on int handle
+    assert done == [1]
+
+
+def test_native_overlapping_read_write_deps_do_not_deadlock(native_engine):
+    """A var listed in both const and mutable counts once, as a write
+    (Engine::DeduplicateVarHandle, ref engine.h:251-269)."""
+    eng = native_engine
+    v = eng.new_variable()
+    ran = []
+    eng.push(lambda: ran.append(1), const_vars=[v], mutable_vars=[v, v])
+    eng.wait_for_all()
+    assert ran == [1]
+
+
+def test_native_push_on_deleted_var_is_safe(native_engine):
+    """Pushing/waiting on a GC'd variable neither crashes nor hangs."""
+    eng = native_engine
+    v = eng.new_variable()
+    eng.push(lambda: None, mutable_vars=[v])
+    eng.delete_variable(v)
+    eng.wait_for_all()
+    ran = []
+    eng.push(lambda: ran.append(1), mutable_vars=[v])  # v already GC'd
+    eng.wait_for_var(v)
+    eng.wait_for_all()
+    assert ran == [1]
+
+
+def test_native_sync_push_from_inside_task_no_deadlock(native_engine):
+    """A task chaining a follow-up push in sync mode must not deadlock
+    (NaiveEngine executes inline, ref naive_engine.cc:95-130)."""
+    eng = native_engine
+    eng.set_sync(True)
+    try:
+        order = []
+
+        def stage2():
+            order.append("stage2")
+
+        def stage1():
+            order.append("stage1")
+            eng.push(stage2)
+
+        eng.push(stage1)
+        assert order == ["stage1", "stage2"]
+    finally:
+        eng.set_sync(False)
+
+
+def test_native_task_registry_stays_bounded(native_engine):
+    """A continuously-fed pipeline must not accrete per-task state: after
+    a drain, the shared live-task registry is empty again."""
+    eng = native_engine
+    v = eng.new_variable()
+    for _ in range(200):
+        eng.push(lambda: None, mutable_vars=[v])
+    eng.wait_for_all()
+    assert len(engine._LIVE_TASKS) == 0
+
+
+def test_native_close_is_idempotent_and_blocks_new_pushes():
+    eng = engine.ThreadedEngine(num_workers=2, sync=False)
+    if not eng.native:
+        pytest.skip("native engine library not built")
+    ran = []
+    v = eng.new_variable()
+    eng.push(lambda: ran.append(1), mutable_vars=[v])
+    eng.close()
+    eng.close()                      # idempotent
+    assert ran == [1]                # close drained the queue
+    # post-close pushes degrade to synchronous inline execution (the
+    # same fallback as a missing native library) instead of crashing
+    eng.push(lambda: ran.append(2))
+    assert ran == [1, 2]
+
+
+def test_native_delete_var_with_trailing_reads(native_engine):
+    """A doomed variable whose last pending op is a read still drains and
+    GCs without wedging later work (FinishRead GC path)."""
+    eng = native_engine
+    v = eng.new_variable()
+    log = []
+    eng.push(lambda: log.append("w"), mutable_vars=[v])
+    eng.delete_variable(v)
+    eng.push(lambda: log.append("r"), const_vars=[v])
+    eng.wait_for_all()
+    assert log[0] == "w" and "r" in log
+    w2 = eng.new_variable()
+    eng.push(lambda: log.append("w2"), mutable_vars=[w2])
+    eng.wait_for_all()
+    assert log[-1] == "w2"
