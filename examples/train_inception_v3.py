@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Inception-v3 multi-device symbolic training (BASELINE workload #4).
+
+Parity target: reference ``example/image-classification/train_imagenet.py
+--network inception-v3 --kv-store device`` — the multi-device
+``kvstore='device'`` configuration of the headline tables
+(``example/image-classification/README.md:309-320``).
+
+The model-zoo Gluon inception-v3 is traced into a Symbol (HybridBlock
+called on ``mx.sym.Variable``) and driven through ``mx.mod.Module`` with
+a context list; gradients reduce through the device kvstore (one jitted
+on-device sum — the CommDevice analogue). Synthetic data keeps the
+script hermetic.
+
+    python examples/train_inception_v3.py --num-devices 2 --num-batches 8
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-devices", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=8,
+                    help="global batch (split across devices)")
+    ap.add_argument("--image-size", type=int, default=299)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--num-batches", type=int, default=8)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.io import NDArrayIter
+
+    n_tpu = mx.context.num_tpus()
+    if n_tpu:
+        ctxs = [mx.tpu(i) for i in range(min(args.num_devices, n_tpu))]
+    else:
+        import jax
+        n_cpu = len(jax.devices("cpu"))
+        ctxs = [mx.cpu(i) for i in range(min(args.num_devices, n_cpu))]
+
+    # Trace the Gluon zoo net into a Symbol, reference-style.
+    net = vision.get_model("inceptionv3", classes=args.num_classes)
+    data = mx.sym.Variable("data")
+    sym = mx.sym.SoftmaxOutput(net(data), name="softmax")
+
+    rng = np.random.RandomState(0)
+    shape = (3, args.image_size, args.image_size)
+    n = args.batch_size * args.num_batches
+    X = rng.rand(n, *shape).astype(np.float32)
+    Y = rng.randint(0, args.num_classes, n).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=args.batch_size,
+                     label_name="softmax_label")
+
+    mod = mx.mod.Module(sym, context=ctxs)
+    tic = time.time()
+    mod.fit(it, num_epoch=args.num_epochs,
+            kvstore=args.kv_store,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 2))
+    span = time.time() - tic
+    rate = n * args.num_epochs / span
+    logging.info("devices=%d kvstore=%s: %.2f img/s", len(ctxs),
+                 args.kv_store, rate)
+    print("final-throughput: %.2f img/s" % rate)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Example-script smoke gates: every shipped example must run end-to-end
+on the CI backend (virtual 8-device CPU mesh) with tiny arguments.
+
+Reference analogue: the runnable ``example/`` surface (SURVEY Appendix
+B) that doubles as integration coverage — here executed in-process via
+runpy so the scripts inherit the conftest-pinned backend.
+
+The heavier examples (train_mnist / train_cifar10 / lstm_bucketing /
+train_ssd_toy / numpy_ops) are exercised with real convergence
+thresholds in test_train_convergence.py and test_custom_op.py; this
+file covers the rest of the surface cheaply.
+"""
+import os
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def run_example(script, argv, capsys):
+    old_argv = sys.argv
+    sys.argv = [script] + argv
+    try:
+        runpy.run_path(os.path.join(EXAMPLES, script), run_name="__main__")
+    finally:
+        sys.argv = old_argv
+    return capsys.readouterr().out
+
+
+def test_matrix_factorization_learns(capsys):
+    out = run_example("matrix_factorization.py",
+                      ["--num-epochs", "2", "--num-obs", "4096"], capsys)
+    rmse = float(out.strip().rsplit(" ", 1)[-1])
+    assert rmse < 0.2          # planted-model noise floor is ~0.05
+
+
+def test_word_language_model_beats_uniform(capsys):
+    out = run_example("word_language_model.py",
+                      ["--num-epochs", "1", "--max-batches", "30"], capsys)
+    ppl = float(out.strip().rsplit(" ", 1)[-1])
+    assert ppl < 64.0          # uniform baseline on the synthetic vocab
+
+
+def test_model_parallel_lstm_group2ctx(capsys):
+    out = run_example("model_parallel_lstm.py", ["--num-steps", "60"],
+                      capsys)
+    assert "final-loss" in out
+
+
+@pytest.mark.slow
+def test_inception_v3_multi_device_kvstore_device(capsys):
+    """BASELINE workload #4: inception-v3, ctx list, kvstore='device'
+    (shrunken input so CPU CI stays fast)."""
+    out = run_example(
+        "train_inception_v3.py",
+        ["--num-devices", "2", "--num-batches", "2", "--batch-size", "4",
+         "--image-size", "147", "--num-classes", "4"], capsys)
+    assert "final-throughput" in out
