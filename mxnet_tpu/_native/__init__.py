@@ -14,14 +14,36 @@ import os
 import subprocess
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
+_LOADED = {}              # so_name -> CDLL | None (memoized, incl. misses)
 
 
-def load_shared(so_name):
+def load_shared(so_name, required_symbol=None):
     """Load ``so_name`` from the package dir, lazily building it with the
     in-image toolchain on first miss (serialized via a per-target lock
     file so concurrent workers don't race the same ``make``).  Returns a
-    CDLL or None.
+    CDLL or None.  Memoized per name — a failed build is not retried.
+
+    ``required_symbol`` guards against a stale prebuilt library: when
+    the loaded object lacks the symbol, it is rebuilt once from source
+    and reloaded (gitignored .so files can predate an ABI addition).
     """
+    if so_name in _LOADED:
+        return _LOADED[so_name]
+    lib = _load_uncached(so_name)
+    if lib is not None and required_symbol is not None and \
+            not hasattr(lib, required_symbol):
+        try:
+            os.remove(os.path.join(_DIR, so_name))
+        except OSError:
+            pass
+        lib = _load_uncached(so_name)
+        if lib is not None and not hasattr(lib, required_symbol):
+            lib = None          # still stale: degrade to the fallback
+    _LOADED[so_name] = lib
+    return lib
+
+
+def _load_uncached(so_name):
     so_path = os.path.join(_DIR, so_name)
     if not os.path.exists(so_path) and \
             os.environ.get("MXNET_TPU_BUILD_NATIVE", "1") == "1":

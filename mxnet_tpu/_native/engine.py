@@ -31,13 +31,15 @@ def lib():
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    l = load_shared("libengine.so")
+    l = load_shared("libengine.so", required_symbol="MXEngineFreeAsync")
     if l is None:
         return None
     l.MXEngineCreate.restype = ctypes.c_void_p
     l.MXEngineCreate.argtypes = [ctypes.c_int, ctypes.c_int]
     l.MXEngineFree.restype = None
     l.MXEngineFree.argtypes = [ctypes.c_void_p]
+    l.MXEngineFreeAsync.restype = None
+    l.MXEngineFreeAsync.argtypes = [ctypes.c_void_p]
     l.MXEngineNewVariable.restype = ctypes.c_int64
     l.MXEngineNewVariable.argtypes = [ctypes.c_void_p]
     l.MXEngineDeleteVariable.restype = None

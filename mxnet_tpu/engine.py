@@ -34,6 +34,7 @@ import ctypes
 import itertools
 import os
 import threading
+import weakref
 
 import jax
 
@@ -120,13 +121,80 @@ def _make_trampoline(fn_type):
             if entry is None:     # pragma: no cover - defensive
                 return
             eng, fn = entry
-            try:
-                fn()
-            except BaseException as e:      # noqa: BLE001
-                with _TASKS_LOCK:
-                    eng._errors.append(e)
+            eng._run_inline(fn)
         _TRAMPOLINE = fn_type(_run)
     return _TRAMPOLINE
+
+
+class _EngineCore:
+    """Owner of one native engine handle.  Holds no reference back to the
+    Python-facing ``ThreadedEngine``, so it can serve as the
+    ``weakref.finalize`` callback target: ``close()`` and the finalizer
+    both funnel into the idempotent shutdown paths below, and every
+    native call claims the handle through :meth:`enter`/:meth:`exit`
+    so shutdown can wait out (or exclude) concurrent callers.
+    """
+
+    def __init__(self, nat, h):
+        self.nat = nat
+        self.h = h
+        self.lock = threading.Lock()
+        self.idle = threading.Condition(self.lock)
+        self.inflight = 0
+
+    def enter(self):
+        """Claim the handle for one native call; None once shut down."""
+        with self.lock:
+            if self.h is None:
+                return None
+            self.inflight += 1
+            return self.h
+
+    def exit(self):
+        with self.lock:
+            self.inflight -= 1
+            if self.inflight == 0:
+                self.idle.notify_all()
+
+    def shutdown_sync(self):
+        """Drain and free, waiting out concurrent native calls.  Must not
+        run on one of the engine's own worker threads."""
+        with self.lock:
+            if self.h is None:
+                return
+            h, self.h = self.h, None     # new calls now see 'closed'
+            while self.inflight:
+                self.idle.wait()
+        self.nat.MXEngineWaitForAll(h)
+        self.nat.MXEngineFree(h)
+
+    def shutdown_async(self):
+        """Free via a detached native deleter — for GC on a non-main
+        thread, possibly one of this engine's own workers mid-task,
+        where a synchronous drain would self-deadlock.  No inflight wait
+        is needed: GC implies the engine was unreachable, so no API call
+        can be concurrently holding the handle."""
+        with self.lock:
+            if self.h is None:
+                return
+            h, self.h = self.h, None
+        self.nat.MXEngineFreeAsync(h)
+
+
+def _finalize_core(core):
+    """weakref.finalize callback (GC of a dropped engine, or weakref's
+    atexit hook for engines still alive at interpreter exit)."""
+    import sys
+    if sys.is_finalizing():     # pragma: no cover - teardown path
+        # Too late to run trampolines; let the OS reclaim at exit.
+        return
+    if threading.current_thread() is threading.main_thread():
+        # The main thread can never be an engine worker: safe to drain.
+        # This covers the weakref-atexit path, where a detached deleter
+        # would race process teardown.
+        core.shutdown_sync()
+    else:
+        core.shutdown_async()
 
 
 class ThreadedEngine:
@@ -148,17 +216,20 @@ class ThreadedEngine:
         if sync is None:
             sync = _SYNC
         self._nat = nat.lib()
-        self._lock = threading.Lock()
-        self._idle = threading.Condition(self._lock)
-        self._inflight = 0          # native calls in progress (close gate)
         self._errors = []
         self._pyvar_seq = itertools.count(1)
         if self._nat is not None:
-            self._h = self._nat.MXEngineCreate(int(num_workers),
-                                               1 if sync else 0)
+            h = self._nat.MXEngineCreate(int(num_workers), 1 if sync else 0)
+            self._core = _EngineCore(self._nat, h)
             self._trampoline = _make_trampoline(nat.TASK_FN)
+            # GC safety net: a dropped instance still drains and frees
+            # its C++ engine (and worker threads) instead of leaking
+            # them — and before interpreter teardown, so no trampoline
+            # fires into a finalizing Python.
+            self._finalizer = weakref.finalize(self, _finalize_core,
+                                               self._core)
         else:
-            self._h = None
+            self._core = None
 
     # -- variables ---------------------------------------------------------
 
@@ -191,12 +262,8 @@ class ThreadedEngine:
         (serialized in push order per variable).  Exceptions raised by
         ``fn`` are captured and re-raised at the next wait point.
         """
-        if self._h is None:
-            try:
-                fn()
-            except BaseException as e:      # noqa: BLE001
-                with _TASKS_LOCK:
-                    self._errors.append(e)
+        if self._core is None:
+            self._run_inline(fn)
             return
 
         key = next(_KEY_SEQ)
@@ -207,11 +274,7 @@ class ThreadedEngine:
             with _TASKS_LOCK:
                 _LIVE_TASKS.pop(key, None)
             # Degrade like the no-native fallback: the task still runs.
-            try:
-                fn()
-            except BaseException as e:      # noqa: BLE001
-                with _TASKS_LOCK:
-                    self._errors.append(e)
+            self._run_inline(fn)
             return
         try:
             cv = (ctypes.c_int64 * max(1, len(const_vars)))(*const_vars)
@@ -219,24 +282,31 @@ class ThreadedEngine:
             self._nat.MXEnginePushAsync(
                 h, self._trampoline, ctypes.c_void_p(key),
                 cv, len(const_vars), mv, len(mutable_vars), int(priority))
+        except BaseException:
+            # never handed to the engine: the registry entry would leak
+            with _TASKS_LOCK:
+                _LIVE_TASKS.pop(key, None)
+            raise
         finally:
             self._exit_native()
+
+    def _run_inline(self, fn):
+        """Run a task on the calling thread, capturing its exception for
+        the next wait point (shared by the trampoline and fallbacks)."""
+        try:
+            fn()
+        except BaseException as e:      # noqa: BLE001
+            with _TASKS_LOCK:
+                self._errors.append(e)
 
     # -- synchronization ---------------------------------------------------
 
     def _enter_native(self):
-        """Claim the handle for one native call; None when closed."""
-        with self._lock:
-            if self._h is None:
-                return None
-            self._inflight += 1
-            return self._h
+        """Claim the handle for one native call; None when unavailable."""
+        return None if self._core is None else self._core.enter()
 
     def _exit_native(self):
-        with self._lock:
-            self._inflight -= 1
-            if self._inflight == 0:
-                self._idle.notify_all()
+        self._core.exit()
 
     def _raise_pending(self):
         with _TASKS_LOCK:
@@ -282,20 +352,15 @@ class ThreadedEngine:
                 self._exit_native()
 
     def close(self):
-        """Drain and free the native engine (waits out concurrent calls)."""
-        with self._lock:
-            if self._h is None:
-                return
-            h, self._h = self._h, None   # new calls now see 'closed'
-            while self._inflight:
-                self._idle.wait()
-        self._nat.MXEngineWaitForAll(h)
-        self._nat.MXEngineFree(h)
+        """Drain and free the native engine (waits out concurrent calls).
+        Idempotent; safe against a finalizer that already fired."""
+        if self._core is not None:
+            self._core.shutdown_sync()
 
     @property
     def native(self):
         """True when backed by the C++ scheduler (not the sync fallback)."""
-        return self._h is not None
+        return self._core is not None
 
 
 _SINGLETON = None

@@ -118,10 +118,10 @@ class Engine {
     if (sync_) {
       // NaiveEngine semantics (ref naive_engine.cc:95-130): execute
       // inline, serially, in push order.  Drain any async backlog first
-      // — except when pushed from inside a running task, where waiting
-      // on ourselves would deadlock; serial order is preserved anyway
-      // because the parent task runs inline too.
-      if (!tls_in_worker_) WaitForAll();
+      // — except when pushed from inside one of THIS engine's running
+      // tasks, where waiting on ourselves would deadlock; serial order
+      // is preserved anyway because the parent task runs inline too.
+      if (tls_worker_engine_ != this) WaitForAll();
       if (fn) fn(arg);
       return;
     }
@@ -292,9 +292,9 @@ class Engine {
         t = ready_.top();
         ready_.pop();
       }
-      tls_in_worker_ = true;
+      tls_worker_engine_ = this;
       if (t->fn) t->fn(t->arg);
-      tls_in_worker_ = false;
+      tls_worker_engine_ = nullptr;
       Complete(t);
     }
   }
@@ -327,10 +327,10 @@ class Engine {
   int live_tasks_ = 0;
   bool stop_ = false;
   std::atomic<bool> sync_;
-  static thread_local bool tls_in_worker_;
+  static thread_local Engine* tls_worker_engine_;
 };
 
-thread_local bool Engine::tls_in_worker_ = false;
+thread_local Engine* Engine::tls_worker_engine_ = nullptr;
 
 }  // namespace
 
@@ -341,6 +341,13 @@ void* MXEngineCreate(int num_workers, int sync) {
 }
 
 void MXEngineFree(void* h) { delete static_cast<Engine*>(h); }
+
+// Drain + free on a detached thread.  Safe to call from anywhere —
+// including one of the engine's own worker threads (a GC finalizer can
+// fire mid-task), where a synchronous drain would self-deadlock.
+void MXEngineFreeAsync(void* h) {
+  std::thread([h]() { delete static_cast<Engine*>(h); }).detach();
+}
 
 int64_t MXEngineNewVariable(void* h) {
   return static_cast<Engine*>(h)->NewVar();

@@ -401,3 +401,38 @@ def test_native_delete_var_with_trailing_reads(native_engine):
     eng.push(lambda: log.append("w2"), mutable_vars=[w2])
     eng.wait_for_all()
     assert log[-1] == "w2"
+
+
+def test_native_dropped_engine_is_finalized():
+    """An engine dropped without close() frees its native resources via
+    the GC finalizer (no thread/engine leak)."""
+    import gc
+    eng = engine.ThreadedEngine(num_workers=2, sync=False)
+    if not eng.native:
+        pytest.skip("native engine library not built")
+    ran = []
+    v = eng.new_variable()
+    eng.push(lambda: ran.append(1), mutable_vars=[v])
+    fin = eng._finalizer
+    core = eng._core
+    # _LIVE_TASKS strongly references the engine until the task runs:
+    # wait for the queue to drain before dropping the last reference.
+    deadline = time.time() + 5
+    while ran != [1] and time.time() < deadline:
+        time.sleep(0.01)
+    assert ran == [1]
+    del eng
+    while fin.alive and time.time() < deadline:   # worker-side refs drop
+        gc.collect()
+        time.sleep(0.01)
+    assert not fin.alive          # finalizer fired...
+    assert core.h is None         # ...and released the native handle
+
+
+def test_native_push_error_does_not_leak_registry(native_engine):
+    eng = native_engine
+    v = eng.new_variable()
+    before = len(engine._LIVE_TASKS)
+    with pytest.raises(TypeError):
+        eng.push(lambda: None, const_vars=[v, None])   # bad var handle
+    assert len(engine._LIVE_TASKS) == before
