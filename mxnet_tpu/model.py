@@ -102,15 +102,29 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     logging.info('Saved checkpoint to "%s"', param_name)
 
 
-def load_checkpoint(prefix, epoch):
-    """Load a checkpoint saved by save_checkpoint."""
-    symbol = sym.load("%s-symbol.json" % prefix)
+def split_saved_params(loaded):
+    """Split a loaded ``.params`` dict into (arg_params, aux_params) by
+    the ``arg:``/``aux:`` key prefixes; unprefixed keys are dropped.
+    Shared by :func:`load_checkpoint` and the C predict API shim."""
+    from .base import MXNetError
+    if not isinstance(loaded, dict):
+        raise MXNetError(
+            "params file contains unnamed arrays; expected the "
+            "arg:/aux:-keyed dict written by save_checkpoint")
     arg_params, aux_params = {}, {}
     groups = {"arg": arg_params, "aux": aux_params}
-    for key, val in nd.load("%s-%04d.params" % (prefix, epoch)).items():
+    for key, val in loaded.items():
         kind, _, name = key.partition(":")
         if kind in groups:
             groups[kind][name] = val
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Load a checkpoint saved by save_checkpoint."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = split_saved_params(
+        nd.load("%s-%04d.params" % (prefix, epoch)))
     return symbol, arg_params, aux_params
 
 

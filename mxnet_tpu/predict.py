@@ -40,6 +40,12 @@ class Predictor(object):
                 args[name] = nd.zeros(input_shapes[name], ctx=ctx)
             elif name in arg_params:
                 args[name] = arg_params[name].as_in_context(ctx)
+            elif name.endswith("_label") and shape is not None:
+                # loss-head labels (softmax_label etc., the reference's
+                # `<head>_label` naming convention) are unused at
+                # inference: zero-bind them like Module.predict does.
+                # Anything else missing is a real checkpoint defect.
+                args[name] = nd.zeros(shape, ctx=ctx)
             else:
                 raise MXNetError("checkpoint is missing parameter %r" % name)
         auxs = {}
@@ -79,3 +85,55 @@ class Predictor(object):
         if self._exe.outputs is None:
             raise MXNetError("run forward() first")
         return self._exe.outputs[index]
+
+
+class _EmbeddedPredictor(object):
+    """Byte-oriented shim behind the native C predict API
+    (``native/predict_api.cc`` — ref ``include/mxnet/c_predict_api.h``).
+
+    The C side traffics only in raw buffers: inputs arrive as float32
+    bytes, outputs leave as float32 bytes plus a shape tuple, so the
+    embedding layer never needs the numpy C API.
+    """
+
+    def __init__(self, symbol_json, param_bytes, input_names, input_shapes,
+                 dev_type=1, dev_id=0):
+        from . import context, symbol as sym_mod
+        from .model import split_saved_params
+        from .ndarray import utils as nd_utils
+        symbol = sym_mod.load_json(symbol_json)
+        arg_params, aux_params = split_saved_params(
+            nd_utils.load_from_bytes(param_bytes))
+        if dev_type >= 2 and context.num_tpus():
+            ctx = context.tpu(dev_id)
+        else:
+            ctx = context.cpu(dev_id)
+        shapes = {n: tuple(int(x) for x in s)
+                  for n, s in zip(input_names, input_shapes)}
+        self._pred = Predictor(symbol, arg_params, aux_params, shapes,
+                               ctx=ctx)
+        self._shapes = shapes
+        self._inputs = {}
+        self._outputs = []
+
+    def set_input(self, key, raw):
+        if key not in self._shapes:
+            raise MXNetError("unknown input %r" % key)
+        arr = np.frombuffer(raw, dtype=np.float32).reshape(
+            self._shapes[key]).copy()
+        self._inputs[key] = arr
+
+    def forward(self):
+        outs = self._pred.forward(**self._inputs)
+        self._outputs = [np.ascontiguousarray(o.asnumpy(),
+                                              dtype=np.float32)
+                         for o in outs]
+
+    def num_outputs(self):
+        return len(self._outputs)
+
+    def get_output_shape(self, index):
+        return tuple(int(s) for s in self._outputs[index].shape)
+
+    def get_output_bytes(self, index):
+        return self._outputs[index].tobytes()

@@ -1,0 +1,245 @@
+// C predict API: the standalone deployment surface.
+//
+// Reference analogue: include/mxnet/c_predict_api.h +
+// src/c_api/c_predict_api.cc — the amalgamation's predict-only C ABI
+// (MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutputShape /
+// MXPredGetOutput / MXPredFree, thread-local MXGetLastError), letting a
+// plain C/C++ application run a saved `-symbol.json` + `.params`
+// checkpoint without linking any Python.
+//
+// TPU-native mechanism: the library embeds CPython and drives
+// mxnet_tpu.predict._EmbeddedPredictor, whose bind step compiles the
+// whole graph into one jitted XLA program; all data crosses the
+// boundary as raw float32 buffers, so no numpy C API is required.
+//
+// Build: native/Makefile target libmxpredict.so (links libpython).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef unsigned int mx_uint;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+// Record the pending Python exception into the error slot.
+void SetErrorFromPython() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  SetError(msg);
+}
+
+struct PredictorState {
+  PyObject* obj = nullptr;                       // _EmbeddedPredictor
+  std::vector<std::vector<mx_uint>> out_shapes;  // cached per forward
+};
+
+// Ensure an interpreter exists.  When this library is loaded into a
+// host C program, initialize one exactly once (concurrent MXPredCreate
+// calls are expected from multithreaded hosts); when loaded into a
+// Python process, just use the existing interpreter via GILState.
+std::once_flag g_py_init_once;
+
+bool EnsurePython() {
+  bool ok = true;
+  std::call_once(g_py_init_once, [&ok]() {
+    if (Py_IsInitialized()) return;
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) {
+      ok = false;
+      return;
+    }
+    // Pin CPU explicitly when requested (axon plugin races otherwise).
+    PyRun_SimpleString(
+        "import os\n"
+        "if os.environ.get('JAX_PLATFORMS', '').startswith('cpu'):\n"
+        "    import jax\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n");
+    // Release the GIL acquired by Py_Initialize so later
+    // PyGILState_Ensure calls work uniformly from any thread.
+    PyEval_SaveThread();
+  });
+  if (!ok) SetError("failed to initialize embedded Python");
+  return ok && Py_IsInitialized();
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, void** out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.predict");
+  if (!mod) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* cls = PyObject_GetAttrString(mod, "_EmbeddedPredictor");
+  Py_DECREF(mod);
+  if (!cls) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* names = PyList_New(num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SetItem(shp, j - lo,
+                      PyLong_FromUnsignedLong(input_shape_data[j]));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject* json = PyUnicode_FromString(symbol_json_str);
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* obj = PyObject_CallFunction(cls, "OOOOii", json, params, names,
+                                        shapes, dev_type, dev_id);
+  Py_DECREF(cls);
+  Py_DECREF(json);
+  Py_DECREF(params);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  if (!obj) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PredictorState* st = new PredictorState();
+  st->obj = obj;
+  *out = st;
+  return 0;
+}
+
+int MXPredSetInput(void* handle, const char* key, const float* data,
+                   mx_uint size) {
+  PredictorState* st = static_cast<PredictorState*>(handle);
+  Gil gil;
+  PyObject* raw = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float));
+  PyObject* r = PyObject_CallMethod(st->obj, "set_input", "sO", key, raw);
+  Py_DECREF(raw);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(void* handle) {
+  PredictorState* st = static_cast<PredictorState*>(handle);
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(st->obj, "forward", nullptr);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  // Cache output shapes so GetOutputShape can hand out stable pointers.
+  st->out_shapes.clear();
+  PyObject* n = PyObject_CallMethod(st->obj, "num_outputs", nullptr);
+  if (!n) {
+    SetErrorFromPython();
+    return -1;
+  }
+  long nout = PyLong_AsLong(n);
+  Py_DECREF(n);
+  for (long i = 0; i < nout; ++i) {
+    PyObject* shp =
+        PyObject_CallMethod(st->obj, "get_output_shape", "l", i);
+    if (!shp) {
+      SetErrorFromPython();
+      return -1;
+    }
+    std::vector<mx_uint> dims;
+    for (Py_ssize_t j = 0; j < PyTuple_Size(shp); ++j)
+      dims.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
+    Py_DECREF(shp);
+    st->out_shapes.push_back(std::move(dims));
+  }
+  return 0;
+}
+
+int MXPredGetOutputShape(void* handle, mx_uint index, mx_uint** shape_data,
+                         mx_uint* shape_ndim) {
+  PredictorState* st = static_cast<PredictorState*>(handle);
+  if (index >= st->out_shapes.size()) {
+    SetError("output index out of range (run MXPredForward first)");
+    return -1;
+  }
+  *shape_data = st->out_shapes[index].data();
+  *shape_ndim = static_cast<mx_uint>(st->out_shapes[index].size());
+  return 0;
+}
+
+int MXPredGetOutput(void* handle, mx_uint index, float* data, mx_uint size) {
+  PredictorState* st = static_cast<PredictorState*>(handle);
+  Gil gil;
+  PyObject* raw =
+      PyObject_CallMethod(st->obj, "get_output_bytes", "I", index);
+  if (!raw) {
+    SetErrorFromPython();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(raw, &buf, &len) != 0 ||
+      static_cast<size_t>(len) != static_cast<size_t>(size) * sizeof(float)) {
+    Py_DECREF(raw);
+    SetError("output size mismatch");
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(raw);
+  return 0;
+}
+
+int MXPredFree(void* handle) {
+  PredictorState* st = static_cast<PredictorState*>(handle);
+  {
+    Gil gil;
+    Py_XDECREF(st->obj);
+  }
+  delete st;
+  return 0;
+}
+
+}  // extern "C"

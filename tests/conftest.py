@@ -42,6 +42,7 @@ def pytest_configure(config):
               os.path.join(repo, "mxnet_tpu", "_native",
                            "libimageloader.so"),
               os.path.join(repo, "mxnet_tpu", "_native", "libengine.so"),
+              os.path.join(repo, "mxnet_tpu", "_native", "libmxpredict.so"),
               os.path.join(repo, "native", "bin", "im2rec")]
     if not all(os.path.exists(p) for p in wanted):
         try:

@@ -1,0 +1,168 @@
+"""C predict API end-to-end: a plain C program loads a saved checkpoint
+through libmxpredict.so and must reproduce the Python Predictor's output.
+
+Reference analogue: the amalgamation deployment path over
+``include/mxnet/c_predict_api.h`` (MXPredCreate/SetInput/Forward/
+GetOutputShape/GetOutput/Free) exercised by a host binary that links no
+Python — SURVEY §2.4's predict-only surface.
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.path.join(REPO, "mxnet_tpu", "_native", "libmxpredict.so")
+
+DRIVER_C = r"""
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef unsigned int mx_uint;
+extern const char* MXGetLastError(void);
+extern int MXPredCreate(const char*, const void*, int, int, int, mx_uint,
+                        const char**, const mx_uint*, const mx_uint*,
+                        void**);
+extern int MXPredSetInput(void*, const char*, const float*, mx_uint);
+extern int MXPredForward(void*);
+extern int MXPredGetOutputShape(void*, mx_uint, mx_uint**, mx_uint*);
+extern int MXPredGetOutput(void*, mx_uint, float*, mx_uint);
+extern int MXPredFree(void*);
+
+static char* slurp(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) { fclose(f); return NULL; }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 4) { fprintf(stderr, "usage: driver sym params out\n"); return 2; }
+  long jsize, psize;
+  char* json = slurp(argv[1], &jsize);
+  char* params = slurp(argv[2], &psize);
+  if (!json || !params) { fprintf(stderr, "read failed\n"); return 2; }
+
+  const char* keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {2, 8};
+  void* pred = NULL;
+  if (MXPredCreate(json, params, (int)psize, 1, 0, 1, keys, indptr, shape,
+                   &pred) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  float input[16];
+  for (int i = 0; i < 16; ++i) input[i] = 0.1f * (float)i - 0.5f;
+  if (MXPredSetInput(pred, "data", input, 16) != 0 ||
+      MXPredForward(pred) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint* oshape; mx_uint ondim;
+  if (MXPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "shape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint total = 1;
+  for (mx_uint i = 0; i < ondim; ++i) total *= oshape[i];
+  float* out = (float*)malloc(total * sizeof(float));
+  if (MXPredGetOutput(pred, 0, out, total) != 0) {
+    fprintf(stderr, "output failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  FILE* fo = fopen(argv[3], "w");
+  for (mx_uint i = 0; i < total; ++i) fprintf(fo, "%.6f\n", out[i]);
+  fclose(fo);
+  MXPredFree(pred);
+  printf("ok %u\n", total);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    """A tiny trained symbolic net saved in reference checkpoint format."""
+    tmp = tmp_path_factory.mktemp("capi")
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="tanh")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    from mxnet_tpu.io import NDArrayIter
+    X = np.random.RandomState(0).randn(64, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    mod = mx.mod.Module(net)
+    mod.fit(NDArrayIter(X, Y, batch_size=16), num_epoch=1,
+            initializer=mx.init.Xavier(), optimizer="sgd")
+    prefix = str(tmp / "capi_mlp")
+    mod.save_checkpoint(prefix, 1)
+    return prefix
+
+
+def _compile_driver(tmp_path):
+    src = tmp_path / "driver.c"
+    src.write_text(DRIVER_C)
+    exe = tmp_path / "driver"
+    cmd = ["gcc", str(src), "-o", str(exe),
+           "-L", os.path.dirname(SO), "-lmxpredict",
+           "-Wl,-rpath," + os.path.dirname(SO)]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return exe
+
+
+def test_c_driver_matches_python_predictor(checkpoint, tmp_path):
+    if not os.path.exists(SO):
+        pytest.skip("libmxpredict.so not built")
+    exe = _compile_driver(tmp_path)
+    out_file = tmp_path / "out.txt"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [str(exe), checkpoint + "-symbol.json", checkpoint + "-0001.params",
+         str(out_file)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    got = np.array([float(x) for x in out_file.read_text().split()],
+                   np.float32).reshape(2, 4)
+
+    # same input through the Python-side Predictor
+    from mxnet_tpu.predict import Predictor
+    pred = Predictor.load(checkpoint, 1, {"data": (2, 8)})
+    x = (0.1 * np.arange(16, dtype=np.float32) - 0.5).reshape(2, 8)
+    want = pred.forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_rejects_missing_weight(checkpoint):
+    """Zero-binding is reserved for *_label args: a genuinely missing
+    weight still raises instead of silently predicting garbage."""
+    from mxnet_tpu.model import load_checkpoint
+    from mxnet_tpu.predict import Predictor
+    symbol, arg_params, aux_params = load_checkpoint(checkpoint, 1)
+    del arg_params["fc1_weight"]
+    with pytest.raises(mx.base.MXNetError, match="fc1_weight"):
+        Predictor(symbol, arg_params, aux_params, {"data": (2, 8)})
+
+
+def test_embedded_predictor_rejects_unnamed_params(checkpoint):
+    """A list-format (unnamed) params blob is a hard error, not silent
+    zero weights."""
+    from mxnet_tpu.predict import _EmbeddedPredictor
+    from mxnet_tpu.ndarray import utils as nd_utils
+    sym_json = open(checkpoint + "-symbol.json").read()
+    raw = nd_utils.save_to_bytes([mx.nd.zeros((3, 3))])
+    with pytest.raises(mx.base.MXNetError, match="unnamed"):
+        _EmbeddedPredictor(sym_json, raw, ["data"], [(2, 8)])
