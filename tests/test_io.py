@@ -217,3 +217,32 @@ def test_libsvm_iter(tmp_path):
         batch2.data[0].asnumpy(), [[0, 0, 3.0, 1.0], [2.5, 0, 0, 0]])
     with pytest.raises(StopIteration):
         it.next()
+
+
+def test_rec2idx_tool_rebuilds_index(tmp_path):
+    """tools/rec2idx.py regenerates an .idx equivalent to the one the
+    indexed writer produced (ref tools/rec2idx.py)."""
+    import runpy
+    import sys as _sys
+    from mxnet_tpu.recordio import MXIndexedRecordIO
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = MXIndexedRecordIO(idx, rec, "w")
+    payloads = [bytes([i]) * (10 + i) for i in range(7)]
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+    original = open(idx).read()
+    rebuilt_path = str(tmp_path / "rebuilt.idx")
+    argv = _sys.argv
+    _sys.argv = ["rec2idx", rec, rebuilt_path]
+    try:
+        runpy.run_path(os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "rec2idx.py"),
+                       run_name="__main__")
+    finally:
+        _sys.argv = argv
+    assert open(rebuilt_path).read() == original
+    r = MXIndexedRecordIO(rebuilt_path, rec, "r")
+    assert r.read_idx(3) == payloads[3]
+    r.close()

@@ -112,3 +112,40 @@ def test_lstm_bucketing_convergence():
     ppl = mod.score(it, mx.metric.Perplexity(ignore_label=0))[0][1]
     # deterministic next-token corpus: uniform baseline is ~vocab (21)
     assert ppl < 5.0, "perplexity %.2f not < 5.0" % ppl
+
+
+def test_mlp_bf16_converges():
+    """bf16 training reaches accuracy parity with fp32 on the MNIST MLP
+    (ref tests/python/train/test_dtype.py — dtype sweeps as convergence
+    gates; bf16 replaces fp16 as the TPU compute dtype)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    train_iter, val_iter = get_mnist_iterator(batch_size=64, flat=True)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(128, activation="relu"))
+    net.add(gluon.nn.Dense(64, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.collect_params().initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(2):
+        train_iter.reset()
+        for batch in train_iter:
+            x = batch.data[0].astype("bfloat16")
+            y = batch.label[0]
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+    correct = total = 0
+    val_iter.reset()
+    for batch in val_iter:
+        out = net(batch.data[0].astype("bfloat16")).asnumpy()
+        correct += (out.argmax(1) == batch.label[0].asnumpy()).sum()
+        total += out.shape[0]
+    acc = correct / total
+    assert acc >= 0.95, "bf16 MLP accuracy %.4f < 0.95" % acc

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Rebuild the .idx file for an existing RecordIO .rec shard.
+
+Reference counterpart: ``tools/rec2idx.py`` — walks the record stream,
+recording each record's byte offset keyed by its sequence number so
+``MXIndexedRecordIO`` (and shuffling readers like ImageRecordIter) can
+seek randomly.
+
+    python tools/rec2idx.py data.rec data.idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("record", help="input .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx file (default: record with .idx)")
+    args = ap.parse_args()
+    idx_path = args.index or os.path.splitext(args.record)[0] + ".idx"
+
+    from mxnet_tpu.recordio import MXRecordIO
+    reader = MXRecordIO(args.record, "r")
+    count = 0
+    with open(idx_path, "w") as out:
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            out.write("%d\t%d\n" % (count, pos))
+            count += 1
+    reader.close()
+    print("wrote %d entries to %s" % (count, idx_path))
+    return count
+
+
+if __name__ == "__main__":
+    main()
