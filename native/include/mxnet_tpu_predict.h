@@ -1,0 +1,127 @@
+/*
+ * C predict API — public header for libmxpredict.so.
+ *
+ * Reference analogue: include/mxnet/c_predict_api.h (the amalgamation's
+ * deployment ABI) plus the header-only C++ convenience layer in the
+ * spirit of cpp-package/include/mxnet-cpp.
+ *
+ * Usage (C):
+ *   void* pred;
+ *   MXPredCreate(symbol_json, param_bytes, param_size, 1, 0,
+ *                1, keys, indptr, shapes, &pred);
+ *   MXPredSetInput(pred, "data", buf, n);
+ *   MXPredForward(pred);
+ *   MXPredGetOutputShape(pred, 0, &shape, &ndim);
+ *   MXPredGetOutput(pred, 0, out, total);
+ *   MXPredFree(pred);
+ *
+ * All functions return 0 on success, -1 on error; MXGetLastError()
+ * returns a thread-local description of the last failure.
+ */
+#ifndef MXNET_TPU_PREDICT_H_
+#define MXNET_TPU_PREDICT_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void* PredictorHandle;
+
+const char* MXGetLastError(void);
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out);
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, mx_uint size);
+
+int MXPredForward(PredictorHandle handle);
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim);
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                    mx_uint size);
+
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu {
+
+/* RAII wrapper over the C ABI (cpp-package style). */
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json, const std::string& params,
+            const std::vector<std::string>& input_names,
+            const std::vector<std::vector<mx_uint>>& input_shapes,
+            int dev_type = 1, int dev_id = 0) {
+    if (input_names.size() != input_shapes.size())
+      throw std::invalid_argument(
+          "input_names and input_shapes must have the same length");
+    std::vector<const char*> keys;
+    std::vector<mx_uint> indptr(1, 0), dims;
+    for (size_t i = 0; i < input_names.size(); ++i) {
+      keys.push_back(input_names[i].c_str());
+      for (mx_uint d : input_shapes[i]) dims.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(dims.size()));
+    }
+    if (MXPredCreate(symbol_json.c_str(), params.data(),
+                     static_cast<int>(params.size()), dev_type, dev_id,
+                     static_cast<mx_uint>(keys.size()), keys.data(),
+                     indptr.data(), dims.data(), &handle_) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    if (MXPredSetInput(handle_, key.c_str(), data.data(),
+                       static_cast<mx_uint>(data.size())) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  void Forward() {
+    if (MXPredForward(handle_) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index = 0) {
+    mx_uint* shape = nullptr;
+    mx_uint ndim = 0;
+    if (MXPredGetOutputShape(handle_, index, &shape, &ndim) != 0)
+      throw std::runtime_error(MXGetLastError());
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<float> GetOutput(mx_uint index = 0) {
+    mx_uint total = 1;
+    for (mx_uint d : GetOutputShape(index)) total *= d;
+    std::vector<float> out(total);
+    if (MXPredGetOutput(handle_, index, out.data(), total) != 0)
+      throw std::runtime_error(MXGetLastError());
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu
+#endif  /* __cplusplus */
+
+#endif  /* MXNET_TPU_PREDICT_H_ */

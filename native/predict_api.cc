@@ -21,7 +21,9 @@
 #include <string>
 #include <vector>
 
-typedef unsigned int mx_uint;
+// Public ABI declarations — keeps implementation and header signatures
+// in lockstep at compile time.
+#include "mxnet_tpu_predict.h"
 
 namespace {
 

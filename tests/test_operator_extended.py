@@ -184,12 +184,10 @@ def test_linalg_gemm_trmm_trsm():
                           transpose_b=True).asnumpy()
     np.testing.assert_allclose(out, a @ b.transpose(0, 2, 1), rtol=1e-4)
     c = _rand(2, 3, 3)
-    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c[:, :, :1] *
-                         np.ones((2, 3, 1), np.float32) @
-                         np.ones((2, 1, 1), np.float32)),
-                         transpose_b=True, alpha=2.0, beta=0.0).asnumpy()
-    np.testing.assert_allclose(out, 2.0 * (a @ b.transpose(0, 2, 1)),
-                               rtol=1e-4)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         transpose_b=True, alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2.0 * (a @ b.transpose(0, 2, 1))
+                               + 0.5 * c, rtol=1e-4)
     l = np.linalg.cholesky(_spd(2, 3))
     x = _rand(2, 3, 4)
     y = nd.linalg_trmm(nd.array(l), nd.array(x)).asnumpy()   # L @ x
