@@ -7,6 +7,8 @@ metric-formatting helper and a wall-clock rate tracker.
 from __future__ import annotations
 
 import logging
+import os
+import threading
 import time
 
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
@@ -41,16 +43,56 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     return _on_epoch_end
 
 
-def do_checkpoint(prefix, period=1):
+# One engine variable per checkpoint prefix (absolute path), so every
+# async writer targeting the same files serializes — and repeated
+# callback construction reuses the variable instead of leaking one each.
+_PREFIX_VARS = {}
+_PREFIX_VARS_LOCK = threading.Lock()
+
+
+def _prefix_var(prefix):
+    from . import engine as _engine
+    key = os.path.abspath(prefix)
+    with _PREFIX_VARS_LOCK:
+        if key not in _PREFIX_VARS:
+            _PREFIX_VARS[key] = _engine.engine().new_variable()
+        return _PREFIX_VARS[key]
+
+
+def do_checkpoint(prefix, period=1, async_write=False):
     """Return an epoch-end callback writing ``prefix-symbol.json`` +
-    ``prefix-NNNN.params`` every *period* epochs (ref callback.py:56)."""
+    ``prefix-NNNN.params`` every *period* epochs (ref callback.py:56).
+
+    ``async_write=True`` schedules the serialization on the host-task
+    engine so the save overlaps the next epoch's compute, the way the
+    reference pushed IO through its dependency engine: parameters are
+    snapshotted zero-copy at callback time (immutable device buffers),
+    and writes to one *prefix* serialize on a shared per-prefix engine
+    variable (two callbacks on the same prefix cannot interleave).
+    Pending saves drain at ``engine.wait_for_all()``, where IO errors
+    re-raise; at interpreter exit remaining saves drain automatically
+    and errors are logged.
+    """
     from .model import save_checkpoint as _save
     every = max(int(period), 1)
 
+    if async_write:
+        from . import engine as _engine
+        ckpt_var = _prefix_var(prefix)
+
     def _on_epoch_end(epoch, sym, arg, aux):
         done = epoch + 1
-        if done % every == 0:
+        if done % every != 0:
+            return
+        if not async_write:
             _save(prefix, done, sym, arg, aux)
+            return
+        snap_arg = {k: v.detach() for k, v in arg.items()}
+        snap_aux = {k: v.detach() for k, v in aux.items()}
+        _engine.engine().push(
+            lambda d=done, a=snap_arg, x=snap_aux:
+                _save(prefix, d, sym, a, x),
+            mutable_vars=[ckpt_var])
 
     return _on_epoch_end
 

@@ -385,4 +385,13 @@ def _shutdown():  # pragma: no cover - interpreter teardown
             _SINGLETON.close()
         except Exception:
             pass
+        # Raising at atexit is useless, but swallowing task failures
+        # (e.g. a final async checkpoint hitting a full disk) silently
+        # is worse: surface them in the log.
+        with _TASKS_LOCK:
+            errors, _SINGLETON._errors = list(_SINGLETON._errors), []
+        for err in errors:
+            import logging
+            logging.getLogger("mxnet_tpu").error(
+                "host-engine task failed before exit: %r", err)
         _SINGLETON = None

@@ -436,3 +436,37 @@ def test_native_push_error_does_not_leak_registry(native_engine):
     with pytest.raises(TypeError):
         eng.push(lambda: None, const_vars=[v, None])   # bad var handle
     assert len(engine._LIVE_TASKS) == before
+
+
+def test_async_checkpoint_callback_overlaps_and_lands(tmp_path):
+    """do_checkpoint(async_write=True) snapshots at callback time and
+    serializes saves per prefix on the host engine."""
+    from mxnet_tpu.io import NDArrayIter
+    prefix = str(tmp_path / "async_ckpt")
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"),
+        name="softmax")
+    X = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+    Y = np.random.RandomState(1).randint(0, 4, 32).astype(np.float32)
+    mod = mx.mod.Module(net)
+    mod.fit(NDArrayIter(X, Y, batch_size=8), num_epoch=3,
+            initializer=mx.init.Xavier(), optimizer="sgd",
+            epoch_end_callback=mx.callback.do_checkpoint(
+                prefix, async_write=True))
+    engine.engine().wait_for_all()
+    from mxnet_tpu.model import load_checkpoint
+    for epoch in (1, 2, 3):
+        sym_l, arg, aux = load_checkpoint(prefix, epoch)
+        assert "fc_weight" in arg
+    # final checkpoint matches the module's final parameters exactly
+    final_arg, _ = mod.get_params()
+    _, arg3, _ = load_checkpoint(prefix, 3)
+    np.testing.assert_allclose(arg3["fc_weight"].asnumpy(),
+                               final_arg["fc_weight"].asnumpy())
+    # ...and epoch 1 holds the values SNAPSHOTTED at callback time, not
+    # the end-of-training values a late aliasing save would produce
+    _, arg1, _ = load_checkpoint(prefix, 1)
+    assert not np.allclose(arg1["fc_weight"].asnumpy(),
+                           final_arg["fc_weight"].asnumpy())

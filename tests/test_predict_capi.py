@@ -111,25 +111,25 @@ def checkpoint(tmp_path_factory):
     return prefix
 
 
-def _compile_driver(tmp_path):
-    src = tmp_path / "driver.c"
-    src.write_text(DRIVER_C)
-    exe = tmp_path / "driver"
-    cmd = ["gcc", str(src), "-o", str(exe),
+def _compile_driver(tmp_path, source, compiler="gcc", suffix=".c",
+                    extra_flags=()):
+    src = tmp_path / ("driver" + suffix)
+    src.write_text(source)
+    exe = tmp_path / ("driver_" + compiler)
+    cmd = [compiler, *extra_flags, str(src), "-o", str(exe),
            "-L", os.path.dirname(SO), "-lmxpredict",
            "-Wl,-rpath," + os.path.dirname(SO)]
     try:
         subprocess.run(cmd, check=True, capture_output=True)
     except FileNotFoundError as exc:     # compiler absent: environment gap
-        pytest.skip("no C compiler: %s" % exc)
+        pytest.skip("no %s compiler: %s" % (compiler, exc))
     # a CalledProcessError propagates: ABI drift must fail, not skip
     return exe
 
 
-def test_c_driver_matches_python_predictor(checkpoint, tmp_path):
-    if not os.path.exists(SO):
-        pytest.skip("libmxpredict.so not built")
-    exe = _compile_driver(tmp_path)
+def _run_driver_and_compare(exe, checkpoint, tmp_path):
+    """Run a compiled driver on the checkpoint; assert its output file
+    matches the Python Predictor on the same fixed input."""
     out_file = tmp_path / "out.txt"
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -141,13 +141,18 @@ def test_c_driver_matches_python_predictor(checkpoint, tmp_path):
     assert proc.returncode == 0, proc.stderr
     got = np.array([float(x) for x in out_file.read_text().split()],
                    np.float32).reshape(2, 4)
-
-    # same input through the Python-side Predictor
     from mxnet_tpu.predict import Predictor
     pred = Predictor.load(checkpoint, 1, {"data": (2, 8)})
     x = (0.1 * np.arange(16, dtype=np.float32) - 0.5).reshape(2, 8)
     want = pred.forward(data=x)[0].asnumpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_c_driver_matches_python_predictor(checkpoint, tmp_path):
+    if not os.path.exists(SO):
+        pytest.skip("libmxpredict.so not built")
+    exe = _compile_driver(tmp_path, DRIVER_C)
+    _run_driver_and_compare(exe, checkpoint, tmp_path)
 
 
 def test_predictor_rejects_missing_weight(checkpoint):
@@ -210,31 +215,8 @@ def test_cpp_raii_wrapper_matches_python(checkpoint, tmp_path):
     """Header-only C++ wrapper (cpp-package analogue) end-to-end."""
     if not os.path.exists(SO):
         pytest.skip("libmxpredict.so not built")
-    src = tmp_path / "driver.cc"
-    src.write_text(CPP_DRIVER)
-    exe = tmp_path / "driver_cpp"
     include_dir = os.path.join(REPO, "native", "include")
-    cmd = ["g++", "-std=c++17", str(src), "-o", str(exe),
-           "-I", include_dir,
-           "-L", os.path.dirname(SO), "-lmxpredict",
-           "-Wl,-rpath," + os.path.dirname(SO)]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True)
-    except FileNotFoundError as exc:     # compiler absent: environment gap
-        pytest.skip("no C++ compiler: %s" % exc)
-    out_file = tmp_path / "out_cpp.txt"
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    proc = subprocess.run(
-        [str(exe), checkpoint + "-symbol.json", checkpoint + "-0001.params",
-         str(out_file)],
-        env=env, capture_output=True, text=True, timeout=300)
-    assert proc.returncode == 0, proc.stderr
-    got = np.array([float(x) for x in out_file.read_text().split()],
-                   np.float32).reshape(2, 4)
-    from mxnet_tpu.predict import Predictor
-    pred = Predictor.load(checkpoint, 1, {"data": (2, 8)})
-    x = (0.1 * np.arange(16, dtype=np.float32) - 0.5).reshape(2, 8)
-    want = pred.forward(data=x)[0].asnumpy()
-    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    exe = _compile_driver(tmp_path, CPP_DRIVER, compiler="g++",
+                          suffix=".cc",
+                          extra_flags=("-std=c++17", "-I", include_dir))
+    _run_driver_and_compare(exe, checkpoint, tmp_path)
