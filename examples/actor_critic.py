@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Actor-critic policy gradient on a tiny corridor environment.
+
+Parity target: reference ``example/gluon/actor_critic.py`` — a shared
+trunk with policy and value heads, REINFORCE-with-baseline updates from
+per-episode returns, entropy-free softmax policy.
+
+The built-in environment replaces OpenAI Gym (zero-egress): a 1-D
+corridor where the agent starts in the middle and is rewarded at the
+right end; optimal return is reachable within a few dozen episodes.
+
+    python examples/actor_critic.py --num-episodes 150
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+class Corridor(object):
+    """States 0..n-1; actions {left, right}; +1 at the right end, -1 at
+    the left end, small step penalty; episode caps at 4n steps."""
+
+    def __init__(self, n=9):
+        self.n = n
+        self.reset()
+
+    def reset(self):
+        self.pos = self.n // 2
+        self.t = 0
+        return self._obs()
+
+    def _obs(self):
+        one = np.zeros(self.n, np.float32)
+        one[self.pos] = 1.0
+        return one
+
+    def step(self, action):
+        self.pos += 1 if action == 1 else -1
+        self.t += 1
+        if self.pos <= 0:
+            return self._obs(), -1.0, True
+        if self.pos >= self.n - 1:
+            return self._obs(), 1.0, True
+        if self.t >= 4 * self.n:
+            return self._obs(), 0.0, True
+        return self._obs(), -0.01, False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-episodes", type=int, default=150)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--corridor", type=int, default=9)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    class Net(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.trunk = gluon.nn.Dense(32, activation="tanh")
+                self.policy = gluon.nn.Dense(2)
+                self.value = gluon.nn.Dense(1)
+
+        def forward(self, x):
+            h = self.trunk(x)
+            return self.policy(h), self.value(h)
+
+    net = Net()
+    net.collect_params().initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    env = Corridor(args.corridor)
+    rng = np.random.RandomState(0)
+    returns_hist = []
+
+    for episode in range(args.num_episodes):
+        obs = env.reset()
+        observations, actions, rewards = [], [], []
+        done = False
+        while not done:
+            logits, _ = net(nd.array(obs[None]))
+            p = np.exp(logits.asnumpy()[0])
+            p = p / p.sum()
+            a = int(rng.choice(2, p=p))
+            observations.append(obs)
+            actions.append(a)
+            obs, r, done = env.step(a)
+            rewards.append(r)
+        # discounted returns
+        G, ret = 0.0, []
+        for r in reversed(rewards):
+            G = r + args.gamma * G
+            ret.append(G)
+        ret = np.array(ret[::-1], np.float32)
+        returns_hist.append(sum(rewards))
+
+        x = nd.array(np.stack(observations))
+        a_idx = nd.array(np.array(actions, np.float32))
+        g = nd.array(ret)
+        T = len(actions)
+        with autograd.record():
+            logits, values = net(x)
+            values = values.reshape((T,))
+            logp = nd.log_softmax(logits)
+            chosen = nd.pick(logp, a_idx)
+            adv = (g - values).detach()
+            policy_loss = -(chosen * adv).sum()
+            value_loss = ((values - g) ** 2).sum()
+            loss = policy_loss + 0.5 * value_loss
+        loss.backward()
+        trainer.step(T)
+        if episode % 25 == 0:
+            recent = np.mean(returns_hist[-25:])
+            logging.info("episode %d: mean return %.3f", episode, recent)
+
+    # non-overlapping halves so short runs can't compare a window with
+    # itself; improvement is judged first half vs second half
+    half = max(1, len(returns_hist) // 2)
+    early = np.mean(returns_hist[:half])
+    late = np.mean(returns_hist[-half:] if len(returns_hist) > 1
+                   else returns_hist)
+    logging.info("mean return first half %.3f -> second half %.3f",
+                 early, late)
+    if len(returns_hist) >= 2:
+        assert late > early, "policy did not improve"
+    print("final-return: %.4f" % late)
+    return late
+
+
+if __name__ == "__main__":
+    main()
