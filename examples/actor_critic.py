@@ -89,7 +89,8 @@ def main():
         done = False
         while not done:
             logits, _ = net(nd.array(obs[None]))
-            p = np.exp(logits.asnumpy()[0])
+            z = logits.asnumpy()[0]
+            p = np.exp(z - z.max())          # stabilized softmax
             p = p / p.sum()
             a = int(rng.choice(2, p=p))
             observations.append(obs)

@@ -116,6 +116,10 @@ def main():
     logging.info("generator mean %.3f vs data mean %.3f (init gap %.3f)",
                  sample.mean(), data_mean, init_gap)
     assert np.isfinite(sample).all()
+    # the generator must have moved its output statistics toward the
+    # data's relative to the untrained tanh output
+    assert gap < init_gap, \
+        "generator stats did not move toward the data distribution"
     print("final-mean-gap: %.4f" % gap)
     return gap
 

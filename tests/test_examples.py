@@ -73,3 +73,13 @@ def test_dcgan_adversarial_loop_runs(capsys):
     losses and produces the metric line (ref example/gluon/dcgan.py)."""
     out = run_example("dcgan.py", ["--num-iters", "20"], capsys)
     assert "final-mean-gap" in out
+
+
+def test_fine_tune_beats_scratch(capsys):
+    """Checkpoint-based transfer: fine-tuned features beat from-scratch
+    on the same small budget (ref fine-tune workflow, README.md:199)."""
+    out = run_example("fine_tune.py", [], capsys)
+    last = out.strip().splitlines()[-1]
+    tuned = float(last.split()[1])
+    scratch = float(last.split()[-1].rstrip(")"))
+    assert tuned > scratch + 0.05
