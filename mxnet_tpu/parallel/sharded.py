@@ -112,10 +112,22 @@ class ShardedTrainer:
     param_rules : list[(regex, PartitionSpec)] — tensor-parallel shardings
         for matching parameter names; unmatched params are replicated.
     batch_axis : mesh axis name the input batch is sharded over.
+    shard_weight_update : bool — cross-replica weight-update sharding
+        (ZeRO-1; arXiv:2004.13336): optimizer state and the update
+        computation are sharded over the batch axis; gradients arrive
+        via reduce-scatter and updated shards re-replicate via
+        all-gather, both inserted by the XLA SPMD partitioner from the
+        sharding constraints. Numerically exact — same update,
+        different placement. Applies per parameter, only where it can:
+        a param falls back to the replicated update when it matches a
+        tensor-parallel rule or its leading dim is not divisible by the
+        batch-axis size; state leaves whose shape differs from the
+        weight's (e.g. scalar schedule state) stay replicated too.
     """
 
     def __init__(self, block, loss, optimizer, mesh=None, param_rules=None,
-                 batch_axis="data", optimizer_params=None):
+                 batch_axis="data", optimizer_params=None,
+                 shard_weight_update=False):
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss
@@ -141,9 +153,9 @@ class ShardedTrainer:
 
         # --- place params/aux on the mesh ---
         def shard_for(name, val):
-            for pat, spec in self._rules:
-                if pat.search(name):
-                    return NamedSharding(self._mesh, spec)
+            spec = self._tp_spec(name)
+            if spec is not None:
+                return NamedSharding(self._mesh, spec)
             return NamedSharding(self._mesh, P())  # replicated
         # jnp.copy first: device_put may alias the source buffer as one
         # shard, and the jitted step donates these — donating an aliased
@@ -157,17 +169,41 @@ class ShardedTrainer:
                               NamedSharding(self._mesh, P()))
             for n in self._aux_names}
 
-        # --- optimizer state, sharded like its weight ---
+        # --- optimizer state: sharded like its weight, or (ZeRO-1)
+        # split over the batch axis when the leading dim divides evenly
+        self._ndata = self._mesh.shape[batch_axis]
+        self._update_shardings = {}
+        for n in self._grad_names:
+            shp = pd[n]._data.shape
+            if shard_weight_update and self._tp_spec(n) is None and \
+                    shp and shp[0] % self._ndata == 0:
+                spec = P(*((batch_axis,) + (None,) * (len(shp) - 1)))
+                self._update_shardings[n] = NamedSharding(self._mesh, spec)
+        replicated = NamedSharding(self._mesh, P())
         self.states = {}
         for n in self._grad_names:
             st = optimizer.create_state(self._index[n], pd[n]._data)
             tree = _state_get(st)
-            sharding = self.params[n].sharding
-            self.states[n] = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sharding), tree)
+            wshape = pd[n]._data.shape
+            base = self._update_shardings.get(n, self.params[n].sharding)
+
+            def place(x, base=base, wshape=wshape):
+                # only weight-shaped leaves take the weight's sharding;
+                # scalar/odd-shaped schedule state stays replicated
+                s = base if tuple(x.shape) == tuple(wshape) else replicated
+                return jax.device_put(x, s)
+
+            self.states[n] = jax.tree_util.tree_map(place, tree)
 
         self._num_update = 0
         self._step_fn = None
+
+    def _tp_spec(self, name):
+        """The tensor-parallel PartitionSpec for a param name, or None."""
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return spec
+        return None
 
     # -- the pure, jitted step --------------------------------------------
     def _build_step(self):
@@ -185,15 +221,34 @@ class ShardedTrainer:
                 l = loss_obj(out_nd, label_nd)
             return jnp.mean(l._data), new_aux
 
+        upd_shardings = self._update_shardings
+        param_shardings = {n: self.params[n].sharding for n in grad_names}
+        wsc = jax.lax.with_sharding_constraint
+
         def apply_updates(params, grads, states, lrs, wds, ts):
             # Pure functional core: the same update_step the eager Updater
             # runs, traced here with lr/wd/t entering as scalars so one
-            # cached program serves every step of the schedule.
+            # cached program serves every step of the schedule.  Under
+            # weight-update sharding the constraints below make the XLA
+            # partitioner reduce-scatter the gradient, run the update on
+            # 1/N of the rows per replica, and all-gather the result
+            # (arXiv:2004.13336).
             new_p, new_s = {}, {}
             for n in grad_names:
                 hyper = {"lr": lrs[n], "wd": wds[n], "t": ts[n]}
-                new_p[n], new_s[n] = opt.update_step(
-                    params[n], grads[n], states[n], hyper)
+                g, p = grads[n], params[n]
+                if n in upd_shardings:
+                    g = wsc(g, upd_shardings[n])
+                    p = wsc(p, upd_shardings[n])
+                np_, ns_ = opt.update_step(p, g, states[n], hyper)
+                if n in upd_shardings:
+                    wshape = tuple(p.shape)
+                    ns_ = jax.tree_util.tree_map(
+                        lambda x, s=upd_shardings[n]:
+                            wsc(x, s) if tuple(x.shape) == wshape else x,
+                        ns_)
+                    np_ = wsc(np_, param_shardings[n])  # all-gather back
+                new_p[n], new_s[n] = np_, ns_
             return new_p, new_s
 
         def step(params, states, aux, data, label, key, lrs, wds, ts):
