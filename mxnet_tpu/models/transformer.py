@@ -29,7 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..parallel.ring_attention import ring_attention
 
 __all__ = ["TransformerLMConfig", "init_transformer_params",
-           "transformer_forward", "make_train_step"]
+           "transformer_forward", "make_train_step",
+           "make_train_step_zero1"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,16 +185,24 @@ def _causal_attn_local(q, k, v, mesh=None):
     return local_attention(q, k, v, causal=True)
 
 
-def make_train_step(cfg, mesh, lr=0.1, seq_axis="seq"):
-    """Build the jitted SPMD train step: (params, tokens, labels) ->
-    (new_params, loss).  Batch is sharded P('data', seq_axis); gradient
-    reduction, TP collectives and the loss mean are all XLA-inserted."""
+def _lm_loss_fn(cfg, mesh, seq_axis):
+    """Mean next-token NLL in fp32 — the loss shared by every train-step
+    builder in this module."""
 
     def loss_of(params, tokens, labels):
         logits = transformer_forward(params, tokens, cfg, mesh, seq_axis)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
         return jnp.mean(nll)
+
+    return loss_of
+
+
+def make_train_step(cfg, mesh, lr=0.1, seq_axis="seq"):
+    """Build the jitted SPMD train step: (params, tokens, labels) ->
+    (new_params, loss).  Batch is sharded P('data', seq_axis); gradient
+    reduction, TP collectives and the loss mean are all XLA-inserted."""
+    loss_of = _lm_loss_fn(cfg, mesh, seq_axis)
 
     def step(params, tokens, labels):
         loss, grads = jax.value_and_grad(loss_of)(params, tokens, labels)
@@ -202,6 +211,57 @@ def make_train_step(cfg, mesh, lr=0.1, seq_axis="seq"):
         return new_params, loss
 
     return jax.jit(step, donate_argnums=(0,))
+
+
+def make_train_step_zero1(cfg, mesh, params, lr=0.1, momentum=0.9,
+                          seq_axis="seq"):
+    """SGD-momentum train step with cross-replica weight-update sharding
+    (ZeRO-1, arXiv:2004.13336) layered on the dp x sp x tp shardings.
+
+    Momentum buffers for replicated (non-TP) parameters shard over the
+    ``data`` axis when the leading dim divides evenly; the sharding
+    constraints make XLA reduce-scatter those gradients, update 1/N of
+    the rows per data replica, and all-gather the weights back.  Returns
+    ``(step, momenta)`` where ``step(params, momenta, tokens, labels) ->
+    (new_params, new_momenta, loss)``.
+    """
+    ndata = mesh.shape.get("data", 1)
+
+    def update_sharding(p):
+        spec = getattr(p.sharding, "spec", P())
+        replicated = all(s is None for s in tuple(spec))
+        if replicated and p.ndim and ndata > 1 and p.shape[0] % ndata == 0:
+            return NamedSharding(
+                mesh, P(*(("data",) + (None,) * (p.ndim - 1))))
+        return p.sharding
+
+    upd_shardings = jax.tree_util.tree_map(update_sharding, params)
+    param_shardings = jax.tree_util.tree_map(lambda p: p.sharding, params)
+    momenta = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(jnp.zeros_like(p), s),
+        params, upd_shardings)
+
+    loss_of = _lm_loss_fn(cfg, mesh, seq_axis)
+    wsc = jax.lax.with_sharding_constraint
+
+    def step(ps, ms, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_of)(ps, tokens, labels)
+
+        def upd(p, g, m, us, pssh):
+            g = wsc(g.astype(m.dtype), us)      # reduce-scatter point
+            new_m = momentum * m + g
+            new_p = wsc(p - lr * new_m.astype(p.dtype), pssh)  # all-gather
+            return new_p, wsc(new_m, us)
+
+        pairs = jax.tree_util.tree_map(upd, ps, grads, ms,
+                                       upd_shardings, param_shardings)
+        new_p = jax.tree_util.tree_map(lambda pr: pr[0], pairs,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree_util.tree_map(lambda pr: pr[1], pairs,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, new_m, loss
+
+    return jax.jit(step, donate_argnums=(0, 1)), momenta
 
 
 def place_batch(tokens, labels, mesh, seq_axis="seq"):

@@ -46,3 +46,35 @@ def test_train_step_loss_decreases():
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses[::5]
     assert np.isfinite(losses[-1])
+
+
+def test_zero1_step_matches_plain_sgd():
+    """make_train_step_zero1 with momentum=0 is plain SGD with different
+    placement: parameter trajectories must agree with make_train_step."""
+    from mxnet_tpu.models.transformer import make_train_step_zero1
+    cfg = TransformerLMConfig(vocab=32, d_model=16, n_heads=4, d_ff=32,
+                              n_layers=2, max_len=16)
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    params_a = init_transformer_params(jax.random.PRNGKey(0), cfg, mesh)
+    params_b = init_transformer_params(jax.random.PRNGKey(0), cfg, mesh)
+    tokens, labels = _data(cfg, 8, 16)
+    tokens, labels = place_batch(tokens, labels, mesh)
+
+    plain = make_train_step(cfg, mesh, lr=0.3)
+    zstep, momenta = make_train_step_zero1(cfg, mesh, params_b, lr=0.3,
+                                           momentum=0.0)
+    # some momentum buffer must actually be sharded over the DATA axis
+    # (TP-sharded buffers don't count: that's inherited, not ZeRO-1)
+    sharded = [m for m in jax.tree_util.tree_leaves(momenta)
+               if "data" in tuple(getattr(m.sharding, "spec", ()) or ())]
+    assert sharded, "no momentum buffer took the ZeRO-1 data sharding"
+
+    for _ in range(3):
+        params_a, loss_a = plain(params_a, tokens, labels)
+        params_b, momenta, loss_b = zstep(params_b, momenta, tokens,
+                                          labels)
+    assert abs(float(loss_a) - float(loss_b)) < 1e-5
+    for la, lb in zip(jax.tree_util.tree_leaves(params_a),
+                      jax.tree_util.tree_leaves(params_b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-4, atol=2e-4)
