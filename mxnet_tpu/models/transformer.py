@@ -225,14 +225,14 @@ def make_train_step_zero1(cfg, mesh, params, lr=0.1, momentum=0.9,
     ``(step, momenta)`` where ``step(params, momenta, tokens, labels) ->
     (new_params, new_momenta, loss)``.
     """
+    from ..parallel.sharded import zero1_update_spec
     ndata = mesh.shape.get("data", 1)
 
     def update_sharding(p):
-        spec = getattr(p.sharding, "spec", P())
-        replicated = all(s is None for s in tuple(spec))
-        if replicated and p.ndim and ndata > 1 and p.shape[0] % ndata == 0:
-            return NamedSharding(
-                mesh, P(*(("data",) + (None,) * (p.ndim - 1))))
+        spec = zero1_update_spec(p.shape, getattr(p.sharding, "spec", P()),
+                                 ndata)
+        if spec is not None:
+            return NamedSharding(mesh, spec)
         return p.sharding
 
     upd_shardings = jax.tree_util.tree_map(update_sharding, params)

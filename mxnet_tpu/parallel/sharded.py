@@ -29,7 +29,19 @@ from .. import random as _random
 from ..ndarray.ndarray import NDArray, _wrap
 from .mesh import auto_mesh
 
-__all__ = ["ShardedTrainer", "block_pure_fn", "sharded_data"]
+__all__ = ["ShardedTrainer", "block_pure_fn", "sharded_data",
+           "zero1_update_spec"]
+
+
+def zero1_update_spec(shape, current_spec, ndata, batch_axis="data"):
+    """The ZeRO-1 (arXiv:2004.13336) update PartitionSpec for a weight,
+    or None when it must fall back to the replicated update: the param
+    must currently be replicated (no TP sharding), the data axis must
+    have >1 replica, and the leading dim must divide evenly."""
+    replicated = all(s is None for s in tuple(current_spec or ()))
+    if replicated and shape and ndata > 1 and shape[0] % ndata == 0:
+        return P(*((batch_axis,) + (None,) * (len(shape) - 1)))
+    return None
 
 
 def _deactivate_hybrid(block, saved=None):
@@ -173,12 +185,14 @@ class ShardedTrainer:
         # split over the batch axis when the leading dim divides evenly
         self._ndata = self._mesh.shape[batch_axis]
         self._update_shardings = {}
-        for n in self._grad_names:
-            shp = pd[n]._data.shape
-            if shard_weight_update and self._tp_spec(n) is None and \
-                    shp and shp[0] % self._ndata == 0:
-                spec = P(*((batch_axis,) + (None,) * (len(shp) - 1)))
-                self._update_shardings[n] = NamedSharding(self._mesh, spec)
+        if shard_weight_update:
+            for n in self._grad_names:
+                spec = zero1_update_spec(pd[n]._data.shape,
+                                         self._tp_spec(n) or P(),
+                                         self._ndata, batch_axis)
+                if spec is not None:
+                    self._update_shardings[n] = NamedSharding(self._mesh,
+                                                              spec)
         replicated = NamedSharding(self._mesh, P())
         self.states = {}
         for n in self._grad_names:
