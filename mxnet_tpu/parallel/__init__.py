@@ -24,8 +24,10 @@ from .mesh import make_mesh, auto_mesh, factor_devices, current_mesh, using_mesh
 from .collectives import (psum, pmean, pmax, all_gather, reduce_scatter,
                           ppermute_shift, all_to_all, axis_index, axis_size,
                           barrier, host_allreduce)
-from .sharded import ShardedTrainer, block_pure_fn, sharded_data
+from .sharded import (ShardedTrainer, block_pure_fn, sharded_data,
+                      zero1_update_spec)
 from .ring_attention import ring_attention, local_attention
+from .pipeline import pipeline_apply
 from . import multihost
 from .multihost import init_from_env
 
@@ -33,7 +35,7 @@ __all__ = [
     "make_mesh", "auto_mesh", "factor_devices", "current_mesh", "using_mesh",
     "psum", "pmean", "pmax", "all_gather", "reduce_scatter", "ppermute_shift",
     "all_to_all", "axis_index", "axis_size", "barrier", "host_allreduce",
-    "ShardedTrainer", "block_pure_fn", "sharded_data",
-    "ring_attention", "local_attention",
+    "ShardedTrainer", "block_pure_fn", "sharded_data", "zero1_update_spec",
+    "ring_attention", "local_attention", "pipeline_apply",
     "multihost", "init_from_env",
 ]

@@ -1,0 +1,101 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh
+axis.
+
+Beyond-reference capability (the reference's closest feature is
+PartialForward, a debug tool — SURVEY §2.5 marks PP absent): layers are
+grouped into S stages, the stage dimension is sharded over the ``pipe``
+mesh axis (one stage's parameters per device), and microbatches stream
+through the stages with ``lax.ppermute`` hops. The schedule is the
+classic GPipe fill-drain loop: ``M + S - 1`` ticks for M microbatches,
+each device computing its stage on whatever activation sits in its slot.
+Implemented with ``shard_map`` so the collective is explicit and the
+whole schedule stays inside one jitted program; differentiable end to
+end (``ppermute`` has a transpose rule), so ``jax.grad`` of a pipelined
+loss trains all stages.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .collectives import ppermute_shift
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, n_microbatches,
+                   axis="pipe"):
+    """Apply S pipeline stages to ``x`` with microbatch streaming.
+
+    Parameters
+    ----------
+    stage_fn : callable(params_slice, activation) -> activation; the
+        per-stage computation. ``params_slice`` is one stage's leaves
+        (leading stage dim removed); activations keep one shape across
+        stages.
+    stage_params : pytree whose leaves have a leading stage dim of size
+        S == mesh.shape[axis] (stack per-stage params with
+        ``jnp.stack``).
+    x : [B, ...] batch; B must divide by ``n_microbatches``.
+    mesh : jax.sharding.Mesh containing ``axis``.
+    n_microbatches : GPipe M; ≥ S keeps the bubble fraction at
+        (S-1)/(M+S-1).
+
+    Returns the full-batch output, numerically identical to applying
+    the stages sequentially.
+    """
+    n_stages = mesh.shape[axis]
+    b = x.shape[0]
+    assert b % n_microbatches == 0, "batch must divide into microbatches"
+    mb = b // n_microbatches
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    def spmd(params_local, micro_all):
+        # params_local: this stage's leaves with leading dim 1
+        params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage_id = lax.axis_index(axis)
+        n_ticks = n_microbatches + n_stages - 1
+
+        state = jnp.zeros_like(micro_all[0])       # activation in my slot
+        outs = jnp.zeros_like(micro_all)
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 ingests microbatch t (when one remains)
+            feed = micro_all[jnp.minimum(t, n_microbatches - 1)]
+            state = jnp.where(stage_id == 0,
+                              jnp.where(t < n_microbatches, feed, state),
+                              state)
+            y = stage_fn(params_here, state)
+            # last stage banks its finished microbatch (t - (S-1))
+            out_idx = t - (n_stages - 1)
+            valid = (stage_id == n_stages - 1) & (out_idx >= 0)
+            outs = lax.cond(
+                valid,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(out_idx, 0), 0),
+                lambda o: o, outs)
+            # shift activations one stage forward (ring; stage 0's
+            # incoming value is ignored — overwritten by the next feed)
+            y = ppermute_shift(y, axis)
+            return (y, outs), None
+
+        (state, outs), _ = lax.scan(tick, (state, outs),
+                                    jnp.arange(n_ticks))
+        # only the last stage's `outs` is real; broadcast it to every
+        # shard so the out_spec can be replicated
+        outs = lax.psum(
+            jnp.where(stage_id == n_stages - 1, outs,
+                      jnp.zeros_like(outs)), axis)
+        return outs
+
+    spec_params = jax.tree_util.tree_map(
+        lambda _: P(axis), stage_params)
+    fn = jax.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(spec_params, P()), out_specs=P(),
+        check_vma=False)
+    outs = fn(stage_params, micro)
+    return outs.reshape((b,) + outs.shape[2:])
