@@ -83,3 +83,13 @@ def test_fine_tune_beats_scratch(capsys):
     tuned = float(last.split()[1])
     scratch = float(last.split()[-1].rstrip(")"))
     assert tuned > scratch + 0.05
+
+
+def test_super_resolution_beats_nearest(capsys):
+    """ESPCN sub-pixel conv beats nearest-neighbour upsampling in PSNR
+    on held-out images (ref example/gluon/super_resolution.py)."""
+    out = run_example("super_resolution.py", [], capsys)
+    last = out.strip().splitlines()[-1]
+    model = float(last.split()[1])
+    base = float(last.split()[-1].rstrip(")"))
+    assert model > base + 0.5
