@@ -95,27 +95,46 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """Compressed sparse row matrix."""
+    """Compressed sparse row matrix: (data, indices, indptr) metadata
+    over a dense backing store, mirroring RowSparseNDArray's design —
+    constructors seed the metadata, mutation drops it, and the parts
+    recompute (scipy, host-side) only when no cache exists."""
     __slots__ = ()
 
+    def _set_data(self, jarr):
+        # any mutation invalidates the sparse metadata
+        self._idx_cache = None
+        super()._set_data(jarr)
+
+    def _seed_csr(self, data, indices, indptr):
+        # copies: np.asarray would alias caller buffers, letting later
+        # external mutation desync metadata from the dense store
+        self._idx_cache = (np.array(data),
+                           np.array(indices, np.int64),
+                           np.array(indptr, np.int64))
+
     def _csr_parts(self):
-        import scipy.sparse as sp
-        m = sp.csr_matrix(self.asnumpy())
-        return m
+        if getattr(self, "_idx_cache", None) is None:
+            import scipy.sparse as sp
+            m = sp.csr_matrix(self.asnumpy())
+            self._idx_cache = (m.data,
+                               m.indices.astype(np.int64),
+                               m.indptr.astype(np.int64))
+        return self._idx_cache
 
     @property
     def indices(self):
-        return array(self._csr_parts().indices.astype(np.int64),
-                     ctx=self.context, dtype=np.int64)
+        return array(self._csr_parts()[1], ctx=self.context,
+                     dtype=np.int64)
 
     @property
     def indptr(self):
-        return array(self._csr_parts().indptr.astype(np.int64),
-                     ctx=self.context, dtype=np.int64)
+        return array(self._csr_parts()[2], ctx=self.context,
+                     dtype=np.int64)
 
     @property
     def data(self):
-        return array(self._csr_parts().data, ctx=self.context,
+        return array(self._csr_parts()[0], ctx=self.context,
                      dtype=self.dtype)
 
     def tostype(self, stype):
@@ -175,11 +194,17 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
             ncols = int(indices.max()) + 1 if indices.size else 0
             shape = (len(indptr) - 1, ncols)
         dense = np.zeros(shape, dtype=data.dtype)
-        for r in range(shape[0]):
-            for j in range(indptr[r], indptr[r + 1]):
-                dense[r, indices[j]] = data[j]
+        rows = np.repeat(np.arange(shape[0]), np.diff(indptr))
+        np.add.at(dense, (rows, indices), data)   # scipy duplicate-sum
         out = array(dense, ctx=ctx, dtype=data.dtype)
-        return _retag(out, "csr")
+        out = _retag(out, "csr")
+        # seed metadata only when it is canonical (no duplicate column
+        # per row) — otherwise properties recompute the summed form
+        # consistent with the dense store
+        flat = rows * max(shape[1], 1) + indices
+        if len(np.unique(flat)) == len(flat):
+            out._seed_csr(data, indices, indptr)
+        return out
     if isinstance(arg1, NDArray):
         return cast_storage(arg1, "csr")
     if hasattr(arg1, "toarray"):  # scipy sparse

@@ -196,3 +196,42 @@ def test_kvstore_row_sparse_pull_seeds_metadata():
     assert got[0].sum() == 0 and got[2].sum() == 0
     np.testing.assert_allclose(got[1], [2, 3])
     np.testing.assert_allclose(got[3], [6, 7])
+
+
+def test_csr_metadata_seeded_and_invalidated():
+    """csr_matrix((data, indices, indptr)) keeps the given metadata
+    without a recompute round-trip; mutation invalidates it (same
+    design as RowSparse index+values caching)."""
+    from mxnet_tpu.ndarray import sparse
+    data = np.array([1.0, 2.0, 3.0], np.float32)
+    indices = np.array([0, 2, 1], np.int64)
+    indptr = np.array([0, 2, 2, 3], np.int64)
+    m = sparse.csr_matrix((data, indices, indptr), shape=(3, 4))
+    np.testing.assert_array_equal(m.indices.asnumpy(), indices)
+    np.testing.assert_array_equal(m.indptr.asnumpy(), indptr)
+    np.testing.assert_allclose(m.data.asnumpy(), data)
+    np.testing.assert_allclose(
+        m.asnumpy(),
+        [[1, 0, 2, 0], [0, 0, 0, 0], [0, 3, 0, 0]])
+    # mutation drops the seeded metadata; recompute reflects new values
+    m[:] = m * 0 + np.array([[0, 5, 0, 0]] * 3, np.float32)
+    np.testing.assert_array_equal(m.indices.asnumpy(), [1, 1, 1])
+    np.testing.assert_array_equal(m.indptr.asnumpy(), [0, 1, 2, 3])
+
+
+def test_csr_constructor_edge_cases():
+    """Seeded metadata never aliases caller buffers, and duplicate
+    column indices sum (scipy convention) with canonical recompute."""
+    from mxnet_tpu.ndarray import sparse
+    d = np.array([1.0, 2.0, 3.0], np.float32)
+    m = sparse.csr_matrix((d, [0, 1, 2], [0, 1, 2, 3]), shape=(3, 3))
+    d[0] = 99.0                      # caller mutates its own buffer
+    np.testing.assert_allclose(m.data.asnumpy(), [1.0, 2.0, 3.0])
+    assert m.asnumpy()[0, 0] == 1.0
+
+    dup = sparse.csr_matrix(
+        (np.array([1.0, 2.0], np.float32), [0, 0], [0, 2, 2]),
+        shape=(2, 3))
+    assert dup.asnumpy()[0, 0] == 3.0           # duplicates sum
+    np.testing.assert_allclose(dup.data.asnumpy(), [3.0])
+    np.testing.assert_array_equal(dup.indices.asnumpy(), [0])
