@@ -422,6 +422,31 @@ class Symbol:
         with open(fname, "w") as f:
             f.write(self.tojson())
 
+    def debug_str(self):
+        """Human-readable graph dump (reference ``Symbol.debug_str`` —
+        one line per node in topological order with op, name, and input
+        wiring; SURVEY §5.5 graph introspection)."""
+        nodes = _topo(self._outputs)
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        lines = ["Symbol Outputs:"]
+        for pos, (n, oi) in enumerate(self._outputs):
+            lines.append("\toutput[%d]=%s(%d)"
+                         % (pos, n.output_name(oi), nid[id(n)]))
+        for n in nodes:
+            if n.op is None:
+                lines.append("Variable:%s" % n.name)
+                continue
+            attrs = ", ".join("%s=%s" % (k, attr_to_string(v))
+                              for k, v in sorted(n.attrs.items()))
+            lines.append("--------------------")
+            lines.append("Op:%s, Name=%s%s"
+                         % (n.op.name, n.name,
+                            (" {%s}" % attrs) if attrs else ""))
+            for k, (s, oi) in enumerate(n.inputs):
+                lines.append("\targ[%d]=%s(%d)"
+                             % (k, s.output_name(oi), nid[id(s)]))
+        return "\n".join(lines) + "\n"
+
     # -- binding (implemented in executor.py) ------------------------------
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):

@@ -142,3 +142,24 @@ def test_infer_shape_error_message():
     net = sym.FullyConnected(d, num_hidden=4)
     with pytest.raises(MXNetError):
         net.infer_shape()  # no shapes at all
+
+
+def test_debug_str_lists_graph():
+    """Symbol.debug_str dumps every node with its wiring (ref
+    symbol.debug_str / GraphExecutor::Print introspection)."""
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    out = mx.sym.Activation(h, act_type="relu", name="act1")
+    s = out.debug_str()
+    assert "Variable:data" in s
+    assert "Op:FullyConnected, Name=fc1" in s
+    assert "Op:Activation, Name=act1" in s
+    assert "act_type=relu" in s
+    # positional wiring: FC's three inputs get distinct arg slots
+    fc_block = s.split("Op:FullyConnected")[1].split("---")[0]
+    assert "arg[0]=data" in fc_block
+    assert "arg[1]=fc1_weight" in fc_block
+    assert "arg[2]=fc1_bias" in fc_block
+    # grouped outputs are numbered by position, not producer out-index
+    g = mx.sym.Group([h, out]).debug_str()
+    assert "output[0]=fc1_output" in g and "output[1]=act1_output" in g
