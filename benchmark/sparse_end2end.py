@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Sparse end-to-end benchmark: dense-backed vs scatter row_sparse update.
+
+Reference counterpart: ``benchmark/python/sparse/sparse_end2end.py`` — the
+harness behind the reference's claim that row_sparse updates beat dense at
+large feature counts. This rebuild's sparse arrays are dense-backed by
+design (``ndarray/sparse.py:1-16``): on TPU, XLA scatters lower to
+serialised HBM read-modify-writes while a full-row dense update is one
+streaming pass that the compiler fuses — so "sparse" update == dense
+update here. This benchmark MEASURES that claim instead of asserting it:
+
+  series A (framework): the sparse linear-classification step through
+      Module (CSR batch -> row_sparse weight -> SGD), our real path.
+  series B (dense jax): hand-rolled dense weight update, lower bound.
+  series C (scatter jax): an emulated scatter-based row update
+      (gather touched rows -> update -> scatter back), the design the
+      reference's C++ kernels use.
+
+Prints one JSON line per series:
+  {"metric": "sparse_linear_step", "series": ..., "steps_per_s": ...}
+
+    python benchmark/sparse_end2end.py --num-features 100000 --nnz 64
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _rate(fn, repeats=3, target_s=2.0):
+    fn()  # compile
+    t0 = time.perf_counter()
+    fn()
+    per = max(time.perf_counter() - t0, 1e-5)
+    iters = max(2, int(target_s / per))
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        rates.append(iters / (time.perf_counter() - t0))
+    return float(np.median(rates))
+
+
+def framework_series(args, x_ids, x_vals, y):
+    """Module-path step on the CSR batch (the real user path)."""
+    import mxnet_tpu as mx
+    from examples.sparse_linear_classification import linear_model
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.ndarray import sparse as sp
+
+    dense = np.zeros((args.batch_size, args.num_features), np.float32)
+    rows = np.repeat(np.arange(args.batch_size), args.nnz)
+    dense[rows, x_ids.ravel()] = x_vals.ravel()
+    csr = sp.csr_matrix(dense)
+
+    mod = mx.mod.Module(linear_model(args.num_features),
+                        data_names=["data"],
+                        label_names=["softmax_label"], context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data",
+                                   (args.batch_size, args.num_features))],
+             label_shapes=[DataDesc("softmax_label", (args.batch_size,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    batch = DataBatch([csr], [mx.nd.array(y)])
+
+    def step():
+        mod._fit_step(batch)
+        mod.get_outputs()[0].wait_to_read()
+
+    return _rate(step)
+
+
+def raw_series(args, x_ids, x_vals, y, mode):
+    """Hand-rolled jax step: dense update vs gather/scatter row update."""
+    import jax
+    import jax.numpy as jnp
+
+    w = jnp.zeros((args.num_features, 2))
+    b = jnp.zeros((2,))
+    ids = jnp.asarray(x_ids)          # (B, nnz)
+    vals = jnp.asarray(x_vals)        # (B, nnz)
+    yj = jnp.asarray(y, jnp.int32)
+
+    def loss_fn(w, b):
+        # gather the touched rows; logits = sum_j v_j * w[id_j]
+        logits = jnp.einsum("bn,bnc->bc", vals, w[ids]) + b
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, yj[:, None], axis=1))
+
+    if mode == "dense":
+        @jax.jit
+        def step(w, b):
+            gw, gb = jax.grad(loss_fn, argnums=(0, 1))(w, b)
+            return w - 0.1 * gw, b - 0.1 * gb
+    else:
+        uids = None
+
+        @jax.jit
+        def step(w, b):
+            # scatter emulation: grads only exist on touched rows; gather
+            # those rows, update, scatter back (reference-style kernel)
+            gw, gb = jax.grad(loss_fn, argnums=(0, 1))(w, b)
+            flat = ids.reshape(-1)
+            rows = gw[flat]                       # gather touched
+            new_rows = w[flat] - 0.1 * rows
+            w = w.at[flat].set(new_rows)          # scatter back
+            return w, b - 0.1 * gb
+
+    state = {"w": w, "b": b}
+
+    def run():
+        state["w"], state["b"] = step(state["w"], state["b"])
+        jax.block_until_ready(state["b"])
+
+    return _rate(run)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-features", type=int, default=100000)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--nnz", type=int, default=64)
+    ap.add_argument("--skip-framework", action="store_true")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    x_ids = rng.randint(0, args.num_features,
+                        (args.batch_size, args.nnz)).astype(np.int32)
+    x_vals = rng.rand(args.batch_size, args.nnz).astype(np.float32)
+    y = rng.randint(0, 2, args.batch_size).astype(np.float32)
+
+    series = {}
+    if not args.skip_framework:
+        series["framework_module"] = framework_series(args, x_ids, x_vals, y)
+    series["raw_dense_update"] = raw_series(args, x_ids, x_vals, y, "dense")
+    series["raw_scatter_update"] = raw_series(args, x_ids, x_vals, y,
+                                              "scatter")
+    for name, rate in series.items():
+        print(json.dumps({"metric": "sparse_linear_step", "series": name,
+                          "steps_per_s": round(rate, 2),
+                          "num_features": args.num_features,
+                          "batch": args.batch_size, "nnz": args.nnz}))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ from . import optimizer
 from . import optimizer as opt
 from . import metric
 from . import operator
+from . import pallas
 from . import rnn
 from . import contrib
 from . import torch

@@ -50,7 +50,23 @@ def _load_uncached(so_name):
         _try_build(so_path)
     if not os.path.exists(so_path):
         return None
-    return ctypes.CDLL(so_path)
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError:
+        # corrupt or ABI-incompatible artifact: rebuild once, then degrade
+        # to the pure-Python fallback (callers expect CDLL-or-None)
+        try:
+            os.remove(so_path)
+        except OSError:
+            return None
+        if os.environ.get("MXNET_TPU_BUILD_NATIVE", "1") == "1":
+            _try_build(so_path)
+        if os.path.exists(so_path):
+            try:
+                return ctypes.CDLL(so_path)
+            except OSError:
+                return None
+        return None
 
 
 def _try_build(so_path):

@@ -32,11 +32,13 @@ from __future__ import annotations
 import atexit
 import ctypes
 import itertools
+import logging
 import os
 import threading
 import weakref
 
 import jax
+import numpy as np
 
 __all__ = ["wait_for_var", "wait_for_all", "push", "is_sync_dispatch",
            "set_sync_dispatch", "ThreadedEngine", "engine"]
@@ -68,8 +70,8 @@ def wait_for_var(arr):
     Accepts a jax/NDArray value (PJRT future) or an ``int`` variable
     handle from :meth:`ThreadedEngine.new_variable`.
     """
-    if isinstance(arr, int):
-        engine().wait_for_var(arr)
+    if isinstance(arr, (int, np.integer)) and not isinstance(arr, bool):
+        engine().wait_for_var(int(arr))
         return
     jax.block_until_ready(arr)
 
@@ -310,10 +312,20 @@ class ThreadedEngine:
 
     def _raise_pending(self):
         with _TASKS_LOCK:
-            if self._errors:
-                err = self._errors[0]
-                self._errors.clear()
-                raise err
+            if not self._errors:
+                return
+            err, rest = self._errors[0], self._errors[1:]
+            self._errors.clear()
+        # surface the FIRST failure; chain the rest via __context__ so no
+        # async task error is silently discarded when several fail between
+        # wait points (e.g. two async checkpoint writes)
+        node = err
+        for extra in rest:
+            logging.getLogger(__name__).error(
+                "additional async engine task failure: %r", extra)
+            node.__context__ = extra
+            node = extra
+        raise err
 
     def wait_for_var(self, var):
         """Block until every write pushed on ``var`` so far has landed."""

@@ -10,9 +10,29 @@ gradient-graph synthesis (nnvm Gradient pass), memory planning
 collapses into *one jitted function per (train/eval) mode*:
 
     eval:  jit(graph_fn)                         — XLA plans memory, fuses
-    train: jax.vjp(graph_fn, grad_args)          — replaces pass::Gradient;
-           forward runs once (residuals kept on device), backward() applies
-           the stored vjp — both legs are compiled XLA programs.
+    train: jit(vjp(graph_fn))                    — replaces pass::Gradient.
+
+The train path compiles exactly TWO programs per bind, traced once and
+cached for the executor's lifetime (reference parity: after
+GraphExecutor::Init the per-step RunOps loop at graph_executor.cc:1403
+does no graph work, it only pushes cached engine ops):
+
+    _fwd_train_jit: (args, aux, rng) -> (outputs, new_aux, vjp_fn)
+        jax.vjp runs INSIDE the jit; the returned ``vjp_fn`` is a
+        jax.tree_util.Partial — a pytree whose leaves are the on-device
+        residuals — so it crosses the jit boundary as data.
+    _bwd_jit: (vjp_fn, out_grads) -> input_grads
+        applies the residual pytree; same treedef every step, so this
+        compiles once too.
+
+``forward_backward`` additionally fuses both legs (and the ones-like
+head gradient) into ONE XLA program — the Module.fit hot path, where XLA
+schedules forward and backward together and residual layouts never
+round-trip through program boundaries.
+
+Auxiliary state (BatchNorm moving stats) flows functionally: graph_fn
+returns updated aux values, forward writes them back into the aux NDArrays
+(reference mutates aux in-kernel).
 
 Auxiliary state (BatchNorm moving stats) flows functionally: graph_fn
 returns updated aux values, forward writes them back into the aux NDArrays
@@ -123,9 +143,38 @@ class Executor:
             symbol, train_mode=False)
         fn_train, _, _ = _build_graph_fn(symbol, train_mode=True)
         self._eval_jit = jax.jit(fn_eval)
-        self._train_fn = fn_train  # vjp'd per forward; jit inside
+        self._train_fn = fn_train  # raw, for the debug (monitor/group) paths
         self._train_jit = jax.jit(fn_train)
+
+        gpos = tuple(self.arg_names.index(n) for n in self._grad_names)
+        self._gpos = gpos
+
+        def _fwd_vjp(arg_vals, aux_vals, rng):
+            def g(grad_vals):
+                full = list(arg_vals)
+                for p, v in zip(gpos, grad_vals):
+                    full[p] = v
+                return fn_train(full, aux_vals, rng)
+            outs, vjp_fn, new_aux = jax.vjp(
+                g, [arg_vals[p] for p in gpos], has_aux=True)
+            return outs, new_aux, vjp_fn
+
+        def _fwd_bwd(arg_vals, aux_vals, rng, ograds):
+            outs, new_aux, vjp_fn = _fwd_vjp(arg_vals, aux_vals, rng)
+            (in_grads,) = vjp_fn(tuple(ograds))
+            return outs, new_aux, in_grads
+
+        def _fwd_bwd_ones(arg_vals, aux_vals, rng):
+            outs, new_aux, vjp_fn = _fwd_vjp(arg_vals, aux_vals, rng)
+            (in_grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+            return outs, new_aux, in_grads
+
+        self._fwd_train_jit = jax.jit(_fwd_vjp)
+        self._bwd_jit = jax.jit(lambda vjp_fn, og: vjp_fn(og))
+        self._fwd_bwd_jit = jax.jit(_fwd_bwd)
+        self._fwd_bwd_ones_jit = jax.jit(_fwd_bwd_ones)
         self._vjp = None
+        self._vjp_jitted = False
         self._outputs = None
         self._monitor = None
         self._group2ctx = group2ctx
@@ -182,6 +231,7 @@ class Executor:
 
                 _o, self._vjp, _na = jax.vjp(
                     f_grp, [arg_vals[p] for p in gpos], has_aux=True)
+                self._vjp_jitted = False
         elif self._monitor is not None and \
                 getattr(self._monitor, "is_active", lambda: True)():
             outs, new_aux = self._forward_monitored(arg_vals, aux_vals, rng,
@@ -199,18 +249,13 @@ class Executor:
 
                 _outs, self._vjp, _na = jax.vjp(
                     f_mon, [arg_vals[p] for p in gpos], has_aux=True)
+                self._vjp_jitted = False
         elif is_train and self._grad_names:
-            gpos = [self.arg_names.index(n) for n in self._grad_names]
-
-            def f(grad_vals):
-                full = list(arg_vals)
-                for p, v in zip(gpos, grad_vals):
-                    full[p] = v
-                outs, new_aux = self._train_jit(full, aux_vals, rng)
-                return outs, new_aux
-
-            outs, self._vjp, new_aux = jax.vjp(
-                f, [arg_vals[p] for p in gpos], has_aux=True)
+            # hot path: ONE cached compiled program; the vjp residuals come
+            # back as a Partial pytree and stay on device for _bwd_jit
+            outs, new_aux, self._vjp = self._fwd_train_jit(
+                arg_vals, aux_vals, rng)
+            self._vjp_jitted = True
         elif is_train:
             outs, new_aux = self._train_jit(arg_vals, aux_vals, rng)
         else:
@@ -322,13 +367,59 @@ class Executor:
             grads_in = tuple(
                 g._data if isinstance(g, NDArray) else jnp.asarray(g)
                 for g in out_grads)
-        (in_grads,) = self._vjp(grads_in)
+        if self._vjp_jitted:
+            (in_grads,) = self._bwd_jit(self._vjp, grads_in)
+        else:
+            (in_grads,) = self._vjp(grads_in)
+        self._write_grads(in_grads)
+
+    def _write_grads(self, in_grads):
         for n, g in zip(self._grad_names, in_grads):
             dst = self.grad_dict[n]
             if self.grad_req[n] == "add":
                 dst._set_data(dst._data + g.astype(dst.dtype))
             else:
                 dst._set_data(g.astype(dst.dtype))
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """Forward + backward as ONE compiled XLA program (Module.fit hot
+        path). Equivalent to ``forward(is_train=True)`` + ``backward()``
+        but with no program boundary between the legs: XLA schedules the
+        whole step, residual layouts never materialize at a program edge.
+        Falls back to the two-call path under a monitor or group2ctx."""
+        if self._group2ctx or (self._monitor is not None and getattr(
+                self._monitor, "is_active", lambda: True)()):
+            self.forward(is_train=True, **kwargs)
+            self.backward(out_grads)
+            return self._outputs
+        if not self._grad_names:
+            self.forward(is_train=True, **kwargs)
+            return self._outputs
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %s" % k)
+            src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            self.arg_dict[k]._set_data(src.astype(self.arg_dict[k].dtype))
+        arg_vals = [self._place(n, self.arg_dict[n]) for n in self.arg_names]
+        aux_vals = [self._place(n, self.aux_dict[n]) for n in self.aux_names]
+        rng = self._place_rng(_random.next_key())
+        if out_grads is None:
+            outs, new_aux, in_grads = self._fwd_bwd_ones_jit(
+                arg_vals, aux_vals, rng)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = tuple(
+                g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads)
+            outs, new_aux, in_grads = self._fwd_bwd_jit(
+                arg_vals, aux_vals, rng, ograds)
+        for n, v in zip(self.aux_names, new_aux):
+            self.aux_dict[n]._set_data(v)
+        self._outputs = [_wrap(o, self._ctx) for o in outs]
+        self._vjp = None  # grads already written; stale vjp must not linger
+        self._write_grads(in_grads)
+        return self._outputs
 
     # -- params ------------------------------------------------------------
     def copy_params_from(self, arg_params, aux_params=None,
@@ -436,3 +527,5 @@ def _profiled(method, label):
 
 Executor.forward = _profiled(Executor.forward, "executor_forward")
 Executor.backward = _profiled(Executor.backward, "executor_backward")
+Executor.forward_backward = _profiled(Executor.forward_backward,
+                                      "executor_forward_backward")

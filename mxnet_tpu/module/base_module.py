@@ -91,6 +91,13 @@ class BaseModule:
         self.forward(data_batch, True)
         self.backward()
 
+    def _fit_step(self, data_batch):
+        """One fit-loop step: fwd+bwd+update. Subclasses may fuse all
+        three into a single compiled program (Module does, when update
+        placement allows)."""
+        self.forward_backward(data_batch)
+        self.update()
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=None,
@@ -154,8 +161,11 @@ class BaseModule:
             self.prepare(batch)
             if monitor is not None:
                 monitor.tic()
-            self.forward_backward(batch)
-            self.update()
+            if monitor is None:
+                self._fit_step(batch)
+            else:
+                self.forward_backward(batch)
+                self.update()
             self.update_metric(train_metric, batch.label)
             if monitor is not None:
                 monitor.toc_print()

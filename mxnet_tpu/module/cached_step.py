@@ -1,0 +1,165 @@
+"""CachedTrainStep: fwd + bwd + optimizer update as ONE donated program.
+
+The reference's training loop after bind does zero graph work per step —
+``GraphExecutor::RunOps`` (graph_executor.cc:1403) pushes cached engine ops
+and the fused optimizer kernels (``src/operator/optimizer_op.cc``) mutate
+weights in place. The TPU equivalent is one jitted XLA program per bound
+(shapes, optimizer) pair:
+
+    (params, data, aux, opt_states, rng, hyper)
+        -> (outputs, new_params, new_aux, new_opt_states)
+
+with parameter / aux / state buffers **donated**, so XLA updates weights
+in place in HBM exactly like the reference's in-place optimizer kernels.
+Gradients are consumed inside the program and never materialise at a
+program boundary — the step is fwd+bwd+update with nothing in between.
+
+Hyper-parameters (per-param lr/wd after scheduler + multipliers, the
+update count ``t``, a fresh PRNG key for stochastic optimizers like SGLD)
+enter as *traced* arrays: a changing learning-rate schedule never causes
+a retrace.
+
+Used automatically by ``Module.fit`` when the update placement allows it
+(single logical parameter copy, optimizer-on-worker — the single-chip and
+fused-SPMD cases); any kvstore-mediated placement falls back to the
+split path. Opt out with ``MXNET_MODULE_FUSED_STEP=0``.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import random as _random
+from ..ndarray import NDArray
+from ..optimizer import _state_raw, _state_writeback
+
+__all__ = ["CachedTrainStep", "fused_step_enabled"]
+
+
+def fused_step_enabled():
+    return os.environ.get("MXNET_MODULE_FUSED_STEP", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+class CachedTrainStep:
+    """One compiled train step bound to (executor, updater, param set)."""
+
+    def __init__(self, executor, updater, param_names):
+        self._exec = executor
+        self._updater = updater
+        self._opt = updater.optimizer
+        # updatable params = the executor's grad-bearing args, in the
+        # module's param order so optimizer indices match the slow path
+        grad_set = set(executor._grad_names)
+        self._pnames = [n for n in param_names if n in grad_set]
+        if set(self._pnames) != grad_set:
+            raise ValueError("fused step needs grads on params only")
+        arg_names = executor.arg_names
+        self._ppos = [arg_names.index(n) for n in self._pnames]
+        self._rest_names = [n for n in arg_names if n not in grad_set]
+        rest_pos = [arg_names.index(n) for n in self._rest_names]
+        self._pidx = {n: i for i, n in enumerate(param_names)}
+
+        fn_train = executor._train_fn
+        n_args = len(arg_names)
+        ppos, opt = self._ppos, self._opt
+
+        def step(params, rest, aux_vals, states, hyper):
+            def g(ps):
+                full = [None] * n_args
+                for p, v in zip(ppos, ps):
+                    full[p] = v
+                for p, v in zip(rest_pos, rest):
+                    full[p] = v
+                return fn_train(full, aux_vals, hyper["rng"])
+            outs, vjp_fn, new_aux = jax.vjp(g, params, has_aux=True)
+            (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+            new_params, new_states = [], []
+            for i, (w, gr) in enumerate(zip(params, grads)):
+                h = {"lr": jnp.asarray(hyper["lr"][i], dtype=w.dtype),
+                     "wd": jnp.asarray(hyper["wd"][i], dtype=w.dtype),
+                     "t": hyper["t"][i], "key": hyper["key"][i]}
+                nw, ns = opt.update_step(w, gr.astype(w.dtype),
+                                         states[i], h)
+                new_params.append(nw.astype(w.dtype))
+                new_states.append(ns)
+            return outs, new_params, new_aux, new_states
+
+        donate = (0, 2, 3) if executor._ctx.device_type != "cpu" else ()
+        self._step_jit = jax.jit(step, donate_argnums=donate)
+
+    def _ensure_states(self):
+        """Create optimizer state through the Updater so checkpoint
+        save/load (updater.get_states/set_states) sees the same layout
+        as the slow path."""
+        for name in self._pnames:
+            idx = self._pidx[name]
+            if idx not in self._updater.states:
+                self._updater.states[idx] = self._opt.create_state(
+                    idx, self._exec.arg_dict[name])
+                self._updater.states_synced[idx] = True
+
+    def run(self, feed):
+        """Execute one step; *feed* maps data/label names to NDArrays."""
+        ex = self._exec
+        for k, v in feed.items():
+            if k in ex.arg_dict:
+                src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                ex.arg_dict[k]._set_data(src.astype(ex.arg_dict[k].dtype))
+        self._ensure_states()
+
+        opt = self._opt
+        prev_num_update = opt.num_update
+        lrs, wds, ts = [], [], []
+        for name in self._pnames:
+            idx = self._pidx[name]
+            opt._update_count(idx)
+            lrs.append(opt._get_lr(idx))
+            wds.append(opt._get_wd(idx))
+            ts.append(opt._index_update_count[idx])
+
+        params = [ex._place(n, ex.arg_dict[n]) for n in self._pnames]
+        rest = [ex._place(n, ex.arg_dict[n]) for n in self._rest_names]
+        aux_vals = [ex._place(n, ex.aux_dict[n]) for n in ex.aux_names]
+        # optimizer state must live where its weight lives (sharded
+        # executors replicate params over a mesh AFTER create_state ran)
+        states = [
+            jax.tree_util.tree_map(
+                lambda leaf, w=w: leaf if getattr(w, "sharding", None) in (
+                    None, getattr(leaf, "sharding", None))
+                else jax.device_put(leaf, w.sharding),
+                _state_raw(self._updater.states[self._pidx[n]]))
+            for n, w in zip(self._pnames, params)]
+        key = ex._place_rng(_random.next_key())
+        ukeys = jax.random.split(key, len(self._pnames) + 1)
+        hyper = {"lr": np.asarray(lrs, np.float32),
+                 "wd": np.asarray(wds, np.float32),
+                 "t": np.asarray(ts, np.int32),
+                 "key": ex._place_rng(ukeys[1:]),
+                 "rng": ex._place_rng(ukeys[0])}
+
+        try:
+            outs, new_params, new_aux, new_states = self._step_jit(
+                params, rest, aux_vals, states, hyper)
+        except NotImplementedError:
+            # optimizer lacks a pure update_step (discovered at trace
+            # time): roll back the count bookkeeping so the slow-path
+            # retry of this same batch doesn't double-count the step
+            for name in self._pnames:
+                opt._index_update_count[self._pidx[name]] -= 1
+            opt.num_update = prev_num_update
+            raise
+
+        for n, v in zip(self._pnames, new_params):
+            ex.arg_dict[n]._set_data(v)
+        for n, v in zip(ex.aux_names, new_aux):
+            ex.aux_dict[n]._set_data(v)
+        for n, s in zip(self._pnames, new_states):
+            _state_writeback(self._updater.states[self._pidx[n]], s)
+        from ..ndarray.ndarray import _wrap
+        ex._outputs = [_wrap(o, ex._ctx) for o in outs]
+        ex._vjp = None
+        return ex._outputs

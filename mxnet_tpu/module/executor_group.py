@@ -87,10 +87,14 @@ class DataParallelExecutorGroup:
 
     def _bind_execs(self, data_shapes, label_shapes):
         self.execs = []
+        type_dict = {d.name: d.dtype
+                     for d in list(data_shapes) + list(label_shapes or [])
+                     if isinstance(d, DataDesc) and d.dtype is not None}
         for i, c in enumerate(self.contexts):
             shape_kwargs = self._sliced_shape(data_shapes, i)
             shape_kwargs.update(self._sliced_shape(label_shapes, i))
             ex = self.symbol.simple_bind(c, grad_req=self.grad_req,
+                                         type_dict=type_dict,
                                          **shape_kwargs)
             self.execs.append(ex)
         self.data_arrays = [[e.arg_dict[n] for e in self.execs]
@@ -151,6 +155,17 @@ class DataParallelExecutorGroup:
             self._load_label(data_batch)
         for ex in self.execs:
             ex.forward(is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        """One fused fwd+bwd XLA program per device (Module.fit hot path;
+        ref RunOps pushes cached ops only, graph_executor.cc:1403)."""
+        assert self.for_training, \
+            "re-bind with for_training=True to run backward"
+        self._load_data(data_batch)
+        if self.label_arrays and data_batch.label:
+            self._load_label(data_batch)
+        for ex in self.execs:
+            ex.forward_backward()
 
     def backward(self, out_grads=None):
         assert self.for_training, "re-bind with for_training=True to run backward"

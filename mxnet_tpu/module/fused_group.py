@@ -91,19 +91,26 @@ class FusedExecutorGroup(object):
         arg_dict, grad_dict = {}, {}
         shapes = {d.name: d.shape for d in data_shapes}
         shapes.update({d.name: d.shape for d in (label_shapes or [])})
-        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        dtypes = {d.name: d.dtype
+                  for d in list(data_shapes) + list(label_shapes or [])
+                  if d.dtype is not None}
+        arg_structs, _, aux_structs = symbol._infer(shape_kwargs=shapes,
+                                                    dtype_kwargs=dtypes)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
-        for name, shape in zip(arg_names, arg_shapes):
-            arg_dict[name] = nd.zeros(shape, ctx=contexts[0])
+        for name, st in zip(arg_names, arg_structs):
+            shape = tuple(st.shape)
+            arg_dict[name] = nd.zeros(shape, ctx=contexts[0], dtype=st.dtype)
             wants_grad = (for_training and name in self.param_names
                           and name not in fixed)
             if name in batch_args:
                 wants_grad = for_training and inputs_need_grad
             if wants_grad and grad_req != "null":
-                grad_dict[name] = nd.zeros(shape, ctx=contexts[0])
-        aux_dict = {name: nd.zeros(shape, ctx=contexts[0])
-                    for name, shape in zip(aux_names, aux_shapes)}
+                grad_dict[name] = nd.zeros(shape, ctx=contexts[0],
+                                           dtype=st.dtype)
+        aux_dict = {name: nd.zeros(tuple(st.shape), ctx=contexts[0],
+                                   dtype=st.dtype)
+                    for name, st in zip(aux_names, aux_structs)}
 
         req = {n: ("write" if n in grad_dict else "null")
                for n in arg_names}
@@ -154,6 +161,13 @@ class FusedExecutorGroup(object):
         if data_batch.label:
             feed.update(zip(self._label_names, data_batch.label))
         self._exec.forward(is_train=bool(is_train), **feed)
+
+    def forward_backward(self, data_batch):
+        """Fused fwd+bwd in one SPMD program over the mesh."""
+        feed = dict(zip(self._data_names, data_batch.data))
+        if data_batch.label:
+            feed.update(zip(self._label_names, data_batch.label))
+        self._exec.forward_backward(**feed)
 
     def backward(self, out_grads=None):
         self._exec.backward(out_grads=out_grads)

@@ -322,6 +322,7 @@ class Module(BaseModule):
 
         self._optimizer, self._kvstore = optimizer, kvstore
         self._update_on_kvstore = update_on_kvstore
+        self._cached_step, self._cached_step_unusable = None, False
 
         if kvstore:
             _initialize_kvstore(
@@ -386,6 +387,67 @@ class Module(BaseModule):
         else:
             new_label = None
         self.reshape(new_data, new_label)
+
+    def _fit_step(self, data_batch):
+        """fit-loop step. Fast path: fwd+bwd+optimizer as ONE donated
+        compiled program (cached_step.CachedTrainStep) when the update
+        placement allows — single logical param copy, optimizer on
+        worker. Falls back to forward_backward + update otherwise."""
+        self._maybe_reshape(data_batch)
+        step = self._get_cached_step()
+        if step is not None:
+            feed = dict(zip(self._data_names, data_batch.data))
+            if data_batch.label:
+                feed.update(zip(self._label_names, data_batch.label))
+            try:
+                step.run(feed)
+                self._params_dirty = True
+                return
+            except NotImplementedError:
+                # optimizer has no pure update_step: permanently fall back
+                self._cached_step_unusable = True
+                self._cached_step = None
+        super()._fit_step(data_batch)
+
+    def _get_cached_step(self):
+        from .cached_step import CachedTrainStep, fused_step_enabled
+        if getattr(self, "_cached_step_unusable", False) \
+                or not fused_step_enabled():
+            return None
+        if not (self.optimizer_initialized and self._updater is not None
+                and self._kvstore is None and not self.inputs_need_grad):
+            return None
+        group = self._exec_group
+        if len(group.execs) != 1:
+            return None
+        ex = group.execs[0]
+        if ex._group2ctx or ex._monitor is not None:
+            return None
+        if any(r not in ("write", "null") for r in ex.grad_req.values()):
+            return None
+        cached = getattr(self, "_cached_step", None)
+        if cached is not None and cached._exec is ex \
+                and cached._updater is self._updater:
+            return cached
+        try:
+            cached = CachedTrainStep(ex, self._updater, group.param_names)
+        except ValueError:
+            cached = None
+            self._cached_step_unusable = True
+        self._cached_step = cached
+        return cached
+
+    def forward_backward(self, data_batch):
+        """fwd+bwd as one compiled program per executor (falls back to the
+        two-call path when the group doesn't support fusing)."""
+        self._require_ready()
+        self._maybe_reshape(data_batch)
+        fused = getattr(self._exec_group, "forward_backward", None)
+        if fused is not None:
+            fused(data_batch)
+        else:
+            self._exec_group.forward(data_batch, True)
+            self._exec_group.backward()
 
     def backward(self, out_grads=None):
         self._require_ready()

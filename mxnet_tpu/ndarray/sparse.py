@@ -198,11 +198,12 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         np.add.at(dense, (rows, indices), data)   # scipy duplicate-sum
         out = array(dense, ctx=ctx, dtype=data.dtype)
         out = _retag(out, "csr")
-        # seed metadata only when it is canonical (no duplicate column
-        # per row) — otherwise properties recompute the summed form
-        # consistent with the dense store
+        # seed metadata only when it is canonical: no duplicate column per
+        # row AND columns sorted within each row (strictly increasing flat
+        # keys) — otherwise .indices/.data would depend on construction
+        # history vs the scipy-recomputed (sorted) form after any mutation
         flat = rows * max(shape[1], 1) + indices
-        if len(np.unique(flat)) == len(flat):
+        if flat.size == 0 or bool(np.all(np.diff(flat) > 0)):
             out._seed_csr(data, indices, indptr)
         return out
     if isinstance(arg1, NDArray):

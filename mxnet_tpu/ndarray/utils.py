@@ -11,6 +11,10 @@ Layout (little-endian):
   ndarray:= uint32 0xF993fac9 (NDARRAY_V2_MAGIC) | int32 stype(0=dense)
           | shape | int32 dev_type | int32 dev_id | int32 type_flag | raw data
   shape  := uint32 ndim | int64 dim[ndim]          (nnvm::TShape::Save)
+
+Legacy records also load (reference LegacyLoad, ndarray.cc:892-937):
+  V1     := uint32 0xF993fac8 | shape | context | type_flag | raw data
+  V0     := uint32 ndim | uint32 dim[ndim] | context | type_flag | raw data
 """
 from __future__ import annotations
 
@@ -26,6 +30,7 @@ __all__ = ["save", "load", "save_to_bytes", "load_from_bytes"]
 
 _LIST_MAGIC = 0x112
 _V2_MAGIC = 0xF993FAC9
+_V1_MAGIC = 0xF993FAC8
 
 
 def _write_shape(buf, shape):
@@ -55,17 +60,31 @@ def _save_one(buf, arr):
     buf.append(np.ascontiguousarray(npy).tobytes())
 
 
-def _load_one(mv, off):
-    (magic,) = struct.unpack_from("<I", mv, off)
-    off += 4
-    if magic != _V2_MAGIC:
-        raise MXNetError("unsupported NDArray binary version 0x%x "
-                         "(only V2 is supported)" % magic)
-    (stype,) = struct.unpack_from("<i", mv, off)
-    off += 4
-    if stype != 0:
-        raise MXNetError("sparse NDArray load not supported yet")
-    shape, off = _read_shape(mv, off)
+def _load_legacy(mv, off, magic):
+    """V1 / V0 NDArray records (reference NDArray::LegacyLoad,
+    src/ndarray/ndarray.cc:908-937 over LegacyTShapeLoad :892).
+
+    V1 (magic 0xF993FAC8): shape is the V2 TShape (uint32 ndim + int64
+    dims). V0 has NO magic — the word already read IS ndim, followed by
+    uint32 dims. Both then carry context, type_flag, raw data like V2.
+    """
+    if magic == _V1_MAGIC:
+        shape, off = _read_shape(mv, off)
+    else:
+        ndim = magic
+        if ndim > 32:  # not a plausible legacy ndim -> corrupt/unknown
+            raise MXNetError("invalid NDArray save format: bad magic 0x%x"
+                             % magic)
+        shape = struct.unpack_from("<%dI" % ndim, mv, off) if ndim else ()
+        off += 4 * ndim
+        shape = tuple(int(d) for d in shape)
+    if not shape:
+        return array(np.zeros((0,), np.float32), ctx=cpu()), off
+    return _read_body(mv, off, shape)
+
+
+def _read_body(mv, off, shape):
+    """context | type_flag | raw data — shared by every format version."""
     dev_type, dev_id = struct.unpack_from("<ii", mv, off)
     off += 8
     (type_flag,) = struct.unpack_from("<i", mv, off)
@@ -76,6 +95,19 @@ def _load_one(mv, off):
     data = np.frombuffer(mv, dtype=dt, count=count, offset=off).reshape(shape)
     off += nbytes
     return array(data, ctx=cpu(), dtype=dt), off
+
+
+def _load_one(mv, off):
+    (magic,) = struct.unpack_from("<I", mv, off)
+    off += 4
+    if magic != _V2_MAGIC:
+        return _load_legacy(mv, off, magic)
+    (stype,) = struct.unpack_from("<i", mv, off)
+    off += 4
+    if stype != 0:
+        raise MXNetError("sparse NDArray load not supported yet")
+    shape, off = _read_shape(mv, off)
+    return _read_body(mv, off, shape)
 
 
 def save_to_bytes(data):

@@ -61,14 +61,15 @@ def _convolution(data, weight, *maybe_bias, kernel=(), stride=(), dilate=(),
     stride = _tup(stride or (1,) * nd, nd)
     dilate = _tup(dilate or (1,) * nd, nd)
     pad = _tup(pad or (0,) * nd, nd)
+    # bf16 in/out: the MXU accumulates partial products in f32 regardless
+    # and rounds once at the output, so no preferred_element_type override
+    # (which would make the conv transpose rule see an f32 cotangent
+    # against bf16 operands and fail under AD)
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, feature_group_count=int(num_group),
-        dimension_numbers=_conv_dims(kernel),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        dimension_numbers=_conv_dims(kernel))
     if not no_bias and maybe_bias:
         b = maybe_bias[0].reshape((1, -1) + (1,) * nd)
         out = out + b

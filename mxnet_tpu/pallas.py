@@ -1,0 +1,150 @@
+"""User-facing Pallas kernel registration — the TPU answer to RTC.
+
+Reference parity: ``python/mxnet/rtc.py`` + ``src/common/rtc.cc:32-80``
+let a user hand the runtime raw CUDA source (``CudaModule(source)
+.get_kernel(...).launch(...)``) and call it on NDArrays. On TPU the
+user-authored kernel is a **Pallas** function instead of CUDA source, and
+"launching" means installing it in the operator registry so it is usable
+from every frontend — ``mx.nd.<name>``, ``mx.sym.<name>``, hybridized
+Gluon blocks, Module training — exactly like a built-in op:
+
+    import mxnet_tpu as mx
+    from jax.experimental import pallas as pl
+
+    def _scale_kernel(x_ref, o_ref, *, alpha):
+        o_ref[...] = x_ref[...] * alpha
+
+    @mx.pallas.register("my_scale", grad=lambda og, ins, outs, attrs:
+                        (og[0] * float(attrs.get("alpha", 1.0)),))
+    def my_scale(x, alpha=2.0, interpret=False):
+        import functools
+        return pl.pallas_call(
+            functools.partial(_scale_kernel, alpha=float(alpha)),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interpret)(x)
+
+    y = mx.nd.my_scale(mx.nd.ones((4, 4)), alpha=3.0)   # eager
+    s = mx.sym.my_scale(mx.sym.Variable("d"), alpha=3.0)  # symbolic
+
+Kernels that accept an ``interpret`` keyword get it filled automatically:
+``False`` on TPU (compiled Mosaic), ``True`` elsewhere (the Pallas
+interpreter — the CPU-test story, mirroring how the in-tree flash
+attention kernels degrade, ``ops/pallas_kernels.py:16``).
+
+Gradients: pure-JAX ops differentiate through ``jax.vjp`` automatically;
+``pl.pallas_call`` does not, so kernels used in training either pass
+``grad=`` (a semantic backward like the reference's custom FGradient) or
+register a companion backward kernel.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+from .base import MXNetError
+from .ops.registry import OP_REGISTRY, Op
+
+__all__ = ["register", "unregister", "registered_kernels"]
+
+_USER_KERNELS = []
+
+
+def _auto_interpret():
+    """Interpret-mode default: compiled on TPU, interpreter elsewhere."""
+    return jax.default_backend() != "tpu"
+
+
+def _expose(name, op):
+    """Install the nd/sym wrappers for a freshly registered op (the
+    import-time generation in ndarray/__init__ and symbol/__init__ has
+    already run by the time a user registers a kernel)."""
+    import sys
+    from . import ndarray as nd_mod
+    from . import symbol as sym_mod
+    from .ndarray import _make_op_func
+    from .symbol import _make_sym_func
+
+    nd_fn = _make_op_func(name, op)
+    sym_fn = _make_sym_func(name, op)
+    setattr(sys.modules[nd_mod.__name__ + "._internal"], name, nd_fn)
+    setattr(sys.modules[sym_mod.__name__ + "._internal"], name, sym_fn)
+    if not name.startswith("_"):
+        setattr(nd_mod, name, nd_fn)
+        setattr(sym_mod, name, sym_fn)
+    return nd_fn
+
+
+def register(name, fn=None, *, grad=None, num_outputs=1, takes_mode=False,
+             needs_rng=False, interpret=None, force=False):
+    """Register *fn* as operator *name*, usable from nd/sym/gluon.
+
+    Parameters
+    ----------
+    fn : pure function ``(*jax_arrays, **attrs) -> array | tuple`` —
+        typically wrapping ``pl.pallas_call``. If it accepts an
+        ``interpret`` keyword, the registry fills it per-backend unless
+        the call site pins it.
+    grad : optional semantic backward
+        ``bwd(out_grads, inputs, outputs, attrs) -> input_grads`` (tuple,
+        one per input). Without it, gradients flow through ``jax.vjp`` —
+        fine for pure-JAX bodies, unavailable for raw pallas_call.
+    interpret : force interpret mode on (True) / off (False); default
+        auto-selects by backend at call time.
+    force : allow replacing an existing registration.
+
+    Returns the eager ``mx.nd.<name>`` callable (decorator-friendly).
+    """
+    if fn is None:  # decorator form
+        def deco(f):
+            return register(name, f, grad=grad, num_outputs=num_outputs,
+                            takes_mode=takes_mode, needs_rng=needs_rng,
+                            interpret=interpret, force=force)
+        return deco
+    if name in OP_REGISTRY and not force:
+        raise MXNetError(
+            "operator %r already registered (pass force=True to replace)"
+            % name)
+
+    params = inspect.signature(fn).parameters
+    accepts_interpret = "interpret" in params
+
+    if accepts_interpret:
+        def body(*arrays, **attrs):
+            if attrs.get("interpret") is None:
+                attrs["interpret"] = (_auto_interpret() if interpret is None
+                                      else interpret)
+            return fn(*arrays, **attrs)
+        body.__name__ = getattr(fn, "__name__", name)
+    else:
+        body = fn
+
+    op = Op(name, body, num_outputs=num_outputs, takes_mode=takes_mode,
+            needs_rng=needs_rng, custom_vjp=grad,
+            attr_defaults={"interpret": None} if accepts_interpret else None)
+    OP_REGISTRY[name] = op
+    if name not in _USER_KERNELS:
+        _USER_KERNELS.append(name)
+    return _expose(name, op)
+
+
+def unregister(name):
+    """Remove a user-registered kernel and its nd/sym wrappers
+    (built-ins are protected)."""
+    import sys
+    from . import ndarray as nd_mod
+    from . import symbol as sym_mod
+    if name not in _USER_KERNELS:
+        raise MXNetError("%r is not a user-registered kernel" % name)
+    _USER_KERNELS.remove(name)
+    OP_REGISTRY.pop(name, None)
+    for mod in (nd_mod, sym_mod,
+                sys.modules.get(nd_mod.__name__ + "._internal"),
+                sys.modules.get(sym_mod.__name__ + "._internal")):
+        if mod is not None and hasattr(mod, name):
+            delattr(mod, name)
+
+
+def registered_kernels():
+    """Names of live user-registered kernels."""
+    return list(_USER_KERNELS)

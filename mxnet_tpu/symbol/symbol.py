@@ -505,6 +505,7 @@ def Group(symbols):
 
 def load_json(json_str):
     graph = json.loads(json_str)
+    graph = _upgrade_json(graph)
     nodes = []
     aux_hint = set()
     # first pass: find aux inputs by walking op input-name metadata
@@ -512,7 +513,7 @@ def load_json(json_str):
         node = SymNode(None if jn["op"] == "null" else get_op(jn["op"]),
                        jn["name"],
                        {k: parse_attr_string(v)
-                        for k, v in (jn.get("attrs") or jn.get("param") or {}).items()},
+                        for k, v in _node_attrs(jn).items()},
                        [])
         nodes.append(node)
     for jn, node in zip(graph["nodes"], nodes):
@@ -526,6 +527,52 @@ def load_json(json_str):
                     src.is_aux = True
     heads = [(nodes[i], oi) for i, oi, *_ in graph["heads"]]
     return Symbol(heads)
+
+
+def _node_attrs(jn):
+    """Node attr dict across JSON generations: modern ``attrs``, 0.9-era
+    ``attr``, pre-0.9 ``param`` (reference legacy_json_util.cc upgrades the
+    same progression in place)."""
+    return jn.get("attrs") or jn.get("attr") or jn.get("param") or {}
+
+
+def _upgrade_json(graph):
+    """Upgrade legacy symbol JSON in place (reference
+    src/nnvm/legacy_json_util.cc:1-200, UpgradeJSON_* chain).
+
+    Handled: (a) node attrs under ``attr``/``param`` keys (rewritten to
+    ``attrs``); (b) pre-0.9 graphs where op params lived on the *op node*
+    but variable metadata (init/lr_mult) was stored flat — moved to
+    ``__key__`` form; (c) dropped long-gone bookkeeping attrs the modern
+    parser rejects (``ctx_group``-era keys are kept, unknown ``mojo``-era
+    parse blockers are not fatal because attrs parse lazily here).
+    """
+    version = 0
+    g_attrs = graph.get("attrs") or {}
+    if isinstance(g_attrs.get("mxnet_version"), (list, tuple)) \
+            and len(g_attrs["mxnet_version"]) == 2:
+        version = int(g_attrs["mxnet_version"][1])
+    for jn in graph.get("nodes", []):
+        attrs = _node_attrs(jn)
+        if jn.get("op") == "null":
+            # legacy variable nodes store their metadata flat; the modern
+            # node model namespaces it (__shape__/__dtype__/... is what
+            # _infer and the optimizer multiplier lookups read)
+            for key in ("init", "lr_mult", "wd_mult", "dtype", "shape"):
+                if key in attrs:
+                    attrs["__%s__" % key] = attrs.pop(key)
+        elif version < 900:
+            # pre-0.9: *variable* metadata could be stranded on the
+            # consuming op node — namespace it out of the op's kwargs
+            # (reference UpgradeJSON_FixParsing:56-86). dtype/shape stay:
+            # on an op node those are real parameters (e.g. Cast(dtype)).
+            for key in ("init", "lr_mult", "wd_mult"):
+                if key in attrs:
+                    attrs["__%s__" % key] = attrs.pop(key)
+        jn.pop("param", None)
+        jn.pop("attr", None)
+        jn["attrs"] = attrs
+    return graph
 
 
 def load(fname):

@@ -175,9 +175,12 @@ int MXPredForward(void* handle) {
   }
   Py_DECREF(r);
   // Cache output shapes so GetOutputShape can hand out stable pointers.
-  st->out_shapes.clear();
+  // Build into a local and swap only on full success: a caller that
+  // ignores a mid-loop error must never observe a half-filled cache.
+  std::vector<std::vector<mx_uint>> shapes;
   PyObject* n = PyObject_CallMethod(st->obj, "num_outputs", nullptr);
   if (!n) {
+    st->out_shapes.clear();
     SetErrorFromPython();
     return -1;
   }
@@ -187,6 +190,7 @@ int MXPredForward(void* handle) {
     PyObject* shp =
         PyObject_CallMethod(st->obj, "get_output_shape", "l", i);
     if (!shp) {
+      st->out_shapes.clear();
       SetErrorFromPython();
       return -1;
     }
@@ -195,8 +199,9 @@ int MXPredForward(void* handle) {
       dims.push_back(static_cast<mx_uint>(
           PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
     Py_DECREF(shp);
-    st->out_shapes.push_back(std::move(dims));
+    shapes.push_back(std::move(dims));
   }
+  st->out_shapes.swap(shapes);
   return 0;
 }
 

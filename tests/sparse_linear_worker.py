@@ -1,0 +1,43 @@
+"""Worker: sparse linear classification against a dist_async PS with
+row_sparse weight pulls (the load-bearing sparse workload, SURVEY §2.2;
+reference example/sparse/linear_classification.py run under the nightly
+dist doctrine).
+
+Run through the launcher:
+
+    python tools/launch.py -n 2 python tests/sparse_linear_worker.py
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "examples"))
+
+import sparse_linear_classification as slc  # noqa: E402
+
+
+class Args:
+    num_epochs = 3
+    batch_size = 64
+    kvstore = "dist_async"
+    optimizer = "sgd"
+    lr = 0.5
+    num_features = 300
+    num_obs = 512
+    data_libsvm = None
+
+
+def main():
+    first, last, acc = slc.train(Args())
+    assert last < first, "rank loss did not improve (%.4f -> %.4f)" % (
+        first, last)
+    assert acc > 0.5, "accuracy %.4f not above chance" % acc
+
+
+if __name__ == "__main__":
+    main()

@@ -72,3 +72,16 @@ def test_dist_lenet_end_to_end():
     rcs = launch(2, 1, [sys.executable, LENET_WORKER],
                  env_extra=ENV, timeout=600)
     assert rcs == [0, 0], "worker exit codes: %r" % (rcs,)
+
+
+SPARSE_WORKER = os.path.join(REPO, "tests", "sparse_linear_worker.py")
+
+
+def test_dist_async_sparse_linear_end_to_end():
+    """The load-bearing sparse workload (SURVEY §2.2): row_sparse weight
+    + dist_async PS + per-batch row_sparse_pull, trained to improving
+    loss on every worker (reference example/sparse/linear_classification
+    run under the nightly dist doctrine)."""
+    rcs = launch(2, 1, [sys.executable, SPARSE_WORKER],
+                 env_extra=ENV, timeout=600)
+    assert rcs == [0, 0], "worker exit codes: %r" % (rcs,)

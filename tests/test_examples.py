@@ -93,3 +93,13 @@ def test_super_resolution_beats_nearest(capsys):
     model = float(last.split()[1])
     base = float(last.split()[-1].rstrip(")"))
     assert model > base + 0.5
+
+
+def test_sparse_linear_classification_learns(capsys):
+    out = run_example("sparse_linear_classification.py",
+                      ["--num-epochs", "3", "--num-obs", "512",
+                       "--num-features", "300"], capsys)
+    line = [l for l in out.splitlines() if l.startswith("FINAL")][-1]
+    fields = dict(kv.split("=") for kv in line.split()[1:])
+    assert float(fields["last_nll"]) < float(fields["first_nll"])
+    assert float(fields["acc"]) > 0.5

@@ -1,0 +1,67 @@
+"""ssh launcher command construction (VERDICT r3 #6; reference
+tools/launch.py:22-30 + dmlc-core ssh tracker). No hosts are contacted —
+only the argv/env contract is checked."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import launch as launch_mod  # noqa: E402
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts.txt"
+    hf.write_text("# cluster\nnode1 2\nnode2\n\nnode3 4  # big box\n")
+    assert launch_mod.parse_hostfile(str(hf)) == [
+        ("node1", 2), ("node2", 1), ("node3", 4)]
+
+
+def test_assign_hosts_round_robin_with_slots():
+    hosts = [("a", 2), ("b", 1)]
+    assert launch_mod._assign_hosts(hosts, 5) == ["a", "a", "b", "a", "a"]
+
+
+def test_build_ssh_commands_contract():
+    plans = launch_mod.build_ssh_commands(
+        3, 2, ["python", "train.py", "--kv-store", "dist_sync"],
+        hosts=[("node1", 2), ("node2", 2)],
+        scheduler_host="head", sched_port=9000, coord_port=9001,
+        cwd="/work dir")
+    roles = [r for r, _, _ in plans]
+    assert roles == ["scheduler", "server", "server",
+                     "worker", "worker", "worker"]
+    sched = plans[0]
+    assert sched[1] == "head"
+    workers = [p for p in plans if p[0] == "worker"]
+    assert [h for _, h, _ in workers] == ["node1", "node1", "node2"]
+
+    for i, (_, host, argv) in enumerate(workers):
+        assert argv[0] == "ssh" and argv[-2] == host
+        payload = argv[-1]
+        # PS contract
+        assert "DMLC_ROLE=worker" in payload
+        assert "DMLC_PS_ROOT_URI=head" in payload
+        assert "DMLC_PS_ROOT_PORT=9000" in payload
+        assert "DMLC_NUM_WORKER=3" in payload
+        assert "DMLC_NUM_SERVER=2" in payload
+        assert "DMLC_WORKER_RANK=%d" % i in payload
+        # jax.distributed contract
+        assert "MXNET_COORDINATOR=head:9001" in payload
+        assert "MXNET_PROCESS_ID=%d" % i in payload
+        assert "MXNET_NUM_PROCESSES=3" in payload
+        # command + cwd quoting
+        assert payload.endswith("python train.py --kv-store dist_sync")
+        assert "cd '/work dir'" in payload
+
+    sched_payload = sched[2][-1]
+    assert "DMLC_ROLE=scheduler" in sched_payload
+    assert "DMLC_WORKER_RANK" not in sched_payload
+
+
+def test_main_requires_hostfile_for_ssh(tmp_path, monkeypatch):
+    monkeypatch.setattr(sys, "argv",
+                        ["launch.py", "-n", "2", "--launcher", "ssh",
+                         "python", "x.py"])
+    with pytest.raises(SystemExit):
+        launch_mod.main()

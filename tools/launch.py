@@ -1,19 +1,32 @@
 #!/usr/bin/env python
-"""Local distributed-training launcher.
+"""Distributed-training launcher: local subprocesses or ssh fan-out.
 
 Reference counterpart: ``tools/launch.py`` + the dmlc-core tracker
 (``launch.py:22-30``) — which spawned 1 scheduler, S servers and N workers
-over ssh/yarn/mpi/local.  This rebuild implements the ``local`` launcher:
-every role is a subprocess of this machine running the SAME command line,
-differentiated by the ``DMLC_ROLE`` env var; ``kv = mx.kv.create('dist_*')``
-inside the script detects the role and either runs the server loop or
-returns a worker kvstore (mxnet_tpu/kvstore.py).
+over ssh/yarn/mpi/local.  This rebuild implements:
+
+``local``  — every role is a subprocess of this machine running the SAME
+    command line, differentiated by the ``DMLC_ROLE`` env var;
+    ``kv = mx.kv.create('dist_*')`` inside the script detects the role and
+    either runs the server loop or returns a worker kvstore
+    (mxnet_tpu/kvstore.py).
+
+``ssh``    — roles fan out over the hosts in ``-H hostfile`` (one host per
+    line, optionally ``host slots``), scheduler on the launching machine.
+    Each remote command carries the full DMLC_* parameter-server contract
+    plus the MXNET_* jax.distributed contract (coordinator address =
+    launching host), so workers can run multi-host pjit over DCN and/or
+    the TCP PS. Passwordless ssh to every host is assumed, like the
+    reference's ssh tracker.
 
 Usage:
     python tools/launch.py -n 4 [-s 2] python train.py --kv-store dist_sync
+    python tools/launch.py -n 8 -H hosts.txt --launcher ssh \\
+        python train.py --kv-store dist_async
 """
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -80,17 +93,138 @@ def launch(num_workers, num_servers, cmd, env_extra=None, timeout=None):
     return rcs
 
 
+def parse_hostfile(path):
+    """Hostfile lines: ``host`` or ``host slots``; '#' comments allowed."""
+    hosts = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            hosts.append((parts[0],
+                          int(parts[1]) if len(parts) > 1 else 1))
+    if not hosts:
+        raise ValueError("hostfile %s has no hosts" % path)
+    return hosts
+
+
+def _assign_hosts(hosts, n):
+    """Round-robin *n* ranks over (host, slots) honoring slot counts."""
+    out = []
+    while len(out) < n:
+        progressed = False
+        for host, slots in hosts:
+            take = min(slots, n - len(out))
+            if take > 0:
+                out.extend([host] * take)
+                progressed = True
+            if len(out) >= n:
+                break
+        if not progressed:
+            break
+    return out[:n]
+
+
+def build_ssh_commands(num_workers, num_servers, cmd, hosts,
+                       scheduler_host=None, sched_port=None, coord_port=None,
+                       ssh_opts=("-o", "StrictHostKeyChecking=no"),
+                       cwd=None):
+    """Construct the per-role ssh argv lists (no sockets touched — unit-
+    testable; reference analogue dmlc-core tracker/dmlc_tracker/ssh.py).
+
+    Returns a list of (role, host, argv). The scheduler runs on
+    *scheduler_host* (default: the launching machine, addressed by its
+    routable hostname so remote ranks can reach it back).
+    """
+    scheduler_host = scheduler_host or socket.gethostname()
+    sched_port = sched_port or free_port()
+    coord_port = coord_port or free_port()
+    base_env = {
+        "DMLC_PS_ROOT_URI": scheduler_host,
+        "DMLC_PS_ROOT_PORT": str(sched_port),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "MXNET_COORDINATOR": "%s:%d" % (scheduler_host, coord_port),
+        "MXNET_NUM_PROCESSES": str(num_workers),
+    }
+    cwd = cwd or os.getcwd()
+
+    def remote_argv(host, role, rank=None):
+        env = dict(base_env, DMLC_ROLE=role)
+        if rank is not None:
+            env["DMLC_WORKER_RANK"] = str(rank)
+            env["MXNET_PROCESS_ID"] = str(rank)
+        exports = " ".join("%s=%s" % (k, shlex.quote(v))
+                           for k, v in sorted(env.items()))
+        payload = "cd %s && env %s %s" % (
+            shlex.quote(cwd), exports, " ".join(map(shlex.quote, cmd)))
+        return ["ssh", *ssh_opts, host, payload]
+
+    plans = [("scheduler", scheduler_host,
+              remote_argv(scheduler_host, "scheduler"))]
+    server_hosts = _assign_hosts(hosts, num_servers)
+    worker_hosts = _assign_hosts(hosts, num_workers)
+    if len(worker_hosts) < num_workers or len(server_hosts) < num_servers:
+        # under-assignment would export DMLC_NUM_WORKER=n while spawning
+        # fewer ranks — the scheduler would wait forever. Fail loudly.
+        raise ValueError(
+            "hostfile provides %d usable slots but %d workers / %d "
+            "servers requested" % (sum(s for _, s in hosts), num_workers,
+                                   num_servers))
+    for host in server_hosts:
+        plans.append(("server", host, remote_argv(host, "server")))
+    for rank, host in enumerate(worker_hosts):
+        plans.append(("worker", host, remote_argv(host, "worker", rank)))
+    return plans
+
+
+def launch_ssh(num_workers, num_servers, cmd, hostfile, timeout=None):
+    """ssh fan-out launcher: spawn every role per build_ssh_commands and
+    wait for the workers (reference launch.py ssh mode)."""
+    plans = build_ssh_commands(num_workers, num_servers, cmd,
+                               parse_hostfile(hostfile))
+    procs = [(role, host, subprocess.Popen(argv))
+             for role, host, argv in plans]
+    workers = [(h, p) for role, h, p in procs if role == "worker"]
+    others = [(h, p) for role, h, p in procs if role != "worker"]
+    rcs = []
+    try:
+        for host, w in workers:
+            rcs.append(w.wait(timeout=timeout))
+        for _, p in others:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    finally:
+        for _, _, p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rcs
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=None)
-    ap.add_argument("--launcher", default="local", choices=["local"])
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="hostfile for the ssh launcher")
+    ap.add_argument("--launcher", default=None, choices=["local", "ssh"],
+                    help="default: ssh when -H given, else local")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    launcher = args.launcher or ("ssh" if args.hostfile else "local")
     nserv = args.num_servers if args.num_servers is not None else args.num_workers
-    rcs = launch(args.num_workers, nserv, args.command)
+    if launcher == "ssh":
+        if not args.hostfile:
+            ap.error("ssh launcher needs -H hostfile")
+        rcs = launch_ssh(args.num_workers, nserv, args.command,
+                         args.hostfile)
+    else:
+        rcs = launch(args.num_workers, nserv, args.command)
     sys.exit(max(rcs) if rcs else 1)
 
 
