@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Fast-gradient-sign adversarial examples against a trained classifier.
+
+Parity target: reference ``example/adversary`` — train a small MNIST-like
+net, then perturb inputs by ``eps * sign(dL/dx)`` (FGSM, Goodfellow 2014)
+using input gradients from autograd, and show accuracy collapsing on the
+adversarial batch while staying high on the clean one.
+
+    python examples/adversary_fgsm.py --num-epochs 6 --eps 0.4
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+_MASKS = np.random.RandomState(123).rand(10, 8, 8) > 0.5
+
+
+def make_set(n, rng=None):
+    """10-class 'digit' patterns: class k lights a distinct fixed 8x8
+    mask (shared across train AND validation sets)."""
+    rng = rng or np.random.RandomState(33)
+    masks = _MASKS
+    y = rng.randint(0, 10, n)
+    x = masks[y].astype(np.float32) * 0.8
+    x += rng.normal(0, 0.15, x.shape).astype(np.float32)
+    return np.clip(x, 0, 1).reshape(n, 64), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=4)
+    ap.add_argument("--eps", type=float, default=0.4)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    train_x, train_y = make_set(2048)
+    for epoch in range(args.num_epochs):
+        for i in range(0, len(train_x), 64):
+            x = nd.array(train_x[i:i + 64])
+            y = nd.array(train_y[i:i + 64])
+            with autograd.record():
+                # per-sample loss + step(batch) = the gluon convention
+                # (Trainer.step rescales grads by 1/batch)
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            loss = nd.mean(loss)
+        logging.info("epoch %d loss %.4f", epoch, float(loss.asnumpy()))
+
+    val_x, val_y = make_set(512, rng=np.random.RandomState(91))
+    xv = nd.array(val_x)
+    yv = nd.array(val_y)
+    clean_acc = float((net(xv).asnumpy().argmax(axis=1) == val_y).mean())
+
+    # FGSM: ascend the loss wrt the INPUT (x.grad via attach_grad)
+    xv.attach_grad()
+    with autograd.record():
+        loss = nd.mean(loss_fn(net(xv), yv))
+    loss.backward()
+    x_adv = nd.clip(xv + args.eps * nd.sign(xv.grad), 0.0, 1.0)
+    adv_acc = float((net(x_adv).asnumpy().argmax(axis=1) == val_y).mean())
+    print("clean acc %.3f adversarial acc %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, args.eps))
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Toy sequence recognition with LSTM + CTC.
+
+Parity target: reference ``example/ctc`` (LSTM-OCR on captchas) reduced to
+its skeleton: a synthetic "stripe image" per digit string (each digit
+renders as a distinctive column pattern with variable width) -> LSTM over
+columns -> per-frame logits -> ``CTCLoss`` -> greedy CTC decode. The gate
+is label error rate: untrained LER ~1.0, trained well below.
+
+    python examples/ctc_ocr_toy.py --num-epochs 10
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+N_CLASS = 5          # digits 1..5 (0 = CTC blank, blank_label="first")
+T = 20               # frames (columns)
+H = 8                # column height
+MAXLEN = 4
+
+
+def render(seq, rng):
+    """Each digit d occupies 2-4 columns lighting row d (+ a faint row
+    d+2 texture); gaps of 1-2 blank columns between digits. Returns the
+    image AND the digits actually drawn (a digit that would overflow the
+    T frames is dropped from the label too)."""
+    img = np.zeros((T, H), np.float32)
+    t = rng.randint(0, 2)
+    drawn = []
+    for d in seq:
+        w = rng.randint(2, 5)
+        if t + w > T:
+            break
+        drawn.append(int(d))
+        for _ in range(w):
+            img[t, d] = 1.0
+            img[t, (d + 2) % H] = 0.4
+            t += 1
+        t += rng.randint(1, 3)
+    img += rng.randn(T, H).astype(np.float32) * 0.05
+    return img, drawn
+
+
+def make_set(n, rng=None):
+    rng = rng or np.random.RandomState(3)
+    xs = np.zeros((n, T, H), np.float32)
+    labels = np.zeros((n, MAXLEN), np.float32)   # 0-padded
+    for i in range(n):
+        k = rng.randint(1, MAXLEN + 1)
+        seq = rng.randint(1, N_CLASS + 1, size=k)
+        xs[i], drawn = render(seq, rng)
+        if not drawn:           # ensure at least one digit rendered
+            xs[i, 2:4, 1] = 1.0
+            drawn = [1]
+        labels[i, :len(drawn)] = drawn
+    return xs, labels
+
+
+def greedy_decode(logits):
+    """logits (T, N, C) -> list of label lists (collapse repeats, drop
+    blank=0)."""
+    ids = logits.argmax(axis=2).T      # (N, T)
+    out = []
+    for row in ids:
+        seq, prev = [], -1
+        for c in row:
+            if c != prev and c != 0:
+                seq.append(int(c))
+            prev = c
+        out.append(seq)
+    return out
+
+
+def ler(pred, truth):
+    """Mean normalized edit distance."""
+    def edit(a, b):
+        dp = np.arange(len(b) + 1, dtype=np.int32)
+        for i, ca in enumerate(a, 1):
+            prev, dp[0] = dp[0], i
+            for j, cb in enumerate(b, 1):
+                prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                         prev + (ca != cb))
+        return dp[-1]
+    return float(np.mean([edit(p, t) / max(len(t), 1)
+                          for p, t in zip(pred, truth)]))
+
+
+def build_symbols(hidden=32):
+    """LSTM -> per-frame logits -> CTCLoss, all symbolic (the reference
+    lstm_ocr pattern: sym unroll + WarpCTC + Module). Returns
+    (train_symbol, logits_symbol) sharing parameter names."""
+    import mxnet_tpu as mx
+    S = mx.sym
+    data = S.Variable("data")                       # (N, T, H)
+    label = S.Variable("label")                     # (N, MAXLEN)
+    cell = mx.rnn.LSTMCell(num_hidden=hidden, prefix="lstm_")
+    outputs, _ = cell.unroll(T, inputs=data, layout="NTC",
+                             merge_outputs=True)    # (N, T, hidden)
+    pred = S.Reshape(outputs, shape=(-1, hidden))
+    pred = S.FullyConnected(pred, num_hidden=N_CLASS + 1, name="proj")
+    logits = S.transpose(S.Reshape(pred, shape=(-1, T, N_CLASS + 1)),
+                         axes=(1, 0, 2))            # (T, N, C)
+    loss = S.contrib.CTCLoss(logits, label, blank_label="first",
+                             name="ctc")
+    return S.MakeLoss(S.mean(loss), name="ctc_loss"), logits
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import NDArrayIter
+
+    train_x, train_y = make_set(512)
+    it = NDArrayIter(train_x, train_y, batch_size=args.batch_size,
+                     shuffle=True, label_name="label")
+    train_sym, logits_sym = build_symbols()
+    mod = mx.mod.Module(train_sym, data_names=["data"],
+                        label_names=["label"], context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params=(("learning_rate", args.lr),))
+
+    for epoch in range(args.num_epochs):
+        it.reset()
+        tot = nb = 0
+        for batch in it:
+            mod._fit_step(batch)        # ONE compiled fwd+bwd+adam program
+            tot += float(mod.get_outputs()[0].asnumpy())
+            nb += 1
+        logging.info("epoch %d ctc loss %.4f", epoch, tot / nb)
+
+    # decode through a shared-weight logits executor
+    val_x, val_y = make_set(128, rng=np.random.RandomState(42))
+    arg_params, aux_params = mod.get_params()
+    ex = logits_sym.simple_bind(mx.cpu(), grad_req="null",
+                                data=(len(val_x), T, H))
+    ex.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+    ex.arg_dict["data"][:] = val_x
+    logits = ex.forward()[0].asnumpy()
+    pred = greedy_decode(logits)
+    truth = [[int(c) for c in row if c != 0] for row in val_y]
+    rate = ler(pred, truth)
+    print("label error rate: %.3f" % rate)
+    return rate
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Word embeddings with noise-contrastive estimation (NCE).
+
+Parity target: reference ``example/nce-loss`` (word2vec with NCE against
+a full-softmax bottleneck). Synthetic corpus: a vocabulary partitioned
+into topics; sentences draw words from one topic, so words of the same
+topic co-occur. Skip-gram pairs are trained with NCE — one logistic
+discrimination of the true context word against k noise words drawn from
+the unigram distribution — instead of a |V|-way softmax. Gate: mean
+cosine similarity within topics beats across topics.
+
+    python examples/nce_word_embeddings.py --num-epochs 5
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+VOCAB = 120
+TOPICS = 6
+TOPIC_SIZE = VOCAB // TOPICS
+DIM = 16
+
+
+def make_pairs(n_sent, sent_len, rng):
+    """Skip-gram (center, context) pairs from topic-clustered sentences."""
+    centers, contexts = [], []
+    for _ in range(n_sent):
+        topic = rng.randint(TOPICS)
+        words = topic * TOPIC_SIZE + rng.randint(TOPIC_SIZE, size=sent_len)
+        for i in range(sent_len):
+            for j in (i - 1, i + 1):
+                if 0 <= j < sent_len:
+                    centers.append(words[i])
+                    contexts.append(words[j])
+    return np.array(centers, np.float32), np.array(contexts, np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--num-negative", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(0)
+    centers, contexts = make_pairs(400, 6, rng)
+
+    emb_in = gluon.nn.Embedding(VOCAB, DIM)
+    emb_out = gluon.nn.Embedding(VOCAB, DIM)
+    emb_in.initialize(mx.init.Uniform(0.1))
+    emb_out.initialize(mx.init.Uniform(0.1))
+    params = gluon.ParameterDict()
+    params.update(emb_in.collect_params())
+    params.update(emb_out.collect_params())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": args.lr})
+
+    k = args.num_negative
+    bs = args.batch_size
+    order = np.arange(len(centers))
+    for epoch in range(args.num_epochs):
+        rng.shuffle(order)
+        tot = 0.0
+        nb = 0
+        for i in range(0, len(order) - bs + 1, bs):
+            idx = order[i:i + bs]
+            c = nd.array(centers[idx])
+            pos = nd.array(contexts[idx])
+            # noise words from the (uniform here) unigram distribution
+            neg = nd.array(rng.randint(0, VOCAB, (bs, k)).astype(
+                np.float32))
+            with autograd.record():
+                vc = emb_in(c)                       # (B, D)
+                vpos = emb_out(pos)                  # (B, D)
+                vneg = emb_out(neg)                  # (B, k, D)
+                # NCE: log sigma(vc.vpos) + sum log sigma(-vc.vneg)
+                pos_score = nd.sum(vc * vpos, axis=1)
+                neg_score = nd.sum(nd.expand_dims(vc, axis=1) * vneg,
+                                   axis=2)            # (B, k)
+                loss = -nd.mean(nd.log(nd.sigmoid(pos_score) + 1e-7)) \
+                    - nd.mean(nd.sum(nd.log(nd.sigmoid(-neg_score) + 1e-7),
+                                     axis=1))
+            loss.backward()
+            trainer.step(bs)
+            tot += float(loss.asnumpy())
+            nb += 1
+        logging.info("epoch %d nce loss %.4f", epoch, tot / nb)
+
+    # gate: within-topic cosine similarity > across-topic
+    W = emb_in.weight.data().asnumpy()
+    W = W / (np.linalg.norm(W, axis=1, keepdims=True) + 1e-8)
+    sims = W @ W.T
+    topic_of = np.arange(VOCAB) // TOPIC_SIZE
+    same = topic_of[:, None] == topic_of[None, :]
+    np.fill_diagonal(same, False)
+    within = float(sims[same].mean())
+    across = float(sims[~same & ~np.eye(VOCAB, dtype=bool)].mean())
+    print("within-topic sim %.3f across-topic sim %.3f margin %.3f"
+          % (within, across, within - across))
+    return within - across
+
+
+if __name__ == "__main__":
+    main()

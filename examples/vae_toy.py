@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Variational autoencoder on synthetic 8x8 two-mode images.
+
+Parity target: reference ``example/autoencoder`` / VAE notebooks — MLP
+encoder to (mu, log-var), reparameterized sample, MLP decoder with
+Bernoulli likelihood, trained on the ELBO. Synthetic data mixes two
+structured modes (horizontal vs vertical bars) plus noise; the gate is
+that the trained ELBO beats the untrained one by a wide margin and that
+reconstructions beat a mean-image baseline.
+
+    python examples/vae_toy.py --num-epochs 15
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+D = 64          # 8x8
+LATENT = 4
+
+
+def make_set(n, rng=None):
+    rng = rng or np.random.RandomState(13)
+    xs = np.zeros((n, D), np.float32)
+    for i in range(n):
+        img = np.zeros((8, 8), np.float32)
+        if rng.rand() < 0.5:
+            img[rng.randint(8), :] = 1.0        # horizontal bar
+            img[rng.randint(8), :] = 1.0
+        else:
+            img[:, rng.randint(8)] = 1.0        # vertical bar
+            img[:, rng.randint(8)] = 1.0
+        flip = rng.rand(8, 8) < 0.02
+        img = np.where(flip, 1.0 - img, img)
+        xs[i] = img.reshape(-1)
+    return xs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=15)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    class VAE(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.enc = gluon.nn.Dense(32, activation="relu")
+                self.mu = gluon.nn.Dense(LATENT)
+                self.logvar = gluon.nn.Dense(LATENT)
+                self.dec1 = gluon.nn.Dense(32, activation="relu")
+                self.dec2 = gluon.nn.Dense(D)
+
+        def forward(self, x):
+            h = self.enc(x)
+            mu, logvar = self.mu(h), self.logvar(h)
+            eps = nd.array(np.random.randn(*mu.shape).astype(np.float32))
+            z = mu + nd.exp(0.5 * logvar) * eps     # reparameterization
+            logits = self.dec2(self.dec1(z))
+            return logits, mu, logvar
+
+    def elbo_terms(net, x):
+        logits, mu, logvar = net(x)
+        # Bernoulli log-likelihood via numerically stable logistic CE
+        ll = -(nd.relu(logits) - logits * x
+               + nd.log(1 + nd.exp(-nd.abs(logits))))
+        recon = nd.sum(ll, axis=1)
+        kl = -0.5 * nd.sum(1 + logvar - mu * mu - nd.exp(logvar), axis=1)
+        return nd.mean(recon - kl), logits
+
+    net = VAE()
+    net.collect_params().initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    train_x = make_set(1024)
+    val_x = make_set(256, rng=np.random.RandomState(91))
+    np.random.seed(0)
+    elbo0, _ = elbo_terms(net, nd.array(val_x))
+    elbo0 = float(elbo0.asnumpy())
+
+    bs = args.batch_size
+    for epoch in range(args.num_epochs):
+        tot = 0.0
+        nb = 0
+        for i in range(0, len(train_x), bs):
+            x = nd.array(train_x[i:i + bs])
+            with autograd.record():
+                elbo, _ = elbo_terms(net, x)
+                loss = -elbo
+            loss.backward()
+            trainer.step(x.shape[0])
+            tot += float(elbo.asnumpy())
+            nb += 1
+        logging.info("epoch %d elbo %.2f", epoch, tot / nb)
+
+    elbo1, logits = elbo_terms(net, nd.array(val_x))
+    elbo1 = float(elbo1.asnumpy())
+    recon = 1 / (1 + np.exp(-logits.asnumpy()))
+    err = float(np.mean((recon - val_x) ** 2))
+    base = float(np.mean((train_x.mean(axis=0)[None] - val_x) ** 2))
+    print("elbo untrained %.2f trained %.2f recon mse %.4f baseline %.4f"
+          % (elbo0, elbo1, err, base))
+    return elbo1 - elbo0, base - err
+
+
+if __name__ == "__main__":
+    main()

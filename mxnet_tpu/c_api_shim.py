@@ -1,0 +1,89 @@
+"""Python side of the general C API (native/c_api.cc).
+
+The C library embeds CPython (same mechanism as the predict ABI,
+``native/predict_api.cc``) and calls these helpers; every NDArrayHandle
+the C side holds is a strong reference to an :class:`NDArray`. Keeping
+the logic here keeps the C layer to reference-counting and buffer copies.
+
+Reference analogue: the glue inside ``src/c_api/c_api.cc`` behind
+MXNDArrayCreateEx / MXNDArraySyncCopy{From,To}CPU / MXImperativeInvoke /
+MXListAllOpNames / MXNDArraySave / MXNDArrayLoad.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import CODE_TO_DTYPE, DTYPE_TO_CODE, MXNetError
+from .context import Context
+from .ndarray import NDArray, invoke, load, save, zeros
+from .ops.registry import get_op, list_ops, parse_attr_string
+
+__all__ = ["create", "dtype_code", "itemsize", "shape_of",
+           "copy_from_bytes", "to_bytes", "imperative_invoke",
+           "all_op_names", "save_list", "load_file"]
+
+_DEV = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 6: "tpu"}
+
+
+def create(shape, dev_type, dev_id, dtype_code_):
+    ctx = Context(_DEV.get(int(dev_type), "cpu"), int(dev_id))
+    dtype = np.dtype(CODE_TO_DTYPE[int(dtype_code_)])
+    return zeros(tuple(int(s) for s in shape), ctx=ctx, dtype=dtype)
+
+
+def dtype_code(arr):
+    return int(DTYPE_TO_CODE[np.dtype(arr.dtype)])
+
+
+def itemsize(arr):
+    return int(np.dtype(arr.dtype).itemsize)
+
+
+def shape_of(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def copy_from_bytes(arr, raw):
+    data = np.frombuffer(raw, dtype=arr.dtype)
+    if data.size != int(np.prod(arr.shape)):
+        raise MXNetError(
+            "SyncCopyFromCPU: buffer has %d elements, array needs %d"
+            % (data.size, int(np.prod(arr.shape))))
+    arr[:] = data.reshape(arr.shape)
+    return arr
+
+
+def to_bytes(arr):
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def imperative_invoke(op_name, inputs, keys, vals):
+    """Run a registered operator on NDArray handles (MXImperativeInvoke).
+
+    String attr values arrive stringified exactly like symbol-JSON attrs
+    and parse through the same rules.
+    """
+    op = get_op(op_name)
+    attrs = {k: parse_attr_string(v) for k, v in zip(keys, vals)}
+    out = invoke(op, list(inputs), attrs)
+    return list(out)
+
+
+def all_op_names():
+    return list_ops()
+
+
+def save_list(fname, arrays, keys):
+    if keys:
+        save(fname, dict(zip(keys, arrays)))
+    else:
+        save(fname, list(arrays))
+
+
+def load_file(fname):
+    """Returns (arrays, names) — names empty for list-style files."""
+    loaded = load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[n] for n in names], names
+    return list(loaded), []

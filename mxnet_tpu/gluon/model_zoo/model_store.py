@@ -14,17 +14,31 @@ __all__ = ["get_model_file", "purge"]
 
 
 def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
-    """Return the path of a locally cached pretrained model file."""
-    file_name = "{name}".format(name=name)
-    root = os.path.expanduser(root)
-    file_path = os.path.join(root, file_name + ".params")
-    if os.path.exists(file_path):
-        return file_path
+    """Return the path of a locally cached pretrained model file.
+
+    Search order: *root* (the reference's ``~/.mxnet/models`` cache),
+    then ``MXNET_GLUON_REPO`` interpreted as a local directory (the
+    reference uses that env var as its download base URL; a zero-egress
+    build treats it as a published-weights directory), then the
+    in-repo ``zoo/`` directory of shipped artifacts.
+    """
+    file_name = "{name}.params".format(name=name)
+    repo_zoo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "..", "..", "zoo")
+    candidates = [os.path.expanduser(root)]
+    env_repo = os.environ.get("MXNET_GLUON_REPO")
+    if env_repo and os.path.isdir(os.path.expanduser(env_repo)):
+        candidates.append(os.path.expanduser(env_repo))
+    candidates.append(os.path.normpath(repo_zoo))
+    for cand in candidates:
+        file_path = os.path.join(cand, file_name)
+        if os.path.exists(file_path):
+            return file_path
     raise FileNotFoundError(
-        "Pretrained model file %s is not found in %s and this build has "
-        "no network egress. Copy the .params file into the cache "
-        "directory (MXNet model zoo format) to use pretrained=True."
-        % (file_name + ".params", root))
+        "Pretrained model file %s is not found in any of %s and this "
+        "build has no network egress. Copy the .params file into the "
+        "cache directory (MXNet model zoo format) to use "
+        "pretrained=True." % (file_name, candidates))
 
 
 def purge(root=os.path.join("~", ".mxnet", "models")):

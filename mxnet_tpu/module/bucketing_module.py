@@ -179,8 +179,13 @@ class BucketingModule(BaseModule):
                         shared=self._leader)
         self._active_key = bucket_key
         if self.params_initialized and self._active is not self._leader:
-            # alias the leader's canonical dicts and refresh device copies
+            # alias the leader's canonical dicts and refresh device copies;
+            # the leader's host dicts may be stale after its own fused
+            # device-side steps — sync them down first or the new bucket
+            # resumes from pre-update weights
             leader = self._leader
+            if leader._params_dirty:
+                leader._sync_params_from_devices()
             mod = self._active
             mod._arg_params, mod._aux_params = (leader._arg_params,
                                                 leader._aux_params)
@@ -222,14 +227,36 @@ class BucketingModule(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         self._require_ready()
+        self._switch_for_batch(data_batch)
+        self._active.forward(data_batch, is_train=is_train)
+
+    def _switch_for_batch(self, data_batch):
+        """Activate the batch's bucket (binding + optimizer-lending on
+        first use happen inside switch_bucket)."""
         key = getattr(data_batch, "bucket_key", None)
         if key is not None and key != self._active_key:
             self.switch_bucket(key, data_batch.provide_data,
                                data_batch.provide_label)
-            if self.optimizer_initialized and \
-                    not self._active.optimizer_initialized:
-                self._lend_optimizer(self._active)
-        self._active.forward(data_batch, is_train=is_train)
+
+    def _sync_active_to_leader(self):
+        """Keep the leader authoritative for later bucket switches."""
+        if self._active_key == self._default_bucket_key:
+            return
+        arg, aux = self._active.get_params()
+        leader = self._leader
+        leader._arg_params, leader._aux_params = arg, aux
+        leader._exec_group.set_params(arg, aux)
+        leader._params_dirty = False
+
+    def _fit_step(self, data_batch):
+        """Per-bucket fused step: switch to the batch's bucket, then one
+        donated fwd+bwd+update program on that bucket's module (each
+        bucket keeps its own compiled step)."""
+        self._require_ready()
+        self._switch_for_batch(data_batch)
+        self._params_dirty = True
+        self._active._fit_step(data_batch)
+        self._sync_active_to_leader()
 
     def backward(self, out_grads=None):
         self._require_ready()
@@ -241,12 +268,7 @@ class BucketingModule(BaseModule):
             raise AssertionError("init_optimizer must run before update")
         self._params_dirty = True
         self._active.update()
-        if self._active_key != self._default_bucket_key:
-            # keep the leader authoritative for later bucket switches
-            arg, aux = self._active.get_params()
-            leader = self._leader
-            leader._arg_params, leader._aux_params = arg, aux
-            leader._exec_group.set_params(arg, aux)
+        self._sync_active_to_leader()
 
     def get_outputs(self, merge_multi_context=True):
         self._require_ready()

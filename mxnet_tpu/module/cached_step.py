@@ -104,6 +104,17 @@ class CachedTrainStep:
 
     def run(self, feed):
         """Execute one step; *feed* maps data/label names to NDArrays."""
+        from .. import profiler as _prof
+        if not _prof.is_running():
+            return self._run(feed)
+        t0 = _prof._now_us()
+        try:
+            return self._run(feed)
+        finally:
+            _prof.record_program("module_train_step", t0,
+                                 _prof._now_us() - t0)
+
+    def _run(self, feed):
         ex = self._exec
         for k, v in feed.items():
             if k in ex.arg_dict:

@@ -8,6 +8,7 @@ and the kvstore helpers in ``model.py``.
 from __future__ import annotations
 
 import logging
+import os
 import warnings
 
 from .. import context as ctx_mod
@@ -153,6 +154,18 @@ class Module(BaseModule):
         if not self.binded:
             raise AssertionError("module is not bound")
 
+    def _shape_key(self):
+        """Cache key for the exec-group-per-shape-signature cache."""
+        req = getattr(self, "_grad_req", "write")
+        if isinstance(req, dict):
+            req = tuple(sorted(req.items()))
+        elif isinstance(req, (list, tuple)):
+            req = tuple(req)
+        return (tuple((d.name, tuple(d.shape)) for d in self._data_shapes),
+                tuple((d.name, tuple(d.shape))
+                      for d in (self._label_shapes or ())),
+                self.for_training, self.inputs_need_grad, req)
+
     # ---- parameters ----
 
     def get_params(self):
@@ -241,17 +254,21 @@ class Module(BaseModule):
         if force_rebind:
             self.binded, self._exec_group = False, None
             self._data_shapes = self._label_shapes = None
+            self.__dict__.pop("_reshape_cache", None)
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
         self._data_shapes = _as_descs(data_shapes)
         self._label_shapes = _as_descs(label_shapes)
         self._exec_group = self._make_exec_group(for_training,
                                                  inputs_need_grad, grad_req)
         self.binded = True
+        self.__dict__.setdefault("_reshape_cache", {})[
+            self._shape_key()] = self._exec_group
 
         if shared_module is not None:
             # Alias (not copy) the donor module's host params, per reference.
@@ -280,12 +297,33 @@ class Module(BaseModule):
             grad_req=grad_req, state_names=self._state_names)
 
     def reshape(self, data_shapes, label_shapes=None):
-        """Rebind executors for new input shapes, keeping parameters."""
+        """Rebind executors for new input shapes, keeping parameters.
+
+        Exec groups are cached per shape signature (the reference reuses
+        the shared memory pool, executor.py reshape; under XLA the costly
+        resource is the compiled program, so what we keep is the bound
+        group with its jit caches). Alternating shapes — bucketing, the
+        last partial batch of every epoch — rebind at zero cost after
+        the first visit."""
         self._require_bound()
         self._data_shapes = _as_descs(data_shapes)
         self._label_shapes = _as_descs(label_shapes)
-        self._exec_group = self._make_exec_group(self.for_training,
-                                                 self.inputs_need_grad)
+        cache = self.__dict__.setdefault("_reshape_cache", {})
+        key = self._shape_key()
+        group = cache.pop(key, None)   # pop+reinsert = LRU ordering
+        if group is None:
+            group = self._make_exec_group(
+                self.for_training, self.inputs_need_grad,
+                grad_req=getattr(self, "_grad_req", "write"))
+            # bound the cache: each entry pins compiled programs AND a
+            # device-resident parameter copy — many distinct shapes
+            # (e.g. free-form inference batches) must not accumulate
+            limit = int(os.environ.get("MXNET_MODULE_RESHAPE_CACHE", "8"))
+            while len(cache) >= max(limit, 1):
+                evicted_key = next(iter(cache))
+                cache.pop(evicted_key)
+        cache[key] = group
+        self._exec_group = group
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
@@ -425,15 +463,19 @@ class Module(BaseModule):
             return None
         if any(r not in ("write", "null") for r in ex.grad_req.values()):
             return None
-        cached = getattr(self, "_cached_step", None)
+        # cache on the exec group so alternating reshape() shapes (their
+        # groups are themselves cached) keep their compiled step programs
+        cached = getattr(group, "_cached_train_step", None)
         if cached is not None and cached._exec is ex \
                 and cached._updater is self._updater:
+            self._cached_step = cached
             return cached
         try:
             cached = CachedTrainStep(ex, self._updater, group.param_names)
         except ValueError:
             cached = None
             self._cached_step_unusable = True
+        group._cached_train_step = cached
         self._cached_step = cached
         return cached
 

@@ -53,6 +53,8 @@ def attr_to_string(v):
     if isinstance(v, (bool, int, float, type(None))):
         return str(v)
     if isinstance(v, (tuple, list)):
+        if len(v) == 1:  # "(64,)" — "(64)" would parse back as an int
+            return "(%s,)" % v[0]
         return "(" + ", ".join(str(x) for x in v) + ")"
     if isinstance(v, np.dtype):
         return v.name

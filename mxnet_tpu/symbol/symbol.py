@@ -285,6 +285,10 @@ class Symbol:
             shape = shape_kwargs.get(node.name)
             if shape is None and "__shape__" in node.attrs:
                 shape = node.attrs["__shape__"]
+            if isinstance(shape, (int, np.integer)):
+                # files written before the 1-tuple stringify fix stored
+                # "(64)" which parses back as a bare int
+                shape = (int(shape),)
             dtype = dtype_kwargs.get(node.name)
             if dtype is None:
                 dtype = node.attrs.get("__dtype__", np.float32)

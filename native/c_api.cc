@@ -1,0 +1,319 @@
+// General C API over the embedded interpreter: NDArray CRUD +
+// MXImperativeInvoke (any registered op callable from plain C) +
+// save/load. See include/mxnet_tpu_c.h for the ABI contract and
+// mxnet_tpu/c_api_shim.py for the Python half.
+//
+// Reference analogue: src/c_api/c_api.cc over include/mxnet/c_api.h —
+// here each NDArrayHandle is a strong PyObject* reference to an
+// mxnet_tpu NDArray, wrapped so shape queries hand out stable pointers.
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "embedded_python.h"
+#include "mxnet_tpu_c.h"
+
+using mxtpu::EnsurePython;
+using mxtpu::Gil;
+using mxtpu::SetError;
+using mxtpu::SetErrorFromPython;
+
+namespace {
+
+struct Handle {
+  PyObject* obj = nullptr;          // mxnet_tpu NDArray
+  std::vector<mx_uint> shape;       // cached for MXNDArrayGetShape
+};
+
+PyObject* Shim() {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.c_api_shim");
+  if (!mod) SetErrorFromPython();
+  return mod;
+}
+
+// Call shim.<fn>(...) returning a new reference (nullptr on error,
+// error slot already set).
+PyObject* CallShim(const char* fn, const char* fmt, ...) {
+  PyObject* mod = Shim();
+  if (!mod) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    SetErrorFromPython();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (!args) {
+    Py_DECREF(f);
+    SetErrorFromPython();
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  if (!r) SetErrorFromPython();
+  return r;
+}
+
+Handle* Wrap(PyObject* nd) {
+  Handle* h = new Handle();
+  h->obj = nd;  // takes the reference
+  return h;
+}
+
+bool FillShape(Handle* h) {
+  PyObject* shp = PyObject_GetAttrString(h->obj, "shape");
+  if (!shp) {
+    SetErrorFromPython();
+    return false;
+  }
+  h->shape.clear();
+  Py_ssize_t n = PyTuple_Size(shp);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i))));
+  Py_DECREF(shp);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return mxtpu::last_error().c_str(); }
+
+int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
+                      int dev_id, int /*delay_alloc*/, int dtype,
+                      NDArrayHandle* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* nd = CallShim("create", "(Oiii)", shp, dev_type, dev_id,
+                          dtype);
+  Py_DECREF(shp);
+  if (!nd) return -1;
+  *out = Wrap(nd);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (!handle) return 0;
+  Handle* h = static_cast<Handle*>(handle);
+  {
+    Gil gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata) {
+  Handle* h = static_cast<Handle*>(handle);
+  Gil gil;
+  if (!FillShape(h)) return -1;
+  *out_dim = static_cast<mx_uint>(h->shape.size());
+  *out_pdata = h->shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  Handle* h = static_cast<Handle*>(handle);
+  Gil gil;
+  PyObject* code = CallShim("dtype_code", "(O)", h->obj);
+  if (!code) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(code));
+  Py_DECREF(code);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size) {
+  // size is an element count (reference contract); bytes follow from
+  // the array's dtype itemsize.
+  Handle* h = static_cast<Handle*>(handle);
+  Gil gil;
+  PyObject* item_o = CallShim("itemsize", "(O)", h->obj);
+  if (!item_o) return -1;
+  long item = PyLong_AsLong(item_o);
+  Py_DECREF(item_o);
+  PyObject* raw = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * item);
+  PyObject* r = CallShim("copy_from_bytes", "(OO)", h->obj, raw);
+  Py_DECREF(raw);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
+  // size is an element count (reference contract) and must equal the
+  // array's element count; the full buffer is copied out.
+  Handle* h = static_cast<Handle*>(handle);
+  Gil gil;
+  PyObject* raw = CallShim("to_bytes", "(O)", h->obj);
+  if (!raw) return -1;
+  char* buf = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(raw, &buf, &nbytes) != 0) {
+    Py_DECREF(raw);
+    SetErrorFromPython();
+    return -1;
+  }
+  if (!FillShape(h)) {
+    Py_DECREF(raw);
+    return -1;
+  }
+  size_t count = 1;
+  for (mx_uint d : h->shape) count *= d;
+  if (size != count) {
+    SetError("SyncCopyToCPU: buffer holds " + std::to_string(size) +
+             " elements, array has " + std::to_string(count));
+    Py_DECREF(raw);
+    return -1;
+  }
+  std::memcpy(data, buf, static_cast<size_t>(nbytes));
+  Py_DECREF(raw);
+  return 0;
+}
+
+int MXNDArrayWaitAll(void) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.ndarray");
+  if (!mod) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "waitall", nullptr);
+  Py_DECREF(mod);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* o = static_cast<Handle*>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(ins, i, o);
+  }
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* res = CallShim("imperative_invoke", "(sOOO)", op_name, ins,
+                           keys, vals);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (!res) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  NDArrayHandle* arr = static_cast<NDArrayHandle*>(
+      std::malloc(sizeof(NDArrayHandle) * n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    arr[i] = Wrap(o);
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  *outputs = arr;
+  return 0;
+}
+
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  static std::vector<std::string> names;
+  static std::vector<const char*> ptrs;
+  PyObject* res = CallShim("all_op_names", "()");
+  if (!res) return -1;
+  names.clear();
+  ptrs.clear();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  for (auto& s : names) ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+int MXNDArraySave(const char* fname, mx_uint num_args,
+                  NDArrayHandle* args, const char** keys) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* arrays = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject* o = static_cast<Handle*>(args[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(arrays, i, o);
+  }
+  PyObject* names = PyList_New(keys ? num_args : 0);
+  if (keys)
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+  PyObject* r = CallShim("save_list", "(sOO)", fname, arrays, names);
+  Py_DECREF(arrays);
+  Py_DECREF(names);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  static std::vector<std::string> names;
+  static std::vector<const char*> name_ptrs;
+  PyObject* res = CallShim("load_file", "(s)", fname);
+  if (!res) return -1;
+  PyObject* arrays = PyTuple_GetItem(res, 0);
+  PyObject* keys = PyTuple_GetItem(res, 1);
+  Py_ssize_t n = PyList_Size(arrays);
+  NDArrayHandle* arr = static_cast<NDArrayHandle*>(
+      std::malloc(sizeof(NDArrayHandle) * n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(arrays, i);
+    Py_INCREF(o);
+    arr[i] = Wrap(o);
+  }
+  names.clear();
+  name_ptrs.clear();
+  Py_ssize_t nk = PyList_Size(keys);
+  for (Py_ssize_t i = 0; i < nk; ++i)
+    names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(keys, i)));
+  for (auto& s : names) name_ptrs.push_back(s.c_str());
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = arr;
+  *out_name_size = static_cast<mx_uint>(name_ptrs.size());
+  *out_names = name_ptrs.data();
+  return 0;
+}
+
+}  // extern "C"

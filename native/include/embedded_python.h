@@ -1,0 +1,79 @@
+// Shared embedded-CPython plumbing for the C ABI libraries
+// (predict_api.cc, c_api.cc): one-shot interpreter init, GIL guard,
+// thread-local error slot. Mirrors the reference's c_api error contract
+// (MXGetLastError returns the last failure on this thread).
+#ifndef MXNET_TPU_EMBEDDED_PYTHON_H_
+#define MXNET_TPU_EMBEDDED_PYTHON_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu {
+
+inline std::string& last_error() {
+  thread_local std::string err;
+  return err;
+}
+
+inline void SetError(const std::string& msg) { last_error() = msg; }
+
+// Record the pending Python exception into the error slot.
+inline void SetErrorFromPython() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  SetError(msg);
+}
+
+// Ensure an interpreter exists. When loaded into a host C program,
+// initialize exactly once; when loaded into a Python process, reuse the
+// existing interpreter via GILState.
+inline bool EnsurePython() {
+  static std::once_flag once;
+  static bool ok = true;
+  std::call_once(once, []() {
+    if (Py_IsInitialized()) return;
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) {
+      ok = false;
+      return;
+    }
+    // Pin CPU explicitly when requested (axon plugin races otherwise).
+    PyRun_SimpleString(
+        "import os\n"
+        "if os.environ.get('JAX_PLATFORMS', '').startswith('cpu'):\n"
+        "    import jax\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n");
+    // Release the GIL acquired by Py_Initialize so later
+    // PyGILState_Ensure calls work uniformly from any thread.
+    PyEval_SaveThread();
+  });
+  if (!ok) SetError("failed to initialize embedded Python");
+  return ok && Py_IsInitialized();
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_EMBEDDED_PYTHON_H_

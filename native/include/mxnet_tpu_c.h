@@ -1,0 +1,165 @@
+/* General C API: NDArray CRUD + imperative op invocation + save/load.
+ *
+ * Reference analogue: the core of include/mxnet/c_api.h —
+ * MXNDArrayCreateEx, MXNDArrayFree, MXNDArrayGetShape, MXNDArrayGetDType,
+ * MXNDArraySyncCopyFromCPU/ToCPU, MXNDArrayWaitAll, MXImperativeInvoke,
+ * MXListAllOpNames, MXNDArraySave/Load — enough for a C host to drive
+ * the full eager operator corpus without linking Python.
+ *
+ * Conventions (reference-compatible):
+ *  - every function returns 0 on success, -1 on error;
+ *    MXGetLastError() describes the last failure on this thread.
+ *  - NDArrayHandle owns a reference; release with MXNDArrayFree.
+ *  - MXImperativeInvoke allocates *outputs with malloc when
+ *    *num_outputs == 0 on entry; the caller frees each handle with
+ *    MXNDArrayFree and the array itself with free().
+ *  - dtype codes: 0=float32 1=float64 2=float16 3=uint8 4=int32
+ *    5=int8 6=int64 (reference mshadow type flags).
+ *  - dev_type: 1=cpu 2=gpu 3=cpu_pinned 6=tpu.
+ *
+ * Build: native/Makefile target libmxnet_c.so (embeds CPython).
+ */
+#ifndef MXNET_TPU_C_H_
+#define MXNET_TPU_C_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void* NDArrayHandle;
+
+const char* MXGetLastError(void);
+
+int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size);
+int MXNDArrayWaitAll(void);
+
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals);
+
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array);
+
+int MXNDArraySave(const char* fname, mx_uint num_args,
+                  NDArrayHandle* args, const char** keys);
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names);
+
+#ifdef __cplusplus
+}
+
+/* Header-only C++ RAII layer (cpp-package style, matching the Predictor
+ * wrapper in mxnet_tpu_predict.h): NDArray value type + Invoke(). */
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace mxnet_tpu {
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  NDArray(const std::vector<mx_uint>& shape, int dev_type = 1,
+          int dev_id = 0, int dtype = 0) {
+    if (MXNDArrayCreateEx(shape.data(),
+                          static_cast<mx_uint>(shape.size()), dev_type,
+                          dev_id, 0, dtype, &handle_) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  explicit NDArray(NDArrayHandle owned) : handle_(owned) {}
+
+  ~NDArray() {
+    if (handle_) MXNDArrayFree(handle_);
+  }
+
+  NDArray(NDArray&& other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  NDArray& operator=(NDArray&& other) noexcept {
+    if (this != &other) {
+      if (handle_) MXNDArrayFree(handle_);
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+
+  NDArrayHandle handle() const { return handle_; }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint* dims = nullptr;
+    if (MXNDArrayGetShape(handle_, &ndim, &dims) != 0)
+      throw std::runtime_error(MXGetLastError());
+    return std::vector<mx_uint>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+
+  void CopyFrom(const std::vector<float>& data) {
+    if (MXNDArraySyncCopyFromCPU(handle_, data.data(), data.size()) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  std::vector<float> CopyTo() const {
+    std::vector<float> out(Size());
+    if (MXNDArraySyncCopyToCPU(handle_, out.data(), out.size()) != 0)
+      throw std::runtime_error(MXGetLastError());
+    return out;
+  }
+
+ private:
+  NDArrayHandle handle_ = nullptr;
+};
+
+/* Run any registered operator by name (MXImperativeInvoke). */
+inline std::vector<NDArray> Invoke(
+    const std::string& op_name, const std::vector<const NDArray*>& inputs,
+    const std::vector<std::pair<std::string, std::string>>& attrs = {}) {
+  std::vector<NDArrayHandle> in;
+  for (const NDArray* a : inputs) in.push_back(a->handle());
+  std::vector<const char*> keys, vals;
+  for (const auto& kv : attrs) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = 0;
+  NDArrayHandle* outs = nullptr;
+  if (MXImperativeInvoke(op_name.c_str(), static_cast<int>(in.size()),
+                         in.data(), &n_out, &outs,
+                         static_cast<int>(keys.size()), keys.data(),
+                         vals.data()) != 0)
+    throw std::runtime_error(MXGetLastError());
+  std::vector<NDArray> result;
+  for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+  std::free(outs);
+  return result;
+}
+
+}  // namespace mxnet_tpu
+#endif  /* __cplusplus */
+
+#endif /* MXNET_TPU_C_H_ */

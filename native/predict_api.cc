@@ -17,87 +17,32 @@
 
 #include <cstdint>
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
 
 // Public ABI declarations — keeps implementation and header signatures
-// in lockstep at compile time.
+// in lockstep at compile time. The embedded-interpreter plumbing
+// (EnsurePython / Gil / error slot) is shared with c_api.cc.
+#include "embedded_python.h"
 #include "mxnet_tpu_predict.h"
 
+using mxtpu::EnsurePython;
+using mxtpu::Gil;
+using mxtpu::SetError;
+using mxtpu::SetErrorFromPython;
+
 namespace {
-
-thread_local std::string g_last_error;
-
-void SetError(const std::string& msg) { g_last_error = msg; }
-
-// Record the pending Python exception into the error slot.
-void SetErrorFromPython() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value) {
-    PyObject* s = PyObject_Str(value);
-    if (s) {
-      const char* c = PyUnicode_AsUTF8(s);
-      if (c) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  SetError(msg);
-}
 
 struct PredictorState {
   PyObject* obj = nullptr;                       // _EmbeddedPredictor
   std::vector<std::vector<mx_uint>> out_shapes;  // cached per forward
 };
 
-// Ensure an interpreter exists.  When this library is loaded into a
-// host C program, initialize one exactly once (concurrent MXPredCreate
-// calls are expected from multithreaded hosts); when loaded into a
-// Python process, just use the existing interpreter via GILState.
-std::once_flag g_py_init_once;
-
-bool EnsurePython() {
-  bool ok = true;
-  std::call_once(g_py_init_once, [&ok]() {
-    if (Py_IsInitialized()) return;
-    Py_InitializeEx(0);
-    if (!Py_IsInitialized()) {
-      ok = false;
-      return;
-    }
-    // Pin CPU explicitly when requested (axon plugin races otherwise).
-    PyRun_SimpleString(
-        "import os\n"
-        "if os.environ.get('JAX_PLATFORMS', '').startswith('cpu'):\n"
-        "    import jax\n"
-        "    jax.config.update('jax_platforms', 'cpu')\n");
-    // Release the GIL acquired by Py_Initialize so later
-    // PyGILState_Ensure calls work uniformly from any thread.
-    PyEval_SaveThread();
-  });
-  if (!ok) SetError("failed to initialize embedded Python");
-  return ok && Py_IsInitialized();
-}
-
-class Gil {
- public:
-  Gil() : state_(PyGILState_Ensure()) {}
-  ~Gil() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
 }  // namespace
 
 extern "C" {
 
-const char* MXGetLastError() { return g_last_error.c_str(); }
+const char* MXGetLastError() { return mxtpu::last_error().c_str(); }
 
 int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
                  int param_size, int dev_type, int dev_id,

@@ -43,6 +43,7 @@ def pytest_configure(config):
                            "libimageloader.so"),
               os.path.join(repo, "mxnet_tpu", "_native", "libengine.so"),
               os.path.join(repo, "mxnet_tpu", "_native", "libmxpredict.so"),
+              os.path.join(repo, "mxnet_tpu", "_native", "libmxnet_c.so"),
               os.path.join(repo, "native", "bin", "im2rec")]
     if not all(os.path.exists(p) for p in wanted):
         try:

@@ -1,0 +1,199 @@
+"""General C API end-to-end: a plain C program drives NDArray creation,
+imperative op invocation, and save/load through libmxnet_c.so.
+
+Reference analogue: include/mxnet/c_api.h core (MXNDArrayCreateEx /
+SyncCopy / MXImperativeInvoke / MXListAllOpNames / MXNDArraySave/Load)
+exercised by a host binary that links no Python (SURVEY §2.1 C API row).
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.path.join(REPO, "mxnet_tpu", "_native", "libmxnet_c.so")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(SO),
+                                reason="libmxnet_c.so not built")
+
+DRIVER_C = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "mxnet_tpu_c.h"
+
+#define CHECK(x) do { if ((x) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError()); return 1; } \
+} while (0)
+
+int main(int argc, char** argv) {
+  /* 2x3 ones + 2x3 twos -> broadcast_add -> sum = 18 */
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &a));
+  CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &b));
+  float ones[6] = {1, 1, 1, 1, 1, 1};
+  float twos[6] = {2, 2, 2, 2, 2, 2};
+  CHECK(MXNDArraySyncCopyFromCPU(a, ones, 6));
+  CHECK(MXNDArraySyncCopyFromCPU(b, twos, 6));
+
+  NDArrayHandle ins[2];
+  ins[0] = a; ins[1] = b;
+  int n_out = 0;
+  NDArrayHandle* outs = NULL;
+  CHECK(MXImperativeInvoke("broadcast_add", 2, ins, &n_out, &outs,
+                           0, NULL, NULL));
+  if (n_out != 1) { fprintf(stderr, "n_out=%d\n", n_out); return 1; }
+
+  mx_uint ndim = 0;
+  const mx_uint* dims = NULL;
+  CHECK(MXNDArrayGetShape(outs[0], &ndim, &dims));
+  if (ndim != 2 || dims[0] != 2 || dims[1] != 3) return 1;
+  int dtype = -1;
+  CHECK(MXNDArrayGetDType(outs[0], &dtype));
+  if (dtype != 0) return 1;
+
+  float result[6];
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], result, 6));
+  float total = 0;
+  for (int i = 0; i < 6; ++i) total += result[i];
+  if (total != 18.0f) { fprintf(stderr, "sum=%f\n", total); return 1; }
+
+  /* attrs travel stringified: transpose with axes */
+  const char* keys[1] = {"axes"};
+  const char* vals[1] = {"(1, 0)"};
+  int n_t = 0;
+  NDArrayHandle* touts = NULL;
+  CHECK(MXImperativeInvoke("transpose", 1, &outs[0], &n_t, &touts,
+                           1, keys, vals));
+  CHECK(MXNDArrayGetShape(touts[0], &ndim, &dims));
+  if (ndim != 2 || dims[0] != 3 || dims[1] != 2) return 1;
+
+  /* op registry is reachable */
+  mx_uint n_ops = 0;
+  const char** op_names = NULL;
+  CHECK(MXListAllOpNames(&n_ops, &op_names));
+  if (n_ops < 300) { fprintf(stderr, "n_ops=%u\n", n_ops); return 1; }
+
+  /* save -> load roundtrip with names */
+  const char* save_keys[1] = {"x"};
+  CHECK(MXNDArraySave(argv[1], 1, &outs[0], save_keys));
+  mx_uint n_loaded = 0, n_names = 0;
+  NDArrayHandle* loaded = NULL;
+  const char** names = NULL;
+  CHECK(MXNDArrayLoad(argv[1], &n_loaded, &loaded, &n_names, &names));
+  if (n_loaded != 1 || n_names != 1 || strcmp(names[0], "x") != 0)
+    return 1;
+  float back[6];
+  CHECK(MXNDArraySyncCopyToCPU(loaded[0], back, 6));
+  for (int i = 0; i < 6; ++i)
+    if (back[i] != 3.0f) return 1;
+
+  CHECK(MXNDArrayWaitAll());
+  MXNDArrayFree(a);
+  MXNDArrayFree(b);
+  MXNDArrayFree(outs[0]);
+  free(outs);
+  MXNDArrayFree(touts[0]);
+  free(touts);
+  MXNDArrayFree(loaded[0]);
+  free(loaded);
+  printf("C-API-OK\n");
+  return 0;
+}
+"""
+
+
+def test_c_driver_end_to_end(tmp_path):
+    driver = tmp_path / "driver.c"
+    driver.write_text(DRIVER_C)
+    exe = tmp_path / "driver"
+    subprocess.run(
+        ["gcc", str(driver), "-I", os.path.join(REPO, "native", "include"),
+         "-o", str(exe), str(SO), "-Wl,-rpath," + os.path.dirname(SO)],
+        check=True, capture_output=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    out = subprocess.run([str(exe), str(tmp_path / "arrs.params")],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert out.returncode == 0, out.stderr
+    assert "C-API-OK" in out.stdout
+
+
+def test_ctypes_in_process_invoke():
+    """The same ABI loaded into a live Python process must reuse the
+    existing interpreter (GILState path) instead of re-initializing."""
+    import ctypes
+    import mxnet_tpu  # noqa: F401  (interpreter already has the package)
+    lib = ctypes.CDLL(SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    # declare pointer args: bare ints from POINTER(c_void_p)[i] would
+    # otherwise truncate to 32 bits
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+    shape = (ctypes.c_uint * 2)(4, 4)
+    h = ctypes.c_void_p()
+    rc = lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError()
+    buf = (ctypes.c_float * 16)(*([2.0] * 16))
+    assert lib.MXNDArraySyncCopyFromCPU(h, buf, 16) == 0
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    rc = lib.MXImperativeInvoke(b"sqrt", 1, ctypes.byref(h),
+                                ctypes.byref(n_out), ctypes.byref(outs),
+                                0, None, None)
+    assert rc == 0, lib.MXGetLastError()
+    assert n_out.value == 1
+    out_buf = (ctypes.c_float * 16)()
+    assert lib.MXNDArraySyncCopyToCPU(outs[0], out_buf, 16) == 0
+    np.testing.assert_allclose(list(out_buf), [2.0 ** 0.5] * 16,
+                               rtol=1e-6)
+    lib.MXNDArrayFree(h)
+    lib.MXNDArrayFree(outs[0])
+
+
+DRIVER_CPP = r"""
+#include <cstdio>
+#include "mxnet_tpu_c.h"
+
+int main() {
+  using mxnet_tpu::NDArray;
+  NDArray a({2, 3});
+  a.CopyFrom({1, 2, 3, 4, 5, 6});
+  auto outs = mxnet_tpu::Invoke("transpose", {&a},
+                                {{"axes", "(1, 0)"}});
+  if (outs.size() != 1) return 1;
+  auto shp = outs[0].Shape();
+  if (shp.size() != 2 || shp[0] != 3 || shp[1] != 2) return 1;
+  auto vals = outs[0].CopyTo();
+  float expect[6] = {1, 4, 2, 5, 3, 6};
+  for (int i = 0; i < 6; ++i)
+    if (vals[i] != expect[i]) return 1;
+  std::printf("CPP-API-OK\n");
+  return 0;
+}
+"""
+
+
+def test_cpp_raii_wrapper(tmp_path):
+    driver = tmp_path / "driver.cc"
+    driver.write_text(DRIVER_CPP)
+    exe = tmp_path / "driver_cpp"
+    subprocess.run(
+        ["g++", "-std=c++17", str(driver),
+         "-I", os.path.join(REPO, "native", "include"),
+         "-o", str(exe), str(SO), "-Wl,-rpath," + os.path.dirname(SO)],
+        check=True, capture_output=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    out = subprocess.run([str(exe)], capture_output=True, text=True,
+                         timeout=300, env=env)
+    assert out.returncode == 0, out.stderr
+    assert "CPP-API-OK" in out.stdout

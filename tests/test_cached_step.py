@@ -189,3 +189,120 @@ def test_fused_step_optimizer_state_checkpoint_roundtrip():
                 continue
             np.testing.assert_allclose(st.asnumpy(),
                                        mod2._updater.states[idx].asnumpy())
+
+
+def test_reshape_alternation_reuses_groups_and_programs():
+    """Alternating input shapes (bucketing / final partial batch) must
+    reuse the cached exec group AND its compiled step program instead of
+    rebinding from scratch (reference shares the memory pool; here the
+    costly resource is the compiled program)."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.module import Module
+    rng = np.random.RandomState(7)
+    mod = Module(_small_net(), context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (8, 6))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+
+    def batch(bs):
+        return DataBatch([nd.array(rng.randn(bs, 6))],
+                         [nd.array(rng.randint(0, 3, (bs,)))])
+
+    groups, steps = set(), set()
+    for _ in range(3):
+        for bs in (8, 5):          # alternate full/partial batch shapes
+            mod._fit_step(batch(bs))
+            groups.add(id(mod._exec_group))
+            assert mod._cached_step is not None
+            steps.add(id(mod._cached_step))
+    assert len(groups) == 2, "groups rebuilt instead of cached"
+    assert len(steps) == 2, "step programs rebuilt instead of cached"
+    for step in (mod._cached_step,):
+        assert step._step_jit._cache_size() == 1
+
+
+def test_reshape_preserves_grad_req_add():
+    """reshape must rebuild groups with the BOUND grad_req (accumulation
+    was silently downgraded to 'write' for reshaped shapes)."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.module import Module
+    rng = np.random.RandomState(11)
+    mod = Module(_small_net(), context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (8, 6))],
+             label_shapes=[DataDesc("softmax_label", (8,))],
+             grad_req="add")
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.reshape([DataDesc("data", (4, 6))],
+                [DataDesc("softmax_label", (4,))])
+    ex = mod._exec_group.execs[0]
+    assert ex.grad_req["fc1_weight"] == "add"
+    batch = DataBatch([nd.array(rng.randn(4, 6))],
+                      [nd.array(rng.randint(0, 3, (4,)))])
+    mod.forward_backward(batch)
+    once = ex.grad_dict["fc1_weight"].asnumpy().copy()
+    mod.forward_backward(batch)
+    np.testing.assert_allclose(ex.grad_dict["fc1_weight"].asnumpy(),
+                               2 * once, rtol=1e-5)
+
+
+def test_reshape_cache_bounded(monkeypatch):
+    from mxnet_tpu.io import DataDesc
+    from mxnet_tpu.module import Module
+    monkeypatch.setenv("MXNET_MODULE_RESHAPE_CACHE", "3")
+    mod = Module(_small_net(), context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (8, 6))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    for bs in (7, 6, 5, 4, 3, 2):
+        mod.reshape([DataDesc("data", (bs, 6))],
+                    [DataDesc("softmax_label", (bs,))])
+    assert len(mod._reshape_cache) <= 3
+
+
+def test_bucketing_default_bucket_updates_survive_switch():
+    """A fused step on the DEFAULT bucket updates device params only;
+    switching buckets must sync those updates down before seeding the
+    next bucket (they used to be silently reverted)."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.module import BucketingModule
+    rng = np.random.RandomState(13)
+
+    def sym_gen(key):
+        # weights shared across buckets (seq-len varies, dims don't)
+        emb = sym_api.Embedding(sym_api.Variable("data"), input_dim=10,
+                                output_dim=6, name="emb")
+        pooled = sym_api.mean(emb, axis=1)
+        net = sym_api.FullyConnected(pooled, num_hidden=4, name="fc")
+        net = sym_api.SoftmaxOutput(net, sym_api.Variable("softmax_label"),
+                                    name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    def batch(n, key):
+        return DataBatch(
+            [nd.array(rng.randint(0, 10, (4, n)).astype(np.float32))],
+            [nd.array(rng.randint(0, 4, (4,)))],
+            bucket_key=key,
+            provide_data=[DataDesc("data", (4, n))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+
+    mod = BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[DataDesc("data", (4, 8))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+
+    w0 = mod._leader._exec_group.execs[0].arg_dict["emb_weight"].asnumpy()
+    w0 = w0.copy()
+    mod._fit_step(batch(8, 8))       # default bucket: device-only update
+    w1 = mod._leader._exec_group.execs[0].arg_dict["emb_weight"].asnumpy()
+    w1 = w1.copy()
+    assert np.abs(w1 - w0).max() > 0, "leader step had no effect"
+    mod._fit_step(batch(5, 5))       # switch must carry w1 forward
+    # the non-default bucket must have STARTED from w1, and its update
+    # must not regress behind w1's step
+    arg, _ = mod.get_params()
+    assert np.abs(arg["emb_weight"].asnumpy() - w0).max() > 0, \
+        "default-bucket update was reverted by the switch"

@@ -103,3 +103,104 @@ def test_sparse_linear_classification_learns(capsys):
     fields = dict(kv.split("=") for kv in line.split()[1:])
     assert float(fields["last_nll"]) < float(fields["first_nll"])
     assert float(fields["acc"]) > 0.5
+
+
+def test_rcnn_toy_detector_learns(capsys):
+    """Proposal -> ROIPooling -> head end-to-end learnability
+    (reference example/rcnn/train_end2end.py skeleton)."""
+    out = run_example("train_rcnn_toy.py",
+                      ["--num-epochs", "6", "--lr", "4e-3"], capsys)
+    miou = float(out.strip().rsplit(" ", 1)[-1])
+    assert miou > 0.3, "refined-proposal IoU %.3f too low" % miou
+
+
+def test_cnn_text_classification_learns(capsys):
+    out = run_example("cnn_text_classification.py",
+                      ["--num-epochs", "4"], capsys)
+    acc = float(out.strip().rsplit(" ", 1)[-1])
+    assert acc > 0.8
+
+
+def test_nce_word_embeddings_cluster(capsys):
+    out = run_example("nce_word_embeddings.py", ["--num-epochs", "4"],
+                      capsys)
+    margin = float(out.strip().rsplit(" ", 1)[-1])
+    assert margin > 0.2, "topic clustering margin %.3f" % margin
+
+
+def test_vae_toy_elbo_improves(capsys):
+    out = run_example("vae_toy.py", ["--num-epochs", "8"], capsys)
+    line = out.strip().splitlines()[-1].split()
+    untrained, trained = float(line[2]), float(line[4])
+    assert trained > untrained + 5.0
+
+
+def test_publish_and_serve_zoo_artifact(capsys, tmp_path, monkeypatch):
+    """Zoo artifact round trip: train -> publish (gluon .params + symbol
+    JSON + V2 checkpoint) -> model_store resolves it -> both load paths
+    reproduce the recorded accuracy surface (VERDICT r3 #10)."""
+    import json
+    import numpy as np
+    out = run_example("train_publish_cifar.py",
+                      ["--num-epochs", "6", "--publish", str(tmp_path),
+                       "--min-acc", "0.5"], capsys)
+    assert "published" in out
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.model_zoo.model_store import get_model_file
+    sys.path.insert(0, EXAMPLES)
+    from train_publish_cifar import NAME
+    from train_cifar10 import synthetic_cifar
+
+    meta = json.load(open(tmp_path / (NAME + ".json")))
+    _, (va_x, va_y) = synthetic_cifar()
+    va_x = np.repeat(np.repeat(va_x, 2, axis=2), 2, axis=3)  # per meta
+
+    # gluon path through model_store (MXNET_GLUON_REPO as local dir)
+    monkeypatch.setenv("MXNET_GLUON_REPO", str(tmp_path))
+    net = vision.get_model("mobilenet0.25", classes=10)
+    net.load_params(get_model_file(NAME), ctx=mx.cpu())
+    out = net(mx.nd.array(va_x[:256])).asnumpy()
+    acc = float((out.argmax(axis=1) == va_y[:256]).mean())
+    assert abs(acc - meta["val_accuracy"]) < 0.08
+
+    # symbolic path: Module.load from the published checkpoint
+    mod = mx.mod.Module.load(str(tmp_path / NAME), 0,
+                             context=mx.cpu())
+    mod.bind(data_shapes=[("data", (256, 3, 64, 64))], for_training=False)
+    mod.forward(mx.io.DataBatch([mx.nd.array(va_x[:256])], None),
+                is_train=False)
+    out2 = mod.get_outputs()[0].asnumpy()
+    acc2 = float((out2.argmax(axis=1) == va_y[:256]).mean())
+    assert abs(acc2 - acc) < 0.02
+
+
+def test_ctc_ocr_learns(capsys):
+    """LSTM + CTC through the symbolic Module path (reference lstm_ocr);
+    greedy decode must reach near-zero label error."""
+    out = run_example("ctc_ocr_toy.py", ["--num-epochs", "60"], capsys)
+    rate = float(out.strip().rsplit(" ", 1)[-1])
+    assert rate < 0.15, "label error rate %.3f" % rate
+
+
+def test_bi_lstm_sort_learns(capsys):
+    out = run_example("bi_lstm_sort.py", ["--num-epochs", "40"], capsys)
+    token_acc = float(out.split("token acc")[1].split()[0])
+    assert token_acc > 0.85, "token accuracy %.3f" % token_acc
+
+
+def test_adversary_fgsm_attack_works(capsys):
+    out = run_example("adversary_fgsm.py", ["--num-epochs", "6"], capsys)
+    parts = out.split()
+    clean = float(parts[parts.index("acc") + 1])
+    adv = float(parts[parts.index("acc", parts.index("acc") + 1) + 1])
+    assert clean > 0.9, "clean accuracy %.3f" % clean
+    assert adv < clean - 0.5, "FGSM barely moved accuracy (%.3f -> %.3f)" \
+        % (clean, adv)
+
+
+def test_multi_task_both_heads_learn(capsys):
+    out = run_example("multi_task.py", ["--num-epochs", "8"], capsys)
+    digit = float(out.split("digit acc")[1].split()[0])
+    parity = float(out.split("parity acc")[1].split()[0])
+    assert digit > 0.9 and parity > 0.9

@@ -163,3 +163,24 @@ def test_debug_str_lists_graph():
     # grouped outputs are numbered by position, not producer out-index
     g = mx.sym.Group([h, out]).debug_str()
     assert "output[0]=fc1_output" in g and "output[1]=act1_output" in g
+
+
+def test_one_element_tuple_attr_roundtrip():
+    """attr stringify: 1-tuples must survive JSON ("(64,)" not "(64)",
+    which parses back as int); old files with the bare form still load."""
+    from mxnet_tpu.ops.registry import attr_to_string, parse_attr_string
+    assert attr_to_string((64,)) == "(64,)"
+    assert parse_attr_string("(64,)") == (64,)
+    s = sym.Variable("w", shape=(64,))
+    loaded = sym.load_json(s.tojson())
+    a, _, _ = loaded.infer_shape()
+    assert a[0] == (64,)
+    # legacy bare-int form still infers
+    import json as _json
+    g = _json.loads(s.tojson())
+    for n in g["nodes"]:
+        if n["name"] == "w":
+            n["attrs"]["__shape__"] = "(64)"
+    legacy = sym.load_json(_json.dumps(g))
+    a, _, _ = legacy.infer_shape()
+    assert a[0] == (64,)
