@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""BiLSTM-CRF sequence tagger on a synthetic tagging task.
+
+Parity target: reference ``example/gluon/lstm_crf`` — LSTM emissions +
+a learned transition matrix, trained by maximizing the CRF
+log-likelihood (forward-algorithm partition via logsumexp recursion)
+and decoded with Viterbi. Eager autograd (the recursions are
+data-dependent only in VALUES, so the T-step python loop traces fine).
+
+Synthetic task: tags follow a first-order Markov chain; each tag emits
+its id as a noisy feature — so both the emission net AND the learned
+transitions matter (a per-step classifier underfits transitions).
+
+    python examples/lstm_crf.py --num-epochs 8
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+T = 8
+TAGS = 4
+FEAT = 6
+
+# a sticky transition chain: staying is likely, jumps are rare
+_TRANS = np.full((TAGS, TAGS), 0.08)
+np.fill_diagonal(_TRANS, 1.0 - 0.08 * (TAGS - 1))
+
+
+def make_set(n, rng=None):
+    rng = rng or np.random.RandomState(19)
+    xs = np.zeros((n, T, FEAT), np.float32)
+    ys = np.zeros((n, T), np.int64)
+    for i in range(n):
+        tag = rng.randint(TAGS)
+        for t in range(T):
+            tag = rng.choice(TAGS, p=_TRANS[tag])
+            ys[i, t] = tag
+            xs[i, t, tag] = 1.0
+        xs[i] += rng.normal(0, 0.6, (T, FEAT)).astype(np.float32)
+    return xs, ys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    class BiLSTMCRF(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.lstm = gluon.rnn.LSTM(16, layout="NTC",
+                                           bidirectional=True)
+                self.emit = gluon.nn.Dense(TAGS, flatten=False)
+                self.trans = self.params.get(
+                    "transitions", shape=(TAGS, TAGS),
+                    init=mx.initializer.Zero())
+
+        def emissions(self, x):                 # (N, T, TAGS)
+            # skip connection: the LSTM adds temporal context on top of
+            # the per-frame features instead of having to relearn them
+            h = nd.concat(self.lstm(x), x, dim=2)
+            return self.emit(h)
+
+        def neg_log_likelihood(self, x, tags_np):
+            """-log p(tags | x) = log Z - score(tags)."""
+            em = self.emissions(x)              # (N, T, K)
+            trans = self.trans.data()           # (K, K)
+            n = x.shape[0]
+            # numerator: emission + transition score of the gold path
+            gold = nd.array(tags_np.astype(np.float32))
+            score = nd.sum(nd.pick(em[:, 0, :], gold[:, 0], axis=1))
+            for t in range(1, T):
+                score = score + nd.sum(nd.pick(em[:, t, :], gold[:, t],
+                                               axis=1))
+                # transition gold[t-1] -> gold[t]
+                flat = gold[:, t - 1] * TAGS + gold[:, t]
+                score = score + nd.sum(nd.pick(
+                    nd.reshape(trans, (1, -1)).broadcast_to((n, TAGS * TAGS)),
+                    flat, axis=1))
+            # partition: forward algorithm in log space
+            alpha = em[:, 0, :]                 # (N, K)
+            for t in range(1, T):
+                # alpha_j' = logsumexp_i(alpha_i + trans_ij) + em_tj
+                mat = nd.expand_dims(alpha, axis=2) + \
+                    nd.expand_dims(trans, axis=0)       # (N, K, K)
+                m = nd.max(mat, axis=1, keepdims=True)
+                alpha = nd.log(nd.sum(nd.exp(mat - m), axis=1)) \
+                    + nd.reshape(m, (n, TAGS)) + em[:, t, :]
+            m = nd.max(alpha, axis=1, keepdims=True)
+            logz = nd.log(nd.sum(nd.exp(alpha - m), axis=1)) \
+                + nd.reshape(m, (n,))
+            return (nd.sum(logz) - score) / n
+
+        def viterbi(self, x):
+            em = self.emissions(x).asnumpy()
+            trans = self.trans.data().asnumpy()
+            n = em.shape[0]
+            path = np.zeros((n, T), np.int64)
+            for i in range(n):
+                delta = em[i, 0].copy()
+                back = np.zeros((T, TAGS), np.int64)
+                for t in range(1, T):
+                    cand = delta[:, None] + trans
+                    back[t] = cand.argmax(axis=0)
+                    delta = cand.max(axis=0) + em[i, t]
+                path[i, T - 1] = delta.argmax()
+                for t in range(T - 1, 0, -1):
+                    path[i, t - 1] = back[t, path[i, t]]
+            return path
+
+    net = BiLSTMCRF()
+    net.collect_params().initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    train_x, train_y = make_set(512)
+    bs = args.batch_size
+    for epoch in range(args.num_epochs):
+        tot = nb = 0
+        for i in range(0, len(train_x), bs):
+            x = nd.array(train_x[i:i + bs])
+            with autograd.record():
+                loss = net.neg_log_likelihood(x, train_y[i:i + bs])
+            loss.backward()
+            trainer.step(1)     # loss already per-sample-averaged
+            tot += float(loss.asnumpy())
+            nb += 1
+        logging.info("epoch %d nll %.4f", epoch, tot / nb)
+
+    val_x, val_y = make_set(128, rng=np.random.RandomState(88))
+    pred = net.viterbi(nd.array(val_x))
+    crf_acc = float((pred == val_y).mean())
+    # baseline: argmax over emissions only (no transitions)
+    em_only = net.emissions(nd.array(val_x)).asnumpy().argmax(axis=2)
+    em_acc = float((em_only == val_y).mean())
+    learned_stick = net.trans.data().asnumpy()
+    diag_margin = float(np.mean(np.diag(learned_stick))
+                        - np.mean(learned_stick))
+    print("crf tag acc %.3f emission-only acc %.3f diag margin %.3f"
+          % (crf_acc, em_acc, diag_margin))
+    return crf_acc, em_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Multiclass SVM head instead of softmax on an MNIST-like task.
+
+Parity target: reference ``example/svm_mnist`` — the same MLP trained
+with ``SVMOutput`` (squared hinge loss against the margin, the semantic
+gradient living in the op) instead of ``SoftmaxOutput``, through Module.
+
+    python examples/svm_mnist.py --num-epochs 6
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+_PROTOS = np.random.RandomState(55).rand(10, 64).astype(np.float32)
+
+
+def make_set(n, rng=None):
+    rng = rng or np.random.RandomState(1)
+    y = rng.randint(0, 10, n)
+    x = _PROTOS[y] + rng.normal(0, 0.3, (n, 64)).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--linear", action="store_true",
+                    help="linear (L1) hinge instead of squared")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import NDArrayIter
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=128,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SVMOutput(net, mx.sym.Variable("svm_label"), margin=1.0,
+                           regularization_coefficient=1.0,
+                           use_linear=args.linear, name="svm")
+
+    train_x, train_y = make_set(2048)
+    it = NDArrayIter(train_x, train_y, batch_size=args.batch_size,
+                     shuffle=True, label_name="svm_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["svm_label"], context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", args.lr),))
+    for epoch in range(args.num_epochs):
+        it.reset()
+        for batch in it:
+            mod._fit_step(batch)
+        logging.info("epoch %d", epoch)
+
+    val_x, val_y = make_set(512, rng=np.random.RandomState(42))
+    from mxnet_tpu.io import DataBatch
+    scores = []
+    for i in range(0, 512, args.batch_size):
+        b = DataBatch([mx.nd.array(val_x[i:i + args.batch_size])],
+                      [mx.nd.array(val_y[i:i + args.batch_size])])
+        mod.forward(b, is_train=False)
+        scores.append(mod.get_outputs()[0].asnumpy())
+    pred = np.concatenate(scores).argmax(axis=1)
+    acc = float((pred == val_y[:len(pred)]).mean())
+    print("svm val accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

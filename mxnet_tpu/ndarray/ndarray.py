@@ -420,6 +420,11 @@ class NDArray:
 
     def __getitem__(self, key):
         key = self._norm_key(key)
+        if ag.is_recording():
+            # slicing must land on the tape or gradients through views
+            # are silently dropped (x[:, t, :] inside autograd.record)
+            return invoke(get_op("_internal_getitem"), [self],
+                          {"key": key})[0]
         return _wrap(self._data[key], self._ctx)
 
     def __setitem__(self, key, value):

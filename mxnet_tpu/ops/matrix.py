@@ -120,6 +120,14 @@ def _split(x, num_outputs=1, axis=1, squeeze_axis=False, **kw):
     return tuple(parts)
 
 
+@register("_internal_getitem")
+def _internal_getitem(x, key=None, **kw):
+    """Eager ``x[key]`` as a registered op so NDArray.__getitem__ lands
+    on the autograd tape (the key travels as a live attr — slices /
+    index arrays — and is never stringified; eager-only by design)."""
+    return x[key]
+
+
 @register("slice", aliases=["crop"])
 def _slice(x, begin=(), end=(), step=None, **kw):
     idx = []

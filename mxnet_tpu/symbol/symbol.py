@@ -175,11 +175,14 @@ class Symbol:
         return {k.strip("_"): attr_to_string(v) for k, v in node.attrs.items()}
 
     def attr_dict(self):
+        """Per-node attrs, keys as stored — special attrs KEEP their
+        dunder form (``__init__``/``__lr_mult__``/...): that is what the
+        initializer's variable-override and the optimizer's multiplier
+        lookups key on (reference symbol.py attr_dict contract)."""
         out = {}
         for node in _topo(self._outputs):
             if node.attrs:
-                out[node.name] = {k.strip("_") if k.startswith("__") else k:
-                                  attr_to_string(v)
+                out[node.name] = {k: attr_to_string(v)
                                   for k, v in node.attrs.items()}
         return out
 

@@ -181,3 +181,35 @@ def test_grad_create_graph_mixed_expression():
     w.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), 4.0 * x.asnumpy(),
                                rtol=1e-5)
+
+
+def test_getitem_slices_land_on_tape():
+    """x[...] views inside record() must carry gradients (they used to
+    bypass the tape entirely, silently returning zero grads)."""
+    em = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    em.attach_grad()
+    lab = nd.array(np.array([1.0, 2.0]))
+    with autograd.record():
+        s = nd.sum(nd.pick(em[:, 1, :], lab, axis=1))
+    s.backward()
+    expected = np.zeros((2, 3, 4), np.float32)
+    expected[0, 1, 1] = 1
+    expected[1, 1, 2] = 1
+    np.testing.assert_allclose(em.grad.asnumpy(), expected)
+
+    x = nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x[1:4] * x[1:4])          # overlapping views add up
+    y.backward()
+    expected = np.zeros(6, np.float32)
+    expected[1:4] = 2 * np.arange(1, 4)
+    np.testing.assert_allclose(x.grad.asnumpy(), expected)
+
+    idx = nd.array(np.array([0.0, 2.0]))     # fancy indexing too
+    x2 = nd.array(np.arange(4, dtype=np.float32))
+    x2.attach_grad()
+    with autograd.record():
+        z = nd.sum(x2[idx])
+    z.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), [1, 0, 1, 0])

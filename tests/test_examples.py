@@ -204,3 +204,31 @@ def test_multi_task_both_heads_learn(capsys):
     digit = float(out.split("digit acc")[1].split()[0])
     parity = float(out.split("parity acc")[1].split()[0])
     assert digit > 0.9 and parity > 0.9
+
+
+def test_svm_mnist_learns(capsys):
+    out = run_example("svm_mnist.py", ["--num-epochs", "6"], capsys)
+    acc = float(out.strip().rsplit(" ", 1)[-1])
+    assert acc > 0.9, "svm accuracy %.3f" % acc
+
+
+def test_factorization_machine_learns_interactions(capsys):
+    out = run_example("factorization_machine.py",
+                      ["--num-epochs", "8"], capsys)
+    parts = out.split()
+    first = float(parts[parts.index("first_loss") + 1])
+    last = float(parts[parts.index("last_loss") + 1])
+    acc = float(parts[parts.index("acc") + 1])
+    assert last < first * 0.5
+    assert acc > 0.8
+
+
+@pytest.mark.slow
+def test_lstm_crf_learns_tags_and_transitions(capsys):
+    out = run_example("lstm_crf.py",
+                      ["--num-epochs", "6", "--lr", "0.01"], capsys)
+    parts = out.split()
+    crf = float(parts[parts.index("acc") + 1])
+    margin = float(parts[parts.index("margin") + 1])
+    assert crf > 0.7, "crf tag accuracy %.3f" % crf
+    assert margin > 0.3, "transition matrix did not learn stickiness"

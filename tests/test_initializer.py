@@ -81,3 +81,37 @@ def test_mixed_initializer():
     b = nd.ones((4,))
     init(mx.init.InitDesc("fc_bias"), b)
     assert (b.asnumpy() == 0).all()
+
+
+def test_variable_level_init_override_honored():
+    """mx.sym.Variable(init=...) must WIN over both the suffix dispatch
+    and the global initializer (attr_dict used to strip the __init__
+    key, silently ignoring per-variable overrides)."""
+    import mxnet_tpu as mx
+    net = mx.sym.FullyConnected(
+        mx.sym.Variable("data"),
+        weight=mx.sym.Variable("fcw", init=mx.initializer.Constant(3.5)),
+        num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.initializer.Zero())
+    w = mod._exec_group.execs[0].arg_dict["fcw"].asnumpy()
+    assert np.all(w == 3.5), "per-variable init override ignored"
+
+
+def test_variable_lr_mult_reaches_optimizer():
+    """__lr_mult__ set on a Variable must reach the optimizer's
+    multiplier table via sym_info (same attr_dict key contract)."""
+    import mxnet_tpu as mx
+    net = mx.sym.FullyConnected(
+        mx.sym.Variable("data"),
+        weight=mx.sym.Variable("fcw", lr_mult=0.25),
+        num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    opt = mx.optimizer.SGD(learning_rate=1.0, sym=net,
+                           param_idx2name={0: "fcw"})
+    assert opt._get_lr(0) == 0.25
