@@ -83,6 +83,8 @@ class NDArray:
 
     @property
     def T(self):
+        if ag.is_recording():
+            return invoke(get_op("transpose"), [self], {})[0]
         return _wrap(self._data.T, self._ctx)
 
     def _set_data(self, jarr):
@@ -141,11 +143,18 @@ class NDArray:
                                self._ctx.device_id, self._stype))
 
     # -- conversion / copy -------------------------------------------------
+    # (casts and copies record on the tape like the reference's Cast /
+    # _copy ops — only detach() deliberately severs the graph)
     def astype(self, dtype, copy=True):
+        if ag.is_recording():
+            return invoke(get_op("Cast"), [self],
+                          {"dtype": dtype_name(dtype)})[0]
         return _wrap(self._data.astype(dtype_np(dtype)), self._ctx)
 
     def copy(self):
-        return _wrap(self._data + 0 if False else jnp.array(self._data), self._ctx)
+        if ag.is_recording():
+            return invoke(get_op("_copy"), [self], {})[0]
+        return _wrap(jnp.array(self._data), self._ctx)
 
     def copyto(self, other):
         """Copy into another NDArray or to a Context (reference CopyFromTo)."""

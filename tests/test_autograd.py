@@ -213,3 +213,35 @@ def test_getitem_slices_land_on_tape():
         z = nd.sum(x2[idx])
     z.backward()
     np.testing.assert_allclose(x2.grad.asnumpy(), [1, 0, 1, 0])
+
+
+def test_view_and_cast_methods_record():
+    """.T, .astype, .copy under record() must carry gradients (same
+    tape-bypass class as __getitem__)."""
+    w = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    w.attach_grad()
+    with autograd.record():
+        y = nd.sum(w.T * nd.array(np.ones((3, 2), np.float32) * 2))
+    y.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), np.full((2, 3), 2.0))
+
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        z = nd.sum(x.astype("float64") * 3)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 3])
+
+    c = nd.array(np.array([1.0, 2.0], np.float32))
+    c.attach_grad()
+    with autograd.record():
+        out = nd.sum(c.copy() * c)      # grad 2c through both paths
+    out.backward()
+    np.testing.assert_allclose(c.grad.asnumpy(), [2, 4])
+
+    d = nd.array(np.array([1.0, 2.0], np.float32))
+    d.attach_grad()
+    with autograd.record():
+        blocked = nd.sum(d.detach() * d)   # detach severs one path
+    blocked.backward()
+    np.testing.assert_allclose(d.grad.asnumpy(), [1, 2])
