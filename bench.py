@@ -18,74 +18,23 @@ cost analysis of the compiled train step when available, else from the
 analytic 3 x 8.2 GFLOP/img model (fwd 2*4.1 GMAC, bwd ~2x fwd). Peak is
 looked up from the device kind (bf16).
 
-Compute runs in bfloat16 (the MXU design point); the driver executes this
-on the real TPU chip.
+Process architecture (round-5 fix of the double-tunnel-open flaw): the
+axon tunnel is single-client and wedges if a client dies uncleanly, so
+the parent process NEVER imports jax. It spawns ONE child per attempt
+(``BENCH_ROLE=chip``) that opens the tunnel, runs the ENTIRE bench, and
+prints the JSON; a timed-out child gets SIGTERM + a grace period before
+SIGKILL so it can close the tunnel cleanly. The parent falls back to a
+CPU child (``BENCH_ROLE=cpu``, JAX_PLATFORMS pinned) only when no chip
+JSON ever appeared, and embeds probe forensics in that fallback line.
 """
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 import numpy as np
-
-
-_PROBE_ERROR = None
-
-
-def _tpu_reachable(total_budget=None):
-    """Probe the accelerator backend in a subprocess, with retries.
-
-    The axon tunnel is single-client and can wedge indefinitely if a
-    previous client died uncleanly; probing out-of-process keeps THIS
-    process able to fall back to CPU (pinning must happen before any
-    backend touch, which is why the probe cannot run inline).
-
-    Round-3 lesson (VERDICT weak #1): one flaky 240s probe silently cost
-    the whole round's on-chip numbers. Now: retry with backoff across a
-    ~15-minute budget, and on final failure record *why* in _PROBE_ERROR
-    so the emitted JSON marks the fallback as a failed measurement.
-    """
-    global _PROBE_ERROR
-    if total_budget is None:
-        total_budget = float(os.environ.get("BENCH_PROBE_BUDGET", "900"))
-    deadline = time.time() + total_budget
-    delay, attempt = 5.0, 0
-    while time.time() < deadline:
-        attempt += 1
-        per_try = max(60.0, min(300.0, deadline - time.time()))
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; assert jax.devices()[0].platform != 'cpu'"],
-                timeout=per_try, capture_output=True)
-            if probe.returncode == 0:
-                _PROBE_ERROR = None
-                return True
-            _PROBE_ERROR = "attempt %d rc=%d: %s" % (
-                attempt, probe.returncode,
-                (probe.stderr or b"").decode(errors="replace")[-300:].strip())
-        except subprocess.TimeoutExpired:
-            _PROBE_ERROR = "attempt %d: probe timed out after %ds" % (
-                attempt, int(per_try))
-        print("bench: TPU probe failed (%s); retrying" % _PROBE_ERROR,
-              file=sys.stderr)
-        time.sleep(min(delay, max(0.0, deadline - time.time())))
-        delay = min(delay * 2, 60.0)
-    return False
-
-
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    _PROBE_ERROR = "skipped: JAX_PLATFORMS=cpu pinned by caller"
-elif not _tpu_reachable():
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
-import jax.numpy as jnp
 
 BATCH = 32
 INFER_BASELINE_IMG_S = 109.0
@@ -128,6 +77,8 @@ def _timed_rate(run, batch, target_s=5.0, max_iters=2000, repeats=3):
 
 def _build_train_step(forward, params, aux, dtype, device):
     """One fused train step using the framework's pure optimizer core."""
+    import jax
+    import jax.numpy as jnp
     from mxnet_tpu import optimizer as opt_mod
     sgd = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4,
                          rescale_grad=1.0)
@@ -158,6 +109,8 @@ def _module_train_rate(mx, batch, dtype, window):
     symbol bind -> Module -> CachedTrainStep (one donated XLA program per
     step). Reference analogue: train_imagenet.py --benchmark 1
     (example/image-classification/README.md:255-260)."""
+    import jax
+    import jax.numpy as jnp
     from mxnet_tpu import symbol as S
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.io import DataBatch, DataDesc
@@ -199,13 +152,23 @@ def _module_train_rate(mx, batch, dtype, window):
     return rate, iters
 
 
-def main():
+def _measure(require_chip, probe_error=None):
+    """Run the bench in THIS process (child role). Prints the JSON line."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # env-var pinning alone can hang under the axon sitecustomize;
+        # the config update is what actually keeps the tunnel untouched
+        jax.config.update("jax_platforms", "cpu")
+
+    if require_chip:
+        # Fail fast (parent retries) rather than silently measuring host.
+        assert jax.devices()[0].platform != "cpu", "no accelerator visible"
+
+    import jax.numpy as jnp
     import mxnet_tpu as mx
     from __graft_entry__ import _build_flagship
 
-    # num_tpus() returns 0 (not raises) on backend-init failure; resolving
-    # the cpu context can still hit a broken accelerator platform, so guard
-    # the whole device pick and fall back to the host CPU backend.
     try:
         dev = (mx.tpu() if mx.context.num_tpus() else mx.cpu()).jax_device
     except RuntimeError:
@@ -245,7 +208,7 @@ def main():
             "vs_baseline": None,
             "device": "cpu",
             "batch": batch,
-            "probe_error": _PROBE_ERROR or "unknown probe failure",
+            "probe_error": probe_error or "unknown probe failure",
         }))
         return
 
@@ -311,6 +274,131 @@ def main():
         "module_vs_raw": round(module_rate / train_rate, 3)
         if module_rate else None,
     }))
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestration: one tunnel client per attempt, SIGTERM before KILL.
+# ---------------------------------------------------------------------------
+
+def _extract_json(text):
+    """Last parseable JSON object line in `text`, or None."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _run_child(role, timeout, extra_env=None):
+    """Spawn one bench child; returns (json_dict|None, error_string)."""
+    env = dict(os.environ)
+    env["BENCH_ROLE"] = role
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # A SIGKILLed client is exactly what wedges the tunnel for the next
+        # attempt: give the child a chance to close it cleanly first.
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, err = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        # the child may have finished measuring and printed its JSON but
+        # wedged closing the tunnel at exit — don't discard a banked result
+        parsed = _extract_json(out or "")
+        if parsed is not None:
+            sys.stdout.write(out)
+            sys.stdout.flush()
+            return parsed, ""
+        return None, "timed out after %ds" % int(timeout)
+    parsed = _extract_json(out or "")
+    if parsed is not None:
+        # Accept a printed measurement even on nonzero rc: a chip child
+        # that crashes tearing down the wedged tunnel AFTER printing its
+        # JSON still produced a valid result.
+        sys.stdout.write(out)
+        sys.stdout.flush()
+        return parsed, ""
+    return None, "rc=%d: %s" % (
+        proc.returncode, (err or "")[-300:].strip().replace("\n", " | "))
+
+
+def _forensics():
+    """Why is the tunnel wedged? Cheap evidence for the fallback JSON."""
+    notes = []
+    try:
+        out = subprocess.run(["ss", "-tnp"], capture_output=True, text=True,
+                             timeout=10).stdout
+        hits = [l.strip() for l in out.splitlines() if "python" in l]
+        notes.append("ss: %d python sockets" % len(hits))
+        notes.extend(hits[:3])
+    except Exception as exc:
+        notes.append("ss failed: %r" % exc)
+    site = "/root/.axon_site/axon"
+    try:
+        logs = sorted(
+            (os.path.join(dp, f) for dp, _, fs in os.walk(site) for f in fs
+             if f.endswith(".log")),
+            key=lambda p: os.path.getmtime(p), reverse=True)
+        if logs:
+            with open(logs[0], "rb") as fh:
+                fh.seek(max(0, os.path.getsize(logs[0]) - 600))
+                tail = fh.read().decode(errors="replace")
+            notes.append("%s tail: %s" % (logs[0], tail[-300:]))
+        else:
+            notes.append("no axon logs under %s" % site)
+    except Exception as exc:
+        notes.append("axon log scan failed: %r" % exc)
+    return " ;; ".join(notes)[:900]
+
+
+def main():
+    role = os.environ.get("BENCH_ROLE", "")
+    if role == "chip":
+        _measure(require_chip=True)
+        return
+    if role == "cpu" or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _measure(require_chip=False,
+                 probe_error=os.environ.get(
+                     "BENCH_PROBE_ERROR",
+                     "skipped: JAX_PLATFORMS=cpu pinned by caller"))
+        return
+
+    total_budget = float(os.environ.get("BENCH_PROBE_BUDGET", "900"))
+    deadline = time.time() + total_budget
+    attempt, last_err = 0, "no attempts made"
+    while time.time() < deadline:
+        attempt += 1
+        # The chip child compiles (~40s) + measures (~60s); give it most of
+        # the remaining budget but keep one retry's worth in reserve.
+        per_try = max(120.0, min(480.0, deadline - time.time()))
+        parsed, err = _run_child("chip", per_try)
+        if parsed is not None:
+            return
+        last_err = "attempt %d: %s" % (attempt, err)
+        print("bench: chip attempt failed (%s); retrying" % last_err,
+              file=sys.stderr)
+        time.sleep(min(10.0, max(0.0, deadline - time.time())))
+
+    probe_error = "%s ;; forensics: %s" % (last_err, _forensics())
+    parsed, err = _run_child(
+        "cpu", 600,
+        {"JAX_PLATFORMS": "cpu", "BENCH_PROBE_ERROR": probe_error})
+    if parsed is None:
+        # Last resort: a JSON line must always come out for the driver.
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0, "unit": "img/s",
+            "vs_baseline": None, "probe_error": probe_error,
+            "cpu_fallback_error": err,
+        }))
 
 
 if __name__ == "__main__":

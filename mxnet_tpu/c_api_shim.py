@@ -20,7 +20,7 @@ from .ops.registry import get_op, list_ops, parse_attr_string
 
 __all__ = ["create", "dtype_code", "itemsize", "shape_of",
            "copy_from_bytes", "to_bytes", "imperative_invoke",
-           "all_op_names", "save_list", "load_file"]
+           "copy_into", "all_op_names", "save_list", "load_file"]
 
 _DEV = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 6: "tpu"}
 
@@ -67,6 +67,17 @@ def imperative_invoke(op_name, inputs, keys, vals):
     attrs = {k: parse_attr_string(v) for k, v in zip(keys, vals)}
     out = invoke(op, list(inputs), attrs)
     return list(out)
+
+
+def copy_into(dst, src):
+    """Write `src` into the caller-preallocated `dst` (MXImperativeInvoke
+    with *num_outputs != 0 on entry — reference out-array semantics)."""
+    if tuple(dst.shape) != tuple(src.shape):
+        raise MXNetError(
+            "preallocated output has shape %s, op produced %s"
+            % (dst.shape, src.shape))
+    src.copyto(dst)
+    return dst
 
 
 def all_op_names():

@@ -67,9 +67,10 @@ class Initializer:
             desc.global_init = self
         attr_init = desc.attrs.get("__init__", "")
         if attr_init:
-            # variable-level override: serialized [class, kwargs]
-            cls_name, cls_kwargs = json.loads(attr_init)
-            create(cls_name, **cls_kwargs)._init_weight(desc, arr)
+            # variable-level override: serialized [class, kwargs] or a plain
+            # registered name — the reference accepts both via create(init)
+            # (ref python/mxnet/initializer.py:134).
+            create(attr_init)._init_weight(desc, arr)
             return
         lowered = desc.lower()
         for suffixes, handler in _SUFFIX_DISPATCH:
@@ -111,8 +112,13 @@ def register(klass):
 
 
 def create(name, **kwargs):
-    if isinstance(name, Initializer):
+    """Name, JSON ``[class, kwargs]`` string, or instance → Initializer
+    (name-or-JSON acceptance mirrors ref python/mxnet/initializer.py:134)."""
+    if isinstance(name, Initializer) or callable(name) and not isinstance(name, (str, type)):
         return name
+    if isinstance(name, str) and name.lstrip().startswith("["):
+        cls_name, cls_kwargs = json.loads(name)
+        return _REG.get(cls_name)(**cls_kwargs)
     return _REG.get(name)(**kwargs)
 
 
@@ -276,7 +282,7 @@ class FusedRNN(Initializer):
         if isinstance(init, Initializer):
             self._init = init
         elif isinstance(init, str) and init:
-            self._init = create(*json.loads(init))
+            self._init = create(init)          # name or JSON form
         else:
             self._init = Uniform(0.1)
 

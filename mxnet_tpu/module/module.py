@@ -11,6 +11,8 @@ import logging
 import os
 import warnings
 
+import numpy as np
+
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
@@ -161,8 +163,18 @@ class Module(BaseModule):
             req = tuple(sorted(req.items()))
         elif isinstance(req, (list, tuple)):
             req = tuple(req)
-        return (tuple((d.name, tuple(d.shape)) for d in self._data_shapes),
-                tuple((d.name, tuple(d.shape))
+        # dtype is part of a group's identity: _bind_execs passes type_dict
+        # into simple_bind, so same-shape/different-dtype must not collide
+        def _dt(d):
+            dt = getattr(d, "dtype", None)
+            try:                       # canonical spelling: np.float32 and
+                return str(np.dtype(dt))  # "float32" must hit the same key
+            except TypeError:
+                return str(dt)
+
+        return (tuple((d.name, tuple(d.shape), _dt(d))
+                      for d in self._data_shapes),
+                tuple((d.name, tuple(d.shape), _dt(d))
                       for d in (self._label_shapes or ())),
                 self.for_training, self.inputs_need_grad, req)
 

@@ -137,24 +137,32 @@ def save(fname, data):
 
 
 def load_from_bytes(raw):
-    mv = memoryview(raw)
-    header, _res = struct.unpack_from("<QQ", mv, 0)
-    if header != _LIST_MAGIC:
-        raise MXNetError("Invalid NDArray file format")
-    (n,) = struct.unpack_from("<Q", mv, 16)
-    off = 24
-    arrays = []
-    for _ in range(n):
-        a, off = _load_one(mv, off)
-        arrays.append(a)
-    (nkeys,) = struct.unpack_from("<Q", mv, off)
-    off += 8
-    keys = []
-    for _ in range(nkeys):
-        (ln,) = struct.unpack_from("<Q", mv, off)
+    try:
+        mv = memoryview(raw)
+        header, _res = struct.unpack_from("<QQ", mv, 0)
+        if header != _LIST_MAGIC:
+            raise MXNetError("Invalid NDArray file format")
+        (n,) = struct.unpack_from("<Q", mv, 16)
+        off = 24
+        arrays = []
+        for _ in range(n):
+            a, off = _load_one(mv, off)
+            arrays.append(a)
+        (nkeys,) = struct.unpack_from("<Q", mv, off)
         off += 8
-        keys.append(bytes(mv[off:off + ln]).decode("utf-8"))
-        off += ln
+        keys = []
+        for _ in range(nkeys):
+            (ln,) = struct.unpack_from("<Q", mv, off)
+            off += 8
+            keys.append(bytes(mv[off:off + ln]).decode("utf-8"))
+            off += ln
+    except MXNetError:
+        raise
+    except (struct.error, IndexError, KeyError, UnicodeDecodeError,
+            ValueError, OverflowError) as exc:
+        # truncated/garbage payloads must fail as a format error, not leak
+        # struct internals to the caller
+        raise MXNetError("Invalid NDArray file format: %s" % exc)
     if keys:
         return dict(zip(keys, arrays))
     return arrays

@@ -48,6 +48,7 @@ from .ops.registry import OP_REGISTRY, Op
 __all__ = ["register", "unregister", "registered_kernels"]
 
 _USER_KERNELS = []
+_SHADOWED = {}  # name -> Op it force-replaced, restored on unregister()
 
 
 def _auto_interpret():
@@ -101,10 +102,15 @@ def register(name, fn=None, *, grad=None, num_outputs=1, takes_mode=False,
                             takes_mode=takes_mode, needs_rng=needs_rng,
                             interpret=interpret, force=force)
         return deco
-    if name in OP_REGISTRY and not force:
-        raise MXNetError(
-            "operator %r already registered (pass force=True to replace)"
-            % name)
+    if name in OP_REGISTRY:
+        if not force:
+            raise MXNetError(
+                "operator %r already registered (pass force=True to replace)"
+                % name)
+        if name not in _SHADOWED and name not in _USER_KERNELS:
+            # force=True over a built-in: stash it so unregister() restores
+            # the core operator instead of deleting it (r4 advice).
+            _SHADOWED[name] = OP_REGISTRY[name]
 
     params = inspect.signature(fn).parameters
     accepts_interpret = "interpret" in params
@@ -143,6 +149,12 @@ def unregister(name):
                 sys.modules.get(sym_mod.__name__ + "._internal")):
         if mod is not None and hasattr(mod, name):
             delattr(mod, name)
+    shadowed = _SHADOWED.pop(name, None)
+    if shadowed is not None:
+        # the kernel force-replaced a built-in: put the original back,
+        # wrappers included, so the framework keeps its core operator
+        OP_REGISTRY[name] = shadowed
+        _expose(name, shadowed)
 
 
 def registered_kernels():

@@ -229,6 +229,30 @@ int MXImperativeInvoke(const char* op_name, int num_inputs,
   Py_DECREF(vals);
   if (!res) return -1;
   Py_ssize_t n = PyList_Size(res);
+  if (*num_outputs != 0) {
+    // Reference contract: a nonzero *num_outputs on entry means *outputs
+    // points to caller-preallocated handles the op must write INTO
+    // (ref src/imperative/imperative.cc out-array path).
+    if (*num_outputs != static_cast<int>(n)) {
+      SetError("MXImperativeInvoke: op produced " + std::to_string(n) +
+               " outputs but caller preallocated " +
+               std::to_string(*num_outputs));
+      Py_DECREF(res);
+      return -1;
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      Handle* dst = static_cast<Handle*>((*outputs)[i]);
+      PyObject* r = CallShim("copy_into", "(OO)", dst->obj,
+                             PyList_GetItem(res, i));
+      if (!r) {
+        Py_DECREF(res);
+        return -1;
+      }
+      Py_DECREF(r);
+    }
+    Py_DECREF(res);
+    return 0;
+  }
   NDArrayHandle* arr = static_cast<NDArrayHandle*>(
       std::malloc(sizeof(NDArrayHandle) * n));
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -245,8 +269,11 @@ int MXImperativeInvoke(const char* op_name, int num_inputs,
 int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
   if (!EnsurePython()) return -1;
   Gil gil;
-  static std::vector<std::string> names;
-  static std::vector<const char*> ptrs;
+  // Per-thread ret store (matches the per-thread MXGetLastError contract;
+  // ref keeps these in MXAPIThreadLocalEntry): pointers handed to one
+  // thread survive other threads' calls.
+  thread_local std::vector<std::string> names;
+  thread_local std::vector<const char*> ptrs;
   PyObject* res = CallShim("all_op_names", "()");
   if (!res) return -1;
   names.clear();
@@ -288,8 +315,8 @@ int MXNDArrayLoad(const char* fname, mx_uint* out_size,
                   const char*** out_names) {
   if (!EnsurePython()) return -1;
   Gil gil;
-  static std::vector<std::string> names;
-  static std::vector<const char*> name_ptrs;
+  thread_local std::vector<std::string> names;       // per-thread ret store
+  thread_local std::vector<const char*> name_ptrs;
   PyObject* res = CallShim("load_file", "(s)", fname);
   if (!res) return -1;
   PyObject* arrays = PyTuple_GetItem(res, 0);

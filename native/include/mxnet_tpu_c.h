@@ -10,9 +10,13 @@
  *  - every function returns 0 on success, -1 on error;
  *    MXGetLastError() describes the last failure on this thread.
  *  - NDArrayHandle owns a reference; release with MXNDArrayFree.
- *  - MXImperativeInvoke allocates *outputs with malloc when
- *    *num_outputs == 0 on entry; the caller frees each handle with
- *    MXNDArrayFree and the array itself with free().
+ *  - MXImperativeInvoke: *num_outputs MUST be initialized on entry.
+ *    0 means "allocate": *outputs is malloc'd and the caller frees each
+ *    handle with MXNDArrayFree and the array itself with free().
+ *    Nonzero means "preallocated" (reference out-array semantics): the
+ *    op writes INTO the *num_outputs valid handles at *outputs; a count
+ *    or shape mismatch is an error. Garbage in *num_outputs routes into
+ *    the preallocated path and is undefined behavior.
  *  - dtype codes: 0=float32 1=float64 2=float16 3=uint8 4=int32
  *    5=int8 6=int64 (reference mshadow type flags).
  *  - dev_type: 1=cpu 2=gpu 3=cpu_pinned 6=tpu.

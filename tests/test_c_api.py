@@ -197,3 +197,49 @@ def test_cpp_raii_wrapper(tmp_path):
                          timeout=300, env=env)
     assert out.returncode == 0, out.stderr
     assert "CPP-API-OK" in out.stdout
+
+
+def test_imperative_invoke_preallocated_outputs():
+    """*num_outputs != 0 on entry means the caller preallocated the output
+    handles and the op must write INTO them (reference out-array
+    semantics) — r4 advice: they used to be leaked and replaced."""
+    import ctypes
+    import mxnet_tpu  # noqa: F401
+    lib = ctypes.CDLL(SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+    shape = (ctypes.c_uint * 1)(4)
+    src, dst = ctypes.c_void_p(), ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(src)) == 0
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(dst)) == 0
+    buf = (ctypes.c_float * 4)(4.0, 9.0, 16.0, 25.0)
+    assert lib.MXNDArraySyncCopyFromCPU(src, buf, 4) == 0
+
+    n_out = ctypes.c_int(1)                       # preallocated!
+    out_arr = (ctypes.c_void_p * 1)(dst.value)
+    outs = ctypes.cast(out_arr, ctypes.POINTER(ctypes.c_void_p))
+    rc = lib.MXImperativeInvoke(b"sqrt", 1, ctypes.byref(src),
+                                ctypes.byref(n_out), ctypes.byref(outs),
+                                0, None, None)
+    assert rc == 0, lib.MXGetLastError()
+    assert n_out.value == 1
+    assert outs[0] == dst.value, "handle must be written into, not replaced"
+    got = (ctypes.c_float * 4)()
+    assert lib.MXNDArraySyncCopyToCPU(dst, got, 4) == 0
+    np.testing.assert_allclose(list(got), [2.0, 3.0, 4.0, 5.0], rtol=1e-6)
+
+    # count mismatch is a loud error, not silent replacement
+    n_bad = ctypes.c_int(2)
+    rc = lib.MXImperativeInvoke(b"sqrt", 1, ctypes.byref(src),
+                                ctypes.byref(n_bad), ctypes.byref(outs),
+                                0, None, None)
+    assert rc != 0
+    assert b"preallocated" in lib.MXGetLastError()
+    lib.MXNDArrayFree(src)
+    lib.MXNDArrayFree(dst)

@@ -115,3 +115,29 @@ def test_variable_lr_mult_reaches_optimizer():
     opt = mx.optimizer.SGD(learning_rate=1.0, sym=net,
                            param_idx2name={0: "fcw"})
     assert opt._get_lr(0) == 0.25
+
+
+def test_string_form_init_attr_accepted():
+    """Gluon-default string attrs (init="zeros") must initialize like the
+    reference's create(name-or-JSON) (ref python/mxnet/initializer.py:134).
+    Regression: r4 only parsed the JSON form and crashed Module.init_params
+    on baseline workload #4 (inception-v3 multi-device kvstore)."""
+    import mxnet_tpu as mx
+    net = mx.sym.FullyConnected(
+        mx.sym.Variable("data"),
+        weight=mx.sym.Variable("fcw", init="ones"),
+        num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.initializer.Zero())
+    w = mod._exec_group.execs[0].arg_dict["fcw"].asnumpy()
+    assert np.all(w == 1.0), "string-form __init__ attr ignored or crashed"
+    # create() itself must accept name, JSON, and instance forms.
+    assert isinstance(mx.initializer.create("zeros"), mx.initializer.Zero)
+    assert isinstance(mx.initializer.create('["uniform", {"scale": 0.1}]'),
+                      mx.initializer.Uniform)
+    inst = mx.initializer.Normal(0.5)
+    assert mx.initializer.create(inst) is inst

@@ -17,9 +17,17 @@ def test_parse_hostfile(tmp_path):
         ("node1", 2), ("node2", 1), ("node3", 4)]
 
 
-def test_assign_hosts_round_robin_with_slots():
+def test_assign_hosts_slots_are_hard_capacity():
     hosts = [("a", 2), ("b", 1)]
-    assert launch_mod._assign_hosts(hosts, 5) == ["a", "a", "b", "a", "a"]
+    assert launch_mod._assign_hosts(hosts, 3) == ["a", "a", "b"]
+    assert launch_mod._assign_hosts(hosts, 2) == ["a", "a"]
+    # over-request returns short so build_ssh_commands fails loudly
+    # instead of silently oversubscribing a host (r4 advice)
+    assert launch_mod._assign_hosts(hosts, 5) == ["a", "a", "b"]
+    with pytest.raises(ValueError, match="usable slots"):
+        launch_mod.build_ssh_commands(
+            5, 0, ["python", "x.py"], hosts=hosts, scheduler_host="head",
+            sched_port=9000, coord_port=9001)
 
 
 def test_build_ssh_commands_contract():

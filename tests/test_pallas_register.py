@@ -125,3 +125,30 @@ def test_unregister_removes_wrappers(_cleanup):
 def test_builtin_protected_from_unregister():
     with pytest.raises(mx.MXNetError):
         mx.pallas.unregister("Convolution")
+
+
+def test_force_over_builtin_restored_on_unregister():
+    """force=True over a built-in must stash the original op and restore
+    it (registry + nd/sym wrappers) on unregister — r4 advice: deleting
+    the built-in left the framework without a core operator."""
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+    original = OP_REGISTRY["relu"]
+    x = nd.array(np.array([-1.0, 2.0], np.float32))
+
+    def fake_relu(a):
+        return a * 0.0 + 7.0
+
+    try:
+        mx.pallas.register("relu", fake_relu, force=True)
+        assert np.allclose(nd.relu(x).asnumpy(), 7.0)
+    finally:
+        mx.pallas.unregister("relu")
+    assert OP_REGISTRY["relu"] is original
+    assert np.allclose(nd.relu(x).asnumpy(), [0.0, 2.0])
+    # double force-register then unregister still restores the ORIGINAL
+    try:
+        mx.pallas.register("relu", fake_relu, force=True)
+        mx.pallas.register("relu", fake_relu, force=True)
+    finally:
+        mx.pallas.unregister("relu")
+    assert OP_REGISTRY["relu"] is original

@@ -110,20 +110,22 @@ def parse_hostfile(path):
 
 
 def _assign_hosts(hosts, n):
-    """Round-robin *n* ranks over (host, slots) honoring slot counts."""
+    """Assign *n* ranks over (host, slots); slots are hard PER-ROLE
+    capacity.
+
+    One rank per slot, hosts in hostfile order; returns fewer than *n*
+    entries when the hostfile is short so the caller's loud ValueError
+    can fire instead of silently oversubscribing a host. Capacity is
+    counted per role: a host with 2 slots takes up to 2 workers AND up
+    to 2 servers — server/worker colocation is the normal PS deployment
+    (the reference's dmlc ssh tracker assigns roles independently too)."""
     out = []
-    while len(out) < n:
-        progressed = False
-        for host, slots in hosts:
-            take = min(slots, n - len(out))
-            if take > 0:
-                out.extend([host] * take)
-                progressed = True
-            if len(out) >= n:
-                break
-        if not progressed:
+    for host, slots in hosts:
+        take = min(slots, n - len(out))
+        out.extend([host] * take)
+        if len(out) >= n:
             break
-    return out[:n]
+    return out
 
 
 def build_ssh_commands(num_workers, num_servers, cmd, hosts,
