@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Parallel advantage actor-critic: batched envs, one forward per step.
+
+Parity target: reference ``example/reinforcement-learning/a3c/`` +
+``parallel_actor_critic/`` — N environments advanced in lockstep, ONE
+batched policy/value forward per timestep (train.py:31-75), trajectories
+accumulated per env, discounted returns + advantage (R - V) driving the
+policy-gradient loss and an L2 value loss, with an entropy bonus for
+exploration (model.py loss assembly). The reference's async multi-worker
+variant shards envs over processes; here env parallelism is a BATCH
+dimension — the TPU-native layout, where one XLA program serves all envs
+and scaling envs means growing the batch, not forking workers.
+
+Gym/Atari is replaced by a vectorized windy-corridor (zero-egress).
+
+    python examples/a3c_parallel.py --num-updates 150
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class VectorCorridor(object):
+    """N independent 1-D corridors advanced in lockstep (numpy-batched).
+    +1 at the right end, -1 at the left, -0.01 per step, cap 4n steps."""
+
+    def __init__(self, num_envs, n=9, seed=0):
+        self.num_envs, self.n = num_envs, n
+        self.rng = np.random.RandomState(seed)
+        self.pos = np.full(num_envs, n // 2)
+        self.t = np.zeros(num_envs, np.int32)
+
+    def obs(self):
+        one = np.zeros((self.num_envs, self.n), np.float32)
+        one[np.arange(self.num_envs), self.pos] = 1.0
+        return one
+
+    def step(self, actions):
+        self.pos += np.where(actions == 1, 1, -1)
+        # stochastic headwind near the goal
+        wind = (self.pos >= self.n - 3) & (self.rng.rand(self.num_envs) < 0.2)
+        self.pos = np.clip(self.pos - wind, 0, self.n - 1)
+        self.t += 1
+        reward = np.full(self.num_envs, -0.01, np.float32)
+        done = np.zeros(self.num_envs, bool)
+        done |= self.pos <= 0
+        reward[self.pos <= 0] = -1.0
+        done |= self.pos >= self.n - 1
+        reward[self.pos >= self.n - 1] = 1.0
+        done |= self.t >= 4 * self.n
+        if done.any():             # auto-reset finished envs
+            self.pos[done] = self.n // 2
+            self.t[done] = 0
+        return self.obs(), reward, done
+
+
+class ACNet(gluon.Block):
+    """Shared trunk + policy/value heads (ref a3c/sym.py:24-39)."""
+
+    def __init__(self, obs_dim, n_actions, hidden=64):
+        super().__init__()
+        self.trunk = nn.Dense(hidden, in_units=obs_dim, activation="relu")
+        self.policy = nn.Dense(n_actions, in_units=hidden)
+        self.value = nn.Dense(1, in_units=hidden)
+
+    def forward(self, x):
+        h = self.trunk(x)
+        return self.policy(h), self.value(h)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-updates", type=int, default=150)
+    ap.add_argument("--num-envs", type=int, default=16)
+    ap.add_argument("--t-max", type=int, default=20)   # rollout length
+    ap.add_argument("--gamma", type=float, default=0.97)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--entropy-beta", type=float, default=0.01)
+    args = ap.parse_args()
+
+    envs = VectorCorridor(args.num_envs, seed=3)
+    rng = np.random.RandomState(4)
+    net = ACNet(envs.n, 2)
+    net.collect_params().initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    obs = envs.obs()
+    recent = []
+    for update in range(args.num_updates):
+        # ---- rollout: t_max lockstep env steps, one batched fwd each ----
+        traj_obs, traj_act, traj_rew, traj_done = [], [], [], []
+        for _ in range(args.t_max):
+            logits, _ = net(mx.nd.array(obs))
+            probs = np.asarray(
+                mx.nd.softmax(logits).asnumpy(), np.float64)
+            probs /= probs.sum(axis=1, keepdims=True)
+            acts = np.array([rng.choice(2, p=p) for p in probs])
+            nxt, rew, done = envs.step(acts)
+            traj_obs.append(obs)
+            traj_act.append(acts)
+            traj_rew.append(rew)
+            traj_done.append(done)
+            obs = nxt
+        recent.append(np.concatenate(traj_rew).mean())
+
+        # ---- n-step discounted returns, zeroed at episode ends ----
+        _, v_last = net(mx.nd.array(obs))
+        ret = v_last.asnumpy()[:, 0]
+        returns = np.zeros((args.t_max, args.num_envs), np.float32)
+        for t in reversed(range(args.t_max)):
+            ret = np.where(traj_done[t], 0.0, ret)
+            ret = traj_rew[t] + args.gamma * ret
+            returns[t] = ret
+
+        flat_obs = np.concatenate(traj_obs)                 # (T*N, obs)
+        flat_act = np.concatenate(traj_act).astype(np.float32)
+        flat_ret = returns.reshape(-1)
+
+        # ---- ONE batched policy-gradient + value + entropy update ----
+        with autograd.record():
+            logits, values = net(mx.nd.array(flat_obs))
+            logp = mx.nd.log_softmax(logits)
+            p = mx.nd.softmax(logits)
+            chosen = mx.nd.sum(
+                logp * mx.nd.one_hot(mx.nd.array(flat_act), 2), axis=1)
+            adv = mx.nd.array(flat_ret) - mx.nd.reshape(values, (-1,))
+            pg_loss = -mx.nd.mean(chosen * mx.nd.BlockGrad(adv))
+            v_loss = mx.nd.mean(mx.nd.square(adv))
+            entropy = -mx.nd.mean(mx.nd.sum(p * logp, axis=1))
+            loss = pg_loss + 0.5 * v_loss - args.entropy_beta * entropy
+        loss.backward()
+        trainer.step(1)
+
+        if (update + 1) % 30 == 0:
+            print("update %d mean-step-reward %.4f"
+                  % (update + 1, np.mean(recent[-30:])))
+
+    print("final-mean-step-reward %.4f" % np.mean(recent[-30:]))
+
+
+if __name__ == "__main__":
+    main()

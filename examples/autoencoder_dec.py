@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Stacked autoencoder pretraining + DEC (Deep Embedded Clustering).
+
+Parity target: reference ``example/autoencoder/`` +
+``example/dec/dec.py`` — ``autoencoder.py:30-149`` builds a symmetric
+encoder/decoder stack with layerwise pretraining then end-to-end
+finetune; ``dec.py:45-130`` takes the trained encoder, initializes
+cluster centers with k-means in embedding space, forms the Student-t
+soft assignment
+
+    q_ij = (1 + |z_i - mu_j|^2 / alpha)^-((alpha+1)/2)  (normalized)
+
+sharpens it into the target distribution ``p = q^2 / f`` (f = column
+sums, dec.py:96-101), and minimizes KL(p || q) over encoder + centers.
+
+MNIST + sklearn KMeans are replaced by synthetic nonlinearly-embedded
+Gaussian blobs and an in-file numpy k-means (zero-egress); cluster
+accuracy uses the best label permutation (dec.py:35-42 cluster_acc).
+
+TPU note: each stage (layer pretrain, finetune, DEC epoch) is a single
+hybridized program over the full batch — the DEC q/p math is pure
+elementwise + matmul, ideal XLA fusion fodder.
+
+    python examples/autoencoder_dec.py --num-points 600
+"""
+import argparse
+import itertools
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def make_blobs(n, dim, k, rng):
+    """k Gaussian blobs pushed through a fixed nonlinearity into dim-D."""
+    centers = rng.randn(k, 3) * 5.0
+    y = rng.randint(0, k, n)
+    z = centers[y] + rng.randn(n, 3) * 0.4
+    proj = rng.randn(3, dim)
+    x = np.tanh(0.4 * (z @ proj)) + 0.05 * rng.randn(n, dim)
+    return x.astype(np.float32), y
+
+
+def kmeans(z, k, rng, iters=50):
+    centers = z[rng.choice(len(z), k, replace=False)].copy()
+    for _ in range(iters):
+        d = ((z[:, None, :] - centers[None]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        for j in range(k):
+            pts = z[assign == j]
+            if len(pts):
+                centers[j] = pts.mean(0)
+    return centers, assign
+
+
+def cluster_acc(pred, truth, k):
+    """Best-permutation accuracy (ref dec.py:35-42)."""
+    best = 0.0
+    for perm in itertools.permutations(range(k)):
+        mapped = np.array([perm[p] for p in pred])
+        best = max(best, float((mapped == truth).mean()))
+    return best
+
+
+class StackedAE(gluon.Block):
+    """Symmetric encoder/decoder (ref autoencoder.py:31-78): dims
+    d0-d1-...-dk mirrored back, relu inside, linear embedding/output."""
+
+    def __init__(self, dims):
+        super().__init__()
+        self.enc, self.dec = [], []
+        for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+            act = None if i == len(dims) - 2 else "relu"
+            layer = nn.Dense(b, in_units=a, activation=act)
+            self.enc.append(layer)
+            setattr(self, "enc%d" % i, layer)   # auto-registers the child
+        for i, (a, b) in enumerate(zip(dims[::-1][:-1], dims[::-1][1:])):
+            act = None if i == len(dims) - 2 else "relu"
+            layer = nn.Dense(b, in_units=a, activation=act)
+            self.dec.append(layer)
+            setattr(self, "dec%d" % i, layer)
+
+    def encode(self, x, depth=None):
+        for layer in self.enc[:depth]:
+            x = layer(x)
+        return x
+
+    def forward(self, x, depth=None):
+        """Full round-trip, or the depth-truncated sub-autoencoder used
+        by layerwise pretraining (ref autoencoder.py:151-169)."""
+        if depth is None:
+            depth = len(self.enc)
+        z = self.encode(x, depth)
+        for layer in self.dec[len(self.dec) - depth:]:
+            z = layer(z)
+        return z
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-points", type=int, default=600)
+    ap.add_argument("--input-dim", type=int, default=20)
+    ap.add_argument("--num-clusters", type=int, default=4)
+    ap.add_argument("--pretrain-epochs", type=int, default=40)
+    ap.add_argument("--finetune-epochs", type=int, default=80)
+    ap.add_argument("--dec-epochs", type=int, default=60)
+    ap.add_argument("--alpha", type=float, default=1.0)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    args = ap.parse_args()
+
+    np.random.seed(42)      # Xavier draws from the global numpy RNG
+    mx.random.seed(42)
+    rng = np.random.RandomState(9)
+    x, y = make_blobs(args.num_points, args.input_dim, args.num_clusters,
+                      rng)
+    xd = mx.nd.array(x)
+    dims = [args.input_dim, 16, 8, 3]
+    ae = StackedAE(dims)
+    ae.collect_params().initialize(mx.init.Xavier())
+    l2 = gluon.loss.L2Loss()
+
+    # ---- stage 1a: layerwise pretraining (ref autoencoder.py:151) ----
+    for depth in range(1, len(dims)):
+        trainer = gluon.Trainer(ae.collect_params(), "adam",
+                                {"learning_rate": args.lr})
+        for _ in range(args.pretrain_epochs):
+            with autograd.record():
+                loss = l2(ae.forward(xd, depth=depth), xd)
+            loss.backward()
+            trainer.step(len(x))
+
+    # ---- stage 1b: end-to-end finetune (ref autoencoder.py:171) ----
+    err0 = float(l2(ae(xd), xd).asnumpy().mean())
+    trainer = gluon.Trainer(ae.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    for _ in range(args.finetune_epochs):
+        with autograd.record():
+            loss = l2(ae(xd), xd)
+        loss.backward()
+        trainer.step(len(x))
+    err1 = float(l2(ae(xd), xd).asnumpy().mean())
+    print("recon-error %.5f -> %.5f" % (err0, err1))
+
+    # ---- stage 2: DEC (ref dec.py:83-130) ----
+    z = ae.encode(xd).asnumpy()
+    centers_np, assign0 = kmeans(z, args.num_clusters, rng)
+    acc0 = cluster_acc(assign0, y, args.num_clusters)
+    centers = mx.nd.array(centers_np)
+    centers.attach_grad()
+    params = ae.collect_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": args.lr})
+
+    for epoch in range(args.dec_epochs):
+        with autograd.record():
+            zz = ae.encode(xd)                               # (N, 3)
+            d2 = mx.nd.sum(
+                mx.nd.square(mx.nd.expand_dims(zz, 1) -
+                             mx.nd.expand_dims(centers, 0)), axis=2)
+            q = (1.0 + d2 / args.alpha) ** (-(args.alpha + 1.0) / 2.0)
+            q = q / mx.nd.sum(q, axis=1, keepdims=True)
+            qn = q.asnumpy()
+            p = qn ** 2 / qn.sum(0, keepdims=True)           # sharpen
+            p = p / p.sum(1, keepdims=True)
+            kl = mx.nd.sum(mx.nd.array(p) *
+                           (mx.nd.log(mx.nd.array(p) + 1e-10) -
+                            mx.nd.log(q + 1e-10))) / len(x)
+        kl.backward()
+        trainer.step(1)
+        centers -= args.lr * 10.0 * centers.grad             # center SGD
+        centers.attach_grad()
+
+    zz = ae.encode(xd).asnumpy()
+    d2 = ((zz[:, None, :] - centers.asnumpy()[None]) ** 2).sum(-1)
+    acc1 = cluster_acc(d2.argmin(1), y, args.num_clusters)
+    print("kmeans-acc %.4f" % acc0)
+    print("final-dec-acc %.4f" % acc1)
+
+
+if __name__ == "__main__":
+    main()

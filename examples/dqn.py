@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Deep Q-Network with replay memory and a target network.
+
+Parity target: reference ``example/reinforcement-learning/dqn/`` —
+``replay_memory.py`` (ring-buffer transitions, uniform minibatch
+sampling), ``dqn_demo.py:45-180`` (epsilon-greedy exploration with a
+linear decay schedule, periodic hard target-network sync, TD(0) targets
+``r + gamma * max_a' Q_target(s', a')``, Huber-style clipped loss), and
+``base.py``'s policy/target twin-network arrangement.
+
+The Atari emulator is replaced by a windy-gridworld environment
+(zero-egress): 6x6 grid, the agent must reach a goal while a stochastic
+wind pushes it off course — enough structure that a Q net clearly beats
+the random policy within a few hundred episodes.
+
+TPU note: the Q-step (batched forward of policy AND target nets + TD
+loss + SGD) is one hybridized gluon program per batch shape — the
+replay batch is the unit of compilation, not the single transition.
+
+    python examples/dqn.py --num-episodes 300
+"""
+import argparse
+import os
+import sys
+from collections import deque
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class WindyGrid(object):
+    """6x6 grid; actions U/D/L/R; wind in middle columns pushes up with
+    probability 0.3; +1 at goal, -0.02 per step, episodes cap at 40."""
+
+    def __init__(self, n=6, seed=0):
+        self.n = n
+        self.rng = np.random.RandomState(seed)
+        self.goal = (n - 1, n - 1)
+        self.reset()
+
+    def reset(self):
+        self.pos = [0, 0]
+        self.t = 0
+        return self.obs()
+
+    def obs(self):
+        one = np.zeros(self.n * self.n, np.float32)
+        one[self.pos[0] * self.n + self.pos[1]] = 1.0
+        return one
+
+    def step(self, a):
+        dr, dc = [(-1, 0), (1, 0), (0, -1), (0, 1)][a]
+        self.pos[0] = min(max(self.pos[0] + dr, 0), self.n - 1)
+        self.pos[1] = min(max(self.pos[1] + dc, 0), self.n - 1)
+        if 2 <= self.pos[1] <= 3 and self.rng.rand() < 0.3:   # wind
+            self.pos[0] = max(self.pos[0] - 1, 0)
+        self.t += 1
+        if tuple(self.pos) == self.goal:
+            return self.obs(), 1.0, True
+        if self.t >= 40:
+            return self.obs(), 0.0, True
+        return self.obs(), -0.02, False
+
+
+class ReplayMemory(object):
+    """Uniform-sampling ring buffer (ref replay_memory.py)."""
+
+    def __init__(self, capacity, obs_dim):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.act = np.zeros(capacity, np.int32)
+        self.rew = np.zeros(capacity, np.float32)
+        self.nxt = np.zeros((capacity, obs_dim), np.float32)
+        self.done = np.zeros(capacity, np.float32)
+        self.size = self.head = 0
+
+    def push(self, s, a, r, s2, d):
+        i = self.head
+        self.obs[i], self.act[i], self.rew[i] = s, a, r
+        self.nxt[i], self.done[i] = s2, float(d)
+        self.head = (self.head + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, rng, batch):
+        idx = rng.randint(0, self.size, batch)
+        return (self.obs[idx], self.act[idx], self.rew[idx],
+                self.nxt[idx], self.done[idx])
+
+
+def make_qnet(n_actions):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(n_actions))
+    return net
+
+
+def sync_target(policy, target):
+    """Hard target sync (ref dqn_demo.py periodic copyto)."""
+    src = policy.collect_params()
+    dst = target.collect_params()
+    for (_, p), (_, t) in zip(sorted(src.items()), sorted(dst.items())):
+        p.data().copyto(t.data())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-episodes", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--gamma", type=float, default=0.98)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--sync-every", type=int, default=200)
+    ap.add_argument("--train-every", type=int, default=4)
+    ap.add_argument("--eps-decay-episodes", type=int, default=200)
+    args = ap.parse_args()
+
+    env = WindyGrid(seed=1)
+    rng = np.random.RandomState(2)
+    obs_dim, n_actions = env.n * env.n, 4
+
+    policy, target = make_qnet(n_actions), make_qnet(n_actions)
+    policy.initialize(mx.init.Xavier())
+    target.initialize(mx.init.Xavier())
+    policy.hybridize()
+    target.hybridize()
+    dummy = mx.nd.zeros((1, obs_dim))   # materialize deferred params
+    policy(dummy)
+    target(dummy)
+    sync_target(policy, target)
+    trainer = gluon.Trainer(policy.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.HuberLoss()
+    memory = ReplayMemory(5000, obs_dim)
+
+    steps, returns = 0, deque(maxlen=50)
+    for ep in range(args.num_episodes):
+        s = env.reset()
+        done, ep_ret = False, 0.0
+        eps = max(0.05, 1.0 - ep / float(args.eps_decay_episodes))
+        while not done:
+            if rng.rand() < eps:
+                a = rng.randint(n_actions)
+            else:
+                q = policy(mx.nd.array(s[None])).asnumpy()
+                a = int(q.argmax())
+            s2, r, done = env.step(a)
+            memory.push(s, a, r, s2, done)
+            s, ep_ret = s2, ep_ret + r
+            steps += 1
+
+            if memory.size >= 200 and steps % args.train_every == 0:
+                bs, ba, br, bn, bd = memory.sample(rng, args.batch_size)
+                q_next = target(mx.nd.array(bn)).asnumpy().max(axis=1)
+                td = br + args.gamma * q_next * (1.0 - bd)
+                tgt = mx.nd.array(td)
+                act = mx.nd.array(ba.astype(np.float32))
+                with autograd.record():
+                    q_all = policy(mx.nd.array(bs))
+                    q_sel = mx.nd.sum(
+                        q_all * mx.nd.one_hot(act, n_actions), axis=1)
+                    loss = loss_fn(q_sel, tgt)
+                loss.backward()
+                trainer.step(args.batch_size)
+            if steps % args.sync_every == 0:
+                sync_target(policy, target)
+        returns.append(ep_ret)
+        if (ep + 1) % 50 == 0:
+            print("episode %d eps %.2f mean-return %.3f"
+                  % (ep + 1, eps, np.mean(returns)))
+
+    # greedy evaluation
+    eval_rets = []
+    for _ in range(20):
+        s = env.reset()
+        done, total = False, 0.0
+        while not done:
+            a = int(policy(mx.nd.array(s[None])).asnumpy().argmax())
+            s, r, done = env.step(a)
+            total += r
+        eval_rets.append(total)
+    print("final-greedy-return %.3f" % np.mean(eval_rets))
+
+
+if __name__ == "__main__":
+    main()

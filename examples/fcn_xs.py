@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""FCN-xs semantic segmentation: fully-convolutional net with staged
+skip fusion (32s -> 16s -> 8s) and bilinear-initialized Deconvolution
+upsampling.
+
+Parity target: reference ``example/fcn-xs/`` — ``symbol_fcnxs.py`` builds
+fcn32s/fcn16s/fcn8s heads over a conv backbone (score heads on pool3/
+pool4, Deconvolution upscores fused by summation, per-pixel
+``SoftmaxOutput(multi_output=True)``), ``init_fcnxs.py:28-36`` seeds the
+deconv kernels with the bilinear upsample filter. This rebuild keeps
+that exact architecture shape on a compact backbone and replaces the
+pretrained-VGG + PASCAL pipeline with a synthetic shapes corpus
+(zero-egress), so the learnability gate runs anywhere.
+
+TPU notes: the whole net is one jitted XLA program through Module; the
+deconvolutions lower to conv_transpose on the MXU; static 32x32 shapes
+avoid the reference's crop-offset algebra (symbol_fcnxs.py:21-81) that
+existed only because VGG pad=100 made shapes dynamic.
+
+    python examples/fcn_xs.py --num-epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_shapes_dataset(n, size, rng):
+    """Images with a bright rectangle (class 1) and a darker disk
+    (class 2) on noisy background (class 0)."""
+    x = rng.rand(n, 3, size, size).astype(np.float32) * 0.2
+    y = np.zeros((n, size, size), np.float32)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        # rectangle
+        h, w = rng.randint(size // 4, size // 2, 2)
+        r0, c0 = rng.randint(0, size - h), rng.randint(0, size - w)
+        x[i, :, r0:r0 + h, c0:c0 + w] += 0.8
+        y[i, r0:r0 + h, c0:c0 + w] = 1
+        # disk (drawn second: occludes)
+        rad = rng.randint(size // 8, size // 4)
+        cy, cx = rng.randint(rad, size - rad, 2)
+        disk = (yy - cy) ** 2 + (xx - cx) ** 2 <= rad ** 2
+        x[i, 0][disk] += 0.5
+        x[i, 1][disk] -= 0.1
+        y[i][disk] = 2
+    return x, y
+
+
+def fcn_symbol(num_classes, style="fcn8s"):
+    """Backbone with three pooling stages + staged skip fusion, the
+    fcn-xs head topology (ref symbol_fcnxs.py:84-167)."""
+    data = mx.sym.Variable("data")
+
+    def block(x, ch, name):
+        x = mx.sym.Convolution(x, kernel=(3, 3), pad=(1, 1),
+                               num_filter=ch, name="conv_%s" % name)
+        x = mx.sym.Activation(x, act_type="relu")
+        return mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max", name="pool_%s" % name)
+
+    p1 = block(data, 16, "1")                      # /2
+    p2 = block(p1, 32, "2")                        # /4
+    p3 = block(p2, 64, "3")                        # /8
+
+    score3 = mx.sym.Convolution(p3, kernel=(1, 1), num_filter=num_classes,
+                                name="score_pool3")
+    if style == "fcn32s":
+        up = mx.sym.Deconvolution(
+            score3, kernel=(16, 16), stride=(8, 8), pad=(4, 4),
+            num_filter=num_classes, no_bias=True, name="bigscore")
+        return mx.sym.SoftmaxOutput(up, mx.sym.Variable("softmax_label"),
+                                    multi_output=True, name="softmax")
+
+    # 16s: fuse pool2 evidence at /4
+    up3 = mx.sym.Deconvolution(
+        score3, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+        num_filter=num_classes, no_bias=True, name="score2")
+    score2 = mx.sym.Convolution(p2, kernel=(1, 1), num_filter=num_classes,
+                                name="score_pool2")
+    fuse2 = up3 + score2
+    if style == "fcn16s":
+        up = mx.sym.Deconvolution(
+            fuse2, kernel=(8, 8), stride=(4, 4), pad=(2, 2),
+            num_filter=num_classes, no_bias=True, name="bigscore")
+        return mx.sym.SoftmaxOutput(up, mx.sym.Variable("softmax_label"),
+                                    multi_output=True, name="softmax")
+
+    # 8s: fuse pool1 evidence at /2
+    up2 = mx.sym.Deconvolution(
+        fuse2, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+        num_filter=num_classes, no_bias=True, name="score4")
+    score1 = mx.sym.Convolution(p1, kernel=(1, 1), num_filter=num_classes,
+                                name="score_pool1")
+    fuse1 = up2 + score1
+    up = mx.sym.Deconvolution(
+        fuse1, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+        num_filter=num_classes, no_bias=True, name="bigscore")
+    return mx.sym.SoftmaxOutput(up, mx.sym.Variable("softmax_label"),
+                                multi_output=True, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--style", default="fcn8s",
+                    choices=["fcn32s", "fcn16s", "fcn8s"])
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--num-images", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.003)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(7)
+    x, y = make_shapes_dataset(args.num_images, args.image_size, rng)
+    xv, yv = make_shapes_dataset(64, args.image_size, rng)
+
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+    sym = fcn_symbol(args.num_classes, args.style)
+
+    mod = mx.mod.Module(sym, context=mx.context.current_context())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    # bilinear-seeded DECONV kernels only (ref init_fcnxs.py:28-36
+    # upsample_filt); the 1x1 score convs stay Xavier
+    mod.init_params(initializer=mx.initializer.Mixed(
+        ["(score2|score4|bigscore)_weight", ".*"],
+        [mx.initializer.Bilinear(), mx.initializer.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params=(("learning_rate", args.lr),))
+    for epoch in range(args.num_epochs):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+
+    # pixel accuracy + mean IoU on held-out images
+    vit = mx.io.NDArrayIter(xv, yv, batch_size=args.batch_size,
+                            label_name="softmax_label")
+    correct = total = 0
+    inter = np.zeros(args.num_classes)
+    union = np.zeros(args.num_classes)
+    for batch in vit:
+        mod.forward(batch, is_train=False)
+        scores = mod.get_outputs()[0].asnumpy()     # (N, C, H, W)
+        pred = scores.argmax(axis=1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum()
+        total += lab.size
+        for c in range(args.num_classes):
+            inter[c] += ((pred == c) & (lab == c)).sum()
+            union[c] += ((pred == c) | (lab == c)).sum()
+    miou = float(np.mean(inter / np.maximum(union, 1)))
+    majority = max((yv == c).mean() for c in range(args.num_classes))
+    print("majority-baseline %.4f" % majority)
+    print("final-miou %.4f" % miou)
+    print("final-pixel-acc %.4f" % (correct / total))
+
+
+if __name__ == "__main__":
+    main()

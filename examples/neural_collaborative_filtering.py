@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Neural collaborative filtering: GMF + MLP fusion over implicit
+feedback with negative sampling and ranking metrics.
+
+Parity target: reference ``example/recommenders/`` — ``demo2-binary.*``
+and ``symbol_alexnet.py``-style deep recommenders go beyond plain
+matrix factorization (covered by ``examples/matrix_factorization.py``)
+to binary/implicit feedback with non-linear interaction models and
+negative sampling (``negativesample.py``). The NeuMF topology used here
+(a generalized-MF elementwise branch + an MLP branch over concatenated
+user/item embeddings, fused into one logit) is the standard deep
+recommender the reference's recommenders README points at.
+
+Data: synthetic implicit feedback from a planted low-rank + nonlinear
+preference model; evaluation is leave-one-out HR@10 / NDCG@10 against
+99 sampled negatives — the reference recommenders' protocol.
+
+    python examples/neural_collaborative_filtering.py --num-epochs 6
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class NeuMF(gluon.Block):
+    """GMF branch (elementwise product) + MLP branch, fused logit."""
+
+    def __init__(self, n_users, n_items, dim=16):
+        super().__init__()
+        self.u_gmf = nn.Embedding(n_users, dim)
+        self.i_gmf = nn.Embedding(n_items, dim)
+        self.u_mlp = nn.Embedding(n_users, dim)
+        self.i_mlp = nn.Embedding(n_items, dim)
+        self.mlp = nn.HybridSequential()
+        self.mlp.add(nn.Dense(32, activation="relu"),
+                     nn.Dense(16, activation="relu"))
+        self.head = nn.Dense(1, in_units=dim + 16)
+
+    def forward(self, users, items):
+        gmf = self.u_gmf(users) * self.i_gmf(items)
+        mlp = self.mlp(mx.nd.concat(self.u_mlp(users),
+                                    self.i_mlp(items), dim=1))
+        return self.head(mx.nd.concat(gmf, mlp, dim=1))[:, 0]
+
+
+def make_interactions(n_users, n_items, rng, per_user=12):
+    """Planted preference: low-rank affinity + nonlinearity; each user
+    'consumes' their top-scoring items (implicit positives)."""
+    uf = rng.randn(n_users, 4)
+    vf = rng.randn(n_items, 4)
+    score = np.tanh(uf @ vf.T) + 0.1 * rng.randn(n_users, n_items)
+    positives = {}
+    for u in range(n_users):
+        positives[u] = set(np.argsort(-score[u])[:per_user].tolist())
+    return positives
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-users", type=int, default=64)
+    ap.add_argument("--num-items", type=int, default=200)
+    ap.add_argument("--num-epochs", type=int, default=24)
+    ap.add_argument("--num-negatives", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.005)
+    args = ap.parse_args()
+
+    np.random.seed(7)
+    mx.random.seed(7)
+    rng = np.random.RandomState(12)
+    positives = make_interactions(args.num_users, args.num_items, rng)
+
+    # leave-one-out: hold out one positive per user for ranking eval
+    held, train_pos = {}, {}
+    for u, items in positives.items():
+        items = sorted(items)
+        held[u] = items[rng.randint(len(items))]
+        train_pos[u] = [i for i in items if i != held[u]]
+
+    net = NeuMF(args.num_users, args.num_items)
+    net.collect_params().initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    for epoch in range(args.num_epochs):
+        users, items, labels = [], [], []
+        for u, its in train_pos.items():
+            for i in its:
+                users.append(u)
+                items.append(i)
+                labels.append(1.0)
+                for _ in range(args.num_negatives):   # negative sampling
+                    j = rng.randint(args.num_items)
+                    while j in positives[u]:
+                        j = rng.randint(args.num_items)
+                    users.append(u)
+                    items.append(j)
+                    labels.append(0.0)
+        order = rng.permutation(len(users))
+        users = np.asarray(users, np.int32)[order]
+        items = np.asarray(items, np.int32)[order]
+        labels = np.asarray(labels, np.float32)[order]
+        total = 0.0
+        for s in range(0, len(users), args.batch_size):
+            ub = mx.nd.array(users[s:s + args.batch_size])
+            ib = mx.nd.array(items[s:s + args.batch_size])
+            lb = mx.nd.array(labels[s:s + args.batch_size])
+            with autograd.record():
+                loss = loss_fn(net(ub, ib), lb)
+            loss.backward()
+            trainer.step(len(users[s:s + args.batch_size]))
+            total += float(loss.asnumpy().mean())
+        print("epoch %d loss %.4f" % (epoch, total))
+
+    # HR@10 / NDCG@10 vs 99 sampled negatives (the NCF protocol)
+    hr, ndcg = [], []
+    for u in range(args.num_users):
+        cands = [held[u]]
+        while len(cands) < 100:
+            j = rng.randint(args.num_items)
+            if j not in positives[u]:
+                cands.append(j)
+        scores = net(mx.nd.array(np.full(100, u, np.int32)),
+                     mx.nd.array(np.asarray(cands, np.int32))).asnumpy()
+        rank = int((scores > scores[0]).sum())
+        hr.append(float(rank < 10))
+        ndcg.append(1.0 / np.log2(rank + 2) if rank < 10 else 0.0)
+    print("final-hr10 %.4f" % np.mean(hr))
+    print("final-ndcg10 %.4f" % np.mean(ndcg))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Stochastic depth: residual blocks randomly dropped during training.
+
+Parity target: reference ``example/stochastic-depth/`` —
+``sd_module.py`` wraps each residual block in a module that skips the
+block with probability ``death_rate`` during training and scales the
+block's contribution by ``1 - death_rate`` at inference;
+``sd_cifar10.py:60-108`` ramps the death rate linearly with depth
+(death_rate * i / len) over a CIFAR ResNet.
+
+Rebuild: a gluon ``StochasticDepthBlock`` drawing one Bernoulli gate per
+block per batch (Huang et al. 2016 linear-decay rule), trained on a
+synthetic CIFAR-shaped 4-class texture task (zero-egress).
+
+TPU note: the gate multiplies the residual branch by 0/1 inside the
+same jitted program — dropping is data, not control flow, so one XLA
+executable covers every gate outcome (no per-pattern retrace; the
+reference's module-level skip rebuilds the execution plan instead).
+
+    python examples/stochastic_depth.py --num-epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def make_texture_data(n, size, rng):
+    """4 classes: horizontal stripes, vertical stripes, checker, blobs."""
+    x = rng.randn(n, 3, size, size).astype(np.float32) * 0.3
+    y = rng.randint(0, 4, n)
+    row = np.arange(size)[:, None]
+    col = np.arange(size)[None, :]
+    for i in range(n):
+        f = rng.randint(2, 5)
+        if y[i] == 0:
+            pat = np.sin(row * f * np.pi / size) * np.ones((1, size))
+        elif y[i] == 1:
+            pat = np.ones((size, 1)) * np.sin(col * f * np.pi / size)
+        elif y[i] == 2:
+            pat = np.sin(row * f * np.pi / size) * \
+                np.sin(col * f * np.pi / size)
+        else:
+            cy, cx = rng.randint(size // 4, 3 * size // 4, 2)
+            pat = np.exp(-((row - cy) ** 2 + (col - cx) ** 2)
+                         / (2.0 * (size / 6) ** 2))
+        x[i] += pat[None].astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+class StochasticDepthBlock(gluon.Block):
+    """Residual block whose branch survives with prob 1-death_rate in
+    training and is scaled by (1-death_rate) at inference
+    (ref sd_module.py decision logic + Huang et al. eq. 5)."""
+
+    def __init__(self, channels, death_rate):
+        super().__init__()
+        self.death_rate = death_rate
+        self.body = nn.HybridSequential()
+        self.body.add(
+            nn.Conv2D(channels, 3, padding=1, use_bias=False),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.Conv2D(channels, 3, padding=1, use_bias=False),
+            nn.BatchNorm())
+
+    def forward(self, x):
+        branch = self.body(x)
+        if autograd.is_training():
+            gate = float(np.random.rand() >= self.death_rate)
+            out = x + gate * branch
+        else:
+            out = x + (1.0 - self.death_rate) * branch
+        return mx.nd.relu(out)
+
+
+class SDResNet(gluon.Block):
+    def __init__(self, num_blocks, channels, classes, final_death=0.5):
+        super().__init__()
+        self.stem = nn.Conv2D(channels, 3, padding=1)
+        self.blocks = nn.Sequential()
+        for i in range(num_blocks):
+            # linear decay: deeper blocks die more (sd_cifar10.py:60-75)
+            rate = final_death * (i + 1) / num_blocks
+            self.blocks.add(StochasticDepthBlock(channels, rate))
+        self.head = nn.HybridSequential()
+        self.head.add(nn.GlobalAvgPool2D(), nn.Dense(classes))
+
+    def forward(self, x):
+        return self.head(self.blocks(self.stem(x)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--num-images", type=int, default=512)
+    ap.add_argument("--image-size", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-blocks", type=int, default=4)
+    ap.add_argument("--death-rate", type=float, default=0.5)
+    ap.add_argument("--lr", type=float, default=0.005)
+    args = ap.parse_args()
+
+    np.random.seed(13)
+    mx.random.seed(13)
+    rng = np.random.RandomState(21)
+    x, y = make_texture_data(args.num_images, args.image_size, rng)
+    xv, yv = make_texture_data(128, args.image_size, rng)
+
+    net = SDResNet(args.num_blocks, 16, 4, args.death_rate)
+    net.collect_params().initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    n_batches = len(x) // args.batch_size
+    for epoch in range(args.num_epochs):
+        order = rng.permutation(len(x))
+        total = 0.0
+        for b in range(n_batches):
+            idx = order[b * args.batch_size:(b + 1) * args.batch_size]
+            data = mx.nd.array(x[idx])
+            label = mx.nd.array(y[idx])
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.asnumpy().mean())
+        print("epoch %d loss %.4f" % (epoch, total / n_batches))
+
+    preds = net(mx.nd.array(xv)).asnumpy().argmax(axis=1)
+    acc = float((preds == yv).mean())
+    print("final-accuracy %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

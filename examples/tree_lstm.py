@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Child-Sum Tree-LSTM for tree-pair relatedness (Tai et al. 2015).
+
+Parity target: reference ``example/gluon/tree_lstm/`` — a
+``ChildSumLSTMCell`` (tree_lstm.py:22-120: i2h on the node input, hs2h
+on the SUM of child hiddens for the i/u/o gates, a per-child forget
+gate from hc2h, cell = sum of forgotten child cells + i*u) and a
+``Similarity`` head scoring two tree encodings (tree_lstm.py:123-151:
+elementwise product + absolute difference → dense → score), trained on
+SICK relatedness and evaluated with Pearson correlation
+(main.py:144-178).
+
+Two deliberate departures:
+- the SICK corpus becomes synthetic random trees whose ground-truth
+  relatedness is the Jaccard overlap of their leaf-token multisets
+  (zero-egress, structure-sensitive);
+- the reference recurses node-by-node in Python (one op dispatch per
+  gate per node). Here the tree is LEVELIZED: nodes are grouped by
+  depth and each level runs as ONE batched embedding/matmul/gather
+  set — the TPU-native layout (a level is a batch; ragged children are
+  a padded (node, k) gather + mask). Same math, ~10x fewer dispatches.
+
+    python examples/tree_lstm.py --num-pairs 120 --num-epochs 6
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+KMAX = 3      # max children per node (generator guarantees this)
+
+
+class Tree(object):
+    __slots__ = ("children", "token")
+
+    def __init__(self, token=None, children=()):
+        self.token = token
+        self.children = list(children)
+
+    def leaves(self):
+        if not self.children:
+            return [self.token]
+        out = []
+        for c in self.children:
+            out.extend(c.leaves())
+        return out
+
+
+def random_tree(rng, vocab, n_leaves):
+    nodes = [Tree(token=int(rng.randint(vocab))) for _ in range(n_leaves)]
+    while len(nodes) > 1:
+        k = rng.randint(2, min(KMAX, len(nodes)) + 1)
+        picked = [nodes.pop(rng.randint(len(nodes))) for _ in range(k)]
+        nodes.append(Tree(children=picked))
+    return nodes[0]
+
+
+def jaccard(a, b):
+    sa, sb = set(a), set(b)
+    return len(sa & sb) / max(len(sa | sb), 1)
+
+
+def levelize(forest):
+    """Flatten a FOREST of trees into joint per-level batches.
+
+    Returns (tokens, levels, roots): ``tokens`` is the int array for
+    level 0 (every leaf of every tree); each later level is
+    (child_idx, child_mask) with indices into the concatenated node
+    order so far, padded to KMAX; ``roots`` indexes each tree's root.
+    The whole minibatch is one disconnected graph, so a level is ONE
+    batched embedding/matmul/gather set across all trees — the layout
+    a TPU wants (and ~100x fewer dispatches than per-node recursion).
+    """
+    depth = {}
+
+    def d(node):
+        if id(node) in depth:
+            return depth[id(node)]
+        val = 0 if not node.children else 1 + max(d(c) for c in node.children)
+        depth[id(node)] = val
+        return val
+
+    nodes = []
+
+    def collect(node):
+        for c in node.children:
+            collect(c)
+        nodes.append(node)
+
+    for tree in forest:
+        d(tree)
+        collect(tree)
+    nodes.sort(key=lambda n: depth[id(n)])
+    order = {id(n): i for i, n in enumerate(nodes)}
+    max_d = max(depth[id(t)] for t in forest)
+    tokens = np.array([n.token for n in nodes if depth[id(n)] == 0],
+                      np.int32)
+    levels = []
+    for lvl in range(1, max_d + 1):
+        level_nodes = [n for n in nodes if depth[id(n)] == lvl]
+        idx = np.zeros((len(level_nodes), KMAX), np.int32)
+        mask = np.zeros((len(level_nodes), KMAX), np.float32)
+        for i, n in enumerate(level_nodes):
+            for j, c in enumerate(n.children):
+                idx[i, j] = order[id(c)]
+                mask[i, j] = 1.0
+        levels.append((idx, mask))
+    roots = np.array([order[id(t)] for t in forest], np.int32)
+    # pre-stage constant index/mask tensors on device ONCE (they are
+    # reused every epoch; rebuilding them per step dominates eager cost)
+    staged = [(mx.nd.array(idx.reshape(-1)), mx.nd.array(mask), idx.shape)
+              for idx, mask in levels]
+    return mx.nd.array(tokens), staged, mx.nd.array(roots)
+
+
+class ChildSumTreeLSTM(gluon.Block):
+    """Levelized child-sum cell — same gate math as the reference's
+    recursive node_forward (ref tree_lstm.py:70-120)."""
+
+    def __init__(self, hidden, vocab, embed):
+        super().__init__()
+        self.hidden = hidden
+        self.embed = nn.Embedding(vocab, embed)
+        self.i2h = nn.Dense(4 * hidden, in_units=embed)
+        self.hs2h = nn.Dense(3 * hidden, in_units=hidden)
+        self.hc2h = nn.Dense(hidden, in_units=hidden)
+        zero_x = np.zeros((1, embed), np.float32)
+        self._zero_x = zero_x        # internal nodes have no token input
+
+    def forward(self, schedule):
+        tokens, levels, roots = schedule
+        H = self.hidden
+        # ---- level 0: every leaf in one batch ----
+        x = self.embed(tokens)
+        iuox = self.i2h(x)
+        i = mx.nd.sigmoid(mx.nd.slice_axis(iuox, 1, 0, H))
+        u = mx.nd.tanh(mx.nd.slice_axis(iuox, 1, 2 * H, 3 * H))
+        o = mx.nd.sigmoid(mx.nd.slice_axis(iuox, 1, 3 * H, 4 * H))
+        c_all = i * u
+        h_all = o * mx.nd.tanh(c_all)
+
+        # ---- internal levels: batched gather + masked child-sum ----
+        zero_iuox = self.i2h(mx.nd.array(self._zero_x))       # (1, 4H)
+        for flat, mask_nd, (n, k) in levels:
+            h_kids = mx.nd.reshape(mx.nd.take(h_all, flat), (n, k, H))
+            c_kids = mx.nd.reshape(mx.nd.take(c_all, flat), (n, k, H))
+            m = mx.nd.expand_dims(mask_nd, 2)                  # (n, k, 1)
+            h_kids = h_kids * m
+            c_kids = c_kids * m
+            hs = mx.nd.sum(h_kids, axis=1)                     # (n, H)
+            iuo_h = self.hs2h(hs)                              # (n, 3H)
+            i_x = mx.nd.slice_axis(zero_iuox, 1, 0, H)
+            f_x = mx.nd.slice_axis(zero_iuox, 1, H, 2 * H)
+            u_x = mx.nd.slice_axis(zero_iuox, 1, 2 * H, 3 * H)
+            o_x = mx.nd.slice_axis(zero_iuox, 1, 3 * H, 4 * H)
+            i = mx.nd.sigmoid(i_x + mx.nd.slice_axis(iuo_h, 1, 0, H))
+            u = mx.nd.tanh(u_x + mx.nd.slice_axis(iuo_h, 1, H, 2 * H))
+            o = mx.nd.sigmoid(o_x + mx.nd.slice_axis(iuo_h, 1, 2 * H, 3 * H))
+            # per-child forget gates, one batched hc2h over (n*k, H)
+            f_h = self.hc2h(mx.nd.reshape(h_kids, (n * k, H)))
+            f = mx.nd.sigmoid(mx.nd.reshape(f_h, (n, k, H)) +
+                              mx.nd.expand_dims(f_x, 0))
+            c = i * u + mx.nd.sum(f * c_kids * m, axis=1)
+            h = o * mx.nd.tanh(c)
+            h_all = mx.nd.concat(h_all, h, dim=0)
+            c_all = mx.nd.concat(c_all, c, dim=0)
+        return mx.nd.take(h_all, roots)                        # (B, H)
+
+
+class Similarity(gluon.Block):
+    """Relatedness head over two encodings (ref tree_lstm.py:123-151)."""
+
+    def __init__(self, hidden, sim_hidden=32):
+        super().__init__()
+        self.wh = nn.Dense(sim_hidden, in_units=2 * hidden)
+        self.wp = nn.Dense(1, in_units=sim_hidden)
+
+    def forward(self, lh, rh):
+        feat = mx.nd.concat(lh * rh, mx.nd.abs(lh - rh), dim=1)
+        return mx.nd.sigmoid(self.wp(mx.nd.tanh(self.wh(feat))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-pairs", type=int, default=400)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=12)
+    ap.add_argument("--hidden", type=int, default=24)
+    ap.add_argument("--embed", type=int, default=12)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    np.random.seed(5)
+    mx.random.seed(5)
+    rng = np.random.RandomState(11)
+    lefts, rights, ys = [], [], []
+    for _ in range(args.num_pairs):
+        lt = random_tree(rng, args.vocab, int(rng.randint(3, 8)))
+        rt = random_tree(rng, args.vocab, int(rng.randint(3, 8)))
+        lefts.append(lt)
+        rights.append(rt)
+        ys.append(jaccard(lt.leaves(), rt.leaves()))
+    n_train = int(0.8 * args.num_pairs)
+
+    # one joint schedule per minibatch: the forest IS the batch
+    bs = args.batch_size
+    batches = []
+    for s in range(0, n_train, bs):
+        ltrees = lefts[s:s + bs]
+        rtrees = rights[s:s + bs]
+        batches.append((levelize(ltrees + rtrees), len(ltrees),
+                        np.asarray(ys[s:s + bs], np.float32)))
+    test_sched = (levelize(lefts[n_train:] + rights[n_train:]),
+                  args.num_pairs - n_train,
+                  np.asarray(ys[n_train:], np.float32))
+
+    cell = ChildSumTreeLSTM(args.hidden, args.vocab, args.embed)
+    head = Similarity(args.hidden)
+    params = cell.collect_params()
+    params.update(head.collect_params())
+    params.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": args.lr})
+    loss_fn = gluon.loss.L2Loss()
+
+    def score(sched):
+        fsched, nb, _ = sched
+        enc = cell(fsched)                                   # (2B, H)
+        lh = mx.nd.slice_axis(enc, 0, 0, nb)
+        rh = mx.nd.slice_axis(enc, 0, nb, 2 * nb)
+        return head(lh, rh)
+
+    for epoch in range(args.num_epochs):
+        total = 0.0
+        for sched in batches:
+            target = mx.nd.array(sched[2][:, None])
+            with autograd.record():
+                loss = loss_fn(score(sched), target)
+            loss.backward()
+            trainer.step(sched[1])
+            total += float(loss.asnumpy().mean())
+        print("epoch %d train-loss %.4f" % (epoch, total / len(batches)))
+
+    preds = score(test_sched).asnumpy()[:, 0]
+    r = float(np.corrcoef(preds, test_sched[2])[0, 1])
+    print("final-pearson %.4f" % r)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ from . import optimizer as opt
 from . import metric
 from . import operator
 from . import pallas
+from . import stream
 from . import rnn
 from . import contrib
 from . import torch

@@ -98,3 +98,175 @@ def load_file(fname):
         names = list(loaded.keys())
         return [loaded[n] for n in names], names
     return list(loaded), []
+
+
+# ---------------------------------------------------------------------------
+# symbol surface (behind MXSymbol*, native/c_api.cc)
+# ---------------------------------------------------------------------------
+
+def symbol_from_json(json_str):
+    from .symbol.symbol import load_json
+    return load_json(json_str)
+
+
+def symbol_from_file(fname):
+    from . import symbol as sym_mod
+    return sym_mod.load(fname)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_save_file(sym, fname):
+    sym.save(fname)
+
+
+def symbol_variable(name):
+    from . import symbol as sym_mod
+    return sym_mod.Variable(name)
+
+
+class _AtomicSymbol(object):
+    """Uncomposed op application (reference CreateAtomicSymbol result):
+    holds (op name, attrs) until MXSymbolCompose supplies inputs."""
+
+    __slots__ = ("op_name", "attrs")
+
+    def __init__(self, op_name, attrs):
+        self.op_name = op_name
+        self.attrs = attrs
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    get_op(op_name)          # fail fast on unknown names
+    return _AtomicSymbol(op_name, dict(zip(keys, vals)))
+
+
+def symbol_compose(atom, name, keys, args):
+    """Apply inputs to an atomic symbol; returns the composed Symbol
+    (the C side rebinds the handle, mirroring in-place Compose)."""
+    from . import symbol as sym_mod
+    if not isinstance(atom, _AtomicSymbol):
+        raise MXNetError("Compose target is already composed")
+    fn = getattr(sym_mod, atom.op_name, None) or \
+        getattr(sym_mod._internal, atom.op_name)
+    kwargs = {k: parse_attr_string(v) for k, v in atom.attrs.items()}
+    if name:
+        kwargs["name"] = name
+    if keys:
+        kwargs.update(dict(zip(keys, args)))
+        return fn(**kwargs)
+    return fn(*args, **kwargs)
+
+
+def symbol_list(sym, what):
+    if what == "arguments":
+        return list(sym.list_arguments())
+    if what == "outputs":
+        return list(sym.list_outputs())
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_infer_shape(sym, keys, shapes):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete)."""
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    try:
+        arg, out, aux = sym.infer_shape(**kwargs)
+    except MXNetError:
+        arg, out, aux = sym.infer_shape_partial(**kwargs)
+    complete = all(s is not None for s in (arg or []) + (aux or []))
+    fix = lambda ss: [tuple(int(d) for d in (s or ())) for s in (ss or [])]
+    return fix(arg), fix(out), fix(aux), bool(complete and arg)
+
+
+# ---------------------------------------------------------------------------
+# executor surface (behind MXExecutor*, native/c_api.cc)
+# ---------------------------------------------------------------------------
+
+def executor_simple_bind(sym, dev_type, dev_id, keys, shapes, grad_req):
+    ctx = Context(_DEV.get(int(dev_type), "cpu"), int(dev_id))
+    shape_kwargs = {k: tuple(int(d) for d in s)
+                    for k, s in zip(keys, shapes)}
+    return sym.simple_bind(ctx, grad_req=grad_req, **shape_kwargs)
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, ograds):
+    ex.backward(out_grads=list(ograds) if ograds else None)
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_array(ex, kind, name):
+    if kind == "arg":
+        table = ex.arg_dict
+    elif kind == "grad":
+        table = {n: g for n, g in ex.grad_dict.items() if g is not None}
+    else:
+        table = ex.aux_dict
+    if name not in table:
+        raise MXNetError("executor has no %s array %r (have: %s)"
+                         % (kind, name, sorted(table)))
+    return table[name]
+
+
+# ---------------------------------------------------------------------------
+# kvstore surface (behind MXKVStore*, native/c_api.cc)
+# ---------------------------------------------------------------------------
+
+def kv_create(kind):
+    from . import kvstore
+    return kvstore.create(kind)
+
+
+def kv_type(kv):
+    return kv.type
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kv_init(kv, keys, values):
+    kv.init(list(keys) if len(keys) > 1 else keys[0],
+            list(values) if len(values) > 1 else values[0])
+
+
+def kv_push(kv, keys, values, priority):
+    kv.push(list(keys) if len(keys) > 1 else keys[0],
+            list(values) if len(values) > 1 else values[0],
+            priority=int(priority))
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys) if len(keys) > 1 else keys[0],
+            out=list(outs) if len(outs) > 1 else outs[0],
+            priority=int(priority))
+
+
+def kv_barrier(kv):
+    kv.barrier()
+
+
+def executor_copy_params(ex, names, arrays):
+    arg, aux = {}, {}
+    for n, a in zip(names, arrays):
+        if n.startswith("aux:"):
+            aux[n[4:]] = a
+        elif n.startswith("arg:"):
+            arg[n[4:]] = a
+        else:
+            (aux if n in ex.aux_dict else arg)[n] = a
+    arg = {n: a for n, a in arg.items() if n in ex.arg_dict}
+    aux = {n: a for n, a in aux.items() if n in ex.aux_dict}
+    ex.copy_params_from(arg, aux, allow_extra_params=True)

@@ -33,11 +33,49 @@ import threading
 import numpy as np
 
 __all__ = ["role", "num_workers", "num_servers", "root_addr",
-           "Conn", "Scheduler", "Server", "WorkerTransport",
-           "run_scheduler", "run_server", "shard_ranges", "server_of_key",
-           "BIGARRAY_BOUND"]
+           "Conn", "ProtocolError", "Scheduler", "Server",
+           "WorkerTransport", "run_scheduler", "run_server",
+           "shard_ranges", "server_of_key", "BIGARRAY_BOUND"]
 
-_LEN = struct.Struct("<Q")
+# Wire frame: magic + protocol version + payload length. The magic word
+# rejects stray/rogue connections before any payload is parsed; the
+# version word makes cross-version jobs fail loudly instead of
+# corrupting state mid-training.
+_MAGIC = b"MXPS"
+_WIRE_VERSION = 1
+_HDR = struct.Struct("<4sHQ")
+_MAX_FRAME = 1 << 34          # 16 GiB: above any realistic shard
+
+
+class ProtocolError(ConnectionError):
+    """Peer spoke garbage: wrong magic/version, oversized frame, or a
+    pickle payload outside the allowlist."""
+
+
+# Payloads are numpy arrays + plain containers + framework classes
+# (set_optimizer ships an mxnet_tpu.optimizer instance). Everything
+# else — os.system et al. — is refused at find_class time, so one
+# malformed/malicious peer cannot execute code in a training job.
+_SAFE_BUILTINS = frozenset({
+    "dict", "list", "tuple", "set", "frozenset", "str", "int", "float",
+    "bool", "bytes", "bytearray", "complex", "slice", "range",
+})
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        root = module.split(".", 1)[0]
+        if root in ("numpy", "mxnet_tpu"):
+            return super().find_class(module, name)
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            "disallowed pickle global %s.%s" % (module, name))
+
+
+def _restricted_loads(blob):
+    import io
+    return _RestrictedUnpickler(io.BytesIO(blob)).load()
 
 
 def BIGARRAY_BOUND():
@@ -64,7 +102,8 @@ def root_addr():
 
 
 class Conn:
-    """Blocking message channel: 8-byte little-endian length + pickle."""
+    """Blocking message channel: (magic, version, length) header +
+    allowlist-restricted pickle payload."""
 
     def __init__(self, sock):
         self.sock = sock
@@ -88,11 +127,25 @@ class Conn:
     def send(self, msg):
         blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
         with self._wlock:
-            self.sock.sendall(_LEN.pack(len(blob)) + blob)
+            self.sock.sendall(
+                _HDR.pack(_MAGIC, _WIRE_VERSION, len(blob)) + blob)
 
     def recv(self):
-        n = _LEN.unpack(self._read(_LEN.size))[0]
-        return pickle.loads(self._read(n))
+        magic, ver, n = _HDR.unpack(self._read(_HDR.size))
+        if magic != _MAGIC:
+            raise ProtocolError("bad frame magic %r" % (magic,))
+        if ver != _WIRE_VERSION:
+            raise ProtocolError(
+                "peer speaks wire version %d, this process speaks %d"
+                % (ver, _WIRE_VERSION))
+        if n > _MAX_FRAME:
+            raise ProtocolError("frame of %d bytes exceeds limit" % n)
+        try:
+            return _restricted_loads(self._read(n))
+        except pickle.UnpicklingError as exc:
+            raise ProtocolError(str(exc))
+        except Exception as exc:   # truncated/garbage pickle bytes
+            raise ProtocolError("undecodable payload: %r" % (exc,))
 
     def _read(self, n):
         buf = bytearray()
@@ -176,14 +229,19 @@ class Scheduler:
         self._done = threading.Event()
 
     def run(self):
-        threads = []
-        need = self.nworkers + self.nservers
-        for _ in range(need):
-            conn = Conn(self.lsock.accept()[0])
-            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
-            t.start()
-            threads.append(t)
-        self._done.wait()
+        # Accept until shutdown rather than counting to N connections: a
+        # malformed/rogue connection must not consume a registration slot
+        # and hang the whole job (it is dropped in _serve instead).
+        self.lsock.settimeout(0.25)
+        while not self._done.is_set():
+            try:
+                sock, _ = self.lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(Conn(sock),),
+                             daemon=True).start()
         for c in self.server_conns:
             try:
                 c.send(("shutdown",))
@@ -192,22 +250,36 @@ class Scheduler:
         self.lsock.close()
 
     def _serve(self, conn):
-        msg = conn.recv()
-        kind = msg[0]
+        try:
+            msg = conn.recv()
+            kind = msg[0]
+            if kind not in ("reg_server", "reg_worker"):
+                raise ProtocolError("first message must register a role")
+        except (ConnectionError, TypeError, IndexError, KeyError):
+            conn.close()   # rogue peer: drop without consuming a slot
+            return
         with self._lock:
             if kind == "reg_server":
                 rank = sum(a is not None for a in self.server_addrs)
+                if rank >= self.nservers:
+                    conn.close()   # over-registration
+                    return
                 self.server_addrs[rank] = msg[1]
                 self.server_conns.append(conn)
             else:
                 # honor the launcher's DMLC_WORKER_RANK when present so
                 # worker i deterministically gets rank i
                 hint = msg[1] if len(msg) > 1 else None
-                if hint is not None and hint not in self.worker_conns:
+                if isinstance(hint, int) and 0 <= hint < self.nworkers \
+                        and hint not in self.worker_conns:
                     rank = hint
                 else:
-                    rank = next(i for i in range(self.nworkers)
-                                if i not in self.worker_conns)
+                    try:
+                        rank = next(i for i in range(self.nworkers)
+                                    if i not in self.worker_conns)
+                    except StopIteration:
+                        conn.close()   # over-registration
+                        return
                 self.worker_conns[rank] = conn
             self._registered.notify_all()
             while (None in self.server_addrs
@@ -323,7 +395,7 @@ class Server:
                 return ("val", w[np.asarray(rows, np.int64)])
         if op == "set_optimizer":
             from . import optimizer as opt
-            optimizer = pickle.loads(msg[1])
+            optimizer = _restricted_loads(msg[1])
             with self._lock:
                 self.updater = opt.get_updater(optimizer)
             return ("ok",)
@@ -472,7 +544,9 @@ class WorkerTransport:
 
     def __init__(self):
         self.sched = Conn.connect(root_addr())
-        rank_hint = os.environ.get("DMLC_WORKER_RANK")
+        rank_hint = (os.environ.get("DMLC_WORKER_RANK")
+                     or os.environ.get("OMPI_COMM_WORLD_RANK")
+                     or os.environ.get("PMI_RANK"))
         self.sched.send(("reg_worker",
                          int(rank_hint) if rank_hint is not None else None))
         msg = self.sched.recv()

@@ -182,10 +182,13 @@ class Accuracy(_PairAccumulator):
         self.axis = axis
 
     def measure(self, label, pred):
-        if pred.ndim > label.ndim:
+        # argmax whenever SHAPES differ, not just ranks: 2D sequence
+        # labels (batch, seq) vs (batch*seq, vocab) scores must reduce
+        # too (ref python/mxnet/metric.py:391-392)
+        if pred.shape != label.shape:
             pred = pred.argmax(axis=self.axis)
-        hits = pred.astype("int64").ravel() == label.astype("int64").ravel()
         check_label_shapes(label.ravel(), pred.ravel(), shape=True)
+        hits = pred.astype("int64").ravel() == label.astype("int64").ravel()
         return int(hits.sum()), hits.size
 
 

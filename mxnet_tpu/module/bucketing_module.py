@@ -179,18 +179,24 @@ class BucketingModule(BaseModule):
                         shared=self._leader)
         self._active_key = bucket_key
         if self.params_initialized and self._active is not self._leader:
-            # alias the leader's canonical dicts and refresh device copies;
-            # the leader's host dicts may be stale after its own fused
-            # device-side steps — sync them down first or the new bucket
-            # resumes from pre-update weights
             leader = self._leader
-            if leader._params_dirty:
-                leader._sync_params_from_devices()
             mod = self._active
             mod._arg_params, mod._aux_params = (leader._arg_params,
                                                 leader._aux_params)
-            mod._exec_group.set_params(leader._arg_params, leader._aux_params)
             mod.params_initialized = True
+            if getattr(mod, "_shares_device_params", False):
+                # device arrays are ALIASED with the leader's: the switch
+                # is free (the reference's shared-pool behavior,
+                # bucketing_module.py:35-106)
+                mod._params_dirty = leader._params_dirty
+            else:
+                # fallback (heterogeneous bucket graphs): refresh device
+                # copies from the leader's host dicts — sync them down
+                # first or the new bucket resumes from pre-update weights
+                if leader._params_dirty:
+                    leader._sync_params_from_devices()
+                mod._exec_group.set_params(leader._arg_params,
+                                           leader._aux_params)
         if self.optimizer_initialized and \
                 not self._active.optimizer_initialized:
             self._lend_optimizer(self._active)
@@ -241,6 +247,11 @@ class BucketingModule(BaseModule):
     def _sync_active_to_leader(self):
         """Keep the leader authoritative for later bucket switches."""
         if self._active_key == self._default_bucket_key:
+            return
+        if getattr(self._active, "_shares_device_params", False):
+            # aliased device arrays: the leader already sees the update;
+            # only its host dicts are now stale
+            self._leader._params_dirty = True
             return
         arg, aux = self._active.get_params()
         leader = self._leader

@@ -114,6 +114,42 @@ class DataParallelExecutorGroup:
                            for n in self.aux_names]
 
     # -- params ------------------------------------------------------------
+    def share_params_with(self, donor):
+        """Alias the donor group's device-resident param/aux NDArrays.
+
+        The TPU answer to the reference's shared memory pool
+        (module/bucketing_module.py:35-106 + graph_executor.cc:868
+        storage sharing): executors read ``handle._data`` at call time
+        and every update path rebinds the handle in place, so aliasing
+        the handles makes bucket switches zero-copy — no device→host
+        sync, no host→device set_params. Returns True when every param
+        and aux state was shared (caller may then skip set_params)."""
+        if type(donor) is not type(self) or \
+                len(self.execs) != len(donor.execs):
+            return False
+        for names, dicts in ((self.param_names, "arg_dict"),
+                             (self.aux_names, "aux_dict")):
+            for name in names:
+                for mine, theirs in zip(self.execs, donor.execs):
+                    src = getattr(theirs, dicts).get(name)
+                    dst = getattr(mine, dicts).get(name)
+                    if src is None or dst is None \
+                            or src.shape != dst.shape \
+                            or src.dtype != dst.dtype:
+                        return False
+        for name in self.param_names:
+            for mine, theirs in zip(self.execs, donor.execs):
+                mine.arg_dict[name] = theirs.arg_dict[name]
+        for name in self.aux_names:
+            for mine, theirs in zip(self.execs, donor.execs):
+                mine.aux_dict[name] = theirs.aux_dict[name]
+        # refresh the per-device views the module/kvstore paths iterate
+        self.param_arrays = [[e.arg_dict[n] for e in self.execs]
+                             for n in self.param_names]
+        self.aux_arrays = [[e.aux_dict[n] for e in self.execs]
+                           for n in self.aux_names]
+        return True
+
     def set_params(self, arg_params, aux_params, allow_extra=False):
         for ex in self.execs:
             ex.copy_params_from(arg_params, aux_params,

@@ -131,6 +131,29 @@ class FusedExecutorGroup(object):
 
     # ---- parameter movement ----
 
+    def share_params_with(self, donor):
+        """Alias the donor's sharded param/aux NDArrays (see
+        DataParallelExecutorGroup.share_params_with — same zero-copy
+        bucket-switch contract, single logical copy here)."""
+        if type(donor) is not type(self):
+            return False
+        dex, mex = donor._exec, self._exec
+        for names, attr in ((self.param_names, "arg_dict"),
+                            (mex.aux_names, "aux_dict")):
+            for name in names:
+                src = getattr(dex, attr, {}).get(name)
+                dst = getattr(mex, attr, {}).get(name)
+                if src is None or dst is None or src.shape != dst.shape \
+                        or src.dtype != dst.dtype:
+                    return False
+        for name in self.param_names:
+            mex.arg_dict[name] = dex.arg_dict[name]
+        for name in mex.aux_names:
+            mex.aux_dict[name] = dex.aux_dict[name]
+        self.param_arrays = [[mex.arg_dict[n]] for n in self.param_names
+                             if n in mex.arg_dict]
+        return True
+
     def set_params(self, arg_params, aux_params, allow_extra=False):
         for name, arr in (arg_params or {}).items():
             if name in self._exec.arg_dict:

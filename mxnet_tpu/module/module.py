@@ -282,12 +282,21 @@ class Module(BaseModule):
         self.__dict__.setdefault("_reshape_cache", {})[
             self._shape_key()] = self._exec_group
 
+        self._shares_device_params = False
         if shared_module is not None:
             # Alias (not copy) the donor module's host params, per reference.
             self._arg_params, self._aux_params = (
                 shared_module._arg_params, shared_module._aux_params)
             self.params_initialized = True
-        if self.params_initialized:
+            donor_group = getattr(shared_module, "_exec_group", None)
+            if donor_group is not None:
+                # alias the donor's DEVICE arrays too: bucket switches
+                # then cost nothing (no sync-down, no set_params up)
+                self._shares_device_params = \
+                    self._exec_group.share_params_with(donor_group)
+                if self._shares_device_params:
+                    self._params_dirty = shared_module._params_dirty
+        if self.params_initialized and not self._shares_device_params:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def _make_exec_group(self, for_training, inputs_need_grad,

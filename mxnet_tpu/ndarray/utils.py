@@ -131,8 +131,11 @@ def save_to_bytes(data):
 
 
 def save(fname, data):
-    """Save list/dict of NDArrays (reference mx.nd.save)."""
-    with open(fname, "wb") as f:
+    """Save list/dict of NDArrays (reference mx.nd.save). Scheme URIs
+    (s3://, mem://, ...) dispatch through mxnet_tpu.stream — the dmlc
+    Stream parity hook (ref include/mxnet/ndarray.h:340)."""
+    from ..stream import open_stream
+    with open_stream(fname, "wb") as f:
         f.write(save_to_bytes(data))
 
 
@@ -169,6 +172,8 @@ def load_from_bytes(raw):
 
 
 def load(fname):
-    """Load list/dict of NDArrays (reference mx.nd.load)."""
-    with open(fname, "rb") as f:
+    """Load list/dict of NDArrays (reference mx.nd.load). Scheme URIs
+    dispatch through mxnet_tpu.stream (dmlc Stream parity)."""
+    from ..stream import open_stream
+    with open_stream(fname, "rb") as f:
         return load_from_bytes(f.read())

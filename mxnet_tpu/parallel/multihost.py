@@ -47,7 +47,11 @@ def init_from_env(force=False):
     if _initialized:
         return rank(), num_processes()
 
-    proc_id = int(_env("MXNET_PROCESS_ID", "DMLC_WORKER_RANK", default="0"))
+    # OMPI_COMM_WORLD_RANK / PMI_RANK: the mpi launcher exports one env
+    # for the whole worker group, so the per-process rank comes from the
+    # MPI runtime itself (ref dmlc_tracker/mpi.py contract)
+    proc_id = int(_env("MXNET_PROCESS_ID", "DMLC_WORKER_RANK",
+                       "OMPI_COMM_WORLD_RANK", "PMI_RANK", default="0"))
     coord = _env("MXNET_COORDINATOR")
     if coord is None:
         host = _env("DMLC_PS_ROOT_URI", default="127.0.0.1")

@@ -45,22 +45,29 @@ class MXRecordIO(object):
 
     def open(self):
         from . import _native
-        lib = _native.lib()
+        from .stream import open_stream, split_scheme
         if self.flag == "w":
             self.writable = True
         elif self.flag == "r":
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        # scheme URIs (s3://, mem://, ...) go through the pluggable
+        # stream layer; the native codec mmaps local paths only
+        scheme, rest = split_scheme(self.uri)
+        remote = scheme not in (None, "file")
+        local_path = rest if scheme == "file" else self.uri
+        lib = None if remote else _native.lib()
         if lib is not None:
             create = (lib.MXRIOWriterCreate if self.writable
                       else lib.MXRIOReaderCreate)
-            self._h = create(self.uri.encode())
+            self._h = create(local_path.encode())
             if not self._h:
                 raise IOError("cannot open %s" % self.uri)
             self._lib = lib
         else:
-            self.fd = open(self.uri, "wb" if self.writable else "rb")
+            self.fd = open_stream(self.uri,
+                                  "wb" if self.writable else "rb")
         self.is_open = True
 
     def __del__(self):
@@ -168,22 +175,29 @@ class MXIndexedRecordIO(MXRecordIO):
         super(MXIndexedRecordIO, self).__init__(uri, flag)
 
     def open(self):
+        from .stream import open_stream
         MXRecordIO.open(self)
         self.idx = {}
         self.keys = []
-        if not self.writable and os.path.isfile(self.idx_path):
-            with open(self.idx_path) as fin:
-                for line in fin.readlines():
-                    line = line.strip().split("\t")
-                    key = self.key_type(line[0])
-                    self.idx[key] = int(line[1])
-                    self.keys.append(key)
+        if not self.writable:
+            try:
+                fin = open_stream(self.idx_path, "r")
+            except (FileNotFoundError, OSError):
+                fin = None    # sidecar optional, any scheme
+            if fin is not None:
+                with fin:
+                    for line in fin.readlines():
+                        line = line.strip().split("\t")
+                        key = self.key_type(line[0])
+                        self.idx[key] = int(line[1])
+                        self.keys.append(key)
 
     def close(self):
         if not self.is_open:
             return
         if self.writable:
-            with open(self.idx_path, "w") as fout:
+            from .stream import open_stream
+            with open_stream(self.idx_path, "w") as fout:
                 for k in self.keys:
                     fout.write("%s\t%d\n" % (str(k), self.idx[k]))
         MXRecordIO.close(self)

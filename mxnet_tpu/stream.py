@@ -1,0 +1,130 @@
+"""Pluggable stream opener: URI-scheme dispatch for save/load/RecordIO.
+
+Reference parity: dmlc-core streams let every reference save/load path
+accept ``s3://`` and ``hdfs://`` URIs transparently
+(``include/mxnet/ndarray.h:340`` Save/Load take dmlc::Stream;
+``dmlc/io.h`` Stream::Create dispatches on the URI scheme). This rebuild
+keeps the same shape with a registry of Python openers instead of C++
+stream subclasses: anything with a scheme prefix routes to its registered
+opener (an fsspec-style callable), bare paths go to ``open``.
+
+Usage::
+
+    import mxnet_tpu as mx
+
+    def s3_opener(uri, mode):
+        import s3fs                       # any fsspec filesystem
+        return s3fs.S3FileSystem().open(uri, mode)
+
+    mx.stream.register_scheme("s3", s3_opener)
+    mx.nd.save("s3://bucket/model.params", {"w": w})   # just works
+
+Zero-egress note: no cloud SDKs ship in this image, so the built-in
+schemes are ``file`` and ``mem`` (an in-process store used by tests and
+handy for ephemeral checkpoints); cloud filesystems plug in via the same
+hook without framework changes.
+"""
+from __future__ import annotations
+
+import io
+import os
+import re
+import threading
+
+from .base import MXNetError
+
+__all__ = ["register_scheme", "unregister_scheme", "open_stream",
+           "registered_schemes", "split_scheme"]
+
+_SCHEME_RE = re.compile(r"^([A-Za-z][A-Za-z0-9+.-]*)://")
+
+_REGISTRY = {}
+_LOCK = threading.Lock()
+
+
+def split_scheme(uri):
+    """('s3', 'bucket/key') for 's3://bucket/key'; (None, uri) for bare
+    paths. Windows drive letters ('C:/x') have no '//' so they stay
+    bare paths."""
+    if not isinstance(uri, (str, os.PathLike)):
+        return None, uri
+    s = os.fspath(uri)
+    m = _SCHEME_RE.match(s)
+    if not m:
+        return None, s
+    return m.group(1).lower(), s[m.end():]
+
+
+def register_scheme(scheme, opener):
+    """Install ``opener(uri, mode) -> file-like`` for ``scheme://`` URIs.
+
+    The opener receives the FULL uri (scheme included, the fsspec
+    convention) and a binary/text mode string. Re-registering a scheme
+    replaces the previous opener (returned, for restore-style tests)."""
+    if not re.match(r"^[A-Za-z][A-Za-z0-9+.-]*$", scheme or ""):
+        raise MXNetError("invalid scheme %r" % (scheme,))
+    with _LOCK:
+        prev = _REGISTRY.get(scheme.lower())
+        _REGISTRY[scheme.lower()] = opener
+    return prev
+
+
+def unregister_scheme(scheme):
+    with _LOCK:
+        return _REGISTRY.pop(scheme.lower(), None)
+
+
+def registered_schemes():
+    with _LOCK:
+        return sorted(_REGISTRY)
+
+
+def open_stream(uri, mode="rb"):
+    """Open *uri* for reading/writing. Scheme-prefixed URIs dispatch to
+    their registered opener; bare paths use the local filesystem."""
+    scheme, _rest = split_scheme(uri)
+    if scheme is None or scheme == "file":
+        path = _rest if scheme == "file" else os.fspath(uri)
+        return open(path, mode)
+    with _LOCK:
+        opener = _REGISTRY.get(scheme)
+    if opener is None:
+        raise MXNetError(
+            "no stream opener registered for scheme %r (uri %r); "
+            "register one with mxnet_tpu.stream.register_scheme"
+            % (scheme, uri))
+    return opener(os.fspath(uri), mode)
+
+
+# ---------------------------------------------------------------------------
+# mem:// — in-process store (tests, ephemeral checkpoints)
+# ---------------------------------------------------------------------------
+
+_MEM = {}
+_MEM_LOCK = threading.Lock()
+
+
+class _MemWriter(io.BytesIO):
+    def __init__(self, key):
+        super().__init__()
+        self._key = key
+
+    def close(self):
+        with _MEM_LOCK:
+            _MEM[self._key] = self.getvalue()
+        super().close()
+
+
+def _mem_opener(uri, mode):
+    _, key = split_scheme(uri)
+    if "w" in mode:
+        writer = _MemWriter(key)
+        return writer if "b" in mode else io.TextIOWrapper(writer)
+    with _MEM_LOCK:
+        if key not in _MEM:
+            raise FileNotFoundError(uri)
+        raw = io.BytesIO(_MEM[key])
+    return raw if "b" in mode else io.TextIOWrapper(raw)
+
+
+register_scheme("mem", _mem_opener)

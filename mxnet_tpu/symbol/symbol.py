@@ -426,7 +426,8 @@ class Symbol:
         return json.dumps(graph, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        from ..stream import open_stream
+        with open_stream(fname, "w") as f:
             f.write(self.tojson())
 
     def debug_str(self):
@@ -583,7 +584,8 @@ def _upgrade_json(graph):
 
 
 def load(fname):
-    with open(fname) as f:
+    from ..stream import open_stream
+    with open_stream(fname, "r") as f:
         return load_json(f.read())
 
 

@@ -344,3 +344,438 @@ int MXNDArrayLoad(const char* fname, mx_uint* out_size,
 }
 
 }  // extern "C"
+
+// ---- symbol + executor surface (ref c_api.h MXSymbol* / MXExecutor*
+// groups; handles are strong PyObject refs like NDArrayHandle) ----
+
+namespace {
+
+// Per-thread ret store for one string-list-returning call site.
+struct StrRet {
+  std::vector<std::string> strs;
+  std::vector<const char*> ptrs;
+  void Fill(PyObject* list) {
+    strs.clear();
+    ptrs.clear();
+    Py_ssize_t n = PyList_Size(list);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(list, i)));
+    for (auto& s : strs) ptrs.push_back(s.c_str());
+  }
+};
+
+// Per-thread ret store for one shape-tuple-list (InferShape group).
+struct ShapeRet {
+  std::vector<std::vector<mx_uint>> dims;
+  std::vector<mx_uint> ndims;
+  std::vector<const mx_uint*> ptrs;
+  void Fill(PyObject* list) {  // list[tuple[int]]
+    dims.clear();
+    ndims.clear();
+    ptrs.clear();
+    Py_ssize_t n = PyList_Size(list);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* t = PyList_GetItem(list, i);
+      Py_ssize_t nd = PyTuple_Size(t);
+      std::vector<mx_uint> shape;
+      for (Py_ssize_t j = 0; j < nd; ++j)
+        shape.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(t, j))));
+      dims.push_back(std::move(shape));
+      ndims.push_back(static_cast<mx_uint>(nd));
+    }
+    for (auto& d : dims) ptrs.push_back(d.data());
+  }
+};
+
+int WrapResult(PyObject* obj, void** out) {
+  if (!obj) return -1;
+  *out = Wrap(obj);
+  return 0;
+}
+
+PyObject* ShapesToPyList(mx_uint num, const mx_uint* ndims,
+                         const mx_uint* flat) {
+  PyObject* shapes = PyList_New(num);
+  mx_uint off = 0;
+  for (mx_uint i = 0; i < num; ++i) {
+    PyObject* t = PyTuple_New(ndims[i]);
+    for (mx_uint j = 0; j < ndims[i]; ++j)
+      PyTuple_SetItem(t, j, PyLong_FromUnsignedLong(flat[off + j]));
+    off += ndims[i];
+    PyList_SetItem(shapes, i, t);
+  }
+  return shapes;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  return WrapResult(CallShim("symbol_from_json", "(s)", json), out);
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  return WrapResult(CallShim("symbol_from_file", "(s)", fname), out);
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
+  Gil gil;
+  thread_local std::string json;
+  PyObject* r = CallShim("symbol_to_json", "(O)",
+                         static_cast<Handle*>(sym)->obj);
+  if (!r) return -1;
+  json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_json = json.c_str();
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
+  Gil gil;
+  PyObject* r = CallShim("symbol_save_file", "(Os)",
+                         static_cast<Handle*>(sym)->obj, fname);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle sym) { return MXNDArrayFree(sym); }
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  return WrapResult(CallShim("symbol_variable", "(s)", name), out);
+}
+
+int MXSymbolCreateAtomicSymbol(const char* op_name, mx_uint num_param,
+                               const char** keys, const char** vals,
+                               SymbolHandle* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* k = PyList_New(num_param);
+  PyObject* v = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(v, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* r = CallShim("symbol_create_atomic", "(sOO)", op_name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  return WrapResult(r, out);
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** keys, SymbolHandle* args) {
+  Gil gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* k = PyList_New(keys ? num_args : 0);
+  if (keys)
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+  PyObject* a = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject* o = static_cast<Handle*>(args[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(a, i, o);
+  }
+  PyObject* r = CallShim("symbol_compose", "(OsOO)", h->obj,
+                         name ? name : "", k, a);
+  Py_DECREF(k);
+  Py_DECREF(a);
+  if (!r) return -1;
+  Py_DECREF(h->obj);   // in-place rebind, reference Compose semantics
+  h->obj = r;
+  return 0;
+}
+
+static int SymbolListImpl(SymbolHandle sym, const char* what, StrRet& ret,
+                          mx_uint* out_size, const char*** out_array) {
+  Gil gil;
+  PyObject* r = CallShim("symbol_list", "(Os)",
+                         static_cast<Handle*>(sym)->obj, what);
+  if (!r) return -1;
+  ret.Fill(r);
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(ret.ptrs.size());
+  *out_array = ret.ptrs.data();
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
+                          const char*** out_array) {
+  thread_local StrRet ret;
+  return SymbolListImpl(sym, "arguments", ret, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint* out_size,
+                        const char*** out_array) {
+  thread_local StrRet ret;
+  return SymbolListImpl(sym, "outputs", ret, out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint* out_size,
+                                const char*** out_array) {
+  thread_local StrRet ret;
+  return SymbolListImpl(sym, "auxiliary", ret, out_size, out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char** keys, const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data,
+                       mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data,
+                       mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data, int* complete) {
+  Gil gil;
+  thread_local ShapeRet in_ret, out_ret, aux_ret;
+  PyObject* k = PyList_New(num_args);
+  PyObject* shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    mx_uint nd = arg_ind_ptr[i + 1] - arg_ind_ptr[i];
+    PyObject* t = PyTuple_New(nd);
+    for (mx_uint j = 0; j < nd; ++j)
+      PyTuple_SetItem(t, j, PyLong_FromUnsignedLong(
+          arg_shape_data[arg_ind_ptr[i] + j]));
+    PyList_SetItem(shapes, i, t);
+  }
+  PyObject* r = CallShim("symbol_infer_shape", "(OOO)",
+                         static_cast<Handle*>(sym)->obj, k, shapes);
+  Py_DECREF(k);
+  Py_DECREF(shapes);
+  if (!r) return -1;
+  in_ret.Fill(PyTuple_GetItem(r, 0));
+  out_ret.Fill(PyTuple_GetItem(r, 1));
+  aux_ret.Fill(PyTuple_GetItem(r, 2));
+  *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  *in_shape_size = static_cast<mx_uint>(in_ret.ndims.size());
+  *in_shape_ndim = in_ret.ndims.data();
+  *in_shape_data = in_ret.ptrs.data();
+  *out_shape_size = static_cast<mx_uint>(out_ret.ndims.size());
+  *out_shape_ndim = out_ret.ndims.data();
+  *out_shape_data = out_ret.ptrs.data();
+  *aux_shape_size = static_cast<mx_uint>(aux_ret.ndims.size());
+  *aux_shape_ndim = aux_ret.ndims.data();
+  *aux_shape_data = aux_ret.ptrs.data();
+  return 0;
+}
+
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         mx_uint num_args, const char** keys,
+                         const mx_uint* arg_ndims, const mx_uint* arg_dims,
+                         const char* grad_req, ExecutorHandle* out) {
+  Gil gil;
+  PyObject* k = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+  PyObject* shapes = ShapesToPyList(num_args, arg_ndims, arg_dims);
+  PyObject* r = CallShim("executor_simple_bind", "(OiiOOs)",
+                         static_cast<Handle*>(sym)->obj, dev_type, dev_id,
+                         k, shapes, grad_req);
+  Py_DECREF(k);
+  Py_DECREF(shapes);
+  return WrapResult(r, out);
+}
+
+int MXExecutorFree(ExecutorHandle exec) { return MXNDArrayFree(exec); }
+
+int MXExecutorForward(ExecutorHandle exec, int is_train) {
+  Gil gil;
+  PyObject* r = CallShim("executor_forward", "(Oi)",
+                         static_cast<Handle*>(exec)->obj, is_train);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle exec, mx_uint num_ograds,
+                       NDArrayHandle* out_grads) {
+  Gil gil;
+  PyObject* g = PyList_New(num_ograds);
+  for (mx_uint i = 0; i < num_ograds; ++i) {
+    PyObject* o = static_cast<Handle*>(out_grads[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(g, i, o);
+  }
+  PyObject* r = CallShim("executor_backward", "(OO)",
+                         static_cast<Handle*>(exec)->obj, g);
+  Py_DECREF(g);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle exec, mx_uint* out_size,
+                      NDArrayHandle** out) {
+  Gil gil;
+  PyObject* r = CallShim("executor_outputs", "(O)",
+                         static_cast<Handle*>(exec)->obj);
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  NDArrayHandle* arr = static_cast<NDArrayHandle*>(
+      std::malloc(sizeof(NDArrayHandle) * n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(r, i);
+    Py_INCREF(o);
+    arr[i] = Wrap(o);
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out = arr;
+  return 0;
+}
+
+static int ExecArrayImpl(ExecutorHandle exec, const char* kind,
+                         const char* name, NDArrayHandle* out) {
+  Gil gil;
+  return WrapResult(CallShim("executor_array", "(Oss)",
+                             static_cast<Handle*>(exec)->obj, kind, name),
+                    out);
+}
+
+int MXExecutorArgArray(ExecutorHandle exec, const char* name,
+                       NDArrayHandle* out) {
+  return ExecArrayImpl(exec, "arg", name, out);
+}
+
+int MXExecutorGradArray(ExecutorHandle exec, const char* name,
+                        NDArrayHandle* out) {
+  return ExecArrayImpl(exec, "grad", name, out);
+}
+
+int MXExecutorAuxArray(ExecutorHandle exec, const char* name,
+                       NDArrayHandle* out) {
+  return ExecArrayImpl(exec, "aux", name, out);
+}
+
+// ---- kvstore surface (ref c_api.h MXKVStore* string-key group) ----
+
+namespace {
+
+// (keys, handles) -> (PyList[str], PyList[NDArray]); both new refs.
+void KvLists(mx_uint num, const char** keys, NDArrayHandle* arrs,
+             PyObject** k_out, PyObject** v_out) {
+  PyObject* k = PyList_New(num);
+  PyObject* v = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyObject* o = static_cast<Handle*>(arrs[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(v, i, o);
+  }
+  *k_out = k;
+  *v_out = v;
+}
+
+}  // namespace
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  return WrapResult(CallShim("kv_create", "(s)", type), out);
+}
+
+int MXKVStoreFree(KVStoreHandle kv) { return MXNDArrayFree(kv); }
+
+int MXKVStoreGetType(KVStoreHandle kv, const char** out_type) {
+  Gil gil;
+  thread_local std::string type;
+  PyObject* r = CallShim("kv_type", "(O)", static_cast<Handle*>(kv)->obj);
+  if (!r) return -1;
+  type = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_type = type.c_str();
+  return 0;
+}
+
+static int KvIntImpl(KVStoreHandle kv, const char* fn, int* out) {
+  Gil gil;
+  PyObject* r = CallShim(fn, "(O)", static_cast<Handle*>(kv)->obj);
+  if (!r) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle kv, int* out_rank) {
+  return KvIntImpl(kv, "kv_rank", out_rank);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int* out_size) {
+  return KvIntImpl(kv, "kv_group_size", out_size);
+}
+
+static int KvOpImpl(KVStoreHandle kv, const char* fn, mx_uint num,
+                    const char** keys, NDArrayHandle* arrs, int priority,
+                    bool with_priority) {
+  Gil gil;
+  PyObject *k, *v;
+  KvLists(num, keys, arrs, &k, &v);
+  PyObject* r = with_priority
+      ? CallShim(fn, "(OOOi)", static_cast<Handle*>(kv)->obj, k, v,
+                 priority)
+      : CallShim(fn, "(OOO)", static_cast<Handle*>(kv)->obj, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* values) {
+  return KvOpImpl(kv, "kv_init", num, keys, values, 0, false);
+}
+
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* values, int priority) {
+  return KvOpImpl(kv, "kv_push", num, keys, values, priority, true);
+}
+
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* outs, int priority) {
+  return KvOpImpl(kv, "kv_pull", num, keys, outs, priority, true);
+}
+
+int MXKVStoreBarrier(KVStoreHandle kv) {
+  Gil gil;
+  PyObject* r = CallShim("kv_barrier", "(O)",
+                         static_cast<Handle*>(kv)->obj);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorCopyParamsFrom(ExecutorHandle exec, mx_uint num,
+                             const char** names, NDArrayHandle* arrays) {
+  Gil gil;
+  PyObject* n = PyList_New(num);
+  PyObject* a = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SetItem(n, i, PyUnicode_FromString(names[i]));
+    PyObject* o = static_cast<Handle*>(arrays[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(a, i, o);
+  }
+  PyObject* r = CallShim("executor_copy_params", "(OOO)",
+                         static_cast<Handle*>(exec)->obj, n, a);
+  Py_DECREF(n);
+  Py_DECREF(a);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

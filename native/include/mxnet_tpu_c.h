@@ -62,6 +62,96 @@ int MXNDArrayLoad(const char* fname, mx_uint* out_size,
                   NDArrayHandle** out_arr, mx_uint* out_name_size,
                   const char*** out_names);
 
+/* ---- symbol surface (ref c_api.h MXSymbol* group, 29 fns; the subset
+ * here lets a C host compose a graph or load a -symbol.json) ---- */
+typedef void* SymbolHandle;
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+int MXSymbolSaveToFile(SymbolHandle sym, const char* fname);
+int MXSymbolFree(SymbolHandle sym);
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+/* Two-step atomic-create + compose, the reference construction flow
+ * (c_api.h:882 MXSymbolCreateAtomicSymbol + :1083 MXSymbolCompose);
+ * the creator is addressed by op name instead of an opaque pointer.
+ * Compose REBINDS *sym in place to the composed node. */
+int MXSymbolCreateAtomicSymbol(const char* op_name, mx_uint num_param,
+                               const char** keys, const char** vals,
+                               SymbolHandle* out);
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** keys, SymbolHandle* args);
+int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
+                          const char*** out_array);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint* out_size,
+                        const char*** out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint* out_size,
+                                const char*** out_array);
+/* Shapes in (keys, csr-style ind, flat dims) form like the reference
+ * (c_api.h:1123); outputs land in per-thread ret stores. complete=1
+ * when every shape was inferred. */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char** keys, const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data,
+                       mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data,
+                       mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data, int* complete);
+
+/* ---- executor surface (ref c_api.h MXExecutor* group, 11 fns) ---- */
+typedef void* ExecutorHandle;
+
+/* simple_bind: shapes as (keys, ndims, flat dims); grad_req is one of
+ * "null" / "write" / "add" applied to every param (ref
+ * MXExecutorSimpleBind c_api.h:1371, collapsed to the common case). */
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         mx_uint num_args, const char** keys,
+                         const mx_uint* arg_ndims, const mx_uint* arg_dims,
+                         const char* grad_req, ExecutorHandle* out);
+int MXExecutorFree(ExecutorHandle exec);
+int MXExecutorForward(ExecutorHandle exec, int is_train);
+/* out_grads may be NULL (ones-like head grads, the training default). */
+int MXExecutorBackward(ExecutorHandle exec, mx_uint num_ograds,
+                       NDArrayHandle* out_grads);
+int MXExecutorOutputs(ExecutorHandle exec, mx_uint* out_size,
+                      NDArrayHandle** out);
+/* Live views into the executor's buffers (new references; the arg view
+ * aliases the bound buffer, so SyncCopyFromCPU into it feeds the next
+ * forward). Grad of a "null"-req arg is an error. */
+int MXExecutorArgArray(ExecutorHandle exec, const char* name,
+                       NDArrayHandle* out);
+int MXExecutorGradArray(ExecutorHandle exec, const char* name,
+                        NDArrayHandle* out);
+int MXExecutorAuxArray(ExecutorHandle exec, const char* name,
+                       NDArrayHandle* out);
+/* Copy a loaded checkpoint into the executor ("arg:"/"aux:" prefixes
+ * accepted — the save_checkpoint layout); extra names are ignored. */
+int MXExecutorCopyParamsFrom(ExecutorHandle exec, mx_uint num,
+                             const char** names, NDArrayHandle* arrays);
+
+/* ---- kvstore surface (ref c_api.h MXKVStore* group, string-key
+ * variants: CreateKVStore/KVStoreInitEx/PushEx/PullEx/GetRank/
+ * GetGroupSize/Barrier/GetType) ---- */
+typedef void* KVStoreHandle;
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle kv);
+int MXKVStoreGetType(KVStoreHandle kv, const char** out_type);
+int MXKVStoreGetRank(KVStoreHandle kv, int* out_rank);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int* out_size);
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* values);
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* values, int priority);
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char** keys,
+                    NDArrayHandle* outs, int priority);
+int MXKVStoreBarrier(KVStoreHandle kv);
+
 #ifdef __cplusplus
 }
 

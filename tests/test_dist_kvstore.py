@@ -85,3 +85,74 @@ def test_dist_async_sparse_linear_end_to_end():
     rcs = launch(2, 1, [sys.executable, SPARSE_WORKER],
                  env_extra=ENV, timeout=600)
     assert rcs == [0, 0], "worker exit codes: %r" % (rcs,)
+
+
+def test_wire_framing_rejects_malformed_peers():
+    """r4 advice: one malformed peer must not crash (or code-exec) a
+    training job. Frame = magic + version + length; payload pickle is
+    allowlist-restricted."""
+    import pickle
+    import socket
+    import struct
+    import threading
+
+    import numpy as np
+    from mxnet_tpu import dist_ps
+
+    # 1. round-trip with numpy + containers still works
+    a, b = socket.socketpair()
+    ca, cb = dist_ps.Conn(a), dist_ps.Conn(b)
+    msg = ("push", "w", 0, np.arange(6, dtype=np.float32), None)
+    ca.send(msg)
+    got = cb.recv()
+    assert got[0] == "push" and np.array_equal(got[3], msg[3])
+
+    # 2. garbage magic -> ProtocolError, not a pickle crash
+    a.sendall(b"GARBAGE!" + b"\x00" * 6)
+    with pytest.raises(dist_ps.ProtocolError, match="magic"):
+        cb.recv()
+    a.close(); b.close()
+
+    # 3. wrong wire version -> loud version error
+    a, b = socket.socketpair()
+    blob = pickle.dumps(("barrier",))
+    a.sendall(struct.pack("<4sHQ", b"MXPS", 999, len(blob)) + blob)
+    with pytest.raises(dist_ps.ProtocolError, match="version"):
+        dist_ps.Conn(b).recv()
+    a.close(); b.close()
+
+    # 4. well-framed but disallowed pickle global (code-exec attempt)
+    class Evil:
+        def __reduce__(self):
+            import os as _os
+            return (_os.system, ("true",))
+
+    a, b = socket.socketpair()
+    blob = pickle.dumps(Evil())
+    a.sendall(struct.pack("<4sHQ", b"MXPS", 1, len(blob)) + blob)
+    with pytest.raises(dist_ps.ProtocolError, match="disallowed"):
+        dist_ps.Conn(b).recv()
+    a.close(); b.close()
+
+    # 5. a live Server drops the malformed peer and keeps serving others
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    addr = lsock.getsockname()
+    server = dist_ps.Server(nworkers=1)
+    stop = threading.Event()
+    t = threading.Thread(target=server.serve_forever, args=(lsock, stop),
+                         daemon=True)
+    t.start()
+    rogue = socket.create_connection(addr)
+    rogue.sendall(b"\xde\xad\xbe\xef" * 8)
+    rogue.close()
+    good = dist_ps.Conn(socket.create_connection(addr))
+    good.send(("init", "w", np.ones(4, np.float32), (4,), (0, 4)))
+    assert good.recv() == ("ok",)
+    good.send(("pull", "w"))
+    tag, val = good.recv()
+    assert tag == "val" and np.array_equal(val, np.ones(4, np.float32))
+    stop.set()
+    good.close()
+    lsock.close()

@@ -73,3 +73,28 @@ def test_main_requires_hostfile_for_ssh(tmp_path, monkeypatch):
                          "python", "x.py"])
     with pytest.raises(SystemExit):
         launch_mod.main()
+
+
+def test_build_mpi_command_contract():
+    """mpi mode: one mpirun per role group with the DMLC_*/MXNET_* env
+    exported via -x (ref launch.py mpi mode + dmlc_tracker/mpi.py)."""
+    plans = launch_mod.build_mpi_command(
+        4, 2, ["python", "train.py"], hostfile="hosts.txt",
+        scheduler_host="head", sched_port=9000, coord_port=9001)
+    assert len(plans) == 3
+    sched, server, worker = plans
+    for argv in plans:
+        assert argv[0] == "mpirun"
+        assert argv[-2:] == ["python", "train.py"]
+        assert "--hostfile" in argv and "hosts.txt" in argv
+        joined = " ".join(argv)
+        assert "-x DMLC_PS_ROOT_URI=head" in joined
+        assert "-x DMLC_PS_ROOT_PORT=9000" in joined
+        assert "-x DMLC_NUM_WORKER=4" in joined
+        assert "-x MXNET_COORDINATOR=head:9001" in joined
+    assert sched[sched.index("-n") + 1] == "1"
+    assert "-x DMLC_ROLE=scheduler" in " ".join(sched)
+    assert server[server.index("-n") + 1] == "2"
+    assert "-x DMLC_ROLE=server" in " ".join(server)
+    assert worker[worker.index("-n") + 1] == "4"
+    assert "-x DMLC_ROLE=worker" in " ".join(worker)

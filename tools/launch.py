@@ -206,13 +206,73 @@ def launch_ssh(num_workers, num_servers, cmd, hostfile, timeout=None):
     return rcs
 
 
+def build_mpi_command(num_workers, num_servers, cmd, hostfile=None,
+                      scheduler_host=None, sched_port=None,
+                      coord_port=None, mpirun="mpirun"):
+    """One ``mpirun`` invocation per role group (reference launch.py mpi
+    mode via dmlc-core tracker/dmlc_tracker/mpi.py: mpirun carries the
+    DMLC_* env with -x and fans the same command over the hosts).
+
+    Returns a list of argv lists — no mpirun is executed here, so the
+    construction is unit-testable on machines without MPI.
+    """
+    scheduler_host = scheduler_host or socket.gethostname()
+    sched_port = sched_port or free_port()
+    coord_port = coord_port or free_port()
+    base_env = {
+        "DMLC_PS_ROOT_URI": scheduler_host,
+        "DMLC_PS_ROOT_PORT": str(sched_port),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "MXNET_COORDINATOR": "%s:%d" % (scheduler_host, coord_port),
+        "MXNET_NUM_PROCESSES": str(num_workers),
+    }
+
+    def group(role, n):
+        argv = [mpirun, "-n", str(n)]
+        if hostfile:
+            argv += ["--hostfile", hostfile]
+        for k, v in sorted(dict(base_env, DMLC_ROLE=role).items()):
+            argv += ["-x", "%s=%s" % (k, v)]
+        # per-process ranks come from the MPI runtime: dist_ps and
+        # parallel.multihost read OMPI_COMM_WORLD_RANK / PMI_RANK when
+        # DMLC_WORKER_RANK is absent
+        return argv + list(cmd)
+
+    plans = [group("scheduler", 1)]
+    if num_servers:
+        plans.append(group("server", num_servers))
+    plans.append(group("worker", num_workers))
+    return plans
+
+
+def launch_mpi(num_workers, num_servers, cmd, hostfile=None, timeout=None):
+    """mpi launcher: run the three role groups under mpirun and wait for
+    the worker group's exit code."""
+    plans = build_mpi_command(num_workers, num_servers, cmd, hostfile)
+    procs = [subprocess.Popen(argv) for argv in plans]
+    try:
+        rc = procs[-1].wait(timeout=timeout)      # worker group
+        for p in procs[:-1]:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    finally:
+        for p in procs:                           # never leak role groups
+            if p.poll() is None:
+                p.kill()
+    return [rc]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=None)
     ap.add_argument("-H", "--hostfile", default=None,
-                    help="hostfile for the ssh launcher")
-    ap.add_argument("--launcher", default=None, choices=["local", "ssh"],
+                    help="hostfile for the ssh/mpi launchers")
+    ap.add_argument("--launcher", default=None,
+                    choices=["local", "ssh", "mpi"],
                     help="default: ssh when -H given, else local")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
@@ -224,6 +284,9 @@ def main():
         if not args.hostfile:
             ap.error("ssh launcher needs -H hostfile")
         rcs = launch_ssh(args.num_workers, nserv, args.command,
+                         args.hostfile)
+    elif launcher == "mpi":
+        rcs = launch_mpi(args.num_workers, nserv, args.command,
                          args.hostfile)
     else:
         rcs = launch(args.num_workers, nserv, args.command)
