@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Long-context training with sequence parallelism + ring attention.
+
+The reference scales sequence length by buckets and gradient truncation;
+this framework makes LONG CONTEXT a first-class axis: the sequence
+dimension is sharded over a mesh axis, activations never materialize the
+full [S, S] attention matrix on one device, and the K/V blocks rotate
+around the ring with ``lax.ppermute`` while a running online-softmax
+accumulates exact attention (`parallel/ring_attention.py:49-106` — the
+Ring Attention construction, Liu et al. 2023).
+
+This example trains a needle-in-a-haystack copy task whose answer
+requires attending ACROSS sequence shards: a key token planted in one
+shard must be recalled at the final position, which lives in a different
+shard — so a correct loss proves cross-shard attention works, not just
+local windows. It runs on the virtual CPU mesh out of the box
+(`XLA_FLAGS=--xla_force_host_platform_device_count=8`) and on a TPU pod
+unchanged: same code, real ICI.
+
+Also checked in-script: ring attention output == dense attention on the
+same batch (exactness), per `tests/test_parallel.py`'s equivalence gate.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/long_context_ring_attention.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+if __name__ == "__main__" and os.environ.get("JAX_PLATFORMS") != "tpu":
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu  # noqa: F401  (op registry not needed; parallel utils are)
+from mxnet_tpu.parallel.ring_attention import (local_attention,
+                                               ring_attention_sharded)
+
+
+def make_needle_batch(rng, batch, seq, vocab, probe_token):
+    """Sequence of noise; the value token sits at a FIXED early position
+    (an early sequence shard) and must be recalled at the FINAL
+    position (the last shard). Fixed-position recall is learnable within
+    a test budget — the probe's query locks onto one position embedding —
+    while still being impossible without attention ACROSS shards."""
+    x = rng.randint(3, vocab, (batch, seq))
+    values = rng.randint(3, vocab, (batch,))
+    needle_pos = seq // 6       # e.g. pos 21 of 128 -> shard 1 of 8;
+    for b in range(batch):      # the probe at pos 127 lives in shard 7
+        x[b, needle_pos] = values[b]
+        x[b, -1] = probe_token
+    return x.astype(np.int32), values.astype(np.int32)
+
+
+def build_model(vocab, d_model, n_heads, seq, mesh):
+    hd = d_model // n_heads
+
+    n_layers = 2      # depth helps the probe separate "what is at the
+                      # needle position" from surrounding noise quickly
+
+    def fwd(params, tokens, use_ring=True):
+        emb = params["embed"][tokens]                     # (B, S, D)
+        pos = params["pos"][None, : tokens.shape[1]]
+        h = emb + pos
+        for i in range(n_layers):
+            pre = "l%d_" % i
+            q = jnp.einsum("bsd,dhk->bhsk", h, params[pre + "wq"])
+            k = jnp.einsum("bsd,dhk->bhsk", h, params[pre + "wk"])
+            v = jnp.einsum("bsd,dhk->bhsk", h, params[pre + "wv"])
+            if use_ring:
+                att = ring_attention_sharded(q, k, v, mesh,
+                                             axis_name="seq", causal=True)
+            else:
+                att = local_attention(q, k, v, causal=True)
+            o = jnp.einsum("bhsk,hkd->bsd", att, params[pre + "wo"])
+            h = h + o
+            m = jax.nn.relu(h @ params[pre + "w1"])
+            h = h + m @ params[pre + "w2"]
+        logits = h @ params["out"]                        # (B, S, V)
+        return logits
+
+    def init(rng):
+        keys = iter(jax.random.split(rng, 3 + 6 * n_layers))
+        s = 0.15
+        params = {
+            "embed": jax.random.normal(next(keys), (vocab, d_model)) * s,
+            "pos": jax.random.normal(next(keys), (seq, d_model)) * s,
+            # random (not zero) head: the pre-training ring-vs-dense
+            # exactness check below must see NONZERO logits to bite
+            "out": jax.random.normal(next(keys), (d_model, vocab)) * s,
+        }
+        for i in range(n_layers):
+            pre = "l%d_" % i
+            params[pre + "wq"] = jax.random.normal(
+                next(keys), (d_model, n_heads, hd)) * s
+            params[pre + "wk"] = jax.random.normal(
+                next(keys), (d_model, n_heads, hd)) * s
+            params[pre + "wv"] = jax.random.normal(
+                next(keys), (d_model, n_heads, hd)) * s
+            params[pre + "wo"] = jax.random.normal(
+                next(keys), (n_heads, hd, d_model)) * s
+            params[pre + "w1"] = jax.random.normal(
+                next(keys), (d_model, 2 * d_model)) * s
+            params[pre + "w2"] = jax.random.normal(
+                next(keys), (2 * d_model, d_model)) * s
+        return params
+
+    return fwd, init
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--num-steps", type=int, default=400)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("seq",))
+    n_shards = len(devices)
+    print("mesh: %d-way sequence parallelism, %d tokens per shard"
+          % (n_shards, args.seq_len // n_shards))
+
+    rng = np.random.RandomState(0)
+    fwd, init = build_model(args.vocab, args.d_model, 4, args.seq_len,
+                            mesh)
+    params = init(jax.random.PRNGKey(0))
+    # params replicated; activations sequence-sharded
+    rep = NamedSharding(mesh, P())
+    params = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, rep), params)
+    tok_sharding = NamedSharding(mesh, P(None, "seq"))
+
+    # exactness: ring == dense on one batch
+    x0, _ = make_needle_batch(rng, 4, args.seq_len, args.vocab, 2)
+    x0 = jax.device_put(x0, tok_sharding)
+    ring_logits = fwd(params, x0, use_ring=True)
+    dense_logits = fwd(params, x0, use_ring=False)
+    gap = float(jnp.max(jnp.abs(ring_logits - dense_logits)))
+    print("ring-vs-dense-max-gap %.2e" % gap)
+
+    def loss_fn(p, x, y):
+        logits = fwd(p, x)[:, -1]                # prediction at the probe
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    opt_state = {"m": zeros, "v": zeros, "t": jnp.zeros((), jnp.int32)}
+
+    @jax.jit
+    def step(p, s, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        t = s["t"] + 1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m = jax.tree_util.tree_map(
+            lambda mm, gg: b1 * mm + (1 - b1) * gg, s["m"], g)
+        v = jax.tree_util.tree_map(
+            lambda vv, gg: b2 * vv + (1 - b2) * gg * gg, s["v"], g)
+        corr = args.lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        new_p = jax.tree_util.tree_map(
+            lambda w, mm, vv: w - corr * mm / (jnp.sqrt(vv) + eps),
+            p, m, v)
+        return new_p, {"m": m, "v": v, "t": t}, loss
+
+    loss = None
+    for it in range(args.num_steps):
+        x, y = make_needle_batch(rng, args.batch_size, args.seq_len,
+                                 args.vocab, 2)
+        x = jax.device_put(x, tok_sharding)
+        y = jax.device_put(jnp.asarray(y), NamedSharding(mesh, P()))
+        params, opt_state, loss = step(params, opt_state, x, y)
+        if (it + 1) % 50 == 0:
+            print("step %d loss %.4f" % (it + 1, float(loss)))
+
+    # recall accuracy: can the probe position retrieve the planted value
+    # from ANOTHER sequence shard?
+    x, y = make_needle_batch(rng, 64, args.seq_len, args.vocab, 2)
+    x = jax.device_put(x, tok_sharding)
+    pred = np.asarray(fwd(params, x)[:, -1].argmax(-1))
+    acc = float((pred == y).mean())
+    print("chance %.4f" % (1.0 / (args.vocab - 3)))
+    print("final-needle-accuracy %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

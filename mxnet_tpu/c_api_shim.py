@@ -217,6 +217,67 @@ def executor_array(ex, kind, name):
 
 
 # ---------------------------------------------------------------------------
+# data-iterator surface (behind MXDataIter*, native/c_api.cc)
+# ---------------------------------------------------------------------------
+
+_ITER_CREATORS = ("CSVIter", "LibSVMIter", "MNISTIter", "ImageRecordIter")
+
+
+def list_data_iters():
+    return list(_ITER_CREATORS)
+
+
+def data_iter_create(name, keys, vals):
+    """Param-string creator (reference MXDataIterCreateIter): attrs
+    arrive stringified and parse through the symbol-attr rules."""
+    from . import io as io_mod
+    from . import image as image_mod
+    if name not in _ITER_CREATORS:
+        raise MXNetError("unknown data iter %r (have %s)"
+                         % (name, _ITER_CREATORS))
+    table = {"CSVIter": io_mod.CSVIter,
+             "LibSVMIter": io_mod.LibSVMIter,
+             "MNISTIter": getattr(io_mod, "MNISTIter", None),
+             "ImageRecordIter": image_mod.ImageRecordIter}
+    cls = table.get(name)
+    if cls is None:
+        raise MXNetError("data iter %r unavailable in this build" % name)
+    kwargs = {k: parse_attr_string(v) for k, v in zip(keys, vals)}
+    return cls(**kwargs)
+
+
+def data_iter_before_first(it):
+    it.reset()
+
+
+def data_iter_next(it):
+    """1 if a batch was produced (stash it on the iter), else 0."""
+    try:
+        it._c_current = next(it)
+        return 1
+    except StopIteration:
+        it._c_current = None
+        return 0
+
+
+def data_iter_get(it, what):
+    batch = getattr(it, "_c_current", None)
+    if batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    arrs = batch.data if what == "data" else batch.label
+    if not arrs:
+        raise MXNetError("current batch has no %s" % what)
+    return arrs[0]
+
+
+def data_iter_pad(it):
+    batch = getattr(it, "_c_current", None)
+    if batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    return int(batch.pad or 0)
+
+
+# ---------------------------------------------------------------------------
 # kvstore surface (behind MXKVStore*, native/c_api.cc)
 # ---------------------------------------------------------------------------
 

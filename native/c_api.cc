@@ -660,6 +660,85 @@ int MXExecutorAuxArray(ExecutorHandle exec, const char* name,
   return ExecArrayImpl(exec, "aux", name, out);
 }
 
+// ---- data-iterator surface (ref c_api.h MXDataIter* group) ----
+
+int MXListDataIters(mx_uint* out_size, const char*** out_array) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  thread_local StrRet ret;
+  PyObject* r = CallShim("list_data_iters", "()");
+  if (!r) return -1;
+  ret.Fill(r);
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(ret.ptrs.size());
+  *out_array = ret.ptrs.data();
+  return 0;
+}
+
+int MXDataIterCreateIter(const char* name, mx_uint num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* k = PyList_New(num_param);
+  PyObject* v = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(v, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* r = CallShim("data_iter_create", "(sOO)", name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  return WrapResult(r, out);
+}
+
+int MXDataIterFree(DataIterHandle handle) { return MXNDArrayFree(handle); }
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  Gil gil;
+  PyObject* r = CallShim("data_iter_before_first", "(O)",
+                         static_cast<Handle*>(handle)->obj);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int* out) {
+  Gil gil;
+  PyObject* r = CallShim("data_iter_next", "(O)",
+                         static_cast<Handle*>(handle)->obj);
+  if (!r) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int DataIterGetImpl(DataIterHandle handle, const char* what,
+                           NDArrayHandle* out) {
+  Gil gil;
+  return WrapResult(CallShim("data_iter_get", "(Os)",
+                             static_cast<Handle*>(handle)->obj, what),
+                    out);
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return DataIterGetImpl(handle, "data", out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return DataIterGetImpl(handle, "label", out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad) {
+  Gil gil;
+  PyObject* r = CallShim("data_iter_pad", "(O)",
+                         static_cast<Handle*>(handle)->obj);
+  if (!r) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
 // ---- kvstore surface (ref c_api.h MXKVStore* string-key group) ----
 
 namespace {

@@ -152,6 +152,22 @@ int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char** keys,
                     NDArrayHandle* outs, int priority);
 int MXKVStoreBarrier(KVStoreHandle kv);
 
+/* ---- data-iterator surface (ref c_api.h MXDataIter* group,
+ * c_api.h:1420-1500: param-string creators, Next/BeforeFirst cursor,
+ * GetData/GetLabel views). ---- */
+typedef void* DataIterHandle;
+
+int MXListDataIters(mx_uint* out_size, const char*** out_array);
+int MXDataIterCreateIter(const char* name, mx_uint num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int* out);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad);
+
 #ifdef __cplusplus
 }
 

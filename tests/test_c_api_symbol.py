@@ -310,3 +310,56 @@ def test_kvstore_c_surface():
     for h in (val, grad, out):
         lib.MXNDArrayFree(h)
     lib.MXKVStoreFree(kv)
+
+
+def test_data_iter_c_surface(tmp_path):
+    """MXDataIter* group: param-string CSVIter creation + cursor
+    protocol from ctypes (ref c_api.h:1420-1500)."""
+    import ctypes
+    import mxnet_tpu  # noqa: F401
+    csv = tmp_path / "d.csv"
+    rows = np.arange(24, dtype=np.float32).reshape(8, 3)
+    np.savetxt(csv, rows, delimiter=",", fmt="%.1f")
+
+    lib = ctypes.CDLL(SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+    lib.MXDataIterFree.argtypes = [ctypes.c_void_p]
+    lib.MXDataIterBeforeFirst.argtypes = [ctypes.c_void_p]
+    lib.MXDataIterNext.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int)]
+    lib.MXDataIterGetData.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_void_p)]
+
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(arr)) == 0
+    names = [arr[i].decode() for i in range(n.value)]
+    assert "CSVIter" in names and "ImageRecordIter" in names
+
+    keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(3,)", b"4")
+    it = ctypes.c_void_p()
+    assert lib.MXDataIterCreateIter(b"CSVIter", 3, keys, vals,
+                                    ctypes.byref(it)) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXDataIterBeforeFirst(it) == 0
+    seen = 0
+    has = ctypes.c_int(0)
+    while True:
+        assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0
+        if not has.value:
+            break
+        d = ctypes.c_void_p()
+        assert lib.MXDataIterGetData(it, ctypes.byref(d)) == 0, \
+            lib.MXGetLastError()
+        buf = (ctypes.c_float * 12)()
+        assert lib.MXNDArraySyncCopyToCPU(d, buf, 12) == 0
+        if seen == 0:
+            np.testing.assert_allclose(list(buf)[:3], [0.0, 1.0, 2.0])
+        lib.MXNDArrayFree(d)
+        seen += 1
+    assert seen == 2        # 8 rows / batch 4
+    lib.MXDataIterFree(it)

@@ -362,3 +362,16 @@ def test_speech_acoustic_model(capsys):
     out = run_example("speech_acoustic_model.py", [], capsys)
     acc = float(out.strip().rsplit(" ", 1)[-1])
     assert acc > 0.9, "frame acc %.3f" % acc
+
+
+@pytest.mark.slow
+def test_long_context_ring_attention(capsys):
+    """Sequence-parallel ring attention: exact vs dense, and the model
+    recalls a needle planted in a DIFFERENT sequence shard — cross-shard
+    attention demonstrably works (parallel/ring_attention.py; beyond the
+    reference's capability set, SURVEY §2.5)."""
+    out = run_example("long_context_ring_attention.py", [], capsys)
+    lines = dict(l.rsplit(" ", 1) for l in out.strip().splitlines()
+                 if " " in l)
+    assert float(lines["ring-vs-dense-max-gap"]) < 1e-3
+    assert float(lines["final-needle-accuracy"]) > 0.9
