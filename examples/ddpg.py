@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""DDPG: deterministic policy gradient for continuous control.
+
+Parity target: reference ``example/reinforcement-learning/ddpg/`` —
+``ddpg.py``/``policies.py``/``qfuncs.py``: a deterministic actor
+``mu(s)``, a critic ``Q(s, a)``, soft (Polyak) target-network tracking
+``theta' <- tau*theta + (1-tau)*theta'``, exploration noise on actions,
+and a replay buffer; the critic regresses the TD target
+``r + gamma * Q'(s', mu'(s'))`` and the actor ascends ``Q(s, mu(s))``.
+
+The rllab/MuJoCo environment is replaced by a 1-D continuous
+"docking" task (zero-egress): the agent applies bounded thrust to
+reach and hold the origin; optimal return is near 0, a random policy
+scores around -25.
+
+    python examples/ddpg.py --num-episodes 60
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class Docking(object):
+    """State (pos, vel); action = thrust in [-1, 1]; reward = -(pos^2 +
+    0.1 vel^2 + 0.01 a^2); episode length 40."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.pos = self.rng.uniform(-2.0, 2.0)
+        self.vel = 0.0
+        self.t = 0
+        return self.obs()
+
+    def obs(self):
+        return np.array([self.pos, self.vel], np.float32)
+
+    def step(self, a):
+        a = float(np.clip(a, -1.0, 1.0))
+        self.vel = 0.9 * self.vel + 0.3 * a
+        self.pos += self.vel
+        self.t += 1
+        r = -(self.pos ** 2 + 0.1 * self.vel ** 2 + 0.01 * a ** 2)
+        return self.obs(), r, self.t >= 40
+
+
+def actor_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(1, activation="tanh"))     # bounded thrust
+    return net
+
+
+def critic_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(1))
+    return net
+
+
+def soft_update(src, dst, tau):
+    """Polyak tracking (ref ddpg.py soft target update). Pair by
+    construction order, not name: auto-generated prefixes differ
+    between instances (dense0_ vs dense3_) and sort unreliably."""
+    for (_, p), (_, t) in zip(list(src.collect_params().items()),
+                              list(dst.collect_params().items())):
+        assert p.shape == t.shape, (p.name, t.name)
+        t.data()[:] = tau * p.data() + (1.0 - tau) * t.data()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-episodes", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--gamma", type=float, default=0.97)
+    ap.add_argument("--tau", type=float, default=0.05)
+    ap.add_argument("--actor-lr", type=float, default=1e-3)
+    ap.add_argument("--critic-lr", type=float, default=2e-3)
+    ap.add_argument("--noise", type=float, default=0.3)
+    args = ap.parse_args()
+
+    np.random.seed(10)
+    mx.random.seed(10)
+    env = Docking(seed=1)
+    rng = np.random.RandomState(2)
+
+    actor, critic = actor_net(), critic_net()
+    actor_t, critic_t = actor_net(), critic_net()
+    for net in (actor, critic, actor_t, critic_t):
+        net.initialize(mx.init.Xavier())
+    dummy_s, dummy_a = mx.nd.zeros((1, 2)), mx.nd.zeros((1, 3))
+    actor(dummy_s); actor_t(dummy_s)
+    critic(dummy_a); critic_t(dummy_a)
+    soft_update(actor, actor_t, 1.0)
+    soft_update(critic, critic_t, 1.0)
+    a_tr = gluon.Trainer(actor.collect_params(), "adam",
+                         {"learning_rate": args.actor_lr})
+    c_tr = gluon.Trainer(critic.collect_params(), "adam",
+                         {"learning_rate": args.critic_lr})
+    l2 = gluon.loss.L2Loss()
+
+    buf_s = np.zeros((20000, 2), np.float32)
+    buf_a = np.zeros((20000, 1), np.float32)
+    buf_r = np.zeros(20000, np.float32)
+    buf_s2 = np.zeros((20000, 2), np.float32)
+    size = head = 0
+
+    def cat(s, a):
+        return mx.nd.concat(s, a, dim=1)
+
+    returns = []
+    for ep in range(args.num_episodes):
+        s = env.reset()
+        done, total = False, 0.0
+        while not done:
+            a = float(actor(mx.nd.array(s[None])).asnumpy()[0, 0])
+            a = np.clip(a + args.noise * rng.randn(), -1.0, 1.0)
+            s2, r, done = env.step(a)
+            buf_s[head], buf_a[head, 0], buf_r[head], buf_s2[head] = \
+                s, a, r, s2
+            head = (head + 1) % len(buf_s)
+            size = min(size + 1, len(buf_s))
+            s, total = s2, total + r
+
+            if size >= 500:
+                idx = rng.randint(0, size, args.batch_size)
+                bs = mx.nd.array(buf_s[idx])
+                ba = mx.nd.array(buf_a[idx])
+                br = mx.nd.array(buf_r[idx])
+                bs2 = mx.nd.array(buf_s2[idx])
+                # critic: TD target from TARGET nets
+                a2 = actor_t(bs2)
+                q2 = critic_t(cat(bs2, a2))[:, 0]
+                target = br + args.gamma * q2
+                with autograd.record():
+                    q = critic(cat(bs, ba))[:, 0]
+                    closs = l2(q, mx.nd.BlockGrad(target))
+                closs.backward()
+                c_tr.step(args.batch_size)
+                # actor: ascend Q(s, mu(s)) — grads flow THROUGH the
+                # critic into the actor (the deterministic PG)
+                with autograd.record():
+                    aloss = -mx.nd.mean(critic(cat(bs, actor(bs))))
+                aloss.backward()
+                a_tr.step(args.batch_size)
+                soft_update(actor, actor_t, args.tau)
+                soft_update(critic, critic_t, args.tau)
+        returns.append(total)
+        if (ep + 1) % 20 == 0:
+            print("episode %d mean-return %.2f"
+                  % (ep + 1, np.mean(returns[-20:])))
+
+    # deterministic evaluation
+    evals = []
+    for _ in range(10):
+        s = env.reset()
+        done, total = False, 0.0
+        while not done:
+            a = float(actor(mx.nd.array(s[None])).asnumpy()[0, 0])
+            s, r, done = env.step(a)
+            total += r
+        evals.append(total)
+    print("random-baseline ~ -25")
+    print("final-eval-return %.3f" % np.mean(evals))
+
+
+if __name__ == "__main__":
+    main()

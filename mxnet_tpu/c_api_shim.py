@@ -217,6 +217,58 @@ def executor_array(ex, kind, name):
 
 
 # ---------------------------------------------------------------------------
+# autograd surface (behind MXAutograd*, native/c_api.cc)
+# ---------------------------------------------------------------------------
+
+def autograd_set_recording(flag):
+    from . import autograd
+    prev = autograd.set_recording(bool(flag))
+    if flag and not prev:
+        # fresh outermost session: drop stale tape nodes, exactly like
+        # the Python record() scope does (autograd.py:67 _clear_tape)
+        autograd._clear_tape()
+    return int(bool(prev))
+
+
+def autograd_set_training(flag):
+    from . import autograd
+    return int(bool(autograd.set_training(bool(flag))))
+
+
+def autograd_is_recording():
+    from . import autograd
+    return int(bool(autograd.is_recording()))
+
+
+def autograd_is_training():
+    from . import autograd
+    return int(bool(autograd.is_training()))
+
+
+def autograd_mark_variables(variables, gradients, reqs):
+    from . import autograd
+    autograd.mark_variables(list(variables), list(gradients),
+                            [str(r) for r in reqs])
+
+
+def autograd_backward(outputs, ograds, retain_graph, train_mode):
+    from . import autograd
+    from .ndarray import ones
+    outputs = list(outputs)
+    if ograds:
+        # a None slot means ones_like for that head (reference
+        # MXAutogradBackwardEx per-head default)
+        ograds = [g if g is not None
+                  else ones(o.shape, ctx=o.context, dtype=o.dtype)
+                  for g, o in zip(ograds, outputs)]
+    else:
+        ograds = None
+    autograd.backward(outputs, ograds,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+# ---------------------------------------------------------------------------
 # data-iterator surface (behind MXDataIter*, native/c_api.cc)
 # ---------------------------------------------------------------------------
 

@@ -660,6 +660,110 @@ int MXExecutorAuxArray(ExecutorHandle exec, const char* name,
   return ExecArrayImpl(exec, "aux", name, out);
 }
 
+// ---- autograd surface (ref c_api.h MXAutograd* group) ----
+
+static int AutogradFlagImpl(const char* fn, int value, int* prev) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* r = CallShim(fn, "(i)", value);
+  if (!r) return -1;
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  return AutogradFlagImpl("autograd_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  return AutogradFlagImpl("autograd_set_training", is_training, prev);
+}
+
+static int AutogradGetImpl(const char* fn, int* curr) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* r = CallShim(fn, "()");
+  if (!r) return -1;
+  *curr = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsRecording(int* curr) {
+  return AutogradGetImpl("autograd_is_recording", curr);
+}
+
+int MXAutogradIsTraining(int* curr) {
+  return AutogradGetImpl("autograd_is_training", curr);
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
+                            mx_uint* reqs_array,
+                            NDArrayHandle* grad_handles) {
+  Gil gil;
+  PyObject* vars = PyList_New(num_var);
+  PyObject* grads = PyList_New(num_var);
+  PyObject* reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i) {
+    PyObject* v = static_cast<Handle*>(var_handles[i])->obj;
+    PyObject* g = static_cast<Handle*>(grad_handles[i])->obj;
+    Py_INCREF(v);
+    Py_INCREF(g);
+    PyList_SetItem(vars, i, v);
+    PyList_SetItem(grads, i, g);
+    // reference OpReqType codes: 0=null, 1=write, 2=write-inplace
+    // (treated as write), 3=add
+    const char* req = reqs_array[i] == 3 ? "add"
+                      : (reqs_array[i] == 0 ? "null" : "write");
+    PyList_SetItem(reqs, i, PyUnicode_FromString(req));
+  }
+  PyObject* r = CallShim("autograd_mark_variables", "(OOO)", vars, grads,
+                         reqs);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles, int retain_graph,
+                         int train_mode) {
+  Gil gil;
+  PyObject* outs = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyObject* o = static_cast<Handle*>(output_handles[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(outs, i, o);
+  }
+  PyObject* ogs;
+  if (ograd_handles) {
+    ogs = PyList_New(num_output);
+    for (mx_uint i = 0; i < num_output; ++i) {
+      if (ograd_handles[i]) {
+        PyObject* o = static_cast<Handle*>(ograd_handles[i])->obj;
+        Py_INCREF(o);
+        PyList_SetItem(ogs, i, o);
+      } else {
+        // NULL slot = ones_like default for that head (ref contract)
+        Py_INCREF(Py_None);
+        PyList_SetItem(ogs, i, Py_None);
+      }
+    }
+  } else {
+    ogs = PyList_New(0);
+  }
+  PyObject* r = CallShim("autograd_backward", "(OOii)", outs, ogs,
+                         retain_graph, train_mode);
+  Py_DECREF(outs);
+  Py_DECREF(ogs);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 // ---- data-iterator surface (ref c_api.h MXDataIter* group) ----
 
 int MXListDataIters(mx_uint* out_size, const char*** out_array) {

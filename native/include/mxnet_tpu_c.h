@@ -152,6 +152,23 @@ int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char** keys,
                     NDArrayHandle* outs, int priority);
 int MXKVStoreBarrier(KVStoreHandle kv);
 
+/* ---- autograd surface (ref c_api.h MXAutograd* group,
+ * c_api.h:702-778: recording/training scopes, mark-variables, tape
+ * backward). grad_reqs use the reference OpReqType codes: 0=null,
+ * 1=write, 2=write-inplace (treated as write), 3=add; marked gradients
+ * are written into the passed grad handles. In BackwardEx a NULL slot
+ * in ograd_handles means ones_like for that head. ---- */
+int MXAutogradSetIsRecording(int is_recording, int* prev);
+int MXAutogradSetIsTraining(int is_training, int* prev);
+int MXAutogradIsRecording(int* curr);
+int MXAutogradIsTraining(int* curr);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
+                            mx_uint* reqs_array,
+                            NDArrayHandle* grad_handles);
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles, int retain_graph,
+                         int train_mode);
+
 /* ---- data-iterator surface (ref c_api.h MXDataIter* group,
  * c_api.h:1420-1500: param-string creators, Next/BeforeFirst cursor,
  * GetData/GetLabel views). ---- */

@@ -363,3 +363,76 @@ def test_data_iter_c_surface(tmp_path):
         seen += 1
     assert seen == 2        # 8 rows / batch 4
     lib.MXDataIterFree(it)
+
+
+def test_autograd_c_surface():
+    """MXAutograd* group: record scope + mark_variables + BackwardEx
+    from ctypes computes d(x^2)/dx = 2x into the marked grad handle
+    (ref c_api.h:702-778)."""
+    import ctypes
+    import mxnet_tpu  # noqa: F401
+    lib = ctypes.CDLL(SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+
+    shape = (ctypes.c_uint * 1)(3)
+    x, g = ctypes.c_void_p(), ctypes.c_void_p()
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(x)) == 0
+    assert lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                 ctypes.byref(g)) == 0
+    buf = (ctypes.c_float * 3)(1.0, 2.0, 3.0)
+    assert lib.MXNDArraySyncCopyFromCPU(x, buf, 3) == 0
+
+    reqs = (ctypes.c_uint * 1)(1)                    # write
+    xs = (ctypes.c_void_p * 1)(x.value)
+    gs = (ctypes.c_void_p * 1)(g.value)
+    assert lib.MXAutogradMarkVariables(1, xs, reqs, gs) == 0, \
+        lib.MXGetLastError()
+
+    prev = ctypes.c_int(-1)
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    assert prev.value == 0
+    cur = ctypes.c_int(-1)
+    assert lib.MXAutogradIsRecording(ctypes.byref(cur)) == 0
+    assert cur.value == 1
+
+    ins = (ctypes.c_void_p * 2)(x.value, x.value)
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXImperativeInvoke(b"elemwise_mul", 2, ins,
+                                  ctypes.byref(n_out), ctypes.byref(outs),
+                                  0, None, None) == 0, lib.MXGetLastError()
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+
+    oh = (ctypes.c_void_p * 1)(outs[0])
+    assert lib.MXAutogradBackwardEx(1, oh, None, 0, 1) == 0, \
+        lib.MXGetLastError()
+    got = (ctypes.c_float * 3)()
+    assert lib.MXNDArraySyncCopyToCPU(g, got, 3) == 0
+    np.testing.assert_allclose(list(got), [2.0, 4.0, 6.0], rtol=1e-6)
+
+    # a NULL slot in ograd_handles = ones_like default (ref contract);
+    # must not crash and must produce the same gradient
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    n2 = ctypes.c_int(0)
+    outs2 = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXImperativeInvoke(b"elemwise_mul", 2, ins,
+                                  ctypes.byref(n2), ctypes.byref(outs2),
+                                  0, None, None) == 0
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    oh2 = (ctypes.c_void_p * 1)(outs2[0])
+    null_ogs = (ctypes.c_void_p * 1)(None)
+    assert lib.MXAutogradBackwardEx(1, oh2, null_ogs, 0, 1) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXNDArraySyncCopyToCPU(g, got, 3) == 0
+    np.testing.assert_allclose(list(got), [2.0, 4.0, 6.0], rtol=1e-6)
+    lib.MXNDArrayFree(outs2[0])
+
+    lib.MXNDArrayFree(x)
+    lib.MXNDArrayFree(g)
+    lib.MXNDArrayFree(outs[0])

@@ -375,3 +375,13 @@ def test_long_context_ring_attention(capsys):
                  if " " in l)
     assert float(lines["ring-vs-dense-max-gap"]) < 1e-3
     assert float(lines["final-needle-accuracy"]) > 0.9
+
+
+@pytest.mark.slow
+def test_ddpg_continuous_control(capsys):
+    """DDPG with target networks + replay: deterministic eval return far
+    above the random baseline on the docking task
+    (ref example/reinforcement-learning/ddpg/)."""
+    out = run_example("ddpg.py", ["--num-episodes", "60"], capsys)
+    ret = float(out.strip().rsplit(" ", 1)[-1])
+    assert ret > -10.0, "eval return %.2f (random ~ -25)" % ret
