@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Kaggle-NDSB-style pipeline: pack images to RecordIO, train from the
+native threaded decoder.
+
+Parity target: reference ``example/kaggle-ndsb1/`` — the plankton
+competition flow: ``gen_img_list.py`` builds a .lst, ``im2rec`` packs
+JPEG images into .rec, ``train_dsb.py`` trains a CNN from
+``ImageRecordIter`` with augmentation, and predictions come from the
+trained module. The plankton corpus is replaced by procedural
+"organism" silhouettes (4 morphology classes: circular, elongated,
+star, ring) rendered at random scale/rotation (zero-egress).
+
+The pipeline stages map 1:1:
+  1. render images           (gen_img_list analogue)
+  2. ``recordio.pack_img`` → .rec/.idx  (im2rec analogue, same format)
+  3. ``image.ImageRecordIter``          (native worker-pool JPEG decode)
+  4. Module CNN fit + accuracy          (train_dsb analogue)
+
+    python examples/kaggle_ndsb_pipeline.py --num-images 512
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def render_organism(cls, size, rng):
+    """Grayscale silhouette on noise; classes differ in morphology."""
+    img = rng.rand(size, size) * 90.0
+    yy, xx = np.mgrid[0:size, 0:size]
+    cy, cx = size / 2 + rng.randn(2) * 2
+    r = (yy - cy) ** 2 + (xx - cx) ** 2
+    theta = np.arctan2(yy - cy, xx - cx) + rng.rand() * np.pi
+    scale = rng.uniform(0.18, 0.3) * size
+    if cls == 0:                                   # circular blob
+        mask = r <= scale ** 2
+    elif cls == 1:                                 # elongated
+        a, b = scale, scale * 0.35
+        rot = rng.rand() * np.pi
+        u = (xx - cx) * np.cos(rot) + (yy - cy) * np.sin(rot)
+        v = -(xx - cx) * np.sin(rot) + (yy - cy) * np.cos(rot)
+        mask = (u / a) ** 2 + (v / b) ** 2 <= 1.0
+    elif cls == 2:                                 # 5-arm star
+        wobble = 1.0 + 0.45 * np.cos(5 * theta)
+        mask = r <= (scale * 0.8 * wobble) ** 2
+    else:                                          # ring
+        mask = (r <= scale ** 2) & (r >= (scale * 0.55) ** 2)
+    img[mask] = 120.0 + rng.randn(mask.sum()) * 35.0
+    rgb = np.repeat(img[:, :, None], 3, axis=2)
+    return np.clip(rgb, 0, 255).astype(np.uint8)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-images", type=int, default=512)
+    ap.add_argument("--image-size", type=int, default=24)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.003)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+
+    np.random.seed(6)
+    mx.random.seed(6)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="ndsb_")
+
+    # ---- stage 1+2: render + pack into RecordIO (.rec/.idx) ----
+    def pack_split(name, n, seed_off):
+        srng = np.random.RandomState(15 + seed_off)
+        path = os.path.join(workdir, name)
+        w = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+        for i in range(n):
+            cls = int(srng.randint(4))
+            img = render_organism(cls, args.image_size, srng)
+            hdr = recordio.IRHeader(0, float(cls), i, 0)
+            w.write_idx(i, recordio.pack_img(hdr, img, quality=95,
+                                             img_fmt=".jpg"))
+        w.close()
+        return path + ".rec"
+
+    train_rec = pack_split("train", args.num_images, 0)
+    val_rec = pack_split("val", 160, 1)
+    print("packed %s (%d images)" % (train_rec, args.num_images))
+
+    # ---- stage 3: native threaded decode + augmentation ----
+    from mxnet_tpu.image import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=train_rec,
+                         data_shape=(3, args.image_size, args.image_size),
+                         batch_size=args.batch_size, shuffle=True,
+                         rand_mirror=True, preprocess_threads=2)
+    vit = ImageRecordIter(path_imgrec=val_rec,
+                          data_shape=(3, args.image_size, args.image_size),
+                          batch_size=args.batch_size)
+
+    # ---- stage 4: CNN through Module ----
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=32,
+                             pad=(1, 1), name="c2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="f1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="f2")
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.context.current_context())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params=(("learning_rate", args.lr),))
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.num_epochs):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        print("epoch %d train-acc %.4f" % (epoch, metric.get()[1]))
+
+    vit.reset()
+    metric.reset()
+    for batch in vit:
+        mod.forward(batch, is_train=False)
+        mod.update_metric(metric, batch.label)
+    print("final-val-acc %.4f" % metric.get()[1])
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,8 @@ __all__ = ["ImageRecordIter"]
 
 def _lib():
     from .._native import load_shared
-    lib = load_shared("libimageloader.so")
+    lib = load_shared("libimageloader.so",
+                      required_symbol="mx_imgloader_last_failed")
     if lib is None:
         raise ImportError("libimageloader.so not built (make -C native)")
     lib.mx_imgloader_create.restype = ctypes.c_void_p
@@ -39,6 +40,10 @@ def _lib():
         ctypes.POINTER(ctypes.c_float)]
     lib.mx_imgloader_reset.argtypes = [ctypes.c_void_p]
     lib.mx_imgloader_destroy.argtypes = [ctypes.c_void_p]
+    lib.mx_imgloader_failures.restype = ctypes.c_long
+    lib.mx_imgloader_failures.argtypes = [ctypes.c_void_p]
+    lib.mx_imgloader_last_failed.restype = ctypes.c_int
+    lib.mx_imgloader_last_failed.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -52,9 +57,11 @@ class ImageRecordIter(_io.DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size,
                  shuffle=False, preprocess_threads=4, rand_mirror=False,
                  seed=0, mean_rgb=None, scale=None, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", allow_corrupt=False,
+                 **kwargs):
         super().__init__(batch_size)
         c, h, w = data_shape
+        self._allow_corrupt = bool(allow_corrupt)
         self._lib = _lib()
         self._handle = self._lib.mx_imgloader_create(
             str(path_imgrec).encode(), batch_size, h, w, c,
@@ -76,6 +83,12 @@ class ImageRecordIter(_io.DataIter):
     def num_samples(self):
         return int(self._lib.mx_imgloader_num_samples(self._handle))
 
+    @property
+    def num_failed(self):
+        """Cumulative records dropped for decode failure (only grows
+        with allow_corrupt=True; strict mode raises instead)."""
+        return int(self._lib.mx_imgloader_failures(self._handle))
+
     def reset(self):
         self._lib.mx_imgloader_reset(self._handle)
 
@@ -84,6 +97,15 @@ class ImageRecordIter(_io.DataIter):
             self._handle,
             self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        fresh = int(self._lib.mx_imgloader_last_failed(self._handle))
+        if fresh and not self._allow_corrupt:
+            # training on garbage must be loud; with allow_corrupt=True
+            # corrupt records are COMPACTED OUT of the batch (true
+            # skip-and-count, like the reference's skip-and-log)
+            raise IOError(
+                "%d record(s) failed to decode (corrupt or non-JPEG "
+                "payload); pass allow_corrupt=True to skip them"
+                % fresh)
         if n == 0:
             raise StopIteration
         data = self._data_buf

@@ -14,6 +14,8 @@
 //   mx_imgloader_create(rec, batch, h, w, c, threads, shuffle, seed, mirror)
 //   mx_imgloader_num_samples(h)
 //   mx_imgloader_next(h, float* data, float* labels) -> n valid (0 = epoch end)
+//   mx_imgloader_last_failed(h) -> decode failures behind the last next()
+//   mx_imgloader_failures(h)    -> cumulative decode failures
 //   mx_imgloader_reset(h)
 //   mx_imgloader_destroy(h)
 //
@@ -123,11 +125,14 @@ struct Batch {
   std::vector<float> data;
   std::vector<float> labels;
   int n = 0;
+  int failed = 0;   // records of THIS batch that failed to decode
 };
 
 struct Loader {
   int fd = -1;
   int batch, h, w, c, threads, shuffle, mirror;
+  std::atomic<long> failures{0};   // cumulative decode failures
+  int last_failed = 0;             // failures of the batch last returned
   std::mt19937 rng;
   std::vector<Rec> recs;
   std::vector<uint32_t> order;
@@ -160,12 +165,15 @@ struct Loader {
     for (uint32_t i = 0; i < order.size(); ++i) order[i] = i;
   }
 
-  void decode_one(uint32_t rec_idx, Batch* out, int slot, bool flip) {
+  // Returns true on success; failures leave the slot zeroed (the
+  // caller compacts them out of the batch).
+  bool decode_one(uint32_t rec_idx, Batch* out, int slot, bool flip) {
     const Rec& r = recs[rec_idx];
     std::vector<unsigned char> raw(r.len);
-    if (pread(fd, raw.data(), r.len, r.off) != static_cast<ssize_t>(r.len))
-      return;
-    if (r.len < 24) return;
+    if (pread(fd, raw.data(), r.len, r.off) !=
+        static_cast<ssize_t>(r.len))
+      return false;
+    if (r.len < 24) return false;
     uint32_t flag;
     float label;
     std::memcpy(&flag, raw.data(), 4);
@@ -173,46 +181,77 @@ struct Loader {
     size_t skip = 24 + static_cast<size_t>(flag > 0 ? flag : 0) * 4;
     if (flag > 0 && r.len >= skip)
       std::memcpy(&label, raw.data() + 24, 4);   // first extended label
-    if (r.len <= skip) return;
+    if (r.len <= skip) return false;
     std::vector<unsigned char> rgb;
     int sw = 0, sh = 0;
     if (!decode_jpeg(raw.data() + skip, r.len - skip, &rgb, &sw, &sh))
-      return;
+      return false;   // corrupt or non-JPEG payload
     float* dst = out->data.data() +
         static_cast<size_t>(slot) * c * h * w;
     resize_to_chw(rgb.data(), sw, sh, dst, w, h, c, flip);
     out->labels[slot] = label;
+    return true;
   }
 
   // Assemble one batch into *out (parallel across `threads` workers).
+  // Corrupt records are dropped and the batch is TOPPED UP from the
+  // records that follow (the reference iterator's read-ahead-past-
+  // corrupt behavior): out->n is short only at true end-of-data.
   void fill(Batch* out) {
     out->data.assign(static_cast<size_t>(batch) * c * h * w, 0.0f);
     out->labels.assign(batch, 0.0f);
-    size_t take = std::min<size_t>(batch, recs.size() - cursor);
-    out->n = static_cast<int>(take);
-    if (take == 0) return;
-    std::vector<uint32_t> picked(order.begin() + cursor,
-                                 order.begin() + cursor + take);
-    std::vector<char> flips(take, 0);
-    if (mirror) {
-      std::bernoulli_distribution coin(0.5);
-      for (auto& fl : flips) fl = coin(rng) ? 1 : 0;
-    }
-    cursor += take;
-    std::atomic<size_t> next_slot{0};
-    auto work = [&]() {
-      for (;;) {
-        size_t slot = next_slot.fetch_add(1);
-        if (slot >= take) return;
-        decode_one(picked[slot], out, static_cast<int>(slot),
-                   flips[slot] != 0);
+    out->failed = 0;
+    size_t plane = static_cast<size_t>(c) * h * w;
+    size_t filled = 0;
+    std::bernoulli_distribution coin(0.5);
+    while (filled < static_cast<size_t>(batch) && cursor < recs.size()) {
+      size_t take = std::min<size_t>(batch - filled,
+                                     recs.size() - cursor);
+      std::vector<uint32_t> picked(order.begin() + cursor,
+                                   order.begin() + cursor + take);
+      std::vector<char> flips(take, 0);
+      if (mirror)
+        for (auto& fl : flips) fl = coin(rng) ? 1 : 0;
+      cursor += take;
+      std::vector<char> ok(take, 0);
+      std::atomic<size_t> next_slot{0};
+      auto work = [&]() {
+        for (;;) {
+          size_t slot = next_slot.fetch_add(1);
+          if (slot >= take) return;
+          ok[slot] = decode_one(picked[slot], out,
+                                static_cast<int>(filled + slot),
+                                flips[slot] != 0) ? 1 : 0;
+        }
+      };
+      int nthreads = std::max(1, threads);
+      std::vector<std::thread> pool;
+      for (int i = 1; i < nthreads; ++i) pool.emplace_back(work);
+      work();
+      for (auto& t : pool) t.join();
+      // compact this round's failed slots, then loop to top up
+      size_t dst = filled;
+      for (size_t src = 0; src < take; ++src) {
+        if (!ok[src]) continue;
+        size_t s = filled + src;
+        if (dst != s) {
+          std::memcpy(out->data.data() + dst * plane,
+                      out->data.data() + s * plane,
+                      plane * sizeof(float));
+          out->labels[dst] = out->labels[s];
+        }
+        ++dst;
       }
-    };
-    int nthreads = std::max(1, threads);
-    std::vector<std::thread> pool;
-    for (int i = 1; i < nthreads; ++i) pool.emplace_back(work);
-    work();
-    for (auto& t : pool) t.join();
+      out->failed += static_cast<int>(filled + take - dst);
+      filled = dst;
+    }
+    out->n = static_cast<int>(filled);
+    failures.fetch_add(out->failed);
+    // zero any tail so padded slots are deterministic
+    for (size_t s = filled; s < static_cast<size_t>(batch); ++s) {
+      std::memset(out->data.data() + s * plane, 0, plane * sizeof(float));
+      out->labels[s] = 0.0f;
+    }
   }
 
   void start_prefetch() {
@@ -265,20 +304,44 @@ int64_t mx_imgloader_num_samples(void* handle) {
 
 int mx_imgloader_next(void* handle, float* data, float* labels) {
   auto* L = static_cast<Loader*>(handle);
-  Batch& b = L->bufs[L->cur];
-  if (b.n == 0) return 0;
-  std::memcpy(data, b.data.data(), b.data.size() * sizeof(float));
-  std::memcpy(labels, b.labels.data(), b.labels.size() * sizeof(float));
-  int n = b.n;
-  // rotate: the prefetched batch becomes current, refill the other
-  if (L->pending.valid()) L->pending.wait();
-  L->cur = 1 - L->cur;
-  L->start_prefetch();
-  return n;
+  L->last_failed = 0;
+  for (;;) {
+    Batch& b = L->bufs[L->cur];
+    L->last_failed += b.failed;
+    if (b.n == 0 && b.failed > 0) {
+      // every record of this batch was corrupt: advance rather than
+      // reporting a spurious epoch end
+      if (L->pending.valid()) L->pending.wait();
+      L->cur = 1 - L->cur;
+      L->start_prefetch();
+      continue;
+    }
+    if (b.n == 0) return 0;        // true epoch end
+    std::memcpy(data, b.data.data(), b.data.size() * sizeof(float));
+    std::memcpy(labels, b.labels.data(),
+                b.labels.size() * sizeof(float));
+    int n = b.n;
+    // rotate: the prefetched batch becomes current, refill the other
+    if (L->pending.valid()) L->pending.wait();
+    L->cur = 1 - L->cur;
+    L->start_prefetch();
+    return n;
+  }
 }
 
 void mx_imgloader_reset(void* handle) {
   static_cast<Loader*>(handle)->reset();
+}
+
+long mx_imgloader_failures(void* handle) {
+  return static_cast<Loader*>(handle)->failures.load();
+}
+
+// Failures attributable to the batch most recently returned by
+// mx_imgloader_next (race-free, unlike polling the cumulative count
+// while prefetch runs).
+int mx_imgloader_last_failed(void* handle) {
+  return static_cast<Loader*>(handle)->last_failed;
 }
 
 void mx_imgloader_destroy(void* handle) {

@@ -385,3 +385,13 @@ def test_ddpg_continuous_control(capsys):
     out = run_example("ddpg.py", ["--num-episodes", "60"], capsys)
     ret = float(out.strip().rsplit(" ", 1)[-1])
     assert ret > -10.0, "eval return %.2f (random ~ -25)" % ret
+
+
+def test_kaggle_ndsb_pipeline(capsys):
+    """Full rec pipeline: pack_img -> .rec -> native threaded decode ->
+    Module CNN; val accuracy well above 0.25 chance
+    (ref example/kaggle-ndsb1/)."""
+    out = run_example("kaggle_ndsb_pipeline.py",
+                      ["--num-epochs", "10"], capsys)
+    acc = float(out.strip().rsplit(" ", 1)[-1])
+    assert acc > 0.55, "val acc %.3f vs 0.25 chance" % acc

@@ -82,3 +82,50 @@ def test_loader_mean_scale(tmp_path):
                          batch_size=4, mean_rgb=(0, 0, 0), scale=2.0)
     arr = it.next().data[0].asnumpy()
     assert arr.max() <= 2.0 and arr.max() > 1.0   # scaled past [0, 1]
+
+
+def test_non_jpeg_payload_fails_loudly(tmp_path):
+    """Corrupt/non-JPEG records must raise, not train on silent zeros
+    (round-5 regression: PNG payloads used to yield all-zero batches);
+    with allow_corrupt=True they are COMPACTED out (skip-and-count)."""
+    import numpy as np
+    import pytest
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import ImageRecordIter
+    path = str(tmp_path / "bad")
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(4):
+        hdr = recordio.IRHeader(0, float(i + 1), i, 0)
+        if i == 2:   # one corrupt record among three valid JPEGs
+            w.write_idx(i, recordio.pack(hdr, b"\x89PNG not a jpeg" * 10))
+        else:
+            img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+            w.write_idx(i, recordio.pack_img(hdr, img, img_fmt=".jpg"))
+    w.close()
+    it = ImageRecordIter(path_imgrec=path + ".rec",
+                         data_shape=(3, 8, 8), batch_size=4)
+    with pytest.raises(IOError, match="failed to decode"):
+        next(it)
+    # opting in: the corrupt record is dropped, NOT fed as zeros/class-0
+    it2 = ImageRecordIter(path_imgrec=path + ".rec",
+                          data_shape=(3, 8, 8), batch_size=4,
+                          allow_corrupt=True)
+    batch = next(it2)
+    kept = 4 - batch.pad
+    assert kept == 3
+    labels = sorted(batch.label[0].asnumpy()[:kept].tolist())
+    assert labels == [1.0, 2.0, 4.0], labels   # corrupt record 3 skipped
+    # an ALL-corrupt file reports a clean epoch end, not garbage batches
+    path2 = str(tmp_path / "allbad")
+    w = recordio.MXIndexedRecordIO(path2 + ".idx", path2 + ".rec", "w")
+    for i in range(3):
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, 1.0, i, 0),
+                                     b"nope" * 20))
+    w.close()
+    it3 = ImageRecordIter(path_imgrec=path2 + ".rec",
+                          data_shape=(3, 8, 8), batch_size=2,
+                          allow_corrupt=True)
+    with pytest.raises(StopIteration):
+        next(it3)
