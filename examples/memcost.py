@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Memory-cost study: rematerialization vs stored activations.
+
+Parity target: reference ``example/memcost/`` — scripts that measure
+training memory under ``MXNET_BACKWARD_DO_MIRROR`` (recompute
+activations in backward instead of storing them, trading ~30% more
+compute for O(sqrt(N)) activation memory).
+
+TPU-native version: the mirror flag maps to ``jax.checkpoint`` on
+residual-block boundaries (the same policy `tests/test_recompute.py`
+gates), and the measurement comes from XLA itself —
+``jit(...).lower().compile().memory_analysis()`` reports the compiled
+program's temp/argument/output allocation exactly, no device probing
+or allocator shims needed.
+
+    python examples/memcost.py --depth 12
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def block(params, x):
+    w1, w2 = params
+    h = jax.nn.relu(x @ w1)
+    return x + h @ w2
+
+
+def make_loss(remat):
+    """Depth as a lax.scan over stacked block params — the TPU-idiomatic
+    deep-residual form (compile time independent of depth). Without
+    remat the scan's backward stores every per-iteration residual in a
+    stacked buffer; jax.checkpoint on the body drops them and replays."""
+    blk = jax.checkpoint(block) if remat else block
+
+    def loss(stacked, x):
+        def step(carry, p):
+            return blk(p, carry), None
+
+        out, _ = jax.lax.scan(step, x, stacked)
+        return jnp.sum(out * out)
+
+    return loss
+
+
+def temp_bytes(fn, *args):
+    compiled = jax.jit(fn).lower(*args).compile()
+    mem = compiled.memory_analysis()
+    return int(getattr(mem, "temp_size_in_bytes", 0))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--width", type=int, default=512)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    stacked = (jnp.asarray(rng.randn(args.depth, args.width, args.width)
+                           * 0.05, jnp.float32),
+               jnp.asarray(rng.randn(args.depth, args.width, args.width)
+                           * 0.05, jnp.float32))
+    x = jnp.asarray(rng.randn(args.batch, args.width), jnp.float32)
+
+    stored = temp_bytes(jax.grad(make_loss(remat=False)), stacked, x)
+    remat = temp_bytes(jax.grad(make_loss(remat=True)), stacked, x)
+    if stored <= 0:
+        raise RuntimeError("memory_analysis reported no temp allocation; "
+                           "the measurement is not working on this backend")
+    # gradients must agree: remat is a pure memory/compute trade
+    g0 = jax.grad(make_loss(False))(stacked, x)
+    g1 = jax.grad(make_loss(True))(stacked, x)
+    gap = max(float(jnp.max(jnp.abs(a - b)))
+              for a, b in zip(g0, g1))
+
+    print("stored-activations-temp-bytes %d" % stored)
+    print("remat-temp-bytes %d" % remat)
+    print("grad-max-gap %.3e" % gap)
+    print("final-memory-ratio %.3f" % (remat / max(stored, 1)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Caffe prototxt -> symbol converter (ref tools/caffe_converter/
+convert_symbol.py). The fixture prototxts are authored here in the
+public text format; the converted symbols must bind and run."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import caffe_converter as cc  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+LENET = """
+name: "LeNet"
+layer { name: "data" type: "Input" top: "data" }
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+  convolution_param { num_output: 50 kernel_size: 5 }
+}
+layer {
+  name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
+  inner_product_param { num_output: 500 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer {
+  name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" top: "loss" }
+"""
+
+
+def test_parse_prototxt_structure():
+    net = cc.parse_prototxt(LENET)
+    assert net["name"] == "LeNet"
+    layers = net["layer"]
+    assert len(layers) == 9
+    assert layers[1]["convolution_param"]["num_output"] == 20
+    assert layers[2]["pooling_param"]["pool"] == "MAX"
+
+
+def test_lenet_converts_binds_and_runs(tmp_path):
+    proto = tmp_path / "lenet.prototxt"
+    proto.write_text(LENET)
+    out = str(tmp_path / "lenet-symbol.json")
+    sym = cc.convert(str(proto), out)
+    args = sym.list_arguments()
+    assert "conv1_weight" in args and "ip2_bias" in args
+    # round-trips through the standard json loader and runs forward
+    loaded = mx.sym.load(out)
+    ex = loaded.simple_bind(mx.cpu(), data=(2, 1, 28, 28),
+                            softmax_label=(2,))
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            mx.initializer.Xavier()(mx.initializer.InitDesc(name), arr)
+    ex.forward(is_train=False)
+    probs = ex.outputs[0].asnumpy()
+    assert probs.shape == (2, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_eltwise_concat_lrn_paths():
+    proto = """
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "c2" type: "Convolution" bottom: "data" top: "c2"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "sum" type: "Eltwise" bottom: "c1" bottom: "c2" top: "sum"
+  eltwise_param { operation: SUM } }
+layer { name: "cat" type: "Concat" bottom: "sum" bottom: "c1" top: "cat" }
+layer { name: "n" type: "LRN" bottom: "cat" top: "n"
+  lrn_param { local_size: 3 } }
+layer { name: "gp" type: "Pooling" bottom: "n" top: "gp"
+  pooling_param { pool: AVE global_pooling: true } }
+layer { name: "fc" type: "InnerProduct" bottom: "gp" top: "fc"
+  inner_product_param { num_output: 3 } }
+layer { name: "sm" type: "Softmax" bottom: "fc" top: "sm" }
+"""
+    sym = cc.prototxt_to_symbol(proto)
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(2, 3, 8, 8),
+                                                softmax_label=(2,))
+    assert out_shapes[0] == (2, 3)
+
+
+def test_unknown_layer_is_loud():
+    proto = """
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "x" type: "SPPLayer" bottom: "data" top: "x" }
+"""
+    with pytest.raises(NotImplementedError, match="SPPLayer"):
+        cc.prototxt_to_symbol(proto)
+
+
+def test_group_dilation_rect_kernels_and_coeff():
+    """AlexNet-style grouped conv, rectangular kernels, dilation, and
+    Eltwise coefficient sums must convert faithfully (silent drops were
+    r5 review findings)."""
+    proto = """
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "g" type: "Convolution" bottom: "data" top: "g"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1 group: 2 } }
+layer { name: "r" type: "Convolution" bottom: "g" top: "r"
+  convolution_param { num_output: 8 kernel_h: 3 kernel_w: 5
+                      pad_h: 1 pad_w: 2 } }
+layer { name: "d" type: "Convolution" bottom: "r" top: "d"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 2 dilation: 2 } }
+layer { name: "diff" type: "Eltwise" bottom: "d" bottom: "g" top: "diff"
+  eltwise_param { operation: SUM coeff: 1 coeff: -1 } }
+"""
+    sym = cc.prototxt_to_symbol(proto)
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(2, 4, 8, 8))
+    args = dict(zip(sym.list_arguments(), arg_shapes))
+    assert args["g_weight"] == (8, 2, 3, 3), args["g_weight"]   # group=2
+    assert args["r_weight"] == (8, 8, 3, 5), args["r_weight"]   # rect
+    assert out_shapes[0] == (2, 8, 8, 8)
+    # coeff: diff = d - g, check numerically
+    ex = sym.simple_bind(mx.cpu(), data=(1, 4, 4, 4))
+    for n, a in ex.arg_dict.items():
+        if n != "data":
+            a[:] = np.random.RandomState(0).rand(*a.shape).astype(a.dtype)
+    ex.forward(is_train=False)
+    import mxnet_tpu as mxx
+    # rebuild the two branches by hand to check the subtraction
+    internals = sym.get_internals()
+    d_out = internals["d_output"]
+    g_out = internals["g_output"]
+    exd = d_out.bind(mx.cpu(), {n: ex.arg_dict[n]
+                                for n in d_out.list_arguments()})
+    exg = g_out.bind(mx.cpu(), {n: ex.arg_dict[n]
+                                for n in g_out.list_arguments()})
+    exd.forward(); exg.forward()
+    np.testing.assert_allclose(
+        ex.outputs[0].asnumpy(),
+        exd.outputs[0].asnumpy() - exg.outputs[0].asnumpy(), rtol=1e-5)

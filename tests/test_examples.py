@@ -395,3 +395,13 @@ def test_kaggle_ndsb_pipeline(capsys):
                       ["--num-epochs", "10"], capsys)
     acc = float(out.strip().rsplit(" ", 1)[-1])
     assert acc > 0.55, "val acc %.3f vs 0.25 chance" % acc
+
+
+def test_memcost_remat_saves_memory(capsys):
+    """jax.checkpoint on the scanned residual body (the
+    MXNET_BACKWARD_DO_MIRROR analogue) must cut XLA's measured temp
+    allocation with bit-identical gradients (ref example/memcost/)."""
+    out = run_example("memcost.py", [], capsys)
+    lines = dict(l.rsplit(" ", 1) for l in out.strip().splitlines())
+    assert float(lines["grad-max-gap"]) < 1e-5
+    assert float(lines["final-memory-ratio"]) < 0.7

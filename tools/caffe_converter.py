@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Convert a Caffe .prototxt network definition to a -symbol.json.
+
+Reference counterpart: ``tools/caffe_converter/`` —
+``convert_symbol.py`` walks a caffe NetParameter and emits the
+equivalent mxnet symbol (plus ``convert_model.py`` for weights). This
+rebuild covers the topology half with a dependency-free text-format
+prototxt parser (no caffe / no compiled protos needed — prototxt is
+plain text): the common vision-layer vocabulary maps onto the
+framework's operator registry and the result saves as standard
+``-symbol.json`` loadable by ``mx.sym.load`` / ``mx.mod.Module``.
+
+Layer coverage (the LeNet/AlexNet/VGG/CaffeNet families):
+    Data/Input, Convolution, Pooling (MAX/AVE, global), InnerProduct,
+    ReLU, TanH, Sigmoid, Dropout, LRN, Softmax/SoftmaxWithLoss,
+    Concat, Eltwise (SUM/PROD/MAX), Flatten, BatchNorm(+Scale folded).
+
+Weight conversion needs a .caffemodel reader; that half requires
+pycaffe or compiled caffe protos (binary protobuf), exactly as the
+reference's convert_model.py does — out of scope in a zero-egress
+image and documented here rather than stubbed.
+
+    python tools/caffe_converter.py lenet.prototxt out-symbol.json
+"""
+import argparse
+import json
+import re
+import sys
+
+__all__ = ["parse_prototxt", "prototxt_to_symbol", "convert"]
+
+
+# ---------------------------------------------------------------------------
+# text-format protobuf parsing (the subset prototxt uses)
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"""
+    (?P<brace_open>\{)
+  | (?P<brace_close>\})
+  | (?P<colon>:)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<word>[A-Za-z0-9_.+-]+)
+""", re.X)
+
+
+def _tokenize(text):
+    text = re.sub(r"#[^\n]*", "", text)          # comments
+    for m in _TOKEN.finditer(text):
+        kind = m.lastgroup
+        val = m.group()
+        yield kind, val
+
+
+def parse_prototxt(text):
+    """Parse prototxt into nested dicts; repeated fields become lists."""
+    root = {}
+    stack = [root]
+    tokens = list(_tokenize(text))
+    i = 0
+    while i < len(tokens):
+        kind, val = tokens[i]
+        if kind == "brace_close":
+            stack.pop()
+            i += 1
+            continue
+        if kind != "word":
+            raise ValueError("unexpected token %r" % val)
+        field = val
+        nxt_kind = tokens[i + 1][0] if i + 1 < len(tokens) else None
+        if nxt_kind == "brace_open":                 # message field
+            child = {}
+            _append(stack[-1], field, child)
+            stack.append(child)
+            i += 2
+        elif nxt_kind == "colon":                    # scalar field
+            vkind, vval = tokens[i + 2]
+            if vkind == "string":
+                value = json.loads(vval)
+            else:
+                value = _coerce(vval)
+            _append(stack[-1], field, value)
+            i += 3
+        else:
+            raise ValueError("field %r missing value" % field)
+    if len(stack) != 1:
+        raise ValueError("unbalanced braces in prototxt")
+    return root
+
+
+def _append(d, key, value):
+    if key in d:
+        if not isinstance(d[key], list):
+            d[key] = [d[key]]
+        d[key].append(value)
+    else:
+        d[key] = value
+
+
+def _coerce(word):
+    for cast in (int, float):
+        try:
+            return cast(word)
+        except ValueError:
+            pass
+    if word in ("true", "false"):
+        return word == "true"
+    return word                                      # enum / identifier
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# layer mapping
+# ---------------------------------------------------------------------------
+
+def _pair(p, base, default):
+    """Caffe spatial params: scalar, repeated [h, w], or _h/_w pair."""
+    v = p.get(base)
+    if isinstance(v, list):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    if v is not None:
+        return (int(v), int(v))
+    h = p.get(base + "_h")
+    w = p.get(base + "_w")
+    if h is not None or w is not None:
+        h = int(h if h is not None else w)
+        w = int(w if w is not None else h)
+        return (h, w)
+    return (default, default)
+
+
+def _kernel_pad_stride(p):
+    # scalar / repeated form is "kernel_size"; the explicit pair form is
+    # "kernel_h"/"kernel_w" (note: NOT kernel_size_h)
+    if "kernel_size" in p:
+        v = p["kernel_size"]
+        kern = ((int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+                if isinstance(v, list) else (int(v), int(v)))
+    else:
+        kern = _pair(p, "kernel", 1)
+    return kern, _pair(p, "pad", 0), _pair(p, "stride", 1)
+
+
+def prototxt_to_symbol(text, mx=None):
+    """Build the framework Symbol for a prototxt NetParameter."""
+    if mx is None:
+        import mxnet_tpu as mx_mod
+        mx = mx_mod
+    net = parse_prototxt(text)
+    layers = _as_list(net.get("layer") or net.get("layers"))
+    sym_of = {}          # caffe blob name -> symbol
+
+    def top_of(layer):
+        tops = _as_list(layer.get("top"))
+        return tops[0] if tops else layer["name"]
+
+    def bottom_syms(layer):
+        return [sym_of[b] for b in _as_list(layer.get("bottom"))]
+
+    out = None
+    for layer in layers:
+        ltype = str(layer.get("type"))
+        name = layer.get("name", ltype)
+        top = top_of(layer)
+        if ltype in ("Data", "Input", "MemoryData", "DATA"):
+            sym_of[top] = mx.sym.Variable("data")
+            if "label" in _as_list(layer.get("top")):
+                sym_of["label"] = mx.sym.Variable("softmax_label")
+            out = sym_of[top]
+            continue
+        bots = bottom_syms(layer)
+        x = bots[0] if bots else out
+        if ltype in ("Convolution", "CONVOLUTION"):
+            p = layer.get("convolution_param", {})
+            kern, pad, stride = _kernel_pad_stride(p)
+            dil = _pair(p, "dilation", 1)
+            out = mx.sym.Convolution(
+                x, kernel=kern, pad=pad, stride=stride,
+                num_filter=int(p["num_output"]),
+                num_group=int(p.get("group", 1)), dilate=dil,
+                no_bias=not p.get("bias_term", True), name=name)
+        elif ltype in ("Pooling", "POOLING"):
+            p = layer.get("pooling_param", {})
+            ptype = "avg" if str(p.get("pool", "MAX")).upper() == "AVE" \
+                else "max"
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(x, global_pool=True,
+                                     kernel=(1, 1), pool_type=ptype,
+                                     name=name)
+            else:
+                kern, pad, stride = _kernel_pad_stride(p)
+                out = mx.sym.Pooling(x, kernel=kern, pad=pad,
+                                     stride=stride, pool_type=ptype,
+                                     pooling_convention="full",  # caffe ceil
+                                     name=name)
+        elif ltype in ("InnerProduct", "INNER_PRODUCT"):
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                x, num_hidden=int(p["num_output"]),
+                no_bias=not p.get("bias_term", True), name=name)
+        elif ltype in ("ReLU", "RELU"):
+            out = mx.sym.Activation(x, act_type="relu", name=name)
+        elif ltype in ("TanH", "TANH"):
+            out = mx.sym.Activation(x, act_type="tanh", name=name)
+        elif ltype in ("Sigmoid", "SIGMOID"):
+            out = mx.sym.Activation(x, act_type="sigmoid", name=name)
+        elif ltype in ("Dropout", "DROPOUT"):
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(x, p=float(p.get("dropout_ratio", 0.5)),
+                                 name=name)
+        elif ltype in ("LRN",):
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(x, nsize=int(p.get("local_size", 5)),
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)), name=name)
+        elif ltype in ("BatchNorm",):
+            out = mx.sym.BatchNorm(x, name=name)
+        elif ltype in ("Scale",):
+            # caffe pairs BatchNorm (normalize-only) with Scale
+            # (gamma/beta); BatchNorm here already carries gamma/beta,
+            # so Scale folds away
+            out = x
+        elif ltype in ("Concat", "CONCAT"):
+            out = mx.sym.Concat(*bots, dim=1, name=name)
+        elif ltype in ("Eltwise", "ELTWISE"):
+            p = layer.get("eltwise_param", {})
+            op = str(p.get("operation", "SUM")).upper()
+            coeffs = [float(cf) for cf in _as_list(p.get("coeff"))]
+            if coeffs and op != "SUM":
+                raise NotImplementedError(
+                    "Eltwise coeff only applies to SUM (layer %r)" % name)
+            if op == "SUM" and coeffs:
+                if len(coeffs) != len(bots):
+                    raise ValueError(
+                        "Eltwise %r: %d coeffs for %d bottoms"
+                        % (name, len(coeffs), len(bots)))
+                terms = [b if cf == 1.0 else b * cf
+                         for b, cf in zip(bots, coeffs)]
+            else:
+                terms = bots
+            out = terms[0]
+            for b in terms[1:]:
+                if op == "PROD":
+                    out = out * b
+                elif op == "MAX":
+                    out = mx.sym.maximum(out, b)
+                else:
+                    out = out + b
+        elif ltype in ("Flatten", "FLATTEN"):
+            out = mx.sym.Flatten(x, name=name)
+        elif ltype in ("Softmax", "SOFTMAX", "SoftmaxWithLoss",
+                       "SOFTMAX_LOSS"):
+            label = sym_of.get("label", mx.sym.Variable("softmax_label"))
+            out = mx.sym.SoftmaxOutput(x, label, name=name)
+        elif ltype in ("Accuracy",):
+            continue                                 # eval-only layer
+        else:
+            raise NotImplementedError(
+                "caffe layer type %r not supported (layer %r)"
+                % (ltype, name))
+        sym_of[top] = out
+    if out is None:
+        raise ValueError("prototxt contained no layers")
+    return out
+
+
+def convert(prototxt_path, out_path, mx=None):
+    with open(prototxt_path) as f:
+        sym = prototxt_to_symbol(f.read(), mx=mx)
+    sym.save(out_path)
+    return sym
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prototxt")
+    ap.add_argument("out_json")
+    args = ap.parse_args()
+    sym = convert(args.prototxt, args.out_json)
+    print("wrote %s (%d args)" % (args.out_json,
+                                  len(sym.list_arguments())))
+
+
+if __name__ == "__main__":
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    main()
