@@ -7,6 +7,8 @@
 
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <mutex>
 #include <string>
 
@@ -46,6 +48,21 @@ inline bool EnsurePython() {
   static bool ok = true;
   std::call_once(once, []() {
     if (Py_IsInitialized()) return;
+    // Hosts that dlopen us with RTLD_LOCAL (Perl's DynaLoader, JNI, …)
+    // leave libpython's symbols invisible to CPython extension modules
+    // (math.so etc. fail with "undefined symbol: PyFloat_Type").
+    // Promote libpython to global visibility before interpreter init;
+    // harmless when the host already linked it globally.
+    {
+      char soname[64];
+      snprintf(soname, sizeof(soname), "libpython%d.%d.so.1.0",
+               PY_MAJOR_VERSION, PY_MINOR_VERSION);
+      if (!dlopen(soname, RTLD_NOW | RTLD_GLOBAL)) {
+        snprintf(soname, sizeof(soname), "libpython%d.%d.so",
+                 PY_MAJOR_VERSION, PY_MINOR_VERSION);
+        dlopen(soname, RTLD_NOW | RTLD_GLOBAL);   // best effort
+      }
+    }
     Py_InitializeEx(0);
     if (!Py_IsInitialized()) {
       ok = false;

@@ -1,0 +1,328 @@
+/* XS half of AI::MXNetTPU — the Perl binding over the general C ABI
+ * (native/include/mxnet_tpu_c.h).
+ *
+ * Reference counterpart: perl-package/AI-MXNet (AI::MXNet), whose
+ * AI::MXNetCAPI swig layer binds include/mxnet/c_api.h. Here the same
+ * role is a hand-written XS module: handles cross as IVs (PTR2IV /
+ * INT2PTR), arrays as Perl arrayrefs of doubles.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxnet_tpu_c.h"
+
+static void croak_last(pTHX) {
+  croak("mxnet_tpu: %s", MXGetLastError());
+}
+
+/* The scalar marshalling below is float32-only; other dtypes would
+ * reinterpret (or overflow) the staging buffer. */
+static void assert_f32(pTHX_ NDArrayHandle h) {
+  int dtype = -1;
+  if (MXNDArrayGetDType(h, &dtype) != 0) croak_last(aTHX);
+  if (dtype != 0)
+    croak("mxnet_tpu: perl marshalling supports float32 only "
+          "(got dtype code %d); cast the array first", dtype);
+}
+
+MODULE = AI::MXNetTPU    PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+IV
+_nd_create(shape_ref)
+    SV* shape_ref
+  PREINIT:
+    AV* av;
+    mx_uint dims[8];
+    mx_uint nd, i;
+    NDArrayHandle h;
+  CODE:
+    av = (AV*)SvRV(shape_ref);
+    nd = (mx_uint)(av_len(av) + 1);
+    if (nd > 8) croak("ndim > 8");
+    for (i = 0; i < nd; ++i)
+      dims[i] = (mx_uint)SvUV(*av_fetch(av, i, 0));
+    if (MXNDArrayCreateEx(dims, nd, 1, 0, 0, 0, &h) != 0)
+      croak_last(aTHX);
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+_nd_free(h)
+    IV h
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+SV*
+_nd_shape(h)
+    IV h
+  PREINIT:
+    mx_uint nd, i;
+    const mx_uint* dims;
+    AV* out;
+  CODE:
+    if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &nd, &dims) != 0)
+      croak_last(aTHX);
+    out = newAV();
+    for (i = 0; i < nd; ++i) av_push(out, newSVuv(dims[i]));
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT:
+    RETVAL
+
+void
+_nd_set(h, vals_ref)
+    IV h
+    SV* vals_ref
+  PREINIT:
+    AV* av;
+    float* buf;
+    size_t n, i;
+  CODE:
+    assert_f32(aTHX_ INT2PTR(NDArrayHandle, h));
+    av = (AV*)SvRV(vals_ref);
+    n = (size_t)(av_len(av) + 1);
+    Newx(buf, n, float);
+    for (i = 0; i < n; ++i)
+      buf[i] = (float)SvNV(*av_fetch(av, (SSize_t)i, 0));
+    if (MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, h), buf, n)
+        != 0) {
+      Safefree(buf);
+      croak_last(aTHX);
+    }
+    Safefree(buf);
+
+SV*
+_nd_get(h)
+    IV h
+  PREINIT:
+    mx_uint nd, i;
+    const mx_uint* dims;
+    size_t n;
+    float* buf;
+    AV* out;
+  CODE:
+    assert_f32(aTHX_ INT2PTR(NDArrayHandle, h));
+    if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &nd, &dims) != 0)
+      croak_last(aTHX);
+    n = 1;
+    for (i = 0; i < nd; ++i) n *= dims[i];
+    Newx(buf, n, float);
+    if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf, n) != 0) {
+      Safefree(buf);
+      croak_last(aTHX);
+    }
+    out = newAV();
+    for (i = 0; i < n; ++i) av_push(out, newSVnv(buf[i]));
+    Safefree(buf);
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT:
+    RETVAL
+
+SV*
+_invoke(op, ins_ref, keys_ref, vals_ref)
+    const char* op
+    SV* ins_ref
+    SV* keys_ref
+    SV* vals_ref
+  PREINIT:
+    AV *ins, *keys, *vals;
+    NDArrayHandle in_h[16];
+    const char* pk[16];
+    const char* pv[16];
+    int n_in, n_par, i, n_out;
+    NDArrayHandle* outs;
+    AV* result;
+  CODE:
+    ins = (AV*)SvRV(ins_ref);
+    keys = (AV*)SvRV(keys_ref);
+    vals = (AV*)SvRV(vals_ref);
+    n_in = (int)(av_len(ins) + 1);
+    n_par = (int)(av_len(keys) + 1);
+    if (n_in > 16 || n_par > 16) croak("too many inputs/params");
+    for (i = 0; i < n_in; ++i)
+      in_h[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(ins, i, 0)));
+    for (i = 0; i < n_par; ++i) {
+      pk[i] = SvPV_nolen(*av_fetch(keys, i, 0));
+      pv[i] = SvPV_nolen(*av_fetch(vals, i, 0));
+    }
+    n_out = 0;
+    outs = NULL;
+    if (MXImperativeInvoke(op, n_in, in_h, &n_out, &outs, n_par, pk, pv)
+        != 0)
+      croak_last(aTHX);
+    result = newAV();
+    for (i = 0; i < n_out; ++i)
+      av_push(result, newSViv(PTR2IV(outs[i])));
+    free(outs);
+    RETVAL = newRV_noinc((SV*)result);
+  OUTPUT:
+    RETVAL
+
+IV
+_sym_from_file(path)
+    const char* path
+  PREINIT:
+    SymbolHandle s;
+  CODE:
+    if (MXSymbolCreateFromFile(path, &s) != 0) croak_last(aTHX);
+    RETVAL = PTR2IV(s);
+  OUTPUT:
+    RETVAL
+
+IV
+_sym_from_json(json)
+    const char* json
+  PREINIT:
+    SymbolHandle s;
+  CODE:
+    if (MXSymbolCreateFromJSON(json, &s) != 0) croak_last(aTHX);
+    RETVAL = PTR2IV(s);
+  OUTPUT:
+    RETVAL
+
+void
+_sym_free(h)
+    IV h
+  CODE:
+    MXSymbolFree(INT2PTR(SymbolHandle, h));
+
+SV*
+_sym_arguments(h)
+    IV h
+  PREINIT:
+    mx_uint n, i;
+    const char** names;
+    AV* out;
+  CODE:
+    if (MXSymbolListArguments(INT2PTR(SymbolHandle, h), &n, &names) != 0)
+      croak_last(aTHX);
+    out = newAV();
+    for (i = 0; i < n; ++i) av_push(out, newSVpv(names[i], 0));
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT:
+    RETVAL
+
+IV
+_exec_bind(sym, names_ref, shapes_ref, grad_req)
+    IV sym
+    SV* names_ref
+    SV* shapes_ref
+    const char* grad_req
+  PREINIT:
+    AV *names, *shapes, *shp;
+    const char* pk[16];
+    mx_uint ndims[16];
+    mx_uint dims[64];
+    mx_uint n, i, j, off;
+    ExecutorHandle ex;
+  CODE:
+    names = (AV*)SvRV(names_ref);
+    shapes = (AV*)SvRV(shapes_ref);
+    n = (mx_uint)(av_len(names) + 1);
+    if (n > 16) croak("too many bind args");
+    off = 0;
+    for (i = 0; i < n; ++i) {
+      pk[i] = SvPV_nolen(*av_fetch(names, i, 0));
+      shp = (AV*)SvRV(*av_fetch(shapes, i, 0));
+      ndims[i] = (mx_uint)(av_len(shp) + 1);
+      for (j = 0; j < ndims[i]; ++j) {
+        if (off >= 64) croak("too many total dims");
+        dims[off++] = (mx_uint)SvUV(*av_fetch(shp, j, 0));
+      }
+    }
+    if (MXExecutorSimpleBind(INT2PTR(SymbolHandle, sym), 1, 0, n, pk,
+                             ndims, dims, grad_req, &ex) != 0)
+      croak_last(aTHX);
+    RETVAL = PTR2IV(ex);
+  OUTPUT:
+    RETVAL
+
+void
+_exec_free(h)
+    IV h
+  CODE:
+    MXExecutorFree(INT2PTR(ExecutorHandle, h));
+
+void
+_exec_forward(h, is_train)
+    IV h
+    int is_train
+  CODE:
+    if (MXExecutorForward(INT2PTR(ExecutorHandle, h), is_train) != 0)
+      croak_last(aTHX);
+
+SV*
+_exec_outputs(h)
+    IV h
+  PREINIT:
+    mx_uint n, i;
+    NDArrayHandle* outs;
+    AV* out;
+  CODE:
+    if (MXExecutorOutputs(INT2PTR(ExecutorHandle, h), &n, &outs) != 0)
+      croak_last(aTHX);
+    out = newAV();
+    for (i = 0; i < n; ++i) av_push(out, newSViv(PTR2IV(outs[i])));
+    free(outs);
+    RETVAL = newRV_noinc((SV*)out);
+  OUTPUT:
+    RETVAL
+
+IV
+_exec_arg(h, name)
+    IV h
+    const char* name
+  PREINIT:
+    NDArrayHandle a;
+  CODE:
+    if (MXExecutorArgArray(INT2PTR(ExecutorHandle, h), name, &a) != 0)
+      croak_last(aTHX);
+    RETVAL = PTR2IV(a);
+  OUTPUT:
+    RETVAL
+
+void
+_exec_copy_params(h, names_ref, handles_ref)
+    IV h
+    SV* names_ref
+    SV* handles_ref
+  PREINIT:
+    AV *names, *handles;
+    const char* pk[64];
+    NDArrayHandle hs[64];
+    mx_uint n, i;
+  CODE:
+    names = (AV*)SvRV(names_ref);
+    handles = (AV*)SvRV(handles_ref);
+    n = (mx_uint)(av_len(names) + 1);
+    if (n > 64) croak("too many params");
+    for (i = 0; i < n; ++i) {
+      pk[i] = SvPV_nolen(*av_fetch(names, i, 0));
+      hs[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(handles, i, 0)));
+    }
+    if (MXExecutorCopyParamsFrom(INT2PTR(ExecutorHandle, h), n, pk, hs)
+        != 0)
+      croak_last(aTHX);
+
+void
+_load(path)
+    const char* path
+  PREINIT:
+    mx_uint n, nn, i;
+    NDArrayHandle* arrs;
+    const char** names;
+    AV *h_out, *n_out;
+  PPCODE:
+    if (MXNDArrayLoad(path, &n, &arrs, &nn, &names) != 0)
+      croak_last(aTHX);
+    h_out = newAV();
+    n_out = newAV();
+    for (i = 0; i < n; ++i) av_push(h_out, newSViv(PTR2IV(arrs[i])));
+    for (i = 0; i < nn; ++i) av_push(n_out, newSVpv(names[i], 0));
+    free(arrs);
+    XPUSHs(sv_2mortal(newRV_noinc((SV*)h_out)));
+    XPUSHs(sv_2mortal(newRV_noinc((SV*)n_out)));

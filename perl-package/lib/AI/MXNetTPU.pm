@@ -1,0 +1,123 @@
+package AI::MXNetTPU;
+
+# Perl binding for the TPU-native MXNet-capability framework.
+#
+# Reference counterpart: perl-package/AI-MXNet (AI::MXNet) over the
+# swig'd AI::MXNetCAPI. This rebuild is a compact XS module over the
+# general C ABI (native/include/mxnet_tpu_c.h): NDArray, imperative
+# invoke, symbol load, executor bind/forward — the inference-and-scoring
+# surface a Perl host realistically needs.
+#
+#   use AI::MXNetTPU;
+#   my $x = AI::MXNetTPU::NDArray->new([2, 3]);
+#   $x->set([1 .. 6]);
+#   my ($y) = AI::MXNetTPU::invoke("relu", [$x]);
+#   my $sym  = AI::MXNetTPU::Symbol->load("net-symbol.json");
+#   my $exec = $sym->simple_bind({ data => [8, 1, 16, 16] });
+
+use strict;
+use warnings;
+
+our $VERSION = '0.05';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+sub invoke {
+    my ($op, $inputs, $attrs) = @_;
+    $attrs ||= {};
+    my @keys = sort keys %$attrs;
+    my @vals = map { "$attrs->{$_}" } @keys;
+    my $outs = _invoke($op, [map { $_->{h} } @$inputs], \@keys, \@vals);
+    return map { AI::MXNetTPU::NDArray->_wrap($_) } @$outs;
+}
+
+sub load_params {
+    my ($path) = @_;
+    my ($handles, $names) = _load($path);
+    my %out;
+    for my $i (0 .. $#$handles) {
+        $out{ $names->[$i] // $i } =
+            AI::MXNetTPU::NDArray->_wrap($handles->[$i]);
+    }
+    return \%out;
+}
+
+package AI::MXNetTPU::NDArray;
+
+sub new {
+    my ($class, $shape) = @_;
+    return bless { h => AI::MXNetTPU::_nd_create($shape), own => 1 },
+        $class;
+}
+
+sub _wrap {
+    my ($class, $h) = @_;
+    return bless { h => $h, own => 1 }, $class;
+}
+
+sub set   { AI::MXNetTPU::_nd_set($_[0]{h}, $_[1]); $_[0] }
+sub aslist { AI::MXNetTPU::_nd_get($_[0]{h}) }
+sub shape { AI::MXNetTPU::_nd_shape($_[0]{h}) }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::_nd_free($self->{h}) if $self->{own};
+}
+
+package AI::MXNetTPU::Symbol;
+
+sub load {
+    my ($class, $path) = @_;
+    return bless { h => AI::MXNetTPU::_sym_from_file($path) }, $class;
+}
+
+sub from_json {
+    my ($class, $json) = @_;
+    return bless { h => AI::MXNetTPU::_sym_from_json($json) }, $class;
+}
+
+sub list_arguments { AI::MXNetTPU::_sym_arguments($_[0]{h}) }
+
+sub simple_bind {
+    my ($self, $shapes, $grad_req) = @_;
+    my @names  = sort keys %$shapes;
+    my @dims   = map { $shapes->{$_} } @names;
+    my $h = AI::MXNetTPU::_exec_bind($self->{h}, \@names, \@dims,
+                                     $grad_req || 'null');
+    return bless { h => $h }, 'AI::MXNetTPU::Executor';
+}
+
+sub DESTROY { AI::MXNetTPU::_sym_free($_[0]{h}) }
+
+package AI::MXNetTPU::Executor;
+
+sub forward {
+    my ($self, $is_train) = @_;
+    AI::MXNetTPU::_exec_forward($self->{h}, $is_train ? 1 : 0);
+    $self;
+}
+
+sub outputs {
+    my ($self) = @_;
+    return [map { AI::MXNetTPU::NDArray->_wrap($_) }
+            @{ AI::MXNetTPU::_exec_outputs($self->{h}) }];
+}
+
+sub arg {
+    my ($self, $name) = @_;
+    return AI::MXNetTPU::NDArray->_wrap(
+        AI::MXNetTPU::_exec_arg($self->{h}, $name));
+}
+
+sub copy_params_from {
+    my ($self, $params) = @_;    # { name => NDArray }
+    my @names = sort keys %$params;
+    AI::MXNetTPU::_exec_copy_params(
+        $self->{h}, \@names, [map { $params->{$_}{h} } @names]);
+    $self;
+}
+
+sub DESTROY { AI::MXNetTPU::_exec_free($_[0]{h}) }
+
+1;

@@ -1,0 +1,49 @@
+#!/usr/bin/perl
+# End-to-end Perl-binding test: NDArray math, imperative ops, and
+# symbol load -> bind -> forward on a saved -symbol.json + .params
+# pair written by the Python side (paths come in via ARGV/ENV).
+use strict;
+use warnings;
+use Test::More;
+use FindBin;
+use lib "$FindBin::Bin/../blib/lib", "$FindBin::Bin/../blib/arch";
+
+use_ok('AI::MXNetTPU');
+
+# ---- NDArray + imperative invoke ----
+my $x = AI::MXNetTPU::NDArray->new([2, 3]);
+$x->set([-3, -2, -1, 1, 2, 3]);
+is_deeply($x->shape, [2, 3], 'shape round trip');
+
+my ($y) = AI::MXNetTPU::invoke('relu', [$x]);
+is_deeply($y->aslist, [0, 0, 0, 1, 2, 3], 'relu through the C ABI');
+
+my ($t) = AI::MXNetTPU::invoke('transpose', [$x],
+                               { axes => '(1, 0)' });
+is_deeply($t->shape, [3, 2], 'attrs travel stringified');
+
+# ---- symbol -> executor, with a checkpoint, if given ----
+my ($sym_file, $param_file) = @ARGV;
+SKIP: {
+    skip 'no model files supplied', 4 unless $sym_file && -e $sym_file;
+    my $sym = AI::MXNetTPU::Symbol->load($sym_file);
+    my $args = $sym->list_arguments;
+    ok(scalar(@$args) >= 3, 'symbol lists arguments');
+
+    my $exec = $sym->simple_bind({ data => [2, 4] });
+    my $params = AI::MXNetTPU::load_params($param_file);
+    $exec->copy_params_from($params);
+
+    my $data = $exec->arg('data');
+    $data->set([0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8]);
+    $exec->forward(0);
+    my $out = $exec->outputs->[0];
+    my $vals = $out->aslist;
+    is(scalar(@$vals), 2 * 3, 'output shape 2x3');
+    my $sum = 0;
+    $sum += $_ for @$vals[0 .. 2];
+    ok(abs($sum - 1.0) < 1e-4, 'softmax row sums to 1');
+    ok((grep { $_ > 0 } @$vals) == scalar(@$vals), 'probabilities > 0');
+}
+
+done_testing();

@@ -148,3 +148,16 @@ layer { name: "diff" type: "Eltwise" bottom: "d" bottom: "g" top: "diff"
     np.testing.assert_allclose(
         ex.outputs[0].asnumpy(),
         exd.outputs[0].asnumpy() - exg.outputs[0].asnumpy(), rtol=1e-5)
+
+
+def test_hash_inside_quoted_name():
+    """'#' inside a quoted layer name is data, not a comment."""
+    proto = '''
+layer { name: "fire#1/squeeze" type: "Input" top: "data" }  # real comment
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 2 } }
+'''
+    net = cc.parse_prototxt(proto)
+    assert net["layer"][0]["name"] == "fire#1/squeeze"
+    sym = cc.prototxt_to_symbol(proto)
+    assert "fc_weight" in sym.list_arguments()

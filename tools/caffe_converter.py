@@ -43,8 +43,25 @@ _TOKEN = re.compile(r"""
 """, re.X)
 
 
+def _strip_comments(text):
+    """Drop # comments, but never inside a quoted string (layer names
+    like "fire#1/squeeze" are legal)."""
+    out = []
+    for line in text.splitlines():
+        in_str = False
+        cut = len(line)
+        for i, ch in enumerate(line):
+            if ch == '"' and (i == 0 or line[i - 1] != "\\"):
+                in_str = not in_str
+            elif ch == "#" and not in_str:
+                cut = i
+                break
+        out.append(line[:cut])
+    return "\n".join(out)
+
+
 def _tokenize(text):
-    text = re.sub(r"#[^\n]*", "", text)          # comments
+    text = _strip_comments(text)
     for m in _TOKEN.finditer(text):
         kind = m.lastgroup
         val = m.group()
