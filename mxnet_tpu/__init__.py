@@ -11,9 +11,17 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
 import jax as _jax
 # MXNet supports float64/int64 tensors; jax defaults to 32-bit only.
 _jax.config.update("jax_enable_x64", True)
+# Mirror an env-pinned platform list into jax.config: plugin
+# sitecustomize hooks (e.g. a tunneled TPU runtime) can otherwise race
+# the env var and hang the first backend touch of a plain
+# `JAX_PLATFORMS=cpu python script.py` run.
+if _os.environ.get("JAX_PLATFORMS", "") not in ("", "axon"):
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus

@@ -405,3 +405,16 @@ def test_memcost_remat_saves_memory(capsys):
     lines = dict(l.rsplit(" ", 1) for l in out.strip().splitlines())
     assert float(lines["grad-max-gap"]) < 1e-5
     assert float(lines["final-memory-ratio"]) < 0.7
+
+
+def test_profiling_demo(capsys, tmp_path):
+    """Chrome-trace profiler walkthrough: eager per-op spans, Module
+    per-program spans, user markers, valid trace JSON
+    (ref example/profiler/)."""
+    out = run_example("profiling_demo.py",
+                      ["--out", str(tmp_path / "p.json")], capsys)
+    lines = dict(l.rsplit(" ", 1) for l in out.strip().splitlines())
+    assert int(lines["final-total-events"]) > 20
+    assert int(lines["has-marker"]) == 1
+    assert int(lines["spans operator"]) > 0
+    assert int(lines["spans program"]) > 0
