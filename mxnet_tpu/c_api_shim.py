@@ -20,7 +20,8 @@ from .ops.registry import get_op, list_ops, parse_attr_string
 
 __all__ = ["create", "dtype_code", "itemsize", "shape_of",
            "copy_from_bytes", "to_bytes", "imperative_invoke",
-           "copy_into", "all_op_names", "save_list", "load_file"]
+           "copy_into", "all_op_names", "save_list", "load_file",
+           "version_number", "random_seed", "notify_shutdown"]
 
 _DEV = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 6: "tpu"}
 
@@ -82,6 +83,26 @@ def copy_into(dst, src):
 
 def all_op_names():
     return list_ops()
+
+
+def version_number():
+    """MAJOR*10000 + MINOR*100 + PATCH (reference MXNET_VERSION shape)."""
+    from . import __version__
+    major, minor, patch = (int(x) for x in __version__.split(".")[:3])
+    return major * 10000 + minor * 100 + patch
+
+
+def random_seed(seed):
+    from . import random as random_mod
+    random_mod.seed(int(seed))
+
+
+def notify_shutdown():
+    """Drain outstanding async work (reference MXNotifyShutdown)."""
+    from . import ndarray as nd_mod
+    nd_mod.waitall()
+    from . import engine
+    engine.wait_for_all()   # module-level: no-ops when no engine exists
 
 
 def save_list(fname, arrays, keys):

@@ -88,6 +88,39 @@ extern "C" {
 
 const char* MXGetLastError() { return mxtpu::last_error().c_str(); }
 
+int MXGetVersion(int* out) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* r = CallShim("version_number", "()");
+  if (!r) return -1;
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (v == -1 && PyErr_Occurred()) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = static_cast<int>(v);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* r = CallShim("random_seed", "(i)", seed);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNotifyShutdown(void) {
+  if (!EnsurePython()) return -1;
+  Gil gil;
+  PyObject* r = CallShim("notify_shutdown", "()");
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
                       int dev_id, int /*delay_alloc*/, int dtype,
                       NDArrayHandle* out) {

@@ -37,6 +37,11 @@ typedef void* NDArrayHandle;
 
 const char* MXGetLastError(void);
 
+/* Library-level controls (ref c_api.h:202-240). */
+int MXGetVersion(int* out);  /* MAJOR*10000+MINOR*100+PATCH: 100 = 0.1.0 */
+int MXRandomSeed(int seed);            /* global RNG chain reseed */
+int MXNotifyShutdown(void);            /* engine drain before exit */
+
 int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
                       int dev_id, int delay_alloc, int dtype,
                       NDArrayHandle* out);

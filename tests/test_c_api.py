@@ -243,3 +243,38 @@ def test_imperative_invoke_preallocated_outputs():
     assert b"preallocated" in lib.MXGetLastError()
     lib.MXNDArrayFree(src)
     lib.MXNDArrayFree(dst)
+
+
+def test_version_seed_shutdown():
+    """Library-level C fns: MXGetVersion / MXRandomSeed (determinism) /
+    MXNotifyShutdown (ref c_api.h:202-240)."""
+    import ctypes
+    import mxnet_tpu  # noqa: F401
+    lib = ctypes.CDLL(SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+    v = ctypes.c_int(-1)
+    assert lib.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value == 100        # 0.1.0
+
+    def draw():
+        assert lib.MXRandomSeed(1234) == 0
+        n_out = ctypes.c_int(0)
+        outs = ctypes.POINTER(ctypes.c_void_p)()
+        keys = (ctypes.c_char_p * 1)(b"shape")
+        vals = (ctypes.c_char_p * 1)(b"(4,)")
+        assert lib.MXImperativeInvoke(b"random_uniform", 0, None,
+                                      ctypes.byref(n_out),
+                                      ctypes.byref(outs), 1, keys,
+                                      vals) == 0, lib.MXGetLastError()
+        buf = (ctypes.c_float * 4)()
+        assert lib.MXNDArraySyncCopyToCPU(outs[0], buf, 4) == 0
+        vals_out = list(buf)
+        lib.MXNDArrayFree(outs[0])
+        return vals_out
+
+    a, b = draw(), draw()
+    assert a == b, "MXRandomSeed must make draws deterministic"
+    assert lib.MXNotifyShutdown() == 0
