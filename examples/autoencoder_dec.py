@@ -133,7 +133,8 @@ def main():
             with autograd.record():
                 loss = l2(ae.forward(xd, depth=depth), xd)
             loss.backward()
-            trainer.step(len(x))
+            # layerwise: only the active depth's params have fresh grads
+            trainer.step(len(x), ignore_stale_grad=True)
 
     # ---- stage 1b: end-to-end finetune (ref autoencoder.py:171) ----
     err0 = float(l2(ae(xd), xd).asnumpy().mean())
@@ -171,7 +172,8 @@ def main():
                            (mx.nd.log(mx.nd.array(p) + 1e-10) -
                             mx.nd.log(q + 1e-10))) / len(x)
         kl.backward()
-        trainer.step(1)
+        # DEC trains the encoder only; decoder grads are stale by design
+        trainer.step(1, ignore_stale_grad=True)
         centers -= args.lr * 10.0 * centers.grad             # center SGD
         centers.attach_grad()
 

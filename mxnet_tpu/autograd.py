@@ -190,6 +190,8 @@ def _backward_impl(heads, head_grads=None, retain_graph=False,
         elif v._grad_req != "null":
             v._grad._set_data(jnp.broadcast_to(g, v._grad.shape).astype(
                 v._grad.dtype) if g.shape != tuple(v._grad.shape) else g.astype(v._grad.dtype))
+        if v._grad_req != "null":
+            v._fresh_grad = True  # Trainer.step stale-grad tracking
 
     result = None
     if variables is not None:

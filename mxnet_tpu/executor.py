@@ -33,10 +33,6 @@ round-trip through program boundaries.
 Auxiliary state (BatchNorm moving stats) flows functionally: graph_fn
 returns updated aux values, forward writes them back into the aux NDArrays
 (reference mutates aux in-kernel).
-
-Auxiliary state (BatchNorm moving stats) flows functionally: graph_fn
-returns updated aux values, forward writes them back into the aux NDArrays
-(reference mutates aux in-kernel).
 """
 from __future__ import annotations
 

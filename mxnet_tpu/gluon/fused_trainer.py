@@ -1,0 +1,160 @@
+"""Fused Gluon Trainer step: the whole weight update as ONE XLA program.
+
+The per-slot ``Trainer.step`` loop issues one kvstore push/pull (a
+separate reduce per slot) plus one eager ``Updater`` dispatch per slot —
+O(n_params) XLA program calls per step (~160 for ResNet-50).  The fused
+path collapses that to
+
+    O(n_buckets) bucketed gradient all-reduce programs   (kvstore.py)
+  + 1 jitted, donated whole-model optimizer program
+
+        (param_list, grad_list, opt_state_list, hyper)
+            -> (new_params, new_opt_states)
+
+mirroring ``module/cached_step.py``'s donated train step and the
+reference's fused ``optimizer_op.cc`` kernels ("Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training", PAPERS.md).
+
+Hyper-parameters — per-slot lr/wd (scheduler and multipliers resolved
+host-side each step), the update counts ``t``, and ``rescale_grad`` —
+enter as *traced* scalars: changing the lr schedule or the batch size
+never retraces.  Compiled steps are cached in ``_STEP_CACHE`` keyed on
+(optimizer class, its static scalar hypers, param shapes/dtypes, opt
+state tree structure), so two Trainers over identical models share one
+program.
+
+Parameter and state buffers are donated on device backends: XLA updates
+weights in place in HBM; the Trainer rebinds the original NDArray
+handles (``Parameter._rebind_data``) so every holder observes the new
+buffers.  Gradients are NOT donated — ``grad_req='add'`` accumulation
+reads them on the next backward.
+
+Opt out with ``MXNET_FUSED_TRAINER=0`` (the per-slot loop stays the
+bitwise-equality oracle in tests/test_fused_trainer.py).
+"""
+from __future__ import annotations
+
+import os
+import weakref
+
+import jax
+import numpy as np
+
+from .. import profiler as _prof
+from .. import random as _random
+from ..optimizer import _state_raw, _state_writeback, static_hypers
+
+__all__ = ["fused_trainer_enabled", "fused_step_fn", "run_fused_step"]
+
+
+def fused_trainer_enabled():
+    return os.environ.get("MXNET_FUSED_TRAINER", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+_STEP_CACHE = {}      # signature -> (weakref to optimizer, jitted step)
+
+
+def _signature(opt, params_raw, states_raw, donate):
+    leaves, treedef = jax.tree_util.tree_flatten(states_raw)
+    return (type(opt), static_hypers(opt),
+            tuple((tuple(w.shape), str(w.dtype)) for w in params_raw),
+            # placement is part of jax's own jit cache key: fold it in so
+            # a same-shape model on a different device/sharding gets its
+            # own entry instead of a retrace of someone else's closure
+            tuple(str(getattr(w, "sharding", None)) for w in params_raw),
+            str(treedef),
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+            bool(donate))
+
+
+def fused_step_fn(opt, params_raw, states_raw, donate):
+    """The jitted whole-model step for this (optimizer, model) signature,
+    compiled once per signature process-wide.
+
+    The compiled step closes over *an* optimizer instance, but only via a
+    weakref: the signature pins every attribute the trace reads, so any
+    same-signature instance produces the same program — and a cached
+    entry whose original optimizer died is rebuilt around the caller's
+    live one instead of pinning the dead model's parameters forever.
+    """
+    sig = _signature(opt, params_raw, states_raw, donate)
+    # prune entries whose owning optimizer died (their compiled programs
+    # would otherwise pin memory forever)
+    for dead in [k for k, (r, _) in _STEP_CACHE.items() if r() is None]:
+        del _STEP_CACHE[dead]
+    entry = _STEP_CACHE.get(sig)
+    if entry is not None:
+        owner = entry[0]()
+        # the closure's owner must still match the signature it was
+        # compiled under — a mid-training hyper mutation on the owner
+        # would otherwise leak into a retrace of this entry
+        if owner is not None and static_hypers(owner) == sig[1]:
+            return entry[1]
+
+    opt_ref = weakref.ref(opt)
+
+    def step(params, grads, states, hyper):
+        o = opt_ref()
+        if o is None:       # only reachable on a retrace after death
+            raise RuntimeError("fused step optimizer was collected")
+        return o.fused_update_step(params, grads, states, hyper)
+
+    # params + states donated: the update happens in place in HBM
+    fn = jax.jit(step, donate_argnums=(0, 2) if donate else ())
+    _STEP_CACHE[sig] = (opt_ref, fn)
+    return fn
+
+
+def run_fused_step(trainer, slots):
+    """Execute one fused step for *slots* ([(slot_idx, Parameter)]).
+
+    Keeps the Updater/optimizer bookkeeping (state layout, update
+    counts, lr/wd resolution) identical to the per-slot loop so
+    ``save_states``/``load_states`` round-trip unchanged and results are
+    bitwise equal.
+    """
+    opt, updater = trainer._optimizer, trainer._updater
+    grads = [p.grad() for _, p in slots]
+
+    if trainer._kvstore is not None:
+        reduced = trainer._kvstore.push_pull_all(
+            [s for s, _ in slots], [[g] for g in grads])
+        # per-slot grad buffers observe the reduced value, like pull(out=g)
+        for g, r in zip(grads, reduced):
+            if r is not g:
+                g._set_data(r._data)
+        raw_grads = [r._data for r in reduced]
+    else:
+        raw_grads = [g._data for g in grads]
+
+    # state + hyper bookkeeping, per slot, exactly like Updater/update()
+    for slot, p in slots:
+        if slot not in updater.states:
+            updater.states[slot] = opt.create_state(slot, p.data())
+            updater.states_synced[slot] = True
+        opt._update_count(slot)
+    hyper = {"lr": np.asarray([opt._get_lr(s) for s, _ in slots],
+                              np.float32),
+             "wd": np.asarray([opt._get_wd(s) for s, _ in slots],
+                              np.float32),
+             "t": np.asarray([opt._index_update_count[s]
+                              for s, _ in slots], np.int32),
+             "rescale": np.float32(opt.rescale_grad)}
+    if getattr(opt, "needs_rng", False):
+        _prof.bump("xla_program_calls")            # the key split
+        hyper["key"] = jax.random.split(_random.next_key(), len(slots))
+
+    params_raw = [p._raw_data() for _, p in slots]
+    states_raw = [_state_raw(updater.states[s]) for s, _ in slots]
+    donate = slots and slots[0][1].data().context.device_type != "cpu"
+    fn = fused_step_fn(opt, params_raw, states_raw, donate)
+    trainer._fused_step_jit = fn                   # introspection / tests
+
+    _prof.bump("xla_program_calls")
+    _prof.bump("trainer_fused_step")
+    new_params, new_states = fn(params_raw, raw_grads, states_raw, hyper)
+
+    for (slot, p), nw, ns in zip(slots, new_params, new_states):
+        p._rebind_data(nw)                         # donation-safe rebind
+        _state_writeback(updater.states[slot], ns)

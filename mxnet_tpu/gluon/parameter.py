@@ -145,6 +145,38 @@ class Parameter(object):
             new.append(old if old > 0 else got)
         self.shape = tuple(new)
 
+    # -- stale-grad tracking (reference parameter.py _fresh_grad) ----------
+    @property
+    def _fresh_grad(self):
+        """True iff backward wrote this parameter's gradient since the
+        last ``Trainer.step`` (reference trainer.py:148 staleness)."""
+        return bool(self._data is not None
+                    and getattr(self._data, "_fresh_grad", False))
+
+    @_fresh_grad.setter
+    def _fresh_grad(self, value):
+        if self._data is not None:
+            self._data._fresh_grad = bool(value)
+
+    # -- raw-buffer access (fused Trainer step) ----------------------------
+    def _raw_data(self):
+        """The underlying jax array of the weight — what a donated XLA
+        program consumes."""
+        return self._check_and_get("data")._data
+
+    def _raw_grad(self):
+        return self.grad()._data
+
+    def _rebind_data(self, jarr):
+        """In-place rebind of the weight handle to a new buffer.
+
+        Every holder of this Parameter shares the one NDArray handle, so
+        rebinding here is what makes buffer donation safe: after the
+        fused step donates the old weight buffer to XLA, all views
+        observe the new buffer through the same handle.
+        """
+        self._check_and_get("data")._set_data(jarr)
+
     # -- accessors ---------------------------------------------------------
     def _check_and_get(self, what="data"):
         if self._data is None:

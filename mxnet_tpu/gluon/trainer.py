@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from .. import kvstore as kvs
 from .. import optimizer as opt
+from .fused_trainer import fused_trainer_enabled, run_fused_step
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -78,14 +79,54 @@ class Trainer(object):
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Gradient-reduce (via kvstore) then update each parameter
-        (ref trainer.py:148). *batch_size* normalises the gradient."""
+        (ref trainer.py:148). *batch_size* normalises the gradient.
+
+        With ``ignore_stale_grad=True`` slots whose gradient was not
+        freshly written by backward since the last step are skipped;
+        otherwise a stale gradient raises (reference trainer.py:148
+        semantics — it usually means the model used only a subset of its
+        Parameters this iteration).
+
+        Default path (``MXNET_FUSED_TRAINER`` unset/1): bucketed
+        gradient all-reduce + ONE jitted, donated whole-model optimizer
+        program (gluon/fused_trainer.py).  ``MXNET_FUSED_TRAINER=0``
+        falls back to the per-slot loop, which is also the
+        bitwise-equality oracle in tests.
+        """
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = float(self._scale) / batch_size
 
+        slots = []
         for slot, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
+            if not param._fresh_grad:
+                if not ignore_stale_grad:
+                    raise UserWarning(
+                        "Gradient of Parameter `%s` has not been updated "
+                        "by backward since last `step`. This could mean "
+                        "a bug in your model that made it only use a "
+                        "subset of the Parameters for this iteration. If "
+                        "you are intentionally only using a subset, call "
+                        "step with ignore_stale_grad=True to suppress "
+                        "this warning and skip updating of Parameters "
+                        "with stale gradient" % param.name)
+                continue
+            slots.append((slot, param))
+
+        if slots:
+            if fused_trainer_enabled() and self._optimizer.supports_fused():
+                run_fused_step(self, slots)
+            else:
+                self._loop_step(slots)
+        for _, param in slots:
+            param._fresh_grad = False
+
+    def _loop_step(self, slots):
+        """Per-slot fallback: one kvstore round + one eager Updater
+        dispatch per parameter (O(n_params) program calls)."""
+        for slot, param in slots:
             grad = param.grad()
             if self._kvstore is not None:
                 # all-reduce the gradient across workers, update locally

@@ -31,11 +31,76 @@ __all__ = ["KVStore", "create"]
 import jax
 import jax.numpy as jnp
 
+from . import profiler as _prof
+
 
 @jax.jit
 def _stack_sum(arrs):
     """One fused XLA reduction over the per-device contributions."""
     return jnp.sum(jnp.stack(arrs), axis=0)
+
+
+# ---- bucketed gradient reduction (DDP-style flat buckets) -----------------
+#
+# One psum/reduce per parameter is O(n_params) collectives per step; the
+# fused Trainer step instead flattens gradients into a small number of
+# fixed-size, dtype-homogeneous buckets and reduces each bucket in ONE
+# collective ("Automatic Cross-Replica Sharding of Weight Update in
+# Data-Parallel Training", PAPERS.md — and every DDP implementation since).
+
+_DEFAULT_BUCKET_BYTES = 4 << 20      # 4 MiB, the PyTorch-DDP default scale
+
+
+def _bucket_bytes():
+    import os
+    try:
+        return max(1, int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
+                                         _DEFAULT_BUCKET_BYTES)))
+    except ValueError:
+        return _DEFAULT_BUCKET_BYTES
+
+
+def _plan_buckets(metas, limit=None):
+    """Greedy fixed-size bucket assignment.
+
+    *metas*: list of ``(group_key, nbytes)`` in slot order — group_key is
+    whatever must be homogeneous inside a bucket (dtype, or
+    (dtype, n_copies)).  Returns a list of buckets, each a list of slot
+    indices; slot order is preserved within a group, no bucket's payload
+    exceeds *limit* bytes (a single oversize tensor gets its own bucket).
+    """
+    limit = limit or _bucket_bytes()
+    open_buckets = {}                  # group_key -> [indices, bytes]
+    plan = []
+    for i, (gk, nbytes) in enumerate(metas):
+        cur = open_buckets.get(gk)
+        if cur is None or (cur[1] + nbytes > limit and cur[0]):
+            cur = [[], 0]
+            open_buckets[gk] = cur
+            plan.append(cur)
+        cur[0].append(i)
+        cur[1] += nbytes
+    return [b[0] for b in plan]
+
+
+@jax.jit
+def _bucket_reduce(copies):
+    """ONE XLA program for a whole bucket: flatten+concat each device
+    copy, sum across copies, split back per key.
+
+    *copies*: tuple (n_copies) of tuples (n_keys) of same-dtype arrays —
+    copies[j][i] is device j's contribution for the bucket's i-th key.
+    """
+    flats = [jnp.concatenate([jnp.ravel(a) for a in copy])
+             for copy in copies]
+    total = flats[0] if len(flats) == 1 \
+        else jnp.sum(jnp.stack(flats), axis=0)
+    outs, off = [], 0
+    for a in copies[0]:
+        n = a.size
+        outs.append(total[off:off + n].reshape(a.shape))
+        off += n
+    return tuple(outs)
 
 
 def _ctx_group_sum(vals):
@@ -93,6 +158,14 @@ class KVStore:
                 raise MXNetError("key %s already initialized" % k)
             self._store[str(k)] = v.copy()
 
+    def _post_reduce(self, k, reduced):
+        """What push does after the cross-copy reduce for one key."""
+        if self._updater is not None:
+            self._updater(_updater_key(k), reduced, self._store[k])
+        else:
+            self._store[k]._set_data(
+                reduced.as_in_context(self._store[k].context)._data)
+
     def push(self, key, value, priority=0):
         keys, vals = _key_list(key, value)
         for k, v in zip(keys, vals):
@@ -100,12 +173,11 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
             vlist = v if isinstance(v, (list, tuple)) else [v]
+            _prof.bump("kvstore_push")
+            if len(vlist) > 1:
+                _prof.bump("xla_program_calls")   # the per-key reduce
             reduced = _ctx_group_sum(list(vlist))
-            if self._updater is not None:
-                self._updater(_updater_key(k), reduced, self._store[k])
-            else:
-                self._store[k]._set_data(
-                    reduced.as_in_context(self._store[k].context)._data)
+            self._post_reduce(k, reduced)
 
     def pull(self, key, out=None, priority=0, row_ids=None,
              ignore_sparse=True):
@@ -117,7 +189,107 @@ class KVStore:
                 raise MXNetError("key %s not initialized" % k)
             olist = o if isinstance(o, (list, tuple)) else [o]
             for dst in olist:
+                _prof.bump("kvstore_pull")
                 self._store[k].copyto(dst)
+
+    # -- batched / bucketed entry points (fused Trainer step front end) ----
+
+    def _normalize_all(self, keys, values):
+        """-> ([str keys], [list-of-NDArray per key]) with init checks."""
+        assert len(keys) == len(values)
+        skeys, vlists = [], []
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            skeys.append(k)
+            vlists.append(list(v) if isinstance(v, (list, tuple)) else [v])
+        return skeys, vlists
+
+    def _reduce_all(self, vlists):
+        """Bucketed cross-copy reduction over all keys at once.
+
+        Single-copy keys are identity (no program — same contract as
+        ``_ctx_group_sum``'s len-1 fast path).  Multi-copy keys are
+        grouped into (dtype, n_copies)-homogeneous flat buckets and each
+        bucket is reduced by ONE ``_bucket_reduce`` program instead of
+        one ``_stack_sum`` per key.  Returns reduced NDArrays, bitwise
+        equal to the per-key path (same copy order, same summation axis).
+        """
+        reduced = [None] * len(vlists)
+        multi = []
+        for i, vlist in enumerate(vlists):
+            if len(vlist) == 1:
+                reduced[i] = vlist[0]
+            else:
+                multi.append(i)
+        if multi:
+            # group key includes the leading copy's device: each key's
+            # reduction must land where its own copy-0 lives (the per-key
+            # _ctx_group_sum contract) — mixing devices in one bucket
+            # would mislabel results' placement
+            metas = [((str(vlists[i][0].dtype), len(vlists[i]),
+                       next(iter(vlists[i][0]._data.devices()))),
+                      vlists[i][0].size * vlists[i][0].dtype.itemsize)
+                     for i in multi]
+            for bucket in _plan_buckets(metas):
+                idxs = [multi[b] for b in bucket]
+                dev = next(iter(vlists[idxs[0]][0]._data.devices()))
+                n_copies = len(vlists[idxs[0]])
+                copies = tuple(
+                    tuple(jax.device_put(vlists[i][j]._data, dev)
+                          for i in idxs)
+                    for j in range(n_copies))
+                _prof.bump("kvstore_bucket_reduce")
+                _prof.bump("xla_program_calls")
+                outs = _bucket_reduce(copies)
+                for i, o in zip(idxs, outs):
+                    reduced[i] = NDArray(o, ctx=vlists[i][0].context)
+        return reduced
+
+    def push_all(self, keys, values, priority=0):
+        """Batched push: one bucketed reduction program per (dtype,
+        n_copies) bucket instead of one reduce per key."""
+        skeys, vlists = self._normalize_all(keys, values)
+        for k, r in zip(skeys, self._reduce_all(vlists)):
+            _prof.bump("kvstore_push")
+            self._post_reduce(k, r)
+
+    def pull_all(self, keys, outs, priority=0):
+        """Batched pull (reference broadcast leg)."""
+        assert len(keys) == len(outs)
+        for k, o in zip(keys, outs):
+            self.pull(k, out=o, priority=priority)
+
+    def push_pull_all(self, keys, values, outs=None, priority=0):
+        """Fused bucketed reduce + broadcast over all keys: the gradient
+        all-reduce a data-parallel ``Trainer.step`` actually needs, in
+        O(n_buckets) programs instead of O(n_keys).
+
+        Returns the reduced per-key NDArrays (and additionally writes
+        them into *outs* when given).  With an updater installed this
+        degrades to the reference push-then-pull semantics (the updater
+        runs per key on the bucketed reduction's result).
+        """
+        skeys, vlists = self._normalize_all(keys, values)
+        reduced = self._reduce_all(vlists)
+        results = []
+        if self._updater is not None:
+            for k, r, v in zip(skeys, reduced, vlists):
+                self._post_reduce(k, r)
+                results.append(self._store[k])
+        else:
+            for k, r in zip(skeys, reduced):
+                # rebind the authoritative copy — no program launched
+                self._store[k]._set_data(
+                    r.as_in_context(self._store[k].context)._data)
+                results.append(r)
+        if outs is not None:
+            for r, o in zip(results, outs):
+                for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                    if dst is not r:
+                        r.copyto(dst)
+        return results
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference kvstore.py:227)."""
@@ -218,6 +390,8 @@ class KVStoreDist(KVStore):
         self._trans = dist_ps.WorkerTransport()
         self._shapes = {}
         self._dtypes = {}
+        self._bucket_layouts = {}     # tuple(keys) -> bucket descriptors
+        self._bucket_inited = set()   # bucket keys registered on servers
         if "async" in kind and self._trans.rank == 0:
             self._trans.set_sync(False)
         # all workers rendezvous here so no push can reach a server that
@@ -304,6 +478,110 @@ class KVStoreDist(KVStore):
                 dst._set_data(nd.array(sparse, ctx=dst.context,
                                        dtype=dst.dtype)._data)
                 dst._stype = "row_sparse"
+
+    def push_all(self, keys, values, priority=0):
+        """Per-key on dist: a bucketed push would leave the per-key
+        server slots stale for later per-key pulls.  The bucketed fast
+        path is ``push_pull_all``, which owns both legs of the round."""
+        for k, v in zip(keys, values):
+            self.push(k, v, priority=priority)
+
+    def pull_all(self, keys, outs, priority=0):
+        for k, o in zip(keys, outs):
+            self.pull(k, out=o, priority=priority)
+
+    def _bucket_layout(self, keys):
+        """Plan (and lazily server-init) flat buckets for a key tuple.
+
+        Deterministic across workers: every rank derives the same layout
+        from the same key/shape/dtype metadata, rank 0 registers the
+        bucket keys server-side, everyone barriers.
+        """
+        kt = tuple(keys)
+        layout = self._bucket_layouts.get(kt)
+        if layout is None:
+            import hashlib
+            metas = [(str(np.dtype(self._dtypes[k])),
+                      int(np.prod(self._shapes[k], dtype=np.int64))
+                      * np.dtype(self._dtypes[k]).itemsize)
+                     for k in keys]
+            layout = []
+            for idxs in _plan_buckets(metas):
+                members = [keys[i] for i in idxs]
+                dtype = np.dtype(self._dtypes[members[0]])
+                sizes = [int(np.prod(self._shapes[k], dtype=np.int64))
+                         for k in members]
+                digest = hashlib.md5(";".join(
+                    "%s:%s:%s" % (k, self._shapes[k], dtype)
+                    for k in members).encode()).hexdigest()[:12]
+                layout.append({"key": "__bucket__" + digest, "idxs": idxs,
+                               "sizes": sizes, "dtype": dtype,
+                               "total": sum(sizes)})
+            self._bucket_layouts[kt] = layout
+        fresh = [b for b in layout if b["key"] not in self._bucket_inited]
+        if fresh:
+            if self.rank == 0:
+                for b in fresh:
+                    self._trans.init(b["key"],
+                                     np.zeros((b["total"],), b["dtype"]))
+            self.barrier()
+            self._bucket_inited.update(b["key"] for b in fresh)
+        return layout
+
+    def push_pull_all(self, keys, values, outs=None, priority=0):
+        """Bucketed gradient all-reduce over the dist transport: one
+        push+pull round per flat bucket instead of per key.
+
+        Note: this path owns both legs of the round — the per-key server
+        slots are NOT updated, so don't interleave it with per-key
+        ``pull`` of the same keys (use push/pull for that).  Sparse
+        values fall back to the per-key path.
+        """
+        skeys = [str(k) for k in keys]
+        vlists = []
+        for k, v in zip(skeys, values):
+            if k not in self._shapes:
+                raise MXNetError("key %s not initialized" % k)
+            vlists.append(list(v) if isinstance(v, (list, tuple)) else [v])
+        if self._optimizer is not None or any(
+                getattr(v, "stype", "default") == "row_sparse"
+                for vl in vlists for v in vl):
+            # update_on_kvstore mode must run the server optimizer on the
+            # real per-key slots, and sparse rows don't map onto flat
+            # ranges — both take the reference per-key path
+            results = []
+            for k, vl in zip(skeys, vlists):
+                self.push(k, vl, priority=priority)
+                dst = vl[0]
+                self.pull(k, out=dst, priority=priority)
+                results.append(dst)
+            return results
+        # local cross-copy combine first (usually len-1 identity)
+        local = [_ctx_group_sum(vl) for vl in vlists]
+        layout = self._bucket_layout(skeys)
+        for b in layout:
+            flat = np.concatenate(
+                [local[i].asnumpy().ravel() for i in b["idxs"]]) \
+                if len(b["idxs"]) > 1 \
+                else local[b["idxs"][0]].asnumpy().ravel()
+            _prof.bump("kvstore_bucket_reduce")
+            self._trans.push(b["key"], flat.astype(b["dtype"], copy=False))
+        results = [None] * len(skeys)
+        for b in layout:
+            flat = self._trans.pull(b["key"], (b["total"],))
+            off = 0
+            for i, n in zip(b["idxs"], b["sizes"]):
+                k = skeys[i]
+                val = flat[off:off + n].reshape(self._shapes[k])
+                off += n
+                results[i] = nd.array(val, ctx=vlists[i][0].context,
+                                      dtype=self._dtypes[k])
+        if outs is not None:
+            for r, o in zip(results, outs):
+                for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                    if dst is not r:
+                        dst._set_data(r.as_in_context(dst.context)._data)
+        return results
 
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the servers (reference kvstore.py:353:

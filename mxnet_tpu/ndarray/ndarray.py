@@ -36,7 +36,7 @@ __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
 class NDArray:
     """A mutable n-dimensional array handle on a device context."""
     __slots__ = ("_data", "_ctx", "_stype", "_grad", "_grad_req", "_marked",
-                 "_tape_node", "name", "__weakref__")
+                 "_fresh_grad", "_tape_node", "name", "__weakref__")
     # numpy scalar-priority so  np_scalar * NDArray  dispatches to us
     __array_priority__ = 1000.0
 
@@ -47,6 +47,7 @@ class NDArray:
         self._grad = None
         self._grad_req = "null"
         self._marked = False
+        self._fresh_grad = False  # grad written by backward since last step
         self._tape_node = None
         self.name = None
 

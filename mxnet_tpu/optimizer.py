@@ -22,10 +22,16 @@ import pickle
 import numpy as np
 import jax.numpy as jnp
 
+import weakref
+
 from .base import Registry
 from . import ndarray as nd
 from .ndarray import NDArray
 from .ops import optim_ops as _kern
+
+# per-instance jitted update_step programs; kept OUT of the instance so
+# optimizers stay picklable (dist set_optimizer, dump_optimizer states)
+_JIT_UPDATE_CACHE = weakref.WeakKeyDictionary()
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
            "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum",
@@ -72,6 +78,20 @@ def _zeros_like_nd(weight, dtype=None):
     from .ndarray.ndarray import _wrap
     data = jnp.zeros_like(weight._data, dtype=dtype or weight.dtype)
     return _wrap(data, weight.context)
+
+
+def static_hypers(opt):
+    """The optimizer scalars BAKED into a compiled update trace
+    (momentum, betas, clip_gradient, ...) — the cache-key complement of
+    the traced hypers (lr/wd/rescale/update counts)."""
+    dynamic = ("lr", "wd", "rescale_grad", "num_update", "begin_num_update")
+    items = []
+    for k, v in sorted(vars(opt).items()):
+        if k in dynamic or k.startswith("_"):
+            continue
+        if isinstance(v, (int, float, bool, str)) or v is None:
+            items.append((k, v))
+    return tuple(items)
 
 
 class Optimizer:
@@ -166,6 +186,10 @@ class Optimizer:
 
     # ---- the two update entry points ----
 
+    #: set by stochastic optimizers (SGLD): the fused step then feeds a
+    #: fresh per-slot PRNG key through ``hyper["key"]``
+    needs_rng = False
+
     def create_state(self, index, weight):
         return None
 
@@ -174,14 +198,103 @@ class Optimizer:
         raise NotImplementedError("%s has no pure update_step"
                                   % type(self).__name__)
 
+    def supports_fused(self):
+        """True iff the whole-model fused step may replace the per-slot
+        ``update`` loop bitwise: the optimizer must expose the pure core
+        and must not have customised the mutating entry point (a custom
+        ``update`` may carry bookkeeping the fused path can't replay)."""
+        cls = type(self)
+        return (cls.update is Optimizer.update
+                and cls.update_step is not Optimizer.update_step)
+
+    @staticmethod
+    def _hyper_dtype(w, state):
+        """lr/wd dtype for one slot: the dtype the eager loop's weak-typed
+        python-float hypers effectively compute in — the weight dtype,
+        EXCEPT when a half-precision weight carries an f32 master copy in
+        its state (multi-precision), where the update math runs in f32."""
+        if np.dtype(w.dtype) == np.float16:
+            import jax
+            leaves = jax.tree_util.tree_flatten(state)[0]
+            if any(np.dtype(l.dtype) == np.float32 for l in leaves):
+                return np.float32
+        return w.dtype
+
+    def fused_update_step(self, weights, grads, states, hyper):
+        """Pure whole-model update: every slot's ``update_step`` in ONE
+        trace, so jit compiles the entire weight update into a single
+        XLA program (the reference's fused optimizer_op.cc kernels,
+        lifted from per-tensor to per-model).
+
+        weights/grads/states: equal-length lists of raw jax pytrees.
+        hyper: {"lr": f32[n], "wd": f32[n], "t": i32[n],
+                "rescale": f32 scalar[, "key": PRNGKey[n]]} — all traced,
+        so lr schedules and batch-size changes never retrace.
+        """
+        prev_rescale = self.rescale_grad
+        self.rescale_grad = hyper["rescale"]
+        try:
+            keys = hyper.get("key")
+            new_ws, new_ss = [], []
+            for i, (w, g, s) in enumerate(zip(weights, grads, states)):
+                hdt = self._hyper_dtype(w, s)
+                h = {"lr": jnp.asarray(hyper["lr"][i], hdt),
+                     "wd": jnp.asarray(hyper["wd"][i], hdt),
+                     "t": hyper["t"][i]}
+                if keys is not None:
+                    h["key"] = keys[i]
+                nw, ns = self.update_step(w, g.astype(w.dtype), s, h)
+                new_ws.append(nw.astype(w.dtype))
+                new_ss.append(ns)
+            return new_ws, new_ss
+        finally:
+            self.rescale_grad = prev_rescale
+
     def update(self, index, weight, grad, state):
         """Classic mutating update: resolves hyper-params for *index*,
-        runs the pure core, writes results back into the NDArrays."""
+        runs the pure core as ONE jitted per-slot program, writes results
+        back into the NDArrays.
+
+        Jitting (rather than eager op-by-op dispatch) matters twice: it
+        fuses the slot's update into a single XLA program like the
+        reference's optimizer_op.cc kernels, and it makes the per-slot
+        loop execute the exact same compiled subgraph as the fused
+        whole-model Trainer step — the bitwise-oracle contract.
+        """
         self._update_count(index)
         hyper = {"lr": self._get_lr(index), "wd": self._get_wd(index),
-                 "t": self._index_update_count[index]}
-        new_w, new_state = self.update_step(weight._data, grad._data,
-                                            _state_raw(state), hyper)
+                 "t": self._index_update_count[index],
+                 # traced, NOT baked: Trainer.step rewrites it per batch
+                 "rescale": self.rescale_grad}
+        if self.needs_rng:
+            # a key must enter as an argument: drawing it inside the
+            # traced fn would freeze one key into the compiled program
+            from . import random as _random
+            hyper["key"] = _random.next_key()
+        import jax
+        # cache key: static scalar hypers are BAKED into the trace, so a
+        # mid-training mutation (opt.clip_gradient = ...) must rebuild.
+        # Recomputing the fingerprint here costs a ~20-attr scan per slot
+        # — micro vs the jit dispatch it gates, and the price of honoring
+        # mutations without a __setattr__ hook on every optimizer.
+        statics = static_hypers(self)
+        cached = _JIT_UPDATE_CACHE.get(self)
+        if cached is None or cached[0] != statics:
+            # weakref.proxy: the cached value must not strongly reference
+            # the key or this WeakKeyDictionary can never evict
+            _self = weakref.proxy(self)
+
+            def _step(w, g, s, h):
+                prev = _self.rescale_grad
+                _self.rescale_grad = h["rescale"]   # trace-time only
+                try:
+                    return _self.update_step(w, g, s, h)
+                finally:
+                    _self.rescale_grad = prev
+            cached = (statics, jax.jit(_step))
+            _JIT_UPDATE_CACHE[self] = cached
+        new_w, new_state = cached[1](weight._data, grad._data,
+                                     _state_raw(state), hyper)
         weight._set_data(new_w)
         _state_writeback(state, new_state)
 
@@ -253,6 +366,8 @@ class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (ref :631): gradient step at
     lr/2 plus N(0, lr) noise."""
 
+    needs_rng = True
+
     def update_step(self, w, g, state, hyper):
         import jax
         lr, wd = hyper["lr"], hyper["wd"]
@@ -305,10 +420,15 @@ class Adam(Optimizer):
         return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
     def update_step(self, w, g, state, hyper):
-        t = hyper["t"]
-        # ** 0.5 (not math.sqrt): t may be a traced scalar under jit
-        corrected = hyper["lr"] * (1.0 - self.beta2 ** t) ** 0.5 \
-            / (1.0 - self.beta1 ** t)
+        # jnp (not python) scalar math: t may be a traced scalar under
+        # jit, and the eager per-slot loop must round identically to the
+        # fused whole-model trace (bitwise-oracle contract) — so both
+        # compute the bias correction in f32 on-device.
+        t = jnp.asarray(hyper["t"], jnp.float32)
+        # final astype keeps fp16 weights in fp16 math (a bare f32 scalar
+        # would promote the whole update)
+        corrected = (hyper["lr"] * jnp.sqrt(1.0 - self.beta2 ** t)
+                     / (1.0 - self.beta1 ** t)).astype(w.dtype)
         mean, var = state
         new_w, new_mean, new_var = _kern._adam_update(
             w, g, mean, var, lr=corrected, wd=hyper["wd"],
@@ -420,7 +540,10 @@ class Adamax(Optimizer):
         return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
     def update_step(self, w, g, state, hyper):
-        lr = hyper["lr"] / (1.0 - self.beta1 ** hyper["t"])
+        # f32 jnp scalar prep: eager loop and fused trace must match;
+        # final astype keeps fp16 weights in fp16 math
+        t = jnp.asarray(hyper["t"], jnp.float32)
+        lr = (hyper["lr"] / (1.0 - self.beta1 ** t)).astype(w.dtype)
         g = _kern._prep_grad(g, self.rescale_grad, self._clip()) \
             + hyper["wd"] * w
         m, u = state
@@ -445,7 +568,9 @@ class Nadam(Optimizer):
                 nd.ones((1,), ctx=weight.context))     # running mu product
 
     def update_step(self, w, g, state, hyper):
-        lr, wd, t = hyper["lr"], hyper["wd"], hyper["t"]
+        lr, wd = hyper["lr"], hyper["wd"]
+        # f32 jnp scalar prep: eager loop and fused trace must match
+        t = jnp.asarray(hyper["t"], jnp.float32)
         g = _kern._prep_grad(g, self.rescale_grad, self._clip()) + wd * w
         mu_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
         mu_next = self.beta1 * (1.0 - 0.5 * 0.96 **
@@ -517,6 +642,9 @@ class Updater:
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
             self.states_synced[index] = True
+        from . import profiler as _prof
+        _prof.bump("xla_program_calls")   # one eager update program per slot
+        _prof.bump("optimizer_update")
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def set_states(self, states):

@@ -28,7 +28,8 @@ import threading
 import time
 
 __all__ = ["profiler_set_config", "set_config", "set_state", "dump_profile",
-           "dump", "pause", "resume", "clear", "Marker"]
+           "dump", "pause", "resume", "clear", "Marker",
+           "bump", "counter", "counters", "reset_counters"]
 
 _lock = threading.Lock()
 _state = {
@@ -39,6 +40,7 @@ _state = {
     "jax_tracing": False,
 }
 _events = []          # finished spans: dicts in Chrome trace format
+_counters = {}        # name -> monotonic int (program-call accounting)
 _t0 = time.perf_counter()
 
 
@@ -122,6 +124,34 @@ def record_program(name, start_us, dur_us):
     if _state["running"]:
         _record(name, "program", start_us, dur_us,
                 tid=threading.get_ident() % 10000)
+
+
+def bump(name, n=1):
+    """Increment a named monotonic counter.
+
+    Counters are always on (an int add, no gating on ``set_state``):
+    they are how tests and benches *prove* call-count claims — e.g. the
+    fused Gluon Trainer step's "one XLA program per step" contract is
+    gated on the ``xla_program_calls`` delta across a step.
+    """
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counter(name):
+    """Current value of one counter (0 if never bumped)."""
+    return _counters.get(name, 0)
+
+
+def counters():
+    """Snapshot of all counters."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters():
+    with _lock:
+        _counters.clear()
 
 
 class Marker:

@@ -71,9 +71,31 @@ def test_row_sparse(kv, nworkers, rank, key="rsp", shape=None):
                                              want[:nworkers + 2, 0])
 
 
+def test_bucketed_push_pull_all(kv, nworkers, rank):
+    """Bucketed gradient all-reduce (kvstore.push_pull_all): every worker
+    contributes rank-dependent grads for several keys; the flat-bucket
+    transport round must return the exact global sum for each key."""
+    shapes = [(5, 3), (7,), (2, 2, 2), (11,)]
+    keys = ["pb%d" % i for i in range(len(shapes))]
+    for k, s in zip(keys, shapes):
+        kv.init(k, mx.nd.zeros(s))
+    tri = nworkers * (nworkers + 1) // 2
+    for it in range(2):
+        vals = [mx.nd.ones(s) * (rank + 1 + it) for s in shapes]
+        outs = kv.push_pull_all(keys, vals)
+        want = tri + nworkers * it
+        for k, o in zip(keys, outs):
+            got = o.asnumpy()
+            assert np.all(got == want), \
+                "bucketed key %s iter %d: got %r want %r" \
+                % (k, it, got.flat[0], want)
+    kv.barrier()
+
+
 def main():
     kv = mx.kv.create("dist_sync")
     nworkers, rank = kv.num_workers, kv.rank
+    test_bucketed_push_pull_all(kv, nworkers, rank)
     kv.set_optimizer(mx.optimizer.create("test", rescale_grad=RATE))
     test_dense(kv, nworkers, rank)
     test_row_sparse(kv, nworkers, rank)

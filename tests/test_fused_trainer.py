@@ -1,0 +1,273 @@
+"""Fused Gluon Trainer step (one donated XLA program + bucketed
+all-reduce) vs the per-slot loop oracle.
+
+Contract (ISSUE 1): with MXNET_FUSED_TRAINER on (default) a
+``Trainer.step`` issues O(1) + O(n_buckets) XLA program calls — gated at
+<= 4 by the profiler counters on a >= 20-parameter model — and its
+parameter/opt-state results are bitwise identical to the per-slot loop
+(``MXNET_FUSED_TRAINER=0``).  Mirrors tests/test_cached_step.py for the
+Module side.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, profiler
+from mxnet_tpu.gluon import nn
+
+
+def _net(n_layers=3, width=8):
+    net = nn.Sequential()
+    for _ in range(n_layers - 1):
+        net.add(nn.Dense(width, activation="relu"))
+    net.add(nn.Dense(3))
+    return net
+
+
+def _train(optimizer, opt_params, fused, steps=4, n_layers=3, width=8,
+           batch_size=16, kvstore="device", lr_schedule=None, seed=0):
+    """Run a small regression net for *steps*; return params + states."""
+    prev_env = os.environ.get("MXNET_FUSED_TRAINER")
+    os.environ["MXNET_FUSED_TRAINER"] = "1" if fused else "0"
+    try:
+        np.random.seed(seed)
+        mx.random.seed(seed)
+        rng = np.random.RandomState(seed + 1)
+        net = _net(n_layers, width)
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), optimizer,
+                                dict(opt_params), kvstore=kvstore)
+        loss_fn = gluon.loss.L2Loss()
+        X = rng.randn(steps, batch_size, 6).astype(np.float32)
+        Y = rng.randn(steps, batch_size, 3).astype(np.float32)
+        for step in range(steps):
+            if lr_schedule is not None:
+                trainer.set_learning_rate(lr_schedule(step))
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(X[step])),
+                               mx.nd.array(Y[step]))
+            loss.backward()
+            trainer.step(batch_size)
+        # key by slot index: block name prefixes auto-number globally
+        params = {i: p.data().asnumpy()
+                  for i, p in enumerate(net.collect_params().values())}
+        states = {}
+        for idx, st in trainer._updater.states.items():
+            leaves = []
+            def _collect(s):
+                if s is None:
+                    leaves.append(None)
+                elif isinstance(s, (tuple, list)):
+                    for x in s:
+                        _collect(x)
+                else:
+                    leaves.append(s.asnumpy())
+            _collect(st)
+            states[idx] = leaves
+        return params, states, trainer
+    finally:
+        if prev_env is None:
+            del os.environ["MXNET_FUSED_TRAINER"]
+        else:
+            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+
+
+def _assert_bitwise(fast, slow, what):
+    assert fast.keys() == slow.keys()
+    for k in fast:
+        f, s = fast[k], slow[k]
+        if isinstance(f, list):
+            for i, (a, b) in enumerate(zip(f, s)):
+                if a is None:
+                    assert b is None
+                    continue
+                np.testing.assert_array_equal(
+                    a, b, err_msg="%s[%s][%d] not bitwise equal"
+                    % (what, k, i))
+        else:
+            np.testing.assert_array_equal(
+                f, s, err_msg="%s[%s] not bitwise equal" % (what, k))
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", (("learning_rate", 0.1), ("momentum", 0.9))),
+    ("adam", (("learning_rate", 0.01),)),
+    ("sgd", (("learning_rate", 0.05), ("momentum", 0.9), ("wd", 1e-3),
+             ("rescale_grad", 0.5), ("clip_gradient", 0.1))),
+    ("adam", (("learning_rate", 0.01), ("wd", 1e-4),
+              ("rescale_grad", 2.0))),
+    ("rmsprop", (("learning_rate", 0.01),)),
+])
+def test_fused_matches_loop_bitwise(optimizer, opt_params):
+    fp, fs, _ = _train(optimizer, opt_params, fused=True)
+    sp, ss, _ = _train(optimizer, opt_params, fused=False)
+    _assert_bitwise(fp, sp, "param")
+    _assert_bitwise(fs, ss, "state")
+
+
+def test_fused_matches_loop_without_kvstore():
+    fp, fs, _ = _train("sgd", (("learning_rate", 0.1), ("momentum", 0.9)),
+                       fused=True, kvstore=None)
+    sp, ss, _ = _train("sgd", (("learning_rate", 0.1), ("momentum", 0.9)),
+                       fused=False, kvstore=None)
+    _assert_bitwise(fp, sp, "param")
+    _assert_bitwise(fs, ss, "state")
+
+
+def test_no_retrace_across_lr_schedule():
+    """A changing lr schedule (and the changing update counts t) must hit
+    the ONE compiled step program — lr/wd/t enter as traced scalars
+    (mirror of test_cached_step.py::test_no_retrace_across_steps)."""
+    _, _, trainer = _train("adam", (("learning_rate", 0.01),), fused=True,
+                           steps=5, lr_schedule=lambda s: 0.01 * 0.5 ** s)
+    assert trainer._fused_step_jit._cache_size() == 1
+
+
+def test_fused_program_call_count():
+    """>= 20-parameter model, one step: <= 4 XLA program calls
+    (ISSUE 1 acceptance gate, via the new profiler counters)."""
+    prev_env = os.environ.get("MXNET_FUSED_TRAINER")
+    os.environ["MXNET_FUSED_TRAINER"] = "1"
+    try:
+        np.random.seed(0)
+        net = _net(n_layers=12, width=8)   # 12 Dense layers -> 24 params
+        net.initialize(init=mx.initializer.Xavier())
+        assert len(net.collect_params()) >= 20
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        loss_fn = gluon.loss.L2Loss()
+        x = mx.nd.array(np.random.randn(8, 6).astype(np.float32))
+        y = mx.nd.array(np.random.randn(8, 3).astype(np.float32))
+
+        def one_step():
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            before = profiler.counter("xla_program_calls")
+            trainer.step(8)
+            return profiler.counter("xla_program_calls") - before
+
+        one_step()                      # warmup (compile)
+        calls = one_step()              # steady state
+        assert calls <= 4, "fused step issued %d program calls" % calls
+        assert profiler.counter("trainer_fused_step") >= 2
+    finally:
+        if prev_env is None:
+            del os.environ["MXNET_FUSED_TRAINER"]
+        else:
+            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+
+
+def test_loop_program_call_count_is_per_slot():
+    """The fallback loop really is O(n_params) — the collapse the fused
+    path claims is measurable, not definitional."""
+    prev_env = os.environ.get("MXNET_FUSED_TRAINER")
+    os.environ["MXNET_FUSED_TRAINER"] = "0"
+    try:
+        np.random.seed(0)
+        net = _net(n_layers=12, width=8)
+        net.initialize(init=mx.initializer.Xavier())
+        n_params = len(net.collect_params())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        loss_fn = gluon.loss.L2Loss()
+        x = mx.nd.array(np.random.randn(8, 6).astype(np.float32))
+        y = mx.nd.array(np.random.randn(8, 3).astype(np.float32))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        before = profiler.counter("xla_program_calls")
+        trainer.step(8)
+        delta = profiler.counter("xla_program_calls") - before
+        assert delta >= n_params
+    finally:
+        if prev_env is None:
+            del os.environ["MXNET_FUSED_TRAINER"]
+        else:
+            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+
+
+def test_ignore_stale_grad():
+    """Reference trainer.py:148 parity: a slot whose grad was not freshly
+    written raises by default and is skipped with ignore_stale_grad."""
+    np.random.seed(0)
+    used = nn.Dense(4, in_units=6)
+    used.initialize()
+    unused = nn.Dense(4, in_units=6)
+    unused.initialize()
+    # force real (non-deferred) init of the unused branch
+    unused(mx.nd.array(np.random.randn(2, 6).astype(np.float32)))
+    params = list(used.collect_params().values()) \
+        + list(unused.collect_params().values())
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+
+    x = mx.nd.array(np.random.randn(2, 6).astype(np.float32))
+    with autograd.record():
+        loss = (used(x) ** 2).sum()
+    loss.backward()
+
+    with pytest.raises(UserWarning):
+        trainer.step(2)                     # unused branch is stale
+
+    before = {p.name: p.data().asnumpy().copy() for p in params}
+    trainer.step(2, ignore_stale_grad=True)
+    for p in used.collect_params().values():
+        assert np.abs(p.data().asnumpy() - before[p.name]).max() > 0, \
+            "used parameter %s was not updated" % p.name
+    for p in unused.collect_params().values():
+        np.testing.assert_array_equal(
+            p.data().asnumpy(), before[p.name],
+            err_msg="stale parameter %s was updated" % p.name)
+
+    # after a step every grad is stale again until the next backward
+    with pytest.raises(UserWarning):
+        trainer.step(2)
+
+
+def test_stale_grad_loop_path_parity():
+    """ignore_stale_grad behaves identically on the fallback loop."""
+    prev_env = os.environ.get("MXNET_FUSED_TRAINER")
+    os.environ["MXNET_FUSED_TRAINER"] = "0"
+    try:
+        test_ignore_stale_grad()
+    finally:
+        if prev_env is None:
+            del os.environ["MXNET_FUSED_TRAINER"]
+        else:
+            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+
+
+def test_loop_path_honors_hyper_mutation():
+    """The jitted per-slot update bakes static hypers (clip_gradient,
+    momentum) into the trace; mutating them mid-training must rebuild
+    the program, not silently keep the stale constant."""
+    import mxnet_tpu.optimizer as opt_mod
+    from mxnet_tpu import nd
+    opt = opt_mod.create("sgd", learning_rate=1.0)
+    w = nd.array(np.zeros(4, np.float32))
+    g = nd.array(np.full(4, 10.0, np.float32))
+    opt.update(0, w, g, opt.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy(), -10.0 * np.ones(4))
+    opt.clip_gradient = 1.0            # mid-training mutation
+    w2 = nd.array(np.zeros(4, np.float32))
+    opt.update(1, w2, g, opt.create_state(1, w2))
+    np.testing.assert_allclose(w2.asnumpy(), -1.0 * np.ones(4))
+
+
+def test_fused_save_load_states_roundtrip(tmp_path):
+    """Checkpointed Updater state written by the fused path loads into a
+    fresh Trainer (same layout as the loop path)."""
+    _, _, trainer = _train("sgd", (("learning_rate", 0.1),
+                                   ("momentum", 0.9)), fused=True)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    _, _, fresh = _train("sgd", (("learning_rate", 0.1),
+                                 ("momentum", 0.9)), fused=True, steps=1)
+    fresh.load_states(f)
+    for idx, st in trainer._updater.states.items():
+        if st is None:
+            continue
+        np.testing.assert_array_equal(st.asnumpy(),
+                                      fresh._updater.states[idx].asnumpy())

@@ -98,3 +98,101 @@ def test_optimizer_states_roundtrip(tmp_path):
     out = nd.empty(SHAPE)
     kv.pull(3, out=out)
     assert np.isfinite(out.asnumpy()).all()
+
+
+# ---- bucketed batched push/pull (fused Trainer front end) -----------------
+
+def test_plan_buckets_dtype_homogeneous_and_capped():
+    from mxnet_tpu.kvstore import _plan_buckets
+    f, h = "float32", "float16"
+    metas = [(f, 100), (f, 100), (h, 50), (f, 300), (h, 50), (f, 100)]
+    plan = _plan_buckets(metas, limit=250)
+    # every bucket homogeneous in group key
+    for bucket in plan:
+        assert len({metas[i][0] for i in bucket}) == 1
+    # payload cap respected (oversize singleton allowed)
+    for bucket in plan:
+        total = sum(metas[i][1] for i in bucket)
+        assert total <= 250 or len(bucket) == 1
+    # all slots covered exactly once, order preserved within dtype
+    flat = sorted(i for b in plan for i in b)
+    assert flat == list(range(len(metas)))
+    f_order = [i for b in plan for i in b if metas[i][0] == f]
+    assert f_order == sorted(f_order)
+    # oversize tensor gets its own bucket
+    assert [3] in plan
+
+
+def test_push_pull_all_matches_per_key():
+    """Bucketed reduce must be bitwise equal to the per-key reduce."""
+    rng = np.random.RandomState(0)
+    shapes = [(4, 4), (3,), (2, 5), (7,), (1, 1)]
+    copies = [[rng.randn(*s).astype(np.float32) for _ in range(3)]
+              for s in shapes]
+
+    kv_a = mx.kv.create("device")
+    kv_b = mx.kv.create("device")
+    keys = list(range(len(shapes)))
+    for k, s in zip(keys, shapes):
+        kv_a.init(k, nd.zeros(s))
+        kv_b.init(k, nd.zeros(s))
+
+    # per-key oracle
+    outs_a = []
+    for k, cps in zip(keys, copies):
+        kv_a.push(k, [nd.array(c) for c in cps])
+        out = nd.empty(shapes[k])
+        kv_a.pull(k, out=out)
+        outs_a.append(out.asnumpy())
+
+    # bucketed batch
+    reduced = kv_b.push_pull_all(
+        keys, [[nd.array(c) for c in cps] for cps in copies])
+    for a, r in zip(outs_a, reduced):
+        np.testing.assert_array_equal(a, r.asnumpy())
+
+
+def test_push_pull_all_issues_one_program_per_bucket():
+    from mxnet_tpu import profiler
+    rng = np.random.RandomState(1)
+    kv = mx.kv.create("device")
+    keys = list(range(24))
+    vals = [[nd.array(rng.randn(8, 8).astype(np.float32))
+             for _ in range(2)] for _ in keys]
+    for k in keys:
+        kv.init(k, nd.zeros((8, 8)))
+    before = profiler.counter("kvstore_bucket_reduce")
+    kv.push_pull_all(keys, vals)
+    n_buckets = profiler.counter("kvstore_bucket_reduce") - before
+    # 24 * 8*8*4B = 6 KiB total: far under the bucket cap -> ONE program
+    assert n_buckets == 1
+
+
+def test_push_pull_all_single_copy_is_identity():
+    """The degenerate 1-copy case (fused Trainer on one device) must not
+    launch any reduce program and must return the values unchanged."""
+    from mxnet_tpu import profiler
+    kv = mx.kv.create("device")
+    kv.init(0, nd.zeros(SHAPE))
+    g = nd.ones(SHAPE) * 3
+    before = profiler.counter("kvstore_bucket_reduce")
+    (out,) = kv.push_pull_all([0], [[g]])
+    assert profiler.counter("kvstore_bucket_reduce") == before
+    assert out is g
+
+
+def test_push_all_runs_updater_per_key():
+    kv = _init_kv()
+    seen = []
+
+    def updater(key, grad, weight):
+        seen.append(key)
+        weight += grad
+
+    kv.set_updater(updater)
+    kv.push_all(KEYS, [[nd.ones(SHAPE)] * 2 for _ in KEYS])
+    outs = [nd.empty(SHAPE) for _ in KEYS]
+    kv.pull_all(KEYS, outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.ones(SHAPE) * 2)
+    assert sorted(seen) == sorted(KEYS)

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Fused vs per-slot Gluon Trainer step micro-bench.
+
+Measures one optimizer step over a small convnet in both execution
+structures (same model, same grads):
+
+- fused  (MXNET_FUSED_TRAINER=1, default): bucketed grad all-reduce +
+  ONE jitted donated whole-model update program
+- loop   (MXNET_FUSED_TRAINER=0): per-slot kvstore push/pull + jitted
+  per-slot update program
+
+and prints one JSON line:
+
+    {"metric": "trainer_step", "fused_s": ..., "loop_s": ...,
+     "program_calls": ...}
+
+Runnable on any backend: `JAX_PLATFORMS=cpu python tools/trainer_step_bench.py`.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, profiler  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def build_net():
+    """Small convnet: 22 trainable parameter slots (conv/bn/dense mix)."""
+    net = nn.Sequential()
+    for ch in (8, 16, 16):
+        net.add(nn.Conv2D(ch, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.Conv2D(ch, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.BatchNorm())
+        net.add(nn.MaxPool2D(pool_size=2))
+    net.add(nn.Flatten())
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(10))
+    return net
+
+
+def run_mode(fused, steps, warmup, batch_size, optimizer, side=None):
+    prev_env = os.environ.get("MXNET_FUSED_TRAINER")
+    os.environ["MXNET_FUSED_TRAINER"] = "1" if fused else "0"
+    try:
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = build_net()
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), optimizer,
+                                {"learning_rate": 0.05})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = mx.nd.array(np.random.randn(batch_size, 3, 16, 16)
+                        .astype(np.float32))
+        y = mx.nd.array(np.random.randint(0, 10, (batch_size,))
+                        .astype(np.float32))
+
+        def one_step(measure_calls=False):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            before = profiler.counter("xla_program_calls")
+            t0 = time.perf_counter()
+            trainer.step(batch_size)
+            for p in net.collect_params().values():
+                p.data().wait_to_read()
+            dt = time.perf_counter() - t0
+            return dt, profiler.counter("xla_program_calls") - before
+
+        for _ in range(warmup):
+            one_step()
+        times, calls = [], 0
+        for _ in range(steps):
+            dt, calls = one_step()
+            times.append(dt)
+        if side is not None:
+            side["n_params"] = len([p for p in
+                                    net.collect_params().values()
+                                    if p.grad_req != "null"])
+        return float(np.median(times)), calls
+    finally:
+        if prev_env is None:
+            del os.environ["MXNET_FUSED_TRAINER"]
+        else:
+            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--optimizer", default="sgd")
+    args = ap.parse_args()
+
+    side = {}
+    fused_s, fused_calls = run_mode(True, args.steps, args.warmup,
+                                    args.batch_size, args.optimizer, side)
+    loop_s, loop_calls = run_mode(False, args.steps, args.warmup,
+                                  args.batch_size, args.optimizer)
+    print(json.dumps({
+        "metric": "trainer_step",
+        "fused_s": round(fused_s, 6),
+        "loop_s": round(loop_s, 6),
+        "program_calls": fused_calls,
+        "loop_program_calls": loop_calls,
+        "n_params": side.get("n_params"),
+        "speedup": round(loop_s / fused_s, 2) if fused_s else None,
+        "backend": mx.context.current_context().device_type,
+    }))
+
+
+if __name__ == "__main__":
+    main()
