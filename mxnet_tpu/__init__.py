@@ -68,6 +68,7 @@ from . import gluon
 from . import parallel
 # models and test_utils are opt-in imports (mxnet_tpu.models /
 # mxnet_tpu.test_utils), keeping `import mxnet_tpu` lean like the reference.
+from . import telemetry
 from . import profiler
 from . import monitor
 from .monitor import Monitor

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .context import Context, current_context
 from . import random as _random
+from . import telemetry as _telemetry
 from .ndarray import NDArray, _wrap, zeros as nd_zeros
 from .symbol.symbol import Symbol, _topo
 
@@ -138,9 +139,14 @@ class Executor:
         fn_eval, self._arg_nodes, self._aux_nodes = _build_graph_fn(
             symbol, train_mode=False)
         fn_train, _, _ = _build_graph_fn(symbol, train_mode=True)
-        self._eval_jit = jax.jit(fn_eval)
+        # every jit product goes through the retrace watchdog: a bound
+        # executor that keeps recompiling (shape-unstable feed) is exactly
+        # the storm the telemetry layer exists to surface
+        self._eval_jit = _telemetry.watch_jit(jax.jit(fn_eval),
+                                              "executor_eval")
         self._train_fn = fn_train  # raw, for the debug (monitor/group) paths
-        self._train_jit = jax.jit(fn_train)
+        self._train_jit = _telemetry.watch_jit(jax.jit(fn_train),
+                                               "executor_train")
 
         gpos = tuple(self.arg_names.index(n) for n in self._grad_names)
         self._gpos = gpos
@@ -165,10 +171,14 @@ class Executor:
             (in_grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
             return outs, new_aux, in_grads
 
-        self._fwd_train_jit = jax.jit(_fwd_vjp)
-        self._bwd_jit = jax.jit(lambda vjp_fn, og: vjp_fn(og))
-        self._fwd_bwd_jit = jax.jit(_fwd_bwd)
-        self._fwd_bwd_ones_jit = jax.jit(_fwd_bwd_ones)
+        self._fwd_train_jit = _telemetry.watch_jit(
+            jax.jit(_fwd_vjp), "executor_fwd_vjp")
+        self._bwd_jit = _telemetry.watch_jit(
+            jax.jit(lambda vjp_fn, og: vjp_fn(og)), "executor_bwd")
+        self._fwd_bwd_jit = _telemetry.watch_jit(
+            jax.jit(_fwd_bwd), "executor_fwd_bwd")
+        self._fwd_bwd_ones_jit = _telemetry.watch_jit(
+            jax.jit(_fwd_bwd_ones), "executor_fwd_bwd_ones")
         self._vjp = None
         self._vjp_jitted = False
         self._outputs = None
@@ -504,18 +514,13 @@ class Executor:
 
 
 def _profiled(method, label):
-    """Wrap an Executor method with a profiler program span (SURVEY §5.1:
-    the reference stamps engine ops; here the unit of execution is the
-    whole compiled program, so that's what gets a trace event)."""
+    """Wrap an Executor method with a program span (SURVEY §5.1: the
+    reference stamps engine ops; here the unit of execution is the whole
+    compiled program, so that's what gets a trace event).  Spans nest —
+    a forward issued inside a ``trainer_step`` span records it as parent."""
     def wrapper(self, *args, **kwargs):
-        from . import profiler as _prof
-        if not _prof.is_running():
+        with _telemetry.span(label, cat="program"):
             return method(self, *args, **kwargs)
-        t0 = _prof._now_us()
-        try:
-            return method(self, *args, **kwargs)
-        finally:
-            _prof.record_program(label, t0, _prof._now_us() - t0)
     wrapper.__name__ = method.__name__
     wrapper.__doc__ = method.__doc__
     return wrapper

@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import profiler as _prof
 from .. import random as _random
+from .. import telemetry as _tel
 from ..optimizer import _state_raw, _state_writeback, static_hypers
 
 __all__ = ["fused_trainer_enabled", "fused_step_fn", "run_fused_step"]
@@ -101,7 +102,8 @@ def fused_step_fn(opt, params_raw, states_raw, donate):
         return o.fused_update_step(params, grads, states, hyper)
 
     # params + states donated: the update happens in place in HBM
-    fn = jax.jit(step, donate_argnums=(0, 2) if donate else ())
+    fn = _tel.watch_jit(jax.jit(step, donate_argnums=(0, 2) if donate else ()),
+                        "fused_trainer_step")
     _STEP_CACHE[sig] = (opt_ref, fn)
     return fn
 
@@ -118,8 +120,9 @@ def run_fused_step(trainer, slots):
     grads = [p.grad() for _, p in slots]
 
     if trainer._kvstore is not None:
-        reduced = trainer._kvstore.push_pull_all(
-            [s for s, _ in slots], [[g] for g in grads])
+        with _tel.span("kvstore_push_pull", cat="kvstore"):
+            reduced = trainer._kvstore.push_pull_all(
+                [s for s, _ in slots], [[g] for g in grads])
         # per-slot grad buffers observe the reduced value, like pull(out=g)
         for g, r in zip(grads, reduced):
             if r is not g:
@@ -153,7 +156,8 @@ def run_fused_step(trainer, slots):
 
     _prof.bump("xla_program_calls")
     _prof.bump("trainer_fused_step")
-    new_params, new_states = fn(params_raw, raw_grads, states_raw, hyper)
+    with _tel.span("fused_optimizer_step", cat="program"):
+        new_params, new_states = fn(params_raw, raw_grads, states_raw, hyper)
 
     for (slot, p), nw, ns in zip(slots, new_params, new_states):
         p._rebind_data(nw)                         # donation-safe rebind

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from .. import kvstore as kvs
 from .. import optimizer as opt
+from .. import telemetry as _tel
 from .fused_trainer import fused_trainer_enabled, run_fused_step
 from .parameter import Parameter, ParameterDict
 
@@ -116,10 +117,16 @@ class Trainer(object):
             slots.append((slot, param))
 
         if slots:
-            if fused_trainer_enabled() and self._optimizer.supports_fused():
-                run_fused_step(self, slots)
-            else:
-                self._loop_step(slots)
+            # step-boundary span: kvstore buckets and the optimizer
+            # program nest inside it, and memory watermarks are sampled
+            # at its exit (telemetry on only)
+            with _tel.span("trainer_step", cat="step", hist="step_time_us",
+                           memory=True):
+                if fused_trainer_enabled() \
+                        and self._optimizer.supports_fused():
+                    run_fused_step(self, slots)
+                else:
+                    self._loop_step(slots)
         for _, param in slots:
             param._fresh_grad = False
 
@@ -130,9 +137,11 @@ class Trainer(object):
             grad = param.grad()
             if self._kvstore is not None:
                 # all-reduce the gradient across workers, update locally
-                self._kvstore.push(slot, [grad])
-                self._kvstore.pull(slot, out=[grad])
-            self._updater(slot, grad, param.data())
+                with _tel.span("kvstore_push_pull", cat="kvstore"):
+                    self._kvstore.push(slot, [grad])
+                    self._kvstore.pull(slot, out=[grad])
+            with _tel.span("optimizer_update", cat="program"):
+                self._updater(slot, grad, param.data())
 
     def save_states(self, fname):
         """Serialise Updater state (optimizer moments etc.) to *fname*."""

@@ -20,7 +20,43 @@ import numpy as np
 
 from .base import MXNetError
 from . import ndarray as nd
+from . import telemetry as _tel
 from .ndarray import NDArray
+
+
+_io_suppress = threading.local()
+
+
+def _timed_batch(produce):
+    """Time one batch fetch through *produce*.
+
+    Feeds the data-starvation telemetry: ``io_batch_wait_us`` is the time
+    the CONSUMER just spent waiting for this batch — when it rivals the
+    step time, the input pipeline (not the device) is the bottleneck.
+    Exactly ONE timing per logical batch: nested fetches (ResizeIter /
+    wrapper iterators delegating to an inner iterator on the same
+    thread) are suppressed by a reentrancy flag, and prefetch PRODUCER
+    threads are suppressed permanently — counting either would
+    double-book batches or overwrite the gauge with the producer's full
+    fetch time, inverting the starvation signal for a healthy prefetched
+    pipeline.  Off path is two cached-bool checks.
+    """
+    if getattr(_io_suppress, "active", False) \
+            or not (_tel.enabled() or _tel.trace_active()):
+        return produce()
+    t0 = _tel.now_us()
+    _io_suppress.active = True
+    try:
+        batch = produce()
+    finally:
+        _io_suppress.active = False
+    dur = _tel.now_us() - t0
+    if _tel.enabled():
+        _tel.bump("io_batches")
+        _tel.set_gauge("io_batch_wait_us", dur)
+    if _tel.trace_active():
+        _tel.add_event("data_batch", "io", t0, dur)
+    return batch
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter",
@@ -96,6 +132,9 @@ class DataIter:
         pass
 
     def next(self):
+        return _timed_batch(self._produce_next)
+
+    def _produce_next(self):
         if not self.iter_next():
             raise StopIteration
         return DataBatch(data=self.getdata(), label=self.getlabel(),
@@ -188,7 +227,8 @@ class _Slot:
         self.thread.start()
 
     def _produce(self):
-        while True:
+        _io_suppress.active = True       # producer fetches are never the
+        while True:                      # consumer's wait
             self.vacant.wait()
             if not self.live:
                 return
@@ -277,6 +317,9 @@ class PrefetchingIter(_BatchView):
         return True
 
     def next(self):
+        return _timed_batch(self._produce_next)
+
+    def _produce_next(self):
         if not self.iter_next():
             raise StopIteration
         return self.current_batch
@@ -372,6 +415,9 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def next(self):
+        return _timed_batch(self._produce_next)
+
+    def _produce_next(self):
         if not self.iter_next():
             raise StopIteration
         return DataBatch(data=self.getdata(), label=self.getlabel(),

@@ -32,12 +32,19 @@ import jax
 import jax.numpy as jnp
 
 from . import profiler as _prof
+from . import telemetry as _tel
 
 
-@jax.jit
 def _stack_sum(arrs):
     """One fused XLA reduction over the per-device contributions."""
     return jnp.sum(jnp.stack(arrs), axis=0)
+
+
+_stack_sum = _tel.watch_jit(jax.jit(_stack_sum), "kvstore_stack_sum")
+
+
+def _nd_nbytes(arr):
+    return arr.size * arr.dtype.itemsize
 
 
 # ---- bucketed gradient reduction (DDP-style flat buckets) -----------------
@@ -83,7 +90,6 @@ def _plan_buckets(metas, limit=None):
     return [b[0] for b in plan]
 
 
-@jax.jit
 def _bucket_reduce(copies):
     """ONE XLA program for a whole bucket: flatten+concat each device
     copy, sum across copies, split back per key.
@@ -101,6 +107,10 @@ def _bucket_reduce(copies):
         outs.append(total[off:off + n].reshape(a.shape))
         off += n
     return tuple(outs)
+
+
+_bucket_reduce = _tel.watch_jit(jax.jit(_bucket_reduce),
+                                "kvstore_bucket_reduce")
 
 
 def _ctx_group_sum(vals):
@@ -176,6 +186,9 @@ class KVStore:
             _prof.bump("kvstore_push")
             if len(vlist) > 1:
                 _prof.bump("xla_program_calls")   # the per-key reduce
+            if _tel.enabled():
+                _tel.bump("kvstore_push_bytes",
+                          sum(_nd_nbytes(c) for c in vlist))
             reduced = _ctx_group_sum(list(vlist))
             self._post_reduce(k, reduced)
 
@@ -190,6 +203,11 @@ class KVStore:
             olist = o if isinstance(o, (list, tuple)) else [o]
             for dst in olist:
                 _prof.bump("kvstore_pull")
+                # each broadcast copy launches one program, mirroring the
+                # reduce leg's accounting (push/pull symmetry)
+                _prof.bump("xla_program_calls")
+                if _tel.enabled():
+                    _tel.bump("kvstore_pull_bytes", _nd_nbytes(dst))
                 self._store[k].copyto(dst)
 
     # -- batched / bucketed entry points (fused Trainer step front end) ----
@@ -242,7 +260,14 @@ class KVStore:
                     for j in range(n_copies))
                 _prof.bump("kvstore_bucket_reduce")
                 _prof.bump("xla_program_calls")
-                outs = _bucket_reduce(copies)
+                nbytes = sum(metas[b][1] for b in bucket)
+                if _tel.enabled():
+                    _tel.bump("kvstore_reduce_bytes", nbytes)
+                    _tel.observe("bucket_bytes", nbytes)
+                with _tel.span("kvstore_bucket_reduce", cat="kvstore",
+                               args={"bytes": nbytes, "keys": len(idxs),
+                                     "copies": n_copies}):
+                    outs = _bucket_reduce(copies)
                 for i, o in zip(idxs, outs):
                     reduced[i] = NDArray(o, ctx=vlists[i][0].context)
         return reduced
@@ -288,6 +313,8 @@ class KVStore:
             for r, o in zip(results, outs):
                 for dst in (o if isinstance(o, (list, tuple)) else [o]):
                     if dst is not r:
+                        _prof.bump("kvstore_pull")
+                        _prof.bump("xla_program_calls")  # broadcast copy
                         r.copyto(dst)
         return results
 
@@ -433,6 +460,9 @@ class KVStoreDist(KVStore):
             if k not in self._shapes:
                 raise MXNetError("key %s not initialized" % k)
             vlist = v if isinstance(v, (list, tuple)) else [v]
+            _prof.bump("kvstore_push")
+            if len(vlist) > 1:
+                _prof.bump("xla_program_calls")   # the local reduce
             reduced = _ctx_group_sum(list(vlist))
             sparse = getattr(reduced, "stype", "default") == "row_sparse"
             if sparse and not self._is_sharded(k):
@@ -455,6 +485,8 @@ class KVStoreDist(KVStore):
             olist = o if isinstance(o, (list, tuple)) else [o]
             val = self._trans.pull(k, self._shapes.get(k, olist[0].shape))
             for dst in olist:
+                _prof.bump("kvstore_pull")
+                _prof.bump("xla_program_calls")   # host->device upload
                 dst._set_data(nd.array(val, ctx=dst.context,
                                        dtype=dst.dtype)._data)
 
@@ -557,6 +589,9 @@ class KVStoreDist(KVStore):
                 results.append(dst)
             return results
         # local cross-copy combine first (usually len-1 identity)
+        for vl in vlists:
+            if len(vl) > 1:
+                _prof.bump("xla_program_calls")   # the local reduce
         local = [_ctx_group_sum(vl) for vl in vlists]
         layout = self._bucket_layout(skeys)
         for b in layout:
@@ -565,7 +600,14 @@ class KVStoreDist(KVStore):
                 if len(b["idxs"]) > 1 \
                 else local[b["idxs"][0]].asnumpy().ravel()
             _prof.bump("kvstore_bucket_reduce")
-            self._trans.push(b["key"], flat.astype(b["dtype"], copy=False))
+            if _tel.enabled():
+                _tel.bump("kvstore_reduce_bytes", int(flat.nbytes))
+                _tel.observe("bucket_bytes", int(flat.nbytes))
+            with _tel.span("kvstore_bucket_reduce", cat="kvstore",
+                           args={"bytes": int(flat.nbytes),
+                                 "keys": len(b["idxs"])}):
+                self._trans.push(b["key"],
+                                 flat.astype(b["dtype"], copy=False))
         results = [None] * len(skeys)
         for b in layout:
             flat = self._trans.pull(b["key"], (b["total"],))
@@ -574,12 +616,16 @@ class KVStoreDist(KVStore):
                 k = skeys[i]
                 val = flat[off:off + n].reshape(self._shapes[k])
                 off += n
+                _prof.bump("kvstore_pull")
+                _prof.bump("xla_program_calls")   # host->device upload
                 results[i] = nd.array(val, ctx=vlists[i][0].context,
                                       dtype=self._dtypes[k])
         if outs is not None:
             for r, o in zip(results, outs):
                 for dst in (o if isinstance(o, (list, tuple)) else [o]):
                     if dst is not r:
+                        _prof.bump("kvstore_pull")
+                        _prof.bump("xla_program_calls")  # broadcast copy
                         dst._set_data(r.as_in_context(dst.context)._data)
         return results
 

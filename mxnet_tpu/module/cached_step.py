@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import random as _random
+from .. import telemetry as _tel
 from ..ndarray import NDArray
 from ..optimizer import _state_raw, _state_writeback
 
@@ -89,7 +90,8 @@ class CachedTrainStep:
             return outs, new_params, new_aux, new_states
 
         donate = (0, 2, 3) if executor._ctx.device_type != "cpu" else ()
-        self._step_jit = jax.jit(step, donate_argnums=donate)
+        self._step_jit = _tel.watch_jit(
+            jax.jit(step, donate_argnums=donate), "module_cached_step")
 
     def _ensure_states(self):
         """Create optimizer state through the Updater so checkpoint
@@ -104,15 +106,10 @@ class CachedTrainStep:
 
     def run(self, feed):
         """Execute one step; *feed* maps data/label names to NDArrays."""
-        from .. import profiler as _prof
-        if not _prof.is_running():
+        _tel.bump("module_train_step")
+        with _tel.span("module_train_step", cat="step",
+                       hist="step_time_us", memory=True):
             return self._run(feed)
-        t0 = _prof._now_us()
-        try:
-            return self._run(feed)
-        finally:
-            _prof.record_program("module_train_step", t0,
-                                 _prof._now_us() - t0)
 
     def _run(self, feed):
         ex = self._exec
@@ -153,8 +150,11 @@ class CachedTrainStep:
                  "rng": ex._place_rng(ukeys[0])}
 
         try:
-            outs, new_params, new_aux, new_states = self._step_jit(
-                params, rest, aux_vals, states, hyper)
+            # program child span inside the module_train_step span: in the
+            # trace, the gap between the two is host-side feed/bookkeeping
+            with _tel.span("module_step_program", cat="program"):
+                outs, new_params, new_aux, new_states = self._step_jit(
+                    params, rest, aux_vals, states, hyper)
         except NotImplementedError:
             # optimizer lacks a pure update_step (discovered at trace
             # time): roll back the count bookkeeping so the slow-path

@@ -25,7 +25,9 @@ import jax.numpy as jnp
 from ..base import MXNetError, dtype_np, dtype_name
 from ..context import Context, current_context, cpu
 from .. import autograd as ag
+from .. import profiler as _prof
 from .. import random as _random
+from .. import telemetry as _tel
 from ..ops.registry import get_op, Op
 
 __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
@@ -468,14 +470,22 @@ def invoke(op, inputs, attrs, out=None):
     Reference analogue: MXImperativeInvokeEx → Imperative::Invoke
     (``src/imperative/imperative.cc:86``) and RecordOp (:182).
     """
-    from .. import profiler as _prof
-    if _prof.is_running() and _prof._state["mode"] == "all":
-        t0 = _prof._now_us()
+    if not (_prof.is_running() or _tel.enabled()):   # the eager off path
+        return _invoke(op, inputs, attrs, out)
+    prof_all = _prof.is_running() and _prof._state["mode"] == "all"
+    tel = _tel.enabled()
+    if prof_all or tel:
+        t0 = _tel.now_us()
         try:
             return _invoke(op, inputs, attrs, out)
         finally:
-            _prof.record_op(op if isinstance(op, str) else op.name,
-                            t0, _prof._now_us() - t0)
+            dur = _tel.now_us() - t0
+            if prof_all:
+                _prof.record_op(op if isinstance(op, str) else op.name,
+                                t0, dur)
+            if tel:
+                _tel.bump("eager_invocations")
+                _tel.observe("eager_dispatch_us", dur)
     return _invoke(op, inputs, attrs, out)
 
 

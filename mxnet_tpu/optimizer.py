@@ -26,6 +26,7 @@ import weakref
 
 from .base import Registry
 from . import ndarray as nd
+from . import telemetry as _tel
 from .ndarray import NDArray
 from .ops import optim_ops as _kern
 
@@ -291,7 +292,8 @@ class Optimizer:
                     return _self.update_step(w, g, s, h)
                 finally:
                     _self.rescale_grad = prev
-            cached = (statics, jax.jit(_step))
+            cached = (statics,
+                      _tel.watch_jit(jax.jit(_step), "optimizer_update_step"))
             _JIT_UPDATE_CACHE[self] = cached
         new_w, new_state = cached[1](weight._data, grad._data,
                                      _state_raw(state), hyper)

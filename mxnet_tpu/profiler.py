@@ -9,29 +9,39 @@ TPU-native redesign: there is no engine worker to instrument — eager ops
 dispatch through ``ndarray.invoke`` and compiled graphs execute as one XLA
 program.  So the profiler has two layers:
 
-1. **Op events** (this module): when running, the eager dispatch path and
-   the Executor forward/backward record wall-clock spans per op / per
-   program, dumped as Chrome ``traceEvents`` JSON — same file format the
-   reference produces, loadable in chrome://tracing or Perfetto.
+1. **Op events**: when running, the eager dispatch path and the Executor
+   forward/backward record wall-clock spans per op / per program, dumped as
+   Chrome ``traceEvents`` JSON — same file format the reference produces,
+   loadable in chrome://tracing or Perfetto.
 2. **Device profile**: ``start()/stop()`` also drive ``jax.profiler``
    (XPlane/TensorBoard) when a trace dir is configured, which is where
    real per-kernel TPU timing lives (XLA fuses ops, so per-op host spans
    are the honest analogue of the reference's engine stats).
 
+The buffers themselves live in :mod:`mxnet_tpu.telemetry` — the runtime
+telemetry plane (hierarchical spans, metrics registry, retrace watchdog)
+shares one merged trace with this module, and ``bump()``/``counter()``
+here are compatibility shims over its typed metrics registry.
+
 Env autostart: ``MXNET_PROFILER_AUTOSTART=1`` (reference env_var.md:101).
 """
 from __future__ import annotations
 
-import json
 import os
 import threading
-import time
+
+from . import telemetry as _telemetry
+from .telemetry import (bump, counter, counters, reset_counters,  # noqa: F401
+                        now_us as _now_us)
 
 __all__ = ["profiler_set_config", "set_config", "set_state", "dump_profile",
            "dump", "pause", "resume", "clear", "Marker",
            "bump", "counter", "counters", "reset_counters"]
 
 _lock = threading.Lock()
+# serializes the jax device-trace transition (flag + jax.profiler call as
+# one unit) — held only on run/stop, never on the hot path
+_jax_trace_lock = threading.Lock()
 _state = {
     "mode": "symbolic",
     "filename": "profile.json",
@@ -39,13 +49,6 @@ _state = {
     "jax_trace_dir": None,
     "jax_tracing": False,
 }
-_events = []          # finished spans: dicts in Chrome trace format
-_counters = {}        # name -> monotonic int (program-call accounting)
-_t0 = time.perf_counter()
-
-
-def _now_us():
-    return (time.perf_counter() - _t0) * 1e6
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json",
@@ -60,7 +63,7 @@ def profiler_set_config(mode="symbolic", filename="profile.json",
         _state["mode"] = mode
         _state["filename"] = filename
         _state["jax_trace_dir"] = kwargs.get("jax_trace_dir")
-        _events.clear()  # new config = new profiling session
+    _telemetry.clear_events()  # new config = new profiling session
 
 
 set_config = profiler_set_config
@@ -73,25 +76,34 @@ def set_state(state="stop"):
     exclude a window without losing prior spans); ``set_config`` or
     ``clear`` starts a fresh buffer.
     """
+    run = state == "run"
     with _lock:
-        run = state == "run"
-        already_tracing = _state["jax_tracing"]
         _state["running"] = run
         tdir = _state["jax_trace_dir"]
-    if run and tdir and not already_tracing:
-        import jax
-        jax.profiler.start_trace(tdir)
-        _state["jax_tracing"] = True
-    elif not run and already_tracing:
-        import jax
-        jax.profiler.stop_trace()
-        _state["jax_tracing"] = False
+        # mirror into telemetry under the same lock: concurrent run/stop
+        # must not leave is_running() and trace_active() disagreeing
+        _telemetry._set_profiler_running(run)
+    # the jax_tracing flag and the jax.profiler side effect transition as
+    # ONE unit under a dedicated lock: concurrent run/stop calls can
+    # neither double-start the device trace nor stop it before the
+    # in-flight start has actually run.  `running` is RE-READ inside the
+    # lock — acting on this call's stale snapshot could start a device
+    # trace after a later stop already won.
+    with _jax_trace_lock:
+        now_running = _state["running"]
+        if now_running and tdir and not _state["jax_tracing"]:
+            import jax
+            jax.profiler.start_trace(tdir)
+            _state["jax_tracing"] = True
+        elif not now_running and _state["jax_tracing"]:
+            import jax
+            jax.profiler.stop_trace()
+            _state["jax_tracing"] = False
 
 
 def clear():
     """Drop all accumulated events."""
-    with _lock:
-        _events.clear()
+    _telemetry.clear_events()
 
 
 def pause():
@@ -106,81 +118,36 @@ def is_running():
     return _state["running"]
 
 
-def _record(name, cat, start_us, dur_us, tid=0):
-    _events.append({"name": name, "cat": cat, "ph": "X",
-                    "ts": start_us, "dur": dur_us,
-                    "pid": os.getpid(), "tid": tid})
-
-
 def record_op(name, start_us, dur_us):
     """Called from the eager dispatch path (mode='all')."""
     if _state["running"] and _state["mode"] == "all":
-        _record(name, "operator", start_us, dur_us,
-                tid=threading.get_ident() % 10000)
+        _telemetry.add_event(name, "operator", start_us, dur_us)
 
 
 def record_program(name, start_us, dur_us):
     """Called from Executor forward/backward (any mode)."""
     if _state["running"]:
-        _record(name, "program", start_us, dur_us,
-                tid=threading.get_ident() % 10000)
+        _telemetry.add_event(name, "program", start_us, dur_us)
 
 
-def bump(name, n=1):
-    """Increment a named monotonic counter.
+class Marker(_telemetry.span):
+    """User annotation span: ``with profiler.Marker("data-load"): ...``
 
-    Counters are always on (an int add, no gating on ``set_state``):
-    they are how tests and benches *prove* call-count claims — e.g. the
-    fused Gluon Trainer step's "one XLA program per step" contract is
-    gated on the ``xla_program_calls`` delta across a step.
+    Markers are telemetry spans: nested Markers record parent/depth and
+    render as nested tracks, and they obey either gate (profiler running
+    OR ``MXNET_TELEMETRY=1``).
     """
-    with _lock:
-        _counters[name] = _counters.get(name, 0) + n
-
-
-def counter(name):
-    """Current value of one counter (0 if never bumped)."""
-    return _counters.get(name, 0)
-
-
-def counters():
-    """Snapshot of all counters."""
-    with _lock:
-        return dict(_counters)
-
-
-def reset_counters():
-    with _lock:
-        _counters.clear()
-
-
-class Marker:
-    """User annotation span: ``with profiler.Marker("data-load"): ...``"""
 
     def __init__(self, name, cat="user"):
-        self._name = name
-        self._cat = cat
-
-    def __enter__(self):
-        self._start = _now_us()
-        return self
-
-    def __exit__(self, *exc):
-        if _state["running"]:
-            _record(self._name, self._cat, self._start,
-                    _now_us() - self._start)
+        super().__init__(name, cat=cat)
 
 
 def dump_profile(filename=None):
     """Write accumulated events as Chrome trace JSON
-    (reference Profiler::DumpProfile, profiler.cc:127-192)."""
+    (reference Profiler::DumpProfile, profiler.cc:127-192), including
+    ``ph:"M"`` process/thread-name metadata so Perfetto labels tracks."""
     fname = filename or _state["filename"]
-    with _lock:
-        payload = {"traceEvents": list(_events),
-                   "displayTimeUnit": "ms"}
-    with open(fname, "w") as f:
-        json.dump(payload, f)
-    return fname
+    return _telemetry.dump_chrome_trace(fname)
 
 
 dump = dump_profile

@@ -1,0 +1,676 @@
+"""Runtime telemetry: hierarchical spans, metrics, retrace watchdog, exporters.
+
+The reference engine stamps every op with ``OprExecStat`` and dumps Chrome
+trace JSON (``src/engine/profiler.{h,cc}``, SURVEY §5.1).  On the TPU build
+the unit of execution is a compiled XLA program, so the observability plane
+is organised around four questions instead of one:
+
+1. **Where does wall time go?**  Hierarchical spans (``span()``): a
+   contextvar carries the enclosing span, so a ``trainer_step`` span
+   contains its kvstore-bucket and optimizer-program children.  Spans land
+   in the same Chrome ``traceEvents`` buffer the profiler always produced
+   (nesting renders by time containment per tid; each event also carries
+   ``args.parent``/``args.depth`` for tooling).
+2. **How many programs / bytes?**  A typed metrics registry — monotonic
+   :class:`Counter`, last-value :class:`Gauge`, fixed-bucket
+   :class:`Histogram` — supersedes the loose ``profiler._counters`` dict.
+   ``profiler.bump()/counter()`` remain as shims onto it, and the counter
+   fast path stays a lock+int-add (tests gate perf contracts on deltas of
+   ``xla_program_calls``; that must never get slower or gated).
+3. **What recompiles?**  The retrace watchdog (:func:`watch_jit`) wraps
+   every jit entry point the framework owns.  A wrapped callable whose
+   jit cache grows during a call records a compile event (name, wall time,
+   cache size) and, past ``MXNET_TELEMETRY_RETRACE_LIMIT`` compiles for one
+   name, logs ONE structured retrace-storm warning — the signature of a
+   shape-unstable input pipeline silently recompiling every step.
+4. **How do I read it?**  Exporters: :func:`dump_chrome_trace` (merged
+   trace + ``ph:"M"`` track-name metadata), :func:`prometheus_text`
+   (text exposition), :func:`snapshot`/:func:`dump_snapshot` (JSON),
+   consumed by ``tools/trace_report.py``.
+
+Gating: ``MXNET_TELEMETRY=1`` enables spans/histograms/watchdog/memory
+sampling.  Counters are ALWAYS on; with telemetry off every other hook is
+one cached-bool check.  Spans also record whenever the classic profiler is
+running (``profiler.set_state('run')``), so existing profiler workflows
+keep working unchanged.
+
+This module is import-light on purpose (stdlib only; jax only touched
+inside memory sampling) — every hot path in the framework imports it.
+"""
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ["enabled", "set_enabled", "configure", "trace_active",
+           "span", "now_us", "add_event", "clear_events",
+           "Counter", "Gauge", "Histogram",
+           "bump", "counter", "counters", "reset_counters",
+           "set_gauge", "gauge", "observe", "histogram",
+           "watch_jit", "compile_events", "retrace_report",
+           "dump_chrome_trace", "prometheus_text", "snapshot",
+           "dump_snapshot", "reset", "sample_memory",
+           "COUNTERS", "GAUGES", "HISTOGRAMS", "METRIC_NAMES"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+# --------------------------------------------------------------------------
+# config / gating
+# --------------------------------------------------------------------------
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def _env_enabled():
+    return os.environ.get("MXNET_TELEMETRY", "0").strip().lower() in _TRUTHY
+
+
+def _env_retrace_limit():
+    try:
+        return max(1, int(os.environ.get("MXNET_TELEMETRY_RETRACE_LIMIT", 5)))
+    except ValueError:
+        return 5
+
+
+def _env_max_events():
+    try:
+        return max(1, int(os.environ.get("MXNET_TELEMETRY_MAX_EVENTS",
+                                         200_000)))
+    except ValueError:
+        return 200_000
+
+
+_ENABLED = _env_enabled()
+_RETRACE_LIMIT = _env_retrace_limit()
+_PROF_RUNNING = False          # mirrored by profiler.set_state
+
+
+def enabled():
+    """Whether the telemetry layer (spans/histograms/watchdog) is on."""
+    return _ENABLED
+
+
+def set_enabled(value):
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+def configure(enabled=None, retrace_limit=None, max_events=None):
+    """Programmatic override of the MXNET_TELEMETRY* env configuration."""
+    global _RETRACE_LIMIT, _events
+    if enabled is not None:
+        set_enabled(enabled)
+    if retrace_limit is not None:
+        _RETRACE_LIMIT = max(1, int(retrace_limit))
+    if max_events is not None:
+        cap = max(1, int(max_events))
+        with _lock:
+            _events = deque(list(_events)[-cap:], maxlen=cap)
+
+
+def refresh_from_env():
+    """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_RETRACE_LIMIT."""
+    global _ENABLED, _RETRACE_LIMIT
+    _ENABLED = _env_enabled()
+    _RETRACE_LIMIT = _env_retrace_limit()
+
+
+def retrace_limit():
+    return _RETRACE_LIMIT
+
+
+def _set_profiler_running(running):
+    """Called by profiler.set_state so spans honor the classic profiler."""
+    global _PROF_RUNNING
+    _PROF_RUNNING = bool(running)
+
+
+def trace_active():
+    """True when spans should record trace events."""
+    return _ENABLED or _PROF_RUNNING
+
+
+# --------------------------------------------------------------------------
+# trace-event buffer (the Chrome traceEvents the profiler always produced)
+# --------------------------------------------------------------------------
+
+_lock = threading.Lock()
+# ring buffer: always-on telemetry must not grow host RSS without bound
+# over a week-long run — the newest MXNET_TELEMETRY_MAX_EVENTS spans win,
+# and evictions are themselves counted (trace_events_dropped)
+_events = deque(maxlen=_env_max_events())
+_tid_cats = {}                     # tid -> set of categories seen on it
+_t0 = time.perf_counter()
+
+# track labels per span category: chrome://tracing / Perfetto show these as
+# the thread-name of each tid's track.  One thread usually hosts several
+# categories (its spans nest on one track — that containment is also what
+# trace_report's self-time sweep relies on), so the label is chosen at
+# dump time from the highest-priority category the tid hosted.
+_CAT_TRACK = {"operator": "eager-dispatch", "program": "executor",
+              "step": "train-step", "kvstore": "kvstore", "io": "data-io",
+              "compile": "jit-compile", "user": "user"}
+_CAT_PRIORITY = ("step", "program", "kvstore", "io", "operator",
+                 "compile", "user")
+
+
+def now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def add_event(name, cat, start_us, dur_us, tid=None, args=None):
+    """Append one complete ('X') event to the trace buffer.
+
+    The append happens under the buffer lock: a concurrent
+    ``dump_chrome_trace`` iterates the ring, and deque iteration raises
+    if it races a mutation.  Events are only recorded while tracing is
+    active, so the lock never touches the telemetry-off path.
+    """
+    if tid is None:
+        tid = threading.get_ident() % 10000
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": start_us,
+          "dur": dur_us, "pid": os.getpid(), "tid": tid}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _tid_cats.setdefault(tid, set()).add(cat)
+        dropped = len(_events) == _events.maxlen   # ring evicts the oldest
+        _events.append(ev)
+    if dropped:
+        bump("trace_events_dropped")
+
+
+def clear_events():
+    with _lock:
+        _events.clear()
+        _tid_cats.clear()
+
+
+# --------------------------------------------------------------------------
+# hierarchical spans
+# --------------------------------------------------------------------------
+
+_SPAN_STACK = contextvars.ContextVar("mxnet_tpu_span_stack", default=())
+
+
+def current_span():
+    """Name of the innermost open span on this context (None outside)."""
+    stack = _SPAN_STACK.get()
+    return stack[-1] if stack else None
+
+
+class span:
+    """Hierarchical timed span: ``with telemetry.span("trainer_step"): ...``
+
+    Nesting is carried by a contextvar (so it survives thread-pool hops
+    that copy context), and recorded two ways: structurally via
+    ``args.parent``/``args.depth``, and visually via time containment on
+    the owning thread's track.  Off path (telemetry off AND profiler
+    stopped) is one bool check.
+
+    *hist*: name of a registered histogram to observe with the span's
+    duration (µs).  *memory*: sample host/device memory watermarks at span
+    exit (step-boundary spans only; it costs a getrusage + device query).
+    *args*: extra key/values for the trace event (e.g. bucket bytes).
+    """
+
+    __slots__ = ("_name", "_cat", "_hist", "_memory", "_args",
+                 "_on", "_t0", "_tok", "_parent")
+
+    def __init__(self, name, cat="user", hist=None, memory=False, args=None):
+        self._name = name
+        self._cat = cat
+        self._hist = hist
+        self._memory = memory
+        self._args = args
+
+    def __enter__(self):
+        if not trace_active():
+            self._on = False
+            return self
+        self._on = True
+        stack = _SPAN_STACK.get()
+        self._parent = stack[-1] if stack else None
+        self._tok = _SPAN_STACK.set(stack + (self._name,))
+        self._t0 = now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if not self._on:
+            return False
+        dur = now_us() - self._t0
+        _SPAN_STACK.reset(self._tok)
+        args = {"parent": self._parent,
+                "depth": len(_SPAN_STACK.get())}
+        if self._args:
+            args.update(self._args)
+        add_event(self._name, self._cat, self._t0, dur, args=args)
+        if self._hist is not None and _ENABLED:
+            observe(self._hist, dur)
+        if self._memory and _ENABLED:
+            sample_memory()
+        return False
+
+
+# --------------------------------------------------------------------------
+# metrics registry
+# --------------------------------------------------------------------------
+#
+# Declarations first: every metric name the framework itself uses MUST be
+# listed here — tests/test_telemetry.py statically scans mxnet_tpu/ for
+# bump()/counter()/observe()/set_gauge() string literals and asserts
+# membership, so a typo'd counter name fails CI instead of silently
+# splitting a time series.
+
+COUNTERS = {
+    "xla_program_calls": "XLA programs launched (perf-contract currency)",
+    "kvstore_push": "kvstore push operations (per key)",
+    "kvstore_pull": "kvstore pull broadcast copies (per destination)",
+    "kvstore_bucket_reduce": "bucketed gradient-reduce programs",
+    "kvstore_push_bytes": "bytes entering kvstore reduction",
+    "kvstore_pull_bytes": "bytes broadcast out of the kvstore",
+    "kvstore_reduce_bytes": "payload bytes moved through bucket reduces",
+    "optimizer_update": "eager per-slot optimizer updates",
+    "trainer_fused_step": "fused whole-model Trainer steps",
+    "module_train_step": "Module CachedTrainStep executions",
+    "eager_invocations": "eager op dispatches through ndarray.invoke",
+    "io_batches": "data batches produced by iterators",
+    "jit_compiles": "watched-jit cache misses (traces+compiles)",
+    "retrace_storms": "watched callables that crossed the retrace limit",
+    "trace_events_dropped": "spans evicted from the bounded trace ring",
+}
+
+GAUGES = {
+    "io_batch_wait_us": "time the training loop waited for the last batch "
+                        "(data starvation when this rivals step time)",
+    "host_rss_peak_bytes": "process peak resident set size",
+    "device_bytes_in_use": "device allocator bytes in use (0 if the "
+                           "backend does not report memory stats)",
+}
+
+# fixed bucket edges (upper bounds; +Inf is implicit)
+_US_BUCKETS = (50.0, 100.0, 250.0, 500.0, 1e3, 2.5e3, 5e3, 1e4, 2.5e4,
+               5e4, 1e5, 2.5e5, 5e5, 1e6, 5e6)
+_BYTE_BUCKETS = (1 << 10, 16 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20,
+                 64 << 20, 256 << 20)
+
+HISTOGRAMS = {
+    "step_time_us": ("trainer/module step wall time", _US_BUCKETS),
+    "eager_dispatch_us": ("eager op dispatch latency", _US_BUCKETS),
+    "jit_compile_us": ("watched-jit trace+compile wall time", _US_BUCKETS),
+    "bucket_bytes": ("kvstore bucket payload sizes", _BYTE_BUCKETS),
+}
+
+METRIC_NAMES = frozenset(COUNTERS) | frozenset(GAUGES) \
+    | frozenset(HISTOGRAMS)
+
+
+class Counter:
+    """Monotonic counter view (the value lives in the registry dict so the
+    bump fast path stays a plain int add under the registry lock)."""
+
+    __slots__ = ("name", "help")
+
+    def __init__(self, name, help=""):
+        self.name, self.help = name, help
+
+    def inc(self, n=1):
+        bump(self.name, n)
+
+    @property
+    def value(self):
+        return counter(self.name)
+
+
+class Gauge:
+    """Last-value gauge."""
+
+    __slots__ = ("name", "help")
+
+    def __init__(self, name, help=""):
+        self.name, self.help = name, help
+
+    def set(self, value):
+        set_gauge(self.name, value)
+
+    @property
+    def value(self):
+        return gauge(self.name)
+
+
+class Histogram:
+    """Fixed-bucket histogram: cumulative-style buckets + sum + count."""
+
+    __slots__ = ("name", "help", "buckets", "counts", "total", "count")
+
+    def __init__(self, name, help="", buckets=_US_BUCKETS):
+        self.name, self.help = name, help
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)   # last = +Inf
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        with _mlock:
+            self._observe(value)
+
+    def _observe(self, value):
+        i = 0
+        for i, edge in enumerate(self.buckets):       # noqa: B007
+            if value <= edge:
+                break
+        else:
+            i = len(self.buckets)
+        self.counts[i] += 1
+        self.total += value
+        self.count += 1
+
+    def percentile(self, q):
+        """Approximate percentile from bucket boundaries (upper edge of
+        the bucket containing the q-quantile observation)."""
+        if self.count == 0:
+            return 0.0
+        rank = q / 100.0 * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank and c:
+                return self.buckets[i] if i < len(self.buckets) \
+                    else float("inf")
+        return float("inf")
+
+    def to_dict(self):
+        return {"buckets": list(self.buckets), "counts": list(self.counts),
+                "sum": self.total, "count": self.count}
+
+
+_mlock = threading.Lock()
+_counters = {}                 # name -> int
+_gauges = {}                   # name -> float
+_hists = {}                    # name -> Histogram
+
+
+def bump(name, n=1):
+    """Increment a named monotonic counter.
+
+    ALWAYS on (no gating on ``enabled()``): counters are how tests and
+    benches prove call-count claims — e.g. the fused Trainer step's
+    "one XLA program per step" contract gates on the
+    ``xla_program_calls`` delta across a step.
+    """
+    with _mlock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counter(name):
+    """Current value of one counter (0 if never bumped)."""
+    return _counters.get(name, 0)
+
+
+def counters():
+    """Snapshot of all counters."""
+    with _mlock:
+        return dict(_counters)
+
+
+def reset_counters():
+    with _mlock:
+        _counters.clear()
+
+
+def set_gauge(name, value):
+    _gauges[name] = float(value)
+
+
+def gauge(name, default=0.0):
+    return _gauges.get(name, default)
+
+
+def histogram(name):
+    """The named Histogram, creating it from the declaration table (or
+    with default µs buckets for ad-hoc names)."""
+    h = _hists.get(name)
+    if h is None:
+        with _mlock:
+            h = _hists.get(name)
+            if h is None:
+                help_, buckets = HISTOGRAMS.get(name, ("", _US_BUCKETS))
+                h = _hists[name] = Histogram(name, help_, buckets)
+    return h
+
+
+def observe(name, value):
+    histogram(name).observe(value)
+
+
+# --------------------------------------------------------------------------
+# retrace watchdog
+# --------------------------------------------------------------------------
+
+_compile_lock = threading.Lock()
+_compiles = {}                 # name -> {"count", "total_us", "last_size"}
+_compile_log = []              # [{name, wall_us, cache_size, ts}]
+_storm_warned = set()
+
+
+class _WatchedJit:
+    """Wrap a jitted callable; a call during which the jit cache grows is a
+    trace+compile and gets recorded against *name*.
+
+    The compiled-program cache key itself is jax-internal; the observable
+    is the (name, cache-size) pair — enough to see WHAT keeps recompiling
+    and how much wall time each recompile costs.  Attribute access
+    (``_cache_size``, ``lower`` ...) proxies to the wrapped callable so
+    cache-size contract tests keep working against the wrapper.
+    """
+
+    __slots__ = ("_fn", "_name", "_seen_lock", "_max_seen")
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self._name = name
+        self._seen_lock = threading.Lock()
+        self._max_seen = 0
+
+    def __call__(self, *args, **kwargs):
+        if not _ENABLED:
+            return self._fn(*args, **kwargs)
+        size_fn = getattr(self._fn, "_cache_size", None)
+        if size_fn is None:
+            return self._fn(*args, **kwargs)
+        before = size_fn()
+        t0 = now_us()
+        out = self._fn(*args, **kwargs)
+        after = size_fn()
+        if after > before:
+            # dedupe concurrent observers of one compile: only the call
+            # that advances the high-water cache size books it
+            with self._seen_lock:
+                fresh = after > self._max_seen
+                if fresh:
+                    self._max_seen = after
+            if fresh:
+                _record_compile(self._name, now_us() - t0, after)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_fn"), item)
+
+
+def watch_jit(fn, name):
+    """Register *fn* (a ``jax.jit`` product) with the retrace watchdog."""
+    return _WatchedJit(fn, name)
+
+
+def _record_compile(name, wall_us, cache_size):
+    with _compile_lock:
+        rec = _compiles.setdefault(
+            name, {"count": 0, "total_us": 0.0, "last_size": 0})
+        rec["count"] += 1
+        rec["total_us"] += wall_us
+        rec["last_size"] = cache_size
+        count = rec["count"]
+        total_ms = rec["total_us"] / 1e3
+        _compile_log.append({"name": name, "wall_us": wall_us,
+                             "cache_size": cache_size, "ts": now_us()})
+        storm = count > _RETRACE_LIMIT and name not in _storm_warned
+        if storm:
+            _storm_warned.add(name)
+    bump("jit_compiles")
+    observe("jit_compile_us", wall_us)
+    if trace_active():
+        t_end = now_us()
+        add_event("compile:%s" % name, "compile", t_end - wall_us, wall_us,
+                  args={"cache_size": cache_size, "compiles": count})
+    if storm:
+        bump("retrace_storms")
+        _LOG.warning(
+            "retrace-storm %s",
+            json.dumps({"callable": name, "compiles": count,
+                        "limit": _RETRACE_LIMIT,
+                        "total_compile_ms": round(total_ms, 3),
+                        "hint": "inputs keep changing shape/dtype/structure;"
+                                " pad or bucket them so the compiled program"
+                                " is reused"}, sort_keys=True))
+
+
+def compile_events():
+    """The raw compile log: [{name, wall_us, cache_size, ts}, ...]."""
+    with _compile_lock:
+        return [dict(e) for e in _compile_log]
+
+
+def retrace_report():
+    """Per-callable compile accounting for exporters / trace_report."""
+    with _compile_lock:
+        return {name: {"count": rec["count"],
+                       "total_ms": rec["total_us"] / 1e3,
+                       "cache_size": rec["last_size"],
+                       "storm": name in _storm_warned}
+                for name, rec in _compiles.items()}
+
+
+# --------------------------------------------------------------------------
+# memory watermarks
+# --------------------------------------------------------------------------
+
+def sample_memory():
+    """Record host/device memory watermarks into the gauges (called at
+    step-span boundaries; safe on backends without memory_stats)."""
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB, macOS bytes; normalise to bytes
+        set_gauge("host_rss_peak_bytes",
+                  rss * 1024 if os.uname().sysname == "Linux" else rss)
+    except Exception:
+        pass
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            set_gauge("device_bytes_in_use", stats.get("bytes_in_use", 0))
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------
+# exporters
+# --------------------------------------------------------------------------
+
+def _metadata_events():
+    """ph:'M' process/thread-name events so Perfetto / chrome://tracing
+    label the tracks instead of showing bare numeric tids.  A track's name
+    is its highest-priority hosted category (a train thread that also
+    dispatches eager ops reads 'train-step', an io producer 'data-io').
+    Caller holds ``_lock``."""
+    pid = os.getpid()
+    meta = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": "mxnet_tpu"}}]
+    for tid, cats in sorted(_tid_cats.items()):
+        label = next((_CAT_TRACK[c] for c in _CAT_PRIORITY if c in cats),
+                     "thread-%d" % tid)
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "args": {"name": label}})
+    return meta
+
+
+def dump_chrome_trace(filename):
+    """Write the merged trace (spans + op events + compile events) with
+    track-name metadata as Chrome trace JSON."""
+    with _lock:
+        payload = {"traceEvents": _metadata_events() + list(_events),
+                   "displayTimeUnit": "ms"}
+    with open(filename, "w") as f:
+        json.dump(payload, f)
+    return filename
+
+
+def prometheus_text():
+    """Prometheus text exposition of every live metric."""
+    lines = []
+    with _mlock:
+        counter_items = sorted(_counters.items())
+        gauge_items = sorted(_gauges.items())
+        # copy each histogram's fields under the lock: a concurrent
+        # observe() must not yield buckets disagreeing with _count/_sum
+        hists = [(h.name, h.help, h.buckets, list(h.counts),
+                  h.total, h.count) for h in _hists.values()]
+    for name, val in counter_items:
+        lines.append("# HELP %s %s" % (name, COUNTERS.get(name, name)))
+        lines.append("# TYPE %s counter" % name)
+        lines.append("%s %d" % (name, val))
+    for name, val in gauge_items:
+        lines.append("# HELP %s %s" % (name, GAUGES.get(name, name)))
+        lines.append("# TYPE %s gauge" % name)
+        lines.append("%s %.17g" % (name, val))
+    for name, help_, buckets, counts, total, count in hists:
+        lines.append("# HELP %s %s" % (name, help_ or name))
+        lines.append("# TYPE %s histogram" % name)
+        cum = 0
+        for edge, c in zip(buckets, counts):
+            cum += c
+            lines.append('%s_bucket{le="%.17g"} %d' % (name, edge, cum))
+        cum += counts[-1]
+        lines.append('%s_bucket{le="+Inf"} %d' % (name, cum))
+        lines.append("%s_sum %.17g" % (name, total))
+        lines.append("%s_count %d" % (name, count))
+    return "\n".join(lines) + "\n"
+
+
+def snapshot():
+    """JSON-serialisable snapshot of the whole telemetry state."""
+    with _mlock:
+        counters_ = dict(_counters)
+        gauges_ = dict(_gauges)
+        hists_ = {n: h.to_dict() for n, h in _hists.items()}
+    return {"enabled": _ENABLED,
+            "retrace_limit": _RETRACE_LIMIT,
+            "counters": counters_,
+            "gauges": gauges_,
+            "histograms": hists_,
+            "retraces": retrace_report()}
+
+
+def dump_snapshot(filename):
+    with open(filename, "w") as f:
+        json.dump(snapshot(), f, indent=1, sort_keys=True)
+    return filename
+
+
+def reset():
+    """Clear events, metrics, and watchdog state (tests / new session)."""
+    clear_events()
+    reset_counters()
+    with _mlock:
+        _gauges.clear()
+        _hists.clear()
+    with _compile_lock:
+        _compiles.clear()
+        _compile_log.clear()
+        _storm_warned.clear()

@@ -181,6 +181,63 @@ def test_push_pull_all_single_copy_is_identity():
     assert out is g
 
 
+def test_program_call_accounting_symmetry():
+    """ISSUE 2 satellite: push and pull book their programs the same way.
+    The reduce leg bumps once per multi-copy reduce; the broadcast leg
+    bumps once per destination copy — so a push/pull round's
+    ``xla_program_calls`` delta is deterministic, not push-only."""
+    from mxnet_tpu import profiler
+    kv = _init_kv()
+
+    # reduce leg: 4 copies -> ONE reduce program; single copy -> none
+    before = profiler.counter("xla_program_calls")
+    kv.push(3, [nd.ones(SHAPE)] * 4)
+    assert profiler.counter("xla_program_calls") - before == 1
+    before = profiler.counter("xla_program_calls")
+    kv.push(3, nd.ones(SHAPE))
+    assert profiler.counter("xla_program_calls") - before == 0
+
+    # broadcast leg: one program per destination
+    out = nd.empty(SHAPE)
+    before = profiler.counter("xla_program_calls")
+    before_pull = profiler.counter("kvstore_pull")
+    kv.pull(3, out=out)
+    assert profiler.counter("xla_program_calls") - before == 1
+    assert profiler.counter("kvstore_pull") - before_pull == 1
+
+    two = [nd.empty(SHAPE), nd.empty(SHAPE)]
+    before = profiler.counter("xla_program_calls")
+    kv.pull(3, out=two)
+    assert profiler.counter("xla_program_calls") - before == 2
+
+    # batched pull books one program per key, same as per-key pulls
+    outs = [nd.empty(SHAPE) for _ in KEYS]
+    before = profiler.counter("xla_program_calls")
+    kv.pull_all(KEYS, outs)
+    assert profiler.counter("xla_program_calls") - before == len(KEYS)
+
+
+def test_push_pull_all_outs_accounting():
+    """The fused round: one bucket-reduce program + one broadcast copy
+    per explicit out; no outs (the fused-Trainer case) adds nothing."""
+    from mxnet_tpu import profiler
+    kv = mx.kv.create("device")
+    keys = list(range(4))
+    for k in keys:
+        kv.init(k, nd.zeros(SHAPE))
+    vals = [[nd.ones(SHAPE)] * 2 for _ in keys]
+
+    before = profiler.counter("xla_program_calls")
+    kv.push_pull_all(keys, vals)
+    assert profiler.counter("xla_program_calls") - before == 1  # 1 bucket
+
+    outs = [nd.empty(SHAPE) for _ in keys]
+    before = profiler.counter("xla_program_calls")
+    kv.push_pull_all(keys, [[nd.ones(SHAPE)] * 2 for _ in keys], outs=outs)
+    # one bucket reduce + one copy per destination
+    assert profiler.counter("xla_program_calls") - before == 1 + len(keys)
+
+
 def test_push_all_runs_updater_per_key():
     kv = _init_kv()
     seen = []

@@ -19,7 +19,11 @@ def test_profiler_chrome_trace(tmp_path):
         trace = json.load(f)
     names = [e["name"] for e in trace["traceEvents"]]
     assert "dot" in names
-    assert all(e["ph"] == "X" for e in trace["traceEvents"])
+    # spans are complete events; metadata ('M') events name the tracks
+    assert all(e["ph"] in ("X", "M") for e in trace["traceEvents"])
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "thread_name"
+               and e["args"]["name"] == "eager-dispatch" for e in meta)
 
 
 def test_profiler_executor_span(tmp_path):
@@ -121,6 +125,101 @@ def test_profiler_pause_resume_keeps_events(tmp_path):
         names = [e["name"] for e in json.load(f)["traceEvents"]]
     assert "phase1" in names and "phase2" in names
     assert "hidden" not in names
+
+
+def test_nested_marker_spans(tmp_path):
+    """Nested Markers record parent/depth and nest by time containment
+    (the hierarchical-span contract, ISSUE 2)."""
+    profiler.set_config(filename=str(tmp_path / "nest.json"))
+    profiler.set_state("run")
+    with profiler.Marker("outer"):
+        with profiler.Marker("inner"):
+            pass
+    profiler.set_state("stop")
+    with open(profiler.dump_profile()) as f:
+        events = json.load(f)["traceEvents"]
+    outer = next(e for e in events if e["name"] == "outer")
+    inner = next(e for e in events if e["name"] == "inner")
+    assert inner["args"]["parent"] == "outer"
+    assert inner["args"]["depth"] == 1
+    assert outer["args"]["parent"] is None
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+
+
+def test_counter_thread_safety():
+    """Concurrent bump() must not lose increments (counters are the
+    perf-contract currency; a lost bump fakes a passing gate)."""
+    import threading
+    name = "thread_safety_probe"
+    base = profiler.counter(name)
+    n_threads, n_bumps = 8, 5000
+
+    def worker():
+        for _ in range(n_bumps):
+            profiler.bump(name)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert profiler.counter(name) - base == n_threads * n_bumps
+
+
+def test_set_state_concurrent_transitions():
+    """The set_state race fix: concurrent run/stop toggles must leave the
+    profiler in a consistent state and never double-start jax tracing
+    (jax_tracing transitions are claimed under the lock)."""
+    import threading
+    errors = []
+
+    def toggler(state):
+        try:
+            for _ in range(200):
+                profiler.set_state(state)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=toggler,
+                                args=("run" if i % 2 else "stop",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    profiler.set_state("stop")
+    assert not profiler.is_running()
+    assert profiler._state["jax_tracing"] is False
+
+
+def test_monitor_pattern_filter_eager_and_compiled_paths():
+    """Pattern filtering on the monitored (eager) batch; the off-interval
+    batch takes the compiled program and must collect nothing."""
+    x = mx.sym.var("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    y = mx.sym.Activation(y, act_type="relu", name="act")
+    ex = y.simple_bind(mx.cpu(), x=(2, 3))
+    mon = monitor.Monitor(interval=2, pattern=".*act.*")
+    mon.install(ex)
+
+    eager_calls = []
+    orig = ex._forward_monitored
+    ex._forward_monitored = lambda *a, **k: (eager_calls.append(1),
+                                             orig(*a, **k))[1]
+
+    mon.tic()
+    ex.forward(is_train=False)          # step 0: monitored eager walk
+    res0 = mon.toc()
+    assert res0 and all("act" in k for _, k, _ in res0)
+    assert all("fc" not in k.split("_")[0] for _, k, _ in res0)
+
+    mon.tic()
+    ex.forward(is_train=False)          # step 1: compiled program
+    res1 = mon.toc()
+    assert res1 == []
+    assert len(eager_calls) == 1        # only step 0 walked eagerly
 
 
 def test_monitor_interval_skips_eager_path():

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""trace_report: summarise a mxnet_tpu Chrome trace + telemetry snapshot.
+
+Reads the ``traceEvents`` JSON produced by ``profiler.dump_profile()`` /
+``telemetry.dump_chrome_trace()`` (and optionally the JSON snapshot from
+``telemetry.dump_snapshot()``) and prints the four tables that answer
+"where did the step go":
+
+  * step-time percentiles  — spans of category ``step`` (``trainer_step``,
+    ``module_train_step``)
+  * top-N ops by SELF time — per-track (tid) stack sweep over the nested
+    'X' events; self time excludes enclosed children, so a fat parent
+    span doesn't hide the child that actually burned the time
+  * kvstore bucket traffic — ``kvstore_bucket_reduce`` spans' payload
+    bytes (how much gradient actually moved per reduce program)
+  * retrace report         — watched-jit compile events (``compile:*``
+    trace events, enriched by the snapshot's per-callable accounting)
+
+Stdlib-only on purpose: the report must run anywhere the trace file can
+be copied, with no jax / framework import.
+
+Usage:
+    python tools/trace_report.py trace.json [--snapshot snap.json]
+                                 [--top 10]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path):
+    with open(path) as f:
+        payload = json.load(f)
+    # both legal Chrome formats: {"traceEvents": [...]} and a bare array
+    events = payload.get("traceEvents", []) if isinstance(payload, dict) \
+        else payload
+    return [e for e in events
+            if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile of a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(1, int(round(q / 100.0 * len(sorted_vals))))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def step_stats(events):
+    durs = sorted(e["dur"] for e in events
+                  if e.get("cat") == "step")
+    if not durs:
+        return None
+    return {"count": len(durs),
+            "p50_ms": percentile(durs, 50) / 1e3,
+            "p90_ms": percentile(durs, 90) / 1e3,
+            "p99_ms": percentile(durs, 99) / 1e3,
+            "max_ms": durs[-1] / 1e3,
+            "total_ms": sum(durs) / 1e3}
+
+
+def self_times(events):
+    """Aggregate per-name total/self wall time via a per-tid stack sweep.
+
+    Chrome 'X' events nest by time containment within one tid: sweep each
+    track in (ts, -dur) order keeping an open-span stack; every event's
+    duration is subtracted from its innermost enclosing parent.
+    """
+    agg = defaultdict(lambda: [0, 0.0, 0.0])      # name -> [calls, total, self]
+    by_tid = defaultdict(list)
+    for e in events:
+        if e.get("cat") == "compile":
+            continue                              # accounted separately
+        by_tid[e.get("tid", 0)].append(e)
+    for track in by_tid.values():
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []                                # [(end_ts, name)]
+        for e in track:
+            ts, dur, name = e["ts"], e["dur"], e["name"]
+            while stack and stack[-1][0] <= ts:
+                stack.pop()
+            rec = agg[name]
+            rec[0] += 1
+            rec[1] += dur
+            rec[2] += dur
+            if stack:
+                agg[stack[-1][1]][2] -= dur       # parent loses child's time
+            stack.append((ts + dur, name))
+    return {name: {"calls": c, "total_ms": t / 1e3, "self_ms": s / 1e3}
+            for name, (c, t, s) in agg.items()}
+
+
+def bucket_stats(events):
+    buckets = [e for e in events if e["name"] == "kvstore_bucket_reduce"]
+    sizes = [e.get("args", {}).get("bytes", 0) for e in buckets]
+    if not buckets:
+        return None
+    return {"reduces": len(buckets),
+            "total_bytes": sum(sizes),
+            "avg_bytes": sum(sizes) / len(buckets),
+            "max_bytes": max(sizes),
+            "total_ms": sum(e["dur"] for e in buckets) / 1e3}
+
+
+def retrace_stats(events, snapshot):
+    """Merge compile trace events with the snapshot's retrace accounting."""
+    out = {}
+    for e in events:
+        if e.get("cat") != "compile":
+            continue
+        name = e["name"].split(":", 1)[-1]
+        rec = out.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                    "storm": False})
+        rec["count"] += 1
+        rec["total_ms"] += e["dur"] / 1e3
+    for name, rec in (snapshot or {}).get("retraces", {}).items():
+        out[name] = {"count": rec["count"], "total_ms": rec["total_ms"],
+                     "storm": rec.get("storm", False)}
+    return out
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return "%.1f%s" % (n, unit)
+        n /= 1024.0
+    return "%.1fGiB" % n
+
+
+def render(events, snapshot, top):
+    lines = []
+
+    lines.append("== step time ==")
+    st = step_stats(events)
+    if st:
+        lines.append("steps %d  p50 %.3fms  p90 %.3fms  p99 %.3fms  "
+                     "max %.3fms  total %.3fms"
+                     % (st["count"], st["p50_ms"], st["p90_ms"],
+                        st["p99_ms"], st["max_ms"], st["total_ms"]))
+    else:
+        lines.append("(no step spans in trace)")
+
+    lines.append("")
+    lines.append("== top %d ops by self time ==" % top)
+    rows = sorted(self_times(events).items(),
+                  key=lambda kv: kv[1]["self_ms"], reverse=True)[:top]
+    if rows:
+        lines.append("%-32s %8s %12s %12s" % ("name", "calls",
+                                              "total_ms", "self_ms"))
+        for name, r in rows:
+            lines.append("%-32s %8d %12.3f %12.3f"
+                         % (name[:32], r["calls"], r["total_ms"],
+                            r["self_ms"]))
+    else:
+        lines.append("(no span events in trace)")
+
+    lines.append("")
+    lines.append("== kvstore bucket traffic ==")
+    bs = bucket_stats(events)
+    if bs:
+        lines.append("reduces %d  bytes %s  avg %s  max %s  wall %.3fms"
+                     % (bs["reduces"], _fmt_bytes(bs["total_bytes"]),
+                        _fmt_bytes(bs["avg_bytes"]),
+                        _fmt_bytes(bs["max_bytes"]), bs["total_ms"]))
+    else:
+        lines.append("(no kvstore bucket spans in trace)")
+
+    lines.append("")
+    lines.append("== retrace report ==")
+    rt = retrace_stats(events, snapshot)
+    if rt:
+        lines.append("%-32s %9s %12s %6s" % ("callable", "compiles",
+                                             "compile_ms", "storm"))
+        for name, r in sorted(rt.items(), key=lambda kv: -kv[1]["count"]):
+            lines.append("%-32s %9d %12.3f %6s"
+                         % (name[:32], r["count"], r["total_ms"],
+                            "YES" if r["storm"] else "-"))
+    else:
+        lines.append("(no compile events recorded)")
+
+    if snapshot:
+        gauges = snapshot.get("gauges", {})
+        wait = gauges.get("io_batch_wait_us")
+        if wait is not None and st and st["count"]:
+            mean_step = st["total_ms"] / st["count"]
+            lines.append("")
+            lines.append("== data pipeline ==")
+            verdict = "DATA-STARVED" if wait / 1e3 > mean_step else "ok"
+            lines.append("last batch wait %.3fms vs mean step %.3fms -> %s"
+                         % (wait / 1e3, mean_step, verdict))
+
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarise an mxnet_tpu Chrome trace "
+                    "(+ optional telemetry snapshot).")
+    ap.add_argument("trace", help="Chrome trace JSON from dump_profile()")
+    ap.add_argument("--snapshot", default=None,
+                    help="JSON from telemetry.dump_snapshot()")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the self-time table (default 10)")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.trace)
+    snapshot = None
+    if args.snapshot:
+        with open(args.snapshot) as f:
+            snapshot = json.load(f)
+    print(render(events, snapshot, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
