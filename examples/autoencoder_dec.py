@@ -114,8 +114,7 @@ def main():
     ap.add_argument("--lr", type=float, default=3e-3)
     args = ap.parse_args()
 
-    np.random.seed(42)      # Xavier draws from the global numpy RNG
-    mx.random.seed(42)
+    mx.random.seed(3)      # governs Xavier draws via random.host_rng()
     rng = np.random.RandomState(9)
     x, y = make_blobs(args.num_points, args.input_dim, args.num_clusters,
                       rng)

@@ -40,6 +40,8 @@ import weakref
 import jax
 import numpy as np
 
+from .lint import sanitizer as _san
+
 __all__ = ["wait_for_var", "wait_for_all", "push", "is_sync_dispatch",
            "set_sync_dispatch", "ThreadedEngine", "engine"]
 
@@ -247,6 +249,7 @@ class ThreadedEngine:
 
     def delete_variable(self, var):
         """GC the variable once every pending task touching it completes."""
+        _san.forget_var(self, var)
         h = self._enter_native()
         if h is not None:
             try:
@@ -263,7 +266,21 @@ class ThreadedEngine:
         other readers); ``mutable_vars`` are write-dependencies
         (serialized in push order per variable).  Exceptions raised by
         ``fn`` are captured and re-raised at the next wait point.
+
+        Under ``MXNET_SANITIZE`` every task is wrapped in a happens-before
+        checker that asserts the declared contract as it executes (writes
+        land in push order, writers exclusive, readers never overlap a
+        writer) — mis-declared deps surface as errors at the next wait
+        point instead of corrupted data.  The checker's write tickets and
+        the native enqueue happen under one push scope so concurrent
+        pushers cannot interleave ticket order against engine order.
         """
+        with _san.push_scope(self):
+            if _san.engine_checker_enabled():
+                fn = _san.guard_task(self, fn, const_vars, mutable_vars)
+            self._push_raw(fn, const_vars, mutable_vars, priority)
+
+    def _push_raw(self, fn, const_vars, mutable_vars, priority):
         if self._core is None:
             self._run_inline(fn)
             return
@@ -285,9 +302,12 @@ class ThreadedEngine:
                 h, self._trampoline, ctypes.c_void_p(key),
                 cv, len(const_vars), mv, len(mutable_vars), int(priority))
         except BaseException:
-            # never handed to the engine: the registry entry would leak
+            # never handed to the engine: the registry entry would leak,
+            # and the happens-before ticket must be rolled back or every
+            # later write to these vars reads as out-of-order
             with _TASKS_LOCK:
                 _LIVE_TASKS.pop(key, None)
+            getattr(fn, "cancel", lambda: None)()
             raise
         finally:
             self._exit_native()

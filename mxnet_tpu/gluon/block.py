@@ -18,6 +18,7 @@ the whole jitted program lands on the tape as a single node via ``jax.vjp``
 """
 from __future__ import annotations
 
+import itertools as _itertools
 import re
 import threading
 
@@ -32,6 +33,7 @@ from .. import symbol as _sym
 from ..symbol import Symbol
 from .. import autograd
 from .. import random as _random
+from .. import telemetry as _tel
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
@@ -319,6 +321,9 @@ class HybridBlock(Block):
         return self._cached_op(*args)
 
 
+_CACHED_OP_SEQ = _itertools.count()
+
+
 class _CachedOp(object):
     """jit-compiled replay of a HybridBlock (reference cached_op.cc).
 
@@ -338,6 +343,11 @@ class _CachedOp(object):
         self._grad_params = [pd[n] for n in grad_names]
         self._aux_params = [pd[n] for n in aux_names]
         self._jit = {}   # train_mode -> jitted fn
+        # watchdog identity: per-instance, so unrelated blocks (including
+        # prefix="" ones) never aggregate into a phantom retrace storm
+        self._watch_name = "gluon_cached_op:%s" % (
+            block.prefix or "%s#%d" % (type(block).__name__,
+                                       next(_CACHED_OP_SEQ)))
         self._fmt = None
         self._in_fmt = None
 
@@ -392,7 +402,8 @@ class _CachedOp(object):
                 # rematerialise forward activations in backward instead of
                 # keeping them live — jax.checkpoint is the XLA-native form
                 pure = jax.checkpoint(pure)
-            self._jit[train] = jax.jit(pure)
+            self._jit[train] = _tel.watch_jit(jax.jit(pure),
+                                              self._watch_name)
         jitted = self._jit[train]
 
         if recording:

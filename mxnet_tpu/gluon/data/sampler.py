@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ... import random as _random
+
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
 
@@ -30,7 +32,7 @@ class RandomSampler(Sampler):
         self._length = length
 
     def __iter__(self):
-        indices = np.random.permutation(self._length)
+        indices = _random.host_rng().permutation(self._length)
         return iter(indices)
 
     def __len__(self):

@@ -14,6 +14,7 @@ import numpy as np
 
 from .base import Registry, MXNetError
 from . import ndarray as nd
+from . import random as _random
 
 __all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
            "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
@@ -160,7 +161,8 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+        arr[:] = _random.host_rng().uniform(-self.scale, self.scale,
+                                            arr.shape)
 
 
 @register
@@ -172,7 +174,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        arr[:] = np.random.normal(0, self.sigma, arr.shape)
+        arr[:] = _random.host_rng().normal(0, self.sigma, arr.shape)
 
 
 @register
@@ -186,9 +188,10 @@ class Orthogonal(Initializer):
     def _init_weight(self, _, arr):
         rows = arr.shape[0]
         cols = int(np.prod(arr.shape[1:]))
-        draw = (np.random.uniform(-1.0, 1.0, (rows, cols))
+        rng = _random.host_rng()
+        draw = (rng.uniform(-1.0, 1.0, (rows, cols))
                 if self.rand_type == "uniform"
-                else np.random.normal(0.0, 1.0, (rows, cols)))
+                else rng.normal(0.0, 1.0, (rows, cols)))
         u, _s, v = np.linalg.svd(draw, full_matrices=False)
         basis = u if u.shape == draw.shape else v
         arr[:] = (self.scale * basis).reshape(arr.shape)
@@ -224,9 +227,9 @@ class Xavier(Initializer):
             raise ValueError("Incorrect factor type")
         sigma = np.sqrt(self.magnitude / factor_fn(*_conv_fans(arr.shape)))
         if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-sigma, sigma, arr.shape)
+            arr[:] = _random.host_rng().uniform(-sigma, sigma, arr.shape)
         elif self.rnd_type == "gaussian":
-            arr[:] = np.random.normal(0, sigma, arr.shape)
+            arr[:] = _random.host_rng().normal(0, sigma, arr.shape)
         else:
             raise ValueError("Unknown random type")
 

@@ -20,6 +20,7 @@ import numpy as np
 
 from .base import MXNetError
 from . import ndarray as nd
+from . import random as _random
 from . import telemetry as _tel
 from .ndarray import NDArray
 
@@ -374,7 +375,7 @@ class NDArrayIter(DataIter):
         total = self.data[0][1].shape[0]
         self.idx = np.arange(total)
         if shuffle:
-            np.random.shuffle(self.idx)
+            _random.host_rng().shuffle(self.idx)
         if last_batch_handle == "discard":
             self.idx = self.idx[:total - total % batch_size]
         self.num_data = self.idx.shape[0]
@@ -402,7 +403,7 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            _random.host_rng().shuffle(self.idx)
         if self.last_batch_handle == "roll_over" \
                 and self.cursor > self.num_data:
             overhang = (self.cursor % self.num_data) % self.batch_size

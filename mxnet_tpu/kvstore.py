@@ -58,13 +58,28 @@ def _nd_nbytes(arr):
 _DEFAULT_BUCKET_BYTES = 4 << 20      # 4 MiB, the PyTorch-DDP default scale
 
 
-def _bucket_bytes():
+def _env_bucket_bytes():
     import os
     try:
         return max(1, int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
                                          _DEFAULT_BUCKET_BYTES)))
     except ValueError:
         return _DEFAULT_BUCKET_BYTES
+
+
+# cached at import (the JG006 cached-value pattern): _plan_buckets runs on
+# every push and must not re-parse the environment per step
+_BUCKET_BYTES = _env_bucket_bytes()
+
+
+def refresh_from_env():
+    """Re-read MXNET_KVSTORE_BUCKET_BYTES (tests / late configuration)."""
+    global _BUCKET_BYTES
+    _BUCKET_BYTES = _env_bucket_bytes()
+
+
+def _bucket_bytes():
+    return _BUCKET_BYTES
 
 
 def _plan_buckets(metas, limit=None):

@@ -1,0 +1,37 @@
+"""graftlint: TPU-footgun static analysis + runtime sanitizer.
+
+Static pass (stdlib-only, safe for CI/pre-commit):
+
+    python -m mxnet_tpu.lint mxnet_tpu/            # scan vs baseline
+    python -m mxnet_tpu.lint --list-rules          # rule catalogue
+    tools/graftlint.py --check-baseline            # stale-suppression rot
+
+Runtime sanitizer (``mxnet_tpu.lint.sanitizer``): ``MXNET_SANITIZE=1``
+turns tracer leaks / host-syncs-under-trace and engine-ordering violations
+into hard errors with the offending user frame; ``=warn`` logs instead.
+
+Rules: JG001 host-sync-under-trace, JG002 naked-jit, JG003 retrace-hazard,
+JG004 donation-after-use, JG005 global-PRNG, JG006 env-read-in-hot-path.
+Docs: docs/LINT.md.
+
+The analyzer halves (``core``/``rules``) load lazily (PEP 562): the
+runtime imports ``lint.sanitizer`` on every ``import mxnet_tpu``, and that
+path must not pay for the ast/tokenize machinery it never uses.
+"""
+
+_CORE_EXPORTS = ("Baseline", "Finding", "default_baseline_path",
+                 "iter_python_files", "lint_file", "lint_paths",
+                 "lint_source", "load_baseline", "repo_root")
+
+__all__ = list(_CORE_EXPORTS) + ["RULES"]
+
+
+def __getattr__(name):
+    if name in _CORE_EXPORTS:
+        from . import core
+        return getattr(core, name)
+    if name == "RULES":
+        from .rules import RULES
+        return RULES
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

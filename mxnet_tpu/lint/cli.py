@@ -1,0 +1,139 @@
+"""graftlint CLI: ``python -m mxnet_tpu.lint`` / ``tools/graftlint.py``.
+
+Exit codes: 0 clean (against the baseline), 1 findings (or stale baseline
+entries under ``--check-baseline``), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (Baseline, default_baseline_path, iter_python_files,
+                   lint_paths, load_baseline, repo_root)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="TPU-footgun static analysis for mxnet_tpu "
+                    "(rules JG001-JG006; see docs/LINT.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to scan (default: mxnet_tpu/ "
+                        "tools/ examples/)")
+    p.add_argument("-f", "--format", choices=("text", "json"),
+                   default="text", help="output format")
+    p.add_argument("--select", default=None, metavar="JG001,JG002",
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline file (default: <repo>/LINT_BASELINE.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings as the new baseline and "
+                        "exit 0")
+    p.add_argument("--check-baseline", action="store_true",
+                   help="fail if the baseline contains entries that no "
+                        "longer fire (stale-suppression rot)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from .rules import RULES
+        for code, rule in sorted(RULES.items()):
+            print("%s  %-24s %s" % (code, rule.name, rule.rationale))
+        return 0
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",")
+                  if c.strip()}
+
+    paths = args.paths or [
+        p for p in (os.path.join(repo_root(), d)
+                    for d in ("mxnet_tpu", "tools", "examples"))
+        if os.path.isdir(p)]
+    for p in paths:
+        if not os.path.exists(p):
+            print("graftlint: no such path: %s" % p, file=sys.stderr)
+            return 2
+
+    root = repo_root()
+    files = iter_python_files(paths)
+    if not files:
+        # scanning nothing must not read as lint-passing (a mis-wired CI
+        # hook pointing at a .pyc or an emptied directory)
+        print("graftlint: no Python files under %s" % ", ".join(paths),
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(files, select=select, rel_root=root)
+
+    # the scan scope: baseline entries outside it were NOT re-checked, so
+    # they must be neither judged stale nor dropped by --write-baseline.
+    # Entries whose file no longer exists can never fire again — they are
+    # in scope (and therefore stale / rewritten away) on every run.
+    scanned = {os.path.relpath(p, root).replace(os.sep, "/")
+               for p in files}
+
+    baseline_path = args.baseline or default_baseline_path()
+
+    def scope_of(baseline):
+        return scanned | {path for (_r, path, _s) in baseline.counts
+                          if not os.path.exists(os.path.join(root, path))}
+
+    if args.write_baseline:
+        prior = load_baseline(baseline_path)
+        keep = prior.merged_outside(scope_of(prior), select)
+        merged = Baseline.from_findings(findings)
+        merged.counts.update(keep.counts)
+        merged.save(baseline_path)
+        print("graftlint: wrote %d finding(s) to %s (%d out-of-scope "
+              "entr%s preserved)"
+              % (len(findings), os.path.relpath(baseline_path), len(keep),
+                 "y" if len(keep) == 1 else "ies"))
+        return 0
+
+    full_baseline = Baseline() if args.no_baseline \
+        else load_baseline(baseline_path)
+    baseline = full_baseline.restrict(scope_of(full_baseline), select)
+    new, matched, stale = baseline.apply(findings)
+
+    if args.check_baseline:
+        if stale:
+            print("graftlint: %d stale baseline entr%s (no longer fire) — "
+                  "remove them or re-run --write-baseline:"
+                  % (len(stale), "y" if len(stale) == 1 else "ies"))
+            for (rule, path, snippet), n in sorted(stale.items()):
+                print("  %s %s (x%d): %s" % (rule, path, n, snippet))
+            return 1
+        print("graftlint: baseline is tight (%d entr%s, all still fire)"
+              % (len(baseline), "y" if len(baseline) == 1 else "ies"))
+        return 0
+
+    if args.format == "json":
+        payload = {"new": [f.to_dict() for f in new],
+                   "baselined": len(matched),
+                   "stale_baseline": [
+                       {"rule": r, "path": p, "snippet": s, "count": n}
+                       for (r, p, s), n in sorted(stale.items())]}
+        print(json.dumps(payload, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.format_text())
+        if new:
+            print("graftlint: %d new finding(s) (%d baselined)"
+                  % (len(new), len(matched)))
+        else:
+            print("graftlint: clean (%d baselined finding(s))"
+                  % len(matched))
+        if stale:
+            print("graftlint: note: %d stale baseline entr%s — run "
+                  "--check-baseline for details"
+                  % (len(stale), "y" if len(stale) == 1 else "ies"))
+    return 1 if new else 0

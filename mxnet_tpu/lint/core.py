@@ -1,0 +1,321 @@
+"""graftlint core: findings, suppressions, baseline, and the file scanner.
+
+The reference framework kept TPU/async footguns out of user code with C++
+compile-time checks and the dependency engine; this JAX rebuild has neither,
+so the same class of mistakes (tracer leaks, retrace storms, global-PRNG
+nondeterminism) only surfaces as slow or flaky runs.  graftlint moves those
+checks to review time: an AST pass over the repo with a small rule registry
+(``rules.py``), inline suppressions, and a checked-in baseline so legacy
+findings do not block CI while new code is held to zero.
+
+Design notes
+------------
+* A finding's identity is ``(rule, path, stripped source line)`` — NOT the
+  line *number*, which rots on every unrelated edit above it.  The baseline
+  stores counts per identity, so k findings with identical text on one file
+  baseline as ``count: k`` and adding a (k+1)-th fires.
+* Suppressions are source comments: ``# graftlint: disable=JG001`` (or
+  ``disable=JG001,JG005`` / ``disable=all``) on the finding's line or alone
+  on the line above it.
+* The scanner is stdlib-only (``ast`` + ``tokenize``): importing the lint
+  package must never drag jax in, because the CLI runs in CI and pre-commit
+  contexts where initializing a backend is wasted seconds.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+
+__all__ = ["Finding", "SourceModule", "lint_source", "lint_file",
+           "lint_paths", "iter_python_files", "Baseline",
+           "load_baseline", "default_baseline_path", "repo_root"]
+
+# codes are comma-separated (spaces allowed around commas only): a
+# justification written after the codes must not leak into the capture
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=((?:[A-Za-z0-9_]+(?:\s*,\s*)?)+)")
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "snippet")
+
+    def __init__(self, rule, path, line, col, message, snippet=""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.snippet = snippet
+
+    @property
+    def fingerprint(self):
+        """Baseline identity: stable across reorderings of the file."""
+        return (self.rule, self.path.replace(os.sep, "/"), self.snippet)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path.replace(os.sep, "/"),
+                "line": self.line, "col": self.col,
+                "message": self.message, "snippet": self.snippet}
+
+    def format_text(self):
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col,
+                                    self.rule, self.message)
+
+    def __repr__(self):
+        return "Finding(%s, %s:%d)" % (self.rule, self.path, self.line)
+
+
+class SourceModule:
+    """Parsed module handed to every rule: AST (with parent links), source
+    lines, and the per-line suppression table."""
+
+    def __init__(self, path, source):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._graftlint_parent = parent
+        self.suppressions = _collect_suppressions(source)
+        self._spread_over_statements()
+
+    def _spread_over_statements(self):
+        """A trailing suppression on ANY physical line of a multi-line
+        statement covers the whole statement — findings anchor to the
+        first line, the comment usually sits on the closing one."""
+        spans = []
+        for node in ast.walk(self.tree):
+            # simple statements only: a compound stmt (def/if/for...)
+            # spans its whole body and would over-suppress it
+            if isinstance(node, ast.stmt) and not hasattr(node, "body") \
+                    and getattr(node, "end_lineno", None) is not None \
+                    and node.end_lineno > node.lineno:
+                spans.append((node.lineno, node.end_lineno))
+        if not spans:
+            return
+        for line, codes in list(self.suppressions.items()):
+            for start, end in spans:
+                if start <= line <= end:
+                    for covered in range(start, end + 1):
+                        self.suppressions.setdefault(
+                            covered, set()).update(codes)
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule, node, message):
+        return Finding(rule, self.path, node.lineno, node.col_offset + 1,
+                       message, self.line_text(node.lineno))
+
+    def suppressed(self, finding):
+        codes = self.suppressions.get(finding.line)
+        if codes is None:
+            return False
+        return "all" in codes or finding.rule in codes
+
+
+def parent(node):
+    return getattr(node, "_graftlint_parent", None)
+
+
+def _collect_suppressions(source):
+    """line -> set of rule codes disabled on that line.
+
+    A standalone suppression comment applies to the NEXT CODE line —
+    skipping blank lines and further comments, so a justification comment
+    may sit on either side of the directive; a trailing comment applies
+    to its own line.
+    """
+    table = {}
+    lines = source.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            line = tok.start[0]
+            standalone = lines[line - 1].lstrip().startswith("#")
+            if standalone:
+                target = line + 1
+                while target <= len(lines):
+                    stripped = lines[target - 1].strip()
+                    if stripped and not stripped.startswith("#"):
+                        break
+                    target += 1
+                # also honor on its own line (harmless; no code there)
+                table.setdefault(line, set()).update(codes)
+            else:
+                target = line
+            table.setdefault(target, set()).update(codes)
+    except tokenize.TokenError:
+        pass
+    return table
+
+
+# ---------------------------------------------------------------------------
+# scanning
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude", "node_modules", "build",
+              "dist", ".eggs"}
+
+
+def iter_python_files(paths):
+    """Expand files/directories into a sorted list of .py files."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        out.append(os.path.join(dirpath, fname))
+    return out
+
+
+def lint_source(source, path="<string>", select=None):
+    """Run every (selected) rule over one source string."""
+    from . import rules as _rules
+    mod = SourceModule(path, source)
+    findings = []
+    for code, rule in sorted(_rules.RULES.items()):
+        if select is not None and code not in select:
+            continue
+        findings.extend(rule.check(mod))
+    findings = [f for f in findings if not mod.suppressed(f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path, select=None, rel_root=None):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, rel_root) if rel_root else path
+    try:
+        return lint_source(source, rel, select=select)
+    except SyntaxError as exc:
+        return [Finding("JG000", rel, exc.lineno or 1, 1,
+                        "file does not parse: %s" % exc.msg)]
+
+
+def lint_paths(paths, select=None, rel_root=None):
+    findings = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, select=select, rel_root=rel_root))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def repo_root():
+    """The directory holding the mxnet_tpu package (…/repo)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_baseline_path():
+    return os.path.join(repo_root(), "LINT_BASELINE.json")
+
+
+class Baseline:
+    """Checked-in legacy findings: counts per finding fingerprint.
+
+    ``apply`` splits current findings into (new, matched); whatever counts
+    remain unconsumed afterwards are STALE entries — suppressions for code
+    that no longer fires, which ``--check-baseline`` turns into an error so
+    the baseline only ever shrinks.
+    """
+
+    def __init__(self, counts=None):
+        self.counts = dict(counts or {})
+
+    @classmethod
+    def from_findings(cls, findings):
+        counts = {}
+        for f in findings:
+            counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+        return cls(counts)
+
+    def apply(self, findings):
+        remaining = dict(self.counts)
+        new, matched = [], []
+        for f in findings:
+            if remaining.get(f.fingerprint, 0) > 0:
+                remaining[f.fingerprint] -= 1
+                matched.append(f)
+            else:
+                new.append(f)
+        stale = {fp: n for fp, n in remaining.items() if n > 0}
+        return new, matched, stale
+
+    def restrict(self, paths=None, rules=None):
+        """The sub-baseline covered by a scan scope.
+
+        A partial scan (explicit file list, ``--select``) must only judge
+        baseline entries it actually re-checked — everything else would
+        read as stale (and a scoped ``--write-baseline`` would silently
+        drop it).  *paths*: set of scanned repo-relative paths; *rules*:
+        selected rule codes.  None means unrestricted.
+        """
+        kept = {}
+        for (rule, path, snippet), n in self.counts.items():
+            if paths is not None and path not in paths:
+                continue
+            if rules is not None and rule not in rules:
+                continue
+            kept[(rule, path, snippet)] = n
+        return Baseline(kept)
+
+    def merged_outside(self, paths=None, rules=None):
+        """The complement of :meth:`restrict` — entries a scoped rewrite
+        must preserve untouched."""
+        scoped = self.restrict(paths, rules).counts
+        return Baseline({fp: n for fp, n in self.counts.items()
+                         if fp not in scoped})
+
+    def to_json(self):
+        entries = [{"rule": r, "path": p, "snippet": s, "count": n}
+                   for (r, p, s), n in sorted(self.counts.items())]
+        return {"version": 1, "entries": entries}
+
+    @classmethod
+    def from_json(cls, payload):
+        counts = {}
+        for e in payload.get("entries", ()):
+            fp = (e["rule"], e["path"], e.get("snippet", ""))
+            counts[fp] = counts.get(fp, 0) + int(e.get("count", 1))
+        return cls(counts)
+
+    def save(self, path):
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def __len__(self):
+        return sum(self.counts.values())
+
+
+def load_baseline(path):
+    if not path or not os.path.exists(path):
+        return Baseline()
+    with open(path, encoding="utf-8") as f:
+        return Baseline.from_json(json.load(f))

@@ -1,0 +1,690 @@
+"""graftlint rules: the TPU/JAX footgun catalogue (JG001-JG006).
+
+Each rule is a small AST check registered in ``RULES``.  They share one
+per-module analysis (:class:`ModuleFacts`) that resolves import aliases to
+dotted names (``np.random.uniform`` -> ``numpy.random.uniform``), finds every
+``jax.jit`` call/decorator, links jitted callables back to their function
+defs, and builds a same-module call graph for hot-path propagation.
+
+The rules are deliberately heuristic — a lint pass that is right about the
+expensive mistakes and wrong occasionally is worth far more than a sound
+analysis that never ships.  False positives have two escape hatches: inline
+``# graftlint: disable=JG00x`` comments and the checked-in baseline.
+
+Rule catalogue (rationale in docs/LINT.md):
+
+JG001 host-sync-under-trace   .asnumpy()/.item()/bool()/int()/float()/
+                              np.asarray on values inside a jit-traced
+                              function: bakes constants or crashes with an
+                              opaque TracerArrayConversionError at runtime.
+JG002 naked-jit               a jax.jit entry point not wrapped in
+                              telemetry.watch_jit: invisible to the PR-2
+                              retrace watchdog, so its retrace storms burn
+                              compile time silently.
+JG003 retrace-hazard          jitted callable parameters whose defaults are
+                              Python strings/bools/dicts/lists and are not
+                              declared static: every distinct value (or any
+                              unhashable) retraces or crashes.
+JG004 donation-after-use      a buffer passed at a donated argnum is read
+                              after the call: XLA may have already reused
+                              its memory (garbage reads on TPU).
+JG005 global-PRNG             np.random.* / random.* module-state draws
+                              instead of seeded mxnet_tpu.random: seed()
+                              cannot make runs reproducible and threads
+                              race the hidden global state.
+JG006 env-read-in-hot-path    os.environ reads inside step/update/push/...
+                              call paths or loops: a getenv per step is a
+                              dict lookup + string parse on the hot path;
+                              use the module-level cached-bool pattern.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import parent
+
+__all__ = ["RULES", "Rule", "register", "ModuleFacts", "HOT_NAME_RE"]
+
+RULES = {}
+
+
+class Rule:
+    __slots__ = ("code", "name", "rationale", "_check")
+
+    def __init__(self, code, name, rationale, check):
+        self.code, self.name, self.rationale = code, name, rationale
+        self._check = check
+
+    def check(self, mod):
+        facts = _facts(mod)
+        return list(self._check(mod, facts))
+
+
+def register(code, name, rationale):
+    def deco(fn):
+        RULES[code] = Rule(code, name, rationale, fn)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# shared per-module analysis
+# ---------------------------------------------------------------------------
+
+def _facts(mod):
+    cached = getattr(mod, "_graftlint_facts", None)
+    if cached is None:
+        cached = mod._graftlint_facts = ModuleFacts(mod)
+    return cached
+
+
+class ModuleFacts:
+    """Everything the rules need, computed once per module."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        self.aliases = {}        # local name -> dotted origin
+        self._collect_imports()
+        self.calls = [n for n in ast.walk(mod.tree)
+                      if isinstance(n, ast.Call)]
+        self.funcdefs = [n for n in ast.walk(mod.tree)
+                         if isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))]
+        self.jit_calls = []      # ast.Call nodes that are jax.jit(...)
+        self.jit_decorated = []  # (funcdef, decorator node)
+        self._collect_jits()
+        self.traced_defs = self._traced_defs()
+
+    # -- imports ------------------------------------------------------------
+
+    def _collect_imports(self):
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                # relative imports get a leading "." so in-repo modules
+                # (e.g. `from . import random`) never collide with stdlib
+                base = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    origin = (base + "." + a.name) if base else a.name
+                    self.aliases[a.asname or a.name] = origin
+
+    def qualname(self, node):
+        """Dotted origin of a Name/Attribute expression, or None.
+
+        ``np.random.uniform`` -> "numpy.random.uniform" given
+        ``import numpy as np``; unknown bases resolve to their local
+        spelling so heuristic suffix checks still work.
+        """
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    # -- jit discovery ------------------------------------------------------
+
+    def _is_jit_name(self, qual):
+        return qual in ("jax.jit", "jax.api.jit") or \
+            (qual is not None and qual.endswith(".jit")
+             and qual.startswith("jax"))
+
+    def is_jit_call(self, call):
+        qual = self.qualname(call.func)
+        if self._is_jit_name(qual):
+            return True
+        # functools.partial(jax.jit, ...) used as a factory
+        if qual in ("functools.partial", "partial") and call.args:
+            return self._is_jit_name(self.qualname(call.args[0]))
+        return False
+
+    def is_watch_jit_call(self, call):
+        qual = self.qualname(call.func)
+        return qual is not None and qual.split(".")[-1] == "watch_jit"
+
+    def _collect_jits(self):
+        for call in self.calls:
+            if self.is_jit_call(call):
+                self.jit_calls.append(call)
+        for fd in self.funcdefs:
+            for dec in fd.decorator_list:
+                if isinstance(dec, ast.Call):
+                    if self.is_jit_call(dec):
+                        self.jit_decorated.append((fd, dec))
+                else:
+                    if self._is_jit_name(self.qualname(dec)):
+                        self.jit_decorated.append((fd, dec))
+
+    def jit_target_def(self, call):
+        """The FunctionDef/Lambda a jax.jit call traces, if resolvable.
+
+        Name lookup is scope-aware: ``jax.jit(step)`` inside a builder
+        resolves to the ``step`` nested in that builder, not to a
+        same-named method elsewhere in the module.
+        """
+        args = call.args
+        if self.qualname(call.func) in ("functools.partial", "partial"):
+            args = args[1:]
+        if not args:
+            return None
+        target = args[0]
+        if isinstance(target, ast.Lambda):
+            return target
+        if not isinstance(target, ast.Name):
+            return None
+        candidates = [fd for fd in self.funcdefs if fd.name == target.id]
+        if not candidates:
+            return None
+        encl = self.enclosing_function(call)
+        for fd in candidates:       # same enclosing function wins
+            p = parent(fd)
+            while p is not None:
+                if p is encl:
+                    return fd
+                if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    break
+                p = parent(p)
+        for fd in candidates:       # else a module/class-level def
+            if self.enclosing_function(fd) is None:
+                return fd
+        return candidates[0]
+
+    def _traced_defs(self):
+        """Function defs whose bodies execute under a jax trace: jitted
+        defs, jit-decorated defs, and defs lexically nested inside one."""
+        traced = set()
+        for call in self.jit_calls:
+            fd = self.jit_target_def(call)
+            if fd is not None:
+                traced.add(fd)
+        for fd, _dec in self.jit_decorated:
+            traced.add(fd)
+        # nested defs trace with their parent
+        grew = True
+        while grew:
+            grew = False
+            for fd in self.funcdefs:
+                if fd in traced:
+                    continue
+                p = parent(fd)
+                while p is not None:
+                    if p in traced:
+                        traced.add(fd)
+                        grew = True
+                        break
+                    p = parent(p)
+        return traced
+
+    def enclosing_function(self, node):
+        p = parent(node)
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return p
+            p = parent(p)
+        return None
+
+    def enclosing_statement(self, node):
+        stmt = node
+        p = parent(stmt)
+        while p is not None and not isinstance(stmt, ast.stmt):
+            stmt = p
+            p = parent(stmt)
+        return stmt if isinstance(stmt, ast.stmt) else None
+
+
+def _static_argspec(call):
+    """(static_argnums set, static_argnames set) from a jit call's literal
+    keywords; non-literal specs resolve to None (= unknown, don't flag)."""
+    nums, names = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = _literal_ints(kw.value)
+            if vals is None:
+                return None, None
+            nums.update(vals)
+        elif kw.arg == "static_argnames":
+            vals = _literal_strs(kw.value)
+            if vals is None:
+                return None, None
+            names.update(vals)
+    return nums, names
+
+
+def _literal_ints(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _literal_strs(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+# ---------------------------------------------------------------------------
+# JG001 host-sync-under-trace
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_METHODS = {"asnumpy", "asscalar", "item", "tolist",
+                      "block_until_ready", "wait_to_read"}
+_HOST_SYNC_BUILTINS = {"bool", "int", "float"}
+_SHAPEY_RE = re.compile(r"\.(shape|ndim|size|dtype)\b|len\(")
+
+
+def _walk_own_body(fd):
+    """Walk a function's nodes WITHOUT descending into nested defs (those
+    are traced defs in their own right and are visited separately)."""
+    stack = list(fd.body) if not isinstance(fd, ast.Lambda) else [fd.body]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue          # nested def: its body is its own traced walk
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register("JG001", "host-sync-under-trace",
+          "host materialization inside a jit trace bakes constants into "
+          "the compiled program or crashes with a tracer error")
+def _jg001(mod, facts):
+    for fd in facts.traced_defs:
+        for node in _walk_own_body(fd):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = _jg001_call(mod, facts, node)
+            if msg:
+                name = getattr(fd, "name", "<lambda>")
+                yield mod.finding("JG001", node, msg % name)
+
+
+def _jg001_call(mod, facts, call):
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _HOST_SYNC_METHODS and not call.args \
+                and not call.keywords:
+            return ("'.%s()' inside jit-traced function '%%s' forces a "
+                    "host sync (or leaks a tracer)" % func.attr)
+        qual = facts.qualname(func)
+        if qual in ("numpy.asarray", "numpy.array") and call.args:
+            arg = call.args[0]
+            if isinstance(arg, (ast.Name, ast.Attribute, ast.Call)):
+                return ("'np.%s(...)' on a traced value inside '%%s' "
+                        "materializes to host" % func.attr)
+    elif isinstance(func, ast.Name) and func.id in _HOST_SYNC_BUILTINS \
+            and func.id not in facts.aliases and len(call.args) == 1:
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant):
+            return None
+        src = ast.get_source_segment(mod.source, arg) or ""
+        if _SHAPEY_RE.search(src):
+            return None           # int(x.shape[0]) etc. is static under jit
+        if isinstance(arg, (ast.Name, ast.Attribute, ast.Call,
+                            ast.Subscript)):
+            return ("'%s(...)' on a traced value inside '%%s' forces a "
+                    "concrete host value" % func.id)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# JG002 naked-jit
+# ---------------------------------------------------------------------------
+
+@register("JG002", "naked-jit",
+          "a jit entry point the retrace watchdog cannot see: wrap it in "
+          "telemetry.watch_jit(jax.jit(fn), name)")
+def _jg002(mod, facts):
+    for call in facts.jit_calls:
+        p = parent(call)
+        if isinstance(p, ast.Call) and facts.is_watch_jit_call(p) \
+                and p.args and p.args[0] is call:
+            continue
+        yield mod.finding(
+            "JG002", call,
+            "naked jax.jit: wrap in telemetry.watch_jit(jax.jit(...), "
+            "'<name>') so retrace storms are booked")
+    for fd, dec in facts.jit_decorated:
+        yield mod.finding(
+            "JG002", dec,
+            "@jax.jit on '%s' bypasses the retrace watchdog: build with "
+            "telemetry.watch_jit(jax.jit(%s), '%s') instead"
+            % (fd.name, fd.name, fd.name))
+
+
+# ---------------------------------------------------------------------------
+# JG003 retrace-hazard
+# ---------------------------------------------------------------------------
+
+_HAZARD_TYPES = {str: "str", bool: "bool"}
+
+
+@register("JG003", "retrace-hazard",
+          "non-array parameters of a jitted callable retrace per distinct "
+          "value (str/bool) or crash as unhashable (dict/list) unless "
+          "declared static")
+def _jg003(mod, facts):
+    for call in facts.jit_calls:
+        fd = facts.jit_target_def(call)
+        if fd is None or isinstance(fd, ast.Lambda):
+            continue
+        nums, names = _static_argspec(call)
+        if nums is None:
+            continue              # non-literal static spec: trust the author
+        args = fd.args
+        params = list(args.posonlyargs) + list(args.args)
+        defaults = list(args.defaults)
+        # defaults right-align to positional params; kw-only params carry
+        # a parallel (possibly None-holed) kw_defaults list
+        dstart = len(params) - len(defaults)
+        hazards = []
+        for i, p in enumerate(params):
+            if i in nums or i < dstart:
+                continue
+            hazards.append((p, defaults[i - dstart]))
+        for p, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None:
+                hazards.append((p, default))
+        for p, default in hazards:
+            if p.arg in names or p.arg in ("self", "cls"):
+                continue
+            hazard = _default_hazard(default)
+            if hazard:
+                yield mod.finding(
+                    "JG003", default,
+                    "parameter '%s' of jitted '%s' defaults to a %s; each "
+                    "distinct value retraces (or is unhashable) — declare "
+                    "it in static_argnames or pass it traced"
+                    % (p.arg, fd.name, hazard))
+
+
+def _default_hazard(node):
+    if isinstance(node, ast.Constant) and type(node.value) in _HAZARD_TYPES:
+        return _HAZARD_TYPES[type(node.value)]
+    if isinstance(node, ast.Dict):
+        return "dict"
+    if isinstance(node, (ast.List, ast.Set)):
+        return "list/set"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# JG004 donation-after-use
+# ---------------------------------------------------------------------------
+
+@register("JG004", "donation-after-use",
+          "a donated input buffer is read after the call; XLA may already "
+          "have reused its memory")
+def _jg004(mod, facts):
+    donated = _donated_callables(facts)
+    if not donated:
+        return
+    for call in facts.calls:
+        key = _callee_key(call.func)
+        if key is None or key not in donated:
+            continue
+        argnums = donated[key]
+        for i in sorted(argnums):
+            if i >= len(call.args):
+                continue
+            arg = call.args[i]
+            if not isinstance(arg, ast.Name):
+                continue
+            use = _read_after(mod, facts, call, arg.id)
+            if use is not None:
+                yield mod.finding(
+                    "JG004", use,
+                    "'%s' was donated at argnum %d of '%s' on line %d and "
+                    "is read afterwards; its buffer may be reused by XLA "
+                    "— rebind it from the call's result or drop the "
+                    "donation" % (arg.id, i, key, call.lineno))
+
+
+def _rebinds_param(fd, name):
+    args = fd.args
+    names = [a.arg for a in (list(args.posonlyargs) + list(args.args)
+                             + list(args.kwonlyargs))]
+    for special in (args.vararg, args.kwarg):
+        if special is not None:
+            names.append(special.arg)
+    return name in names
+
+
+def _walk_skip_rebinding_defs(scope, name):
+    """Walk *scope* but skip nested defs whose parameter list rebinds
+    *name* — their 'name' is a fresh binding, not the donated buffer.
+    Closures that capture *name* ARE walked (a plausible real use)."""
+    stack = [scope]
+    while stack:
+        node = stack.pop()
+        if node is not scope and \
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and _rebinds_param(node, name):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _donated_callables(facts):
+    """name -> donated argnums, for `x = [watch_jit(]jax.jit(f,
+    donate_argnums=...)[)]` assignments (plain and self-attribute)."""
+    out = {}
+    for call in facts.jit_calls:
+        nums = None
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                nums = _literal_ints(kw.value)
+        if not nums:
+            continue
+        # climb through a watch_jit wrapper to the assignment
+        node = call
+        p = parent(node)
+        if isinstance(p, ast.Call) and facts.is_watch_jit_call(p):
+            node, p = p, parent(p)
+        if isinstance(p, ast.Assign):
+            for tgt in p.targets:
+                key = _callee_key(tgt)
+                if key:
+                    out[key] = nums
+    return out
+
+
+def _callee_key(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr          # self._step_fn and obj._step_fn unify
+    return None
+
+
+def _read_after(mod, facts, call, name):
+    """First Load of *name* after *call* in its enclosing scope, unless a
+    Store rebinds it first.  Stores that are targets of the statement
+    containing the call (``x = fn(x)``) count as immediately-after."""
+    scope = facts.enclosing_function(call) or mod.tree
+    call_stmt = facts.enclosing_statement(call)
+    if isinstance(call_stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = call_stmt.targets if isinstance(call_stmt, ast.Assign) \
+            else [call_stmt.target]
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return None   # rebound from the result: the idiom
+    end = (call.end_lineno, call.end_col_offset)
+    events = []
+    for node in _walk_skip_rebinding_defs(scope, name):
+        if isinstance(node, ast.Name) and node.id == name:
+            pos = (node.lineno, node.col_offset)
+            if pos > end:
+                events.append((pos, node))
+    for _pos, node in sorted(events, key=lambda e: e[0]):
+        if isinstance(node.ctx, ast.Store):
+            return None
+        if isinstance(node.ctx, ast.Load):
+            return node
+    return None
+
+
+# ---------------------------------------------------------------------------
+# JG005 global-PRNG
+# ---------------------------------------------------------------------------
+
+_NP_RANDOM_OK = {"RandomState", "default_rng", "Generator", "SeedSequence",
+                 "BitGenerator", "PCG64", "Philox", "MT19937", "get_state",
+                 "set_state"}
+_STDLIB_RANDOM_STATEFUL = {
+    "seed", "random", "randint", "randrange", "shuffle", "choice",
+    "choices", "sample", "uniform", "normalvariate", "gauss",
+    "betavariate", "expovariate", "triangular", "getrandbits",
+    "lognormvariate", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "sample"}
+
+
+@register("JG005", "global-PRNG",
+          "module-state RNG draws are invisible to mxnet_tpu.random.seed "
+          "and race across threads; use random.host_rng() / next_key()")
+def _jg005(mod, facts):
+    for call in facts.calls:
+        qual = facts.qualname(call.func)
+        if qual is None:
+            continue
+        if qual.startswith("numpy.random."):
+            attr = qual.rsplit(".", 1)[-1]
+            if attr not in _NP_RANDOM_OK:
+                yield mod.finding(
+                    "JG005", call,
+                    "np.random.%s uses hidden module state; draw from "
+                    "mxnet_tpu.random.host_rng() (numpy host draws) or "
+                    "next_key() (traced) so mx.random.seed governs it"
+                    % attr)
+        elif qual.startswith("random.") and qual.count(".") == 1:
+            attr = qual.rsplit(".", 1)[-1]
+            if attr in _STDLIB_RANDOM_STATEFUL:
+                yield mod.finding(
+                    "JG005", call,
+                    "stdlib random.%s uses hidden module state; use "
+                    "mxnet_tpu.random.host_rng() so mx.random.seed "
+                    "governs it" % attr)
+
+
+# ---------------------------------------------------------------------------
+# JG006 env-read-in-hot-path
+# ---------------------------------------------------------------------------
+
+HOT_NAME_RE = re.compile(
+    r"(^|_)(step|update|forward|backward|push|pull|invoke|reduce|next|"
+    r"sample|dispatch|train|fit)(_|$)|^__call__$|^__next__$|^__iter__$")
+
+_CACHED_DECORATORS = {"lru_cache", "cache", "cached_property", "functools"}
+
+
+@register("JG006", "env-read-in-hot-path",
+          "os.environ reads on step/update/push paths re-parse strings "
+          "every iteration; hoist into a module-level cached value with an "
+          "explicit refresh hook (the cached-bool pattern)")
+def _jg006(mod, facts):
+    hot = _hot_functions(facts)
+    for node in ast.walk(mod.tree):
+        env = _env_read(facts, node)
+        if env is None:
+            continue
+        fd = facts.enclosing_function(node)
+        in_hot = fd is not None and fd in hot and not _is_cached(fd)
+        in_loop = _inside_loop(node)
+        if not (in_hot or in_loop):
+            continue
+        where = ("hot-path function '%s'" % fd.name) if in_hot \
+            else "a loop body"
+        yield mod.finding(
+            "JG006", node,
+            "%s read inside %s; cache it at module level (cached-bool "
+            "pattern) and re-read only via an explicit refresh"
+            % (env, where))
+
+
+def _env_read(facts, node):
+    if isinstance(node, ast.Call):
+        qual = facts.qualname(node.func)
+        if qual in ("os.environ.get", "os.getenv"):
+            return qual
+    if isinstance(node, ast.Subscript):
+        qual = facts.qualname(node.value)
+        if qual == "os.environ":
+            return "os.environ[...]"
+    return None
+
+
+def _is_cached(fd):
+    if isinstance(fd, ast.Lambda):
+        return False
+    for dec in fd.decorator_list:
+        names = {n.attr if isinstance(n, ast.Attribute)
+                 else getattr(n, "id", None)
+                 for n in ast.walk(dec)}
+        if names & _CACHED_DECORATORS:
+            return True
+    return False
+
+
+def _inside_loop(node):
+    p = parent(node)
+    while p is not None:
+        if isinstance(p, (ast.For, ast.While, ast.AsyncFor)):
+            return True
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False          # a def inside a loop runs later, cold
+        p = parent(p)
+    return False
+
+
+def _hot_functions(facts):
+    """Hot seed = hot-looking name; propagate hotness down the same-module
+    call graph (a helper called from step() is on the step path)."""
+    by_name = {}
+    for fd in facts.funcdefs:
+        by_name.setdefault(fd.name, []).append(fd)
+    calls_from = {}
+    for fd in facts.funcdefs:
+        callees = set()
+        for node in ast.walk(fd):
+            if isinstance(node, ast.Call):
+                key = _callee_key(node.func)
+                if key and key in by_name:
+                    callees.add(key)
+        calls_from[fd] = callees
+    hot = {fd for fd in facts.funcdefs if HOT_NAME_RE.search(fd.name)}
+    grew = True
+    while grew:
+        grew = False
+        for fd in list(hot):
+            for callee in calls_from.get(fd, ()):
+                for target in by_name.get(callee, ()):
+                    if target not in hot:
+                        hot.add(target)
+                        grew = True
+    return hot

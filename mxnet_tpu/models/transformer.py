@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _tel
 from ..parallel.ring_attention import ring_attention
 
 __all__ = ["TransformerLMConfig", "init_transformer_params",
@@ -210,7 +211,8 @@ def make_train_step(cfg, mesh, lr=0.1, seq_axis="seq"):
             lambda p, g: p - lr * g.astype(p.dtype), params, grads)
         return new_params, loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    return _tel.watch_jit(jax.jit(step, donate_argnums=(0,)),
+                          "transformer_train_step")
 
 
 def make_train_step_zero1(cfg, mesh, params, lr=0.1, momentum=0.9,
@@ -261,7 +263,8 @@ def make_train_step_zero1(cfg, mesh, params, lr=0.1, momentum=0.9,
                                        is_leaf=lambda x: isinstance(x, tuple))
         return new_p, new_m, loss
 
-    return jax.jit(step, donate_argnums=(0, 1)), momenta
+    return _tel.watch_jit(jax.jit(step, donate_argnums=(0, 1)),
+                          "transformer_train_step_zero1"), momenta
 
 
 def place_batch(tokens, labels, mesh, seq_axis="seq"):

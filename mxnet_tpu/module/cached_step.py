@@ -41,6 +41,9 @@ __all__ = ["CachedTrainStep", "fused_step_enabled"]
 
 
 def fused_step_enabled():
+    # deliberate re-read: called once per Module.fit bind (not per step),
+    # and tests toggle MXNET_MODULE_FUSED_STEP at runtime
+    # graftlint: disable=JG006
     return os.environ.get("MXNET_MODULE_FUSED_STEP", "1").strip().lower() \
         not in ("0", "false", "off", "no")
 

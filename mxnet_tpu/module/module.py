@@ -339,6 +339,9 @@ class Module(BaseModule):
             # bound the cache: each entry pins compiled programs AND a
             # device-resident parameter copy — many distinct shapes
             # (e.g. free-form inference batches) must not accumulate
+            # deliberate re-read: reshape is a rebind (rare), and tests
+            # monkeypatch the limit at runtime
+            # graftlint: disable=JG006
             limit = int(os.environ.get("MXNET_MODULE_RESHAPE_CACHE", "8"))
             while len(cache) >= max(limit, 1):
                 evicted_key = next(iter(cache))

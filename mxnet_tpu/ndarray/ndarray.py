@@ -28,6 +28,7 @@ from .. import autograd as ag
 from .. import profiler as _prof
 from .. import random as _random
 from .. import telemetry as _tel
+from ..lint import sanitizer as _san
 from ..ops.registry import get_op, Op
 
 __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
@@ -102,6 +103,10 @@ class NDArray:
     wait_to_write = wait_to_read
 
     def asnumpy(self):
+        # every host materialization funnels through here (__array__,
+        # asscalar/item, __bool__/__int__/__float__) — the one choke point
+        # where MXNET_SANITIZE can catch tracer leaks / syncs-under-trace
+        _san.check_host_sync(self._data)
         return np.asarray(self._data)
 
     def __array__(self, dtype=None):

@@ -28,6 +28,8 @@ _initialized = False
 
 def _env(*names, default=None):
     for n in names:
+        # one-shot rendezvous read at init, not a hot path
+        # graftlint: disable=JG006
         v = os.environ.get(n)
         if v not in (None, ""):
             return v

@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import autograd
 from .. import random as _random
+from .. import telemetry as _tel
 from ..ndarray.ndarray import NDArray, _wrap
 from .mesh import auto_mesh
 
@@ -272,7 +273,8 @@ class ShardedTrainer:
                 params, grads, states, lrs, wds, ts)
             return new_params, new_states, new_aux, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return _tel.watch_jit(jax.jit(step, donate_argnums=(0, 1, 2)),
+                              "sharded_train_step")
 
     def step(self, data, label):
         """Run one sharded train step; returns the scalar loss (host float).
@@ -308,7 +310,7 @@ class ShardedTrainer:
             def fwd(params, aux, data, key):
                 outs, _ = fn(params, aux, (data,), key, False)
                 return outs[0] if len(outs) == 1 else outs
-            self._fwd_fn = jax.jit(fwd)
+            self._fwd_fn = _tel.watch_jit(jax.jit(fwd), "sharded_forward")
         data = sharded_data(data, self._mesh, axis=self._batch_axis)
         out = self._fwd_fn(self.params, self.aux, data, _random.next_key())
         return _wrap(out)

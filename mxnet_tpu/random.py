@@ -11,21 +11,38 @@ from __future__ import annotations
 import threading
 
 import jax
+import numpy as np
 
-__all__ = ["seed", "next_key", "current_seed", "key_scope"]
+__all__ = ["seed", "next_key", "current_seed", "key_scope", "host_rng"]
 
 _lock = threading.Lock()
 _seed = 0
 _key = None  # lazily created: backend init must not run at import time
+_host_rng = None  # np.random.Generator once seeded (host-side draws)
 _scope = threading.local()  # per-thread key override stack (jit tracing)
 
 
 def seed(seed_state, ctx="all"):
     """Seed the global RNG (reference: mx.random.seed)."""
-    global _key, _seed
+    global _key, _seed, _host_rng
     with _lock:
         _seed = int(seed_state)
         _key = jax.random.PRNGKey(_seed)
+        _host_rng = np.random.default_rng(_seed)
+
+
+def host_rng():
+    """The numpy RNG for host-side draws (initializers, shuffles).
+
+    After ``mx.random.seed(n)`` this is a dedicated
+    ``np.random.default_rng(n)`` Generator, so host randomness is governed
+    by the framework seed instead of numpy's hidden module state (and
+    never races third-party ``np.random`` users).  Before any ``seed()``
+    call it falls back to the legacy ``np.random`` module so unseeded
+    behavior is unchanged.  Both expose the same draw API surface used
+    here (``uniform``/``normal``/``shuffle``/``permutation``).
+    """
+    return _host_rng if _host_rng is not None else np.random
 
 
 def next_key():

@@ -10,10 +10,10 @@ executors).
 from __future__ import annotations
 
 import bisect
-import random as _rng
 
 import numpy as np
 
+from .. import random as _random
 from ..io import DataBatch, DataDesc, DataIter
 
 __all__ = ["BucketSentenceIter", "encode_sentences"]
@@ -114,13 +114,17 @@ class BucketSentenceIter(DataIter):
         return (seq_len, self.batch_size)
 
     def reset(self):
+        # both shuffles draw from the framework RNG so mx.random.seed
+        # makes epoch order reproducible (JG005)
+        rng = _random.host_rng()
         self._plan = []
         for b, rows in enumerate(self.data):
-            np.random.shuffle(rows)         # row order within bucket
+            rng.shuffle(rows)               # row order within bucket
             for start in range(0, len(rows) - self.batch_size + 1,
                                self.batch_size):
                 self._plan.append((b, start))
-        _rng.shuffle(self._plan)
+        order = rng.permutation(len(self._plan))
+        self._plan = [self._plan[i] for i in order]
         self._cursor = 0
 
     def next(self):

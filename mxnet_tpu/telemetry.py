@@ -283,6 +283,9 @@ COUNTERS = {
     "jit_compiles": "watched-jit cache misses (traces+compiles)",
     "retrace_storms": "watched callables that crossed the retrace limit",
     "trace_events_dropped": "spans evicted from the bounded trace ring",
+    "sanitizer_violations": "footguns caught at runtime by MXNET_SANITIZE "
+                            "(tracer leaks, syncs-under-trace, engine "
+                            "ordering)",
 }
 
 GAUGES = {

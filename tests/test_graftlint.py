@@ -1,0 +1,591 @@
+"""graftlint: per-rule fixtures, suppressions, baseline, runtime sanitizer.
+
+Every JG rule gets a firing (positive) and a non-firing (negative) fixture
+snippet run through ``lint_source``; the sanitizer tests assert a planted
+tracer leak raises under MXNET_SANITIZE=1 and is silent otherwise — the
+same footgun the static JG001 fixture catches at review time (ISSUE 3
+acceptance).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.lint import (Baseline, RULES, lint_source, load_baseline,
+                            repo_root)
+from mxnet_tpu.lint import sanitizer
+
+REPO = repo_root()
+
+
+def codes(src, select=None):
+    findings = lint_source(textwrap.dedent(src), path="fixture.py",
+                          select=select)
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# JG001 host-sync-under-trace
+# ---------------------------------------------------------------------------
+
+def test_jg001_fires_on_host_sync_in_jitted_fn():
+    src = """
+    import jax
+
+    def step(x, arr):
+        lr = float(arr.mean())        # host sync while tracing
+        return x * lr
+
+    step_jit = jax.jit(step)
+    """
+    assert "JG001" in codes(src, {"JG001"})
+
+
+def test_jg001_fires_on_asnumpy_and_item():
+    src = """
+    import jax
+
+    @jax.jit
+    def fwd(x):
+        host = x.asnumpy()
+        s = x.item()
+        return host, s
+    """
+    assert codes(src, {"JG001"}).count("JG001") == 2
+
+
+def test_jg001_fires_in_nested_def():
+    src = """
+    import jax
+
+    def build():
+        def step(x):
+            def inner(y):
+                return y.asnumpy()
+            return inner(x)
+        return jax.jit(step)
+    """
+    assert "JG001" in codes(src, {"JG001"})
+
+
+def test_jg001_silent_outside_trace_and_on_shapes():
+    src = """
+    import jax
+
+    def step(x):
+        n = int(x.shape[0])          # static under jit: fine
+        return x * n
+
+    step_jit = jax.jit(step)
+
+    def eager(arr):
+        return arr.asnumpy()          # not jitted: fine
+    """
+    assert codes(src, {"JG001"}) == []
+
+
+# ---------------------------------------------------------------------------
+# JG002 naked-jit
+# ---------------------------------------------------------------------------
+
+def test_jg002_fires_on_naked_jit_call_and_decorator():
+    src = """
+    import jax
+
+    def f(x):
+        return x + 1
+
+    g = jax.jit(f)
+
+    @jax.jit
+    def h(x):
+        return x * 2
+    """
+    assert codes(src, {"JG002"}).count("JG002") == 2
+
+
+def test_jg002_silent_when_watched():
+    src = """
+    import jax
+    from mxnet_tpu import telemetry as _tel
+
+    def f(x):
+        return x + 1
+
+    g = _tel.watch_jit(jax.jit(f), "f_step")
+    """
+    assert codes(src, {"JG002"}) == []
+
+
+# ---------------------------------------------------------------------------
+# JG003 retrace-hazard
+# ---------------------------------------------------------------------------
+
+def test_jg003_fires_on_str_default_not_static():
+    src = """
+    import jax
+
+    def step(x, mode="train", cfg={}):
+        return x
+
+    step_jit = jax.jit(step)
+    """
+    assert codes(src, {"JG003"}).count("JG003") == 2
+
+
+def test_jg003_fires_on_kwonly_default():
+    src = """
+    import jax
+
+    def step(x, *, mode="train"):
+        return x
+
+    step_jit = jax.jit(step)
+    safe_jit = jax.jit(step, static_argnames=("mode",))
+    """
+    assert codes(src, {"JG003"}).count("JG003") == 1
+
+
+def test_jg003_silent_when_declared_static():
+    src = """
+    import jax
+
+    def step(x, mode="train"):
+        return x
+
+    step_jit = jax.jit(step, static_argnames=("mode",))
+    other = jax.jit(lambda x: x)
+    """
+    assert codes(src, {"JG003"}) == []
+
+
+# ---------------------------------------------------------------------------
+# JG004 donation-after-use
+# ---------------------------------------------------------------------------
+
+def test_jg004_fires_on_read_after_donation():
+    src = """
+    import jax
+
+    def step(p, g):
+        return p - g
+
+    step_jit = jax.jit(step, donate_argnums=(0,))
+
+    def train(params, grads):
+        out = step_jit(params, grads)
+        return params.sum() + out     # params was donated!
+    """
+    assert "JG004" in codes(src, {"JG004"})
+
+
+def test_jg004_silent_on_nested_def_rebinding_name():
+    src = """
+    import jax
+
+    def step(p, g):
+        return p - g
+
+    step_jit = jax.jit(step, donate_argnums=(0,))
+
+    def train(params, grads):
+        out = step_jit(params, grads)
+        def helper(params):          # fresh binding, not the donated buf
+            return params * 2
+        return helper(out)
+    """
+    assert codes(src, {"JG004"}) == []
+
+
+def test_jg004_silent_on_rebind_idiom():
+    src = """
+    import jax
+
+    def step(p, g):
+        return p - g
+
+    step_jit = jax.jit(step, donate_argnums=(0,))
+
+    def train(params, grads):
+        params = step_jit(params, grads)   # rebound from result: fine
+        return params.sum()
+    """
+    assert codes(src, {"JG004"}) == []
+
+
+# ---------------------------------------------------------------------------
+# JG005 global-PRNG
+# ---------------------------------------------------------------------------
+
+def test_jg005_fires_on_module_state_rng():
+    src = """
+    import random
+    import numpy as np
+
+    def draw(shape):
+        a = np.random.uniform(-1, 1, shape)
+        random.shuffle(a)
+        return a
+    """
+    assert codes(src, {"JG005"}).count("JG005") == 2
+
+
+def test_jg005_silent_on_generators_and_framework_rng():
+    src = """
+    import numpy as np
+    from mxnet_tpu import random as _random
+
+    def draw(shape, seed):
+        rng = np.random.default_rng(seed)
+        st = np.random.RandomState(seed)
+        host = _random.host_rng().uniform(-1, 1, shape)
+        return rng.uniform(-1, 1, shape), st.rand(4), host
+    """
+    assert codes(src, {"JG005"}) == []
+
+
+# ---------------------------------------------------------------------------
+# JG006 env-read-in-hot-path
+# ---------------------------------------------------------------------------
+
+def test_jg006_fires_in_hot_function_and_loop():
+    src = """
+    import os
+
+    def _limit():
+        return int(os.environ.get("X_LIMIT", "8"))
+
+    def step(xs):
+        for x in xs:
+            flag = os.environ.get("X_FLAG")       # in a loop
+        return _limit()                           # helper on the step path
+    """
+    assert codes(src, {"JG006"}).count("JG006") == 2
+
+
+def test_jg006_silent_for_module_level_cached_bool():
+    src = """
+    import os
+
+    def _env_enabled():
+        return os.environ.get("X_TELEMETRY", "0") == "1"
+
+    _ENABLED = _env_enabled()
+
+    def step(x):
+        if _ENABLED:
+            return x * 2
+        return x
+    """
+    assert codes(src, {"JG006"}) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions / baseline / CLI
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_same_line_and_line_above():
+    src = """
+    import numpy as np
+
+    def draw(shape):
+        a = np.random.uniform(0, 1, shape)  # graftlint: disable=JG005
+        # graftlint: disable=JG005
+        b = np.random.normal(0, 1, shape)
+        c = np.random.rand(4)               # graftlint: disable=JG001
+        return a, b, c
+    """
+    found = codes(src, {"JG005"})
+    assert found == ["JG005"]          # only the un-suppressed c-line
+
+
+def test_suppression_skips_interleaved_comment_and_blank_lines():
+    src = """
+    import numpy as np
+
+    def draw(shape):
+        # graftlint: disable=JG005
+        # justification may also come AFTER the directive
+
+        a = np.random.uniform(0, 1, shape)
+        return a
+    """
+    assert codes(src, {"JG005"}) == []
+
+
+def test_suppression_on_wrapped_statement_and_with_justification():
+    src = """
+    import numpy as np
+
+    def draw(shape):
+        a = np.random.uniform(
+            -1, 1, shape)  # graftlint: disable=JG005
+        b = np.random.rand(4)  # graftlint: disable=JG005 legacy draw
+        return a, b
+    """
+    assert codes(src, {"JG005"}) == []
+
+
+def test_suppression_disable_all():
+    src = """
+    import numpy as np
+    a = np.random.rand(4)  # graftlint: disable=all
+    """
+    assert codes(src) == []
+
+
+def test_baseline_round_trip(tmp_path):
+    src = textwrap.dedent("""
+    import numpy as np
+    a = np.random.rand(4)
+    b = np.random.rand(4)
+    """)
+    findings = lint_source(src, path="mod.py")
+    assert len(findings) == 2
+    bl = Baseline.from_findings(findings)
+    path = tmp_path / "bl.json"
+    bl.save(str(path))
+    loaded = load_baseline(str(path))
+    new, matched, stale = loaded.apply(findings)
+    assert new == [] and len(matched) == 2 and stale == {}
+    # a third identical draw exceeds the baselined count and fires
+    findings3 = lint_source(src + "c = np.random.rand(4)\n", path="mod.py")
+    new, matched, stale = loaded.apply(findings3)
+    assert len(new) == 1 and len(matched) == 2
+    # removing all draws leaves the baseline stale
+    new, matched, stale = loaded.apply([])
+    assert new == [] and matched == [] and sum(stale.values()) == 2
+
+
+def test_every_rule_registered_with_rationale():
+    assert set(RULES) == {"JG001", "JG002", "JG003", "JG004", "JG005",
+                          "JG006"}
+    for rule in RULES.values():
+        assert rule.name and rule.rationale
+
+
+def test_cli_clean_against_checked_in_baseline():
+    """ISSUE 3 acceptance: the tools CLI exits 0 on mxnet_tpu/ against the
+    checked-in LINT_BASELINE.json, and --check-baseline finds no rot."""
+    tool = os.path.join(REPO, "tools", "graftlint.py")
+    for args in (["mxnet_tpu"], ["--check-baseline"]):
+        proc = subprocess.run([sys.executable, tool] + args, cwd=REPO,
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import numpy as np\nx = np.random.rand(3)\n")
+    tool = os.path.join(REPO, "tools", "graftlint.py")
+    proc = subprocess.run(
+        [sys.executable, tool, str(bad), "--no-baseline", "-f", "json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["new"] and payload["new"][0]["rule"] == "JG005"
+
+
+def test_check_baseline_detects_stale(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    stale_bl = tmp_path / "bl.json"
+    stale_bl.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "JG005", "path": "gone.py",
+         "snippet": "x = np.random.rand(3)", "count": 1}]}))
+    tool = os.path.join(REPO, "tools", "graftlint.py")
+    proc = subprocess.run(
+        [sys.executable, tool, str(clean), "--baseline", str(stale_bl),
+         "--check-baseline"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "stale" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sanitize_raise():
+    sanitizer.configure(mode="raise")
+    yield
+    sanitizer.configure(mode="off")
+
+
+def test_sanitizer_off_is_silent():
+    """The planted sync-under-trace passes silently with MXNET_SANITIZE
+    unset — the hazard jax itself never reports."""
+    import jax
+    assert sanitizer.mode() == "off"
+    const = nd.array(np.ones((2, 2)))
+
+    def f(v):
+        _ = const.asnumpy()           # concrete under trace: silently baked
+        return v + 1
+
+    jax.jit(f)(jax.numpy.ones(3))     # no error
+
+
+def test_sanitizer_catches_sync_under_trace(sanitize_raise):
+    import jax
+    const = nd.array(np.ones((2, 2)))
+
+    def f(v):
+        _ = const.asnumpy()
+        return v + 1
+
+    with pytest.raises(sanitizer.SanitizerError, match="under trace"):
+        jax.jit(f)(jax.numpy.ones(5))
+
+
+def test_sanitizer_catches_tracer_leak(sanitize_raise):
+    import jax
+    leaked = []
+
+    def f(v):
+        leaked.append(nd.NDArray(v))
+        return v * 2
+
+    jax.jit(f)(jax.numpy.ones(3))
+    with pytest.raises(sanitizer.SanitizerError, match="tracer leak"):
+        leaked[0].asnumpy()
+
+
+def test_sanitizer_env_gate_subprocess(tmp_path):
+    """MXNET_SANITIZE=1 in the environment arms the check at import."""
+    script = tmp_path / "leak.py"
+    script.write_text(textwrap.dedent("""
+        import jax, numpy as np
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        from mxnet_tpu.lint.sanitizer import SanitizerError
+        const = nd.array(np.ones((2, 2)))
+        def f(v):
+            _ = const.asnumpy()
+            return v + 1
+        try:
+            jax.jit(f)(jax.numpy.ones(3))
+        except SanitizerError:
+            print("CAUGHT")
+        else:
+            print("MISSED")
+    """))
+    env = dict(os.environ, MXNET_SANITIZE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+    assert "CAUGHT" in proc.stdout, proc.stdout + proc.stderr
+
+
+def test_sanitizer_warn_mode_logs_instead(sanitize_raise, caplog):
+    import jax
+    sanitizer.configure(mode="warn")
+    const = nd.array(np.ones((2, 2)))
+
+    def f(v):
+        _ = const.asnumpy()
+        return v + 1
+
+    import logging
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.sanitizer"):
+        jax.jit(f)(jax.numpy.ones(7))
+    assert any("under trace" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# engine happens-before checker
+# ---------------------------------------------------------------------------
+
+def test_engine_hb_clean_under_sanitizer(sanitize_raise):
+    """A well-declared task graph runs clean under the checker."""
+    eng = mx.engine.ThreadedEngine(num_workers=2)
+    try:
+        v1, v2 = eng.new_variable(), eng.new_variable()
+        order = []
+        for i in range(8):
+            eng.push(lambda i=i: order.append(i), mutable_vars=(v1,))
+        eng.push(lambda: order.append("r"), const_vars=(v1,),
+                 mutable_vars=(v2,))
+        eng.wait_for_all()
+        assert order[:8] == list(range(8))     # write serialization held
+    finally:
+        eng.close()
+
+
+def test_engine_hb_concurrent_pushers_no_false_positive(sanitize_raise):
+    """Ticket issuance and the native enqueue share one push scope, so
+    racing pushers can't interleave ticket order against engine order
+    (which would raise on a perfectly correct program)."""
+    import threading
+    eng = mx.engine.ThreadedEngine(num_workers=4)
+    try:
+        v = eng.new_variable()
+        out = []
+        def pusher(tid):
+            for i in range(25):
+                eng.push(lambda t=tid, i=i: out.append((t, i)),
+                         mutable_vars=(v,))
+        threads = [threading.Thread(target=pusher, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eng.wait_for_all()            # raises on any spurious violation
+        assert len(out) == 100
+    finally:
+        eng.close()
+
+
+def test_engine_hb_catches_out_of_order_write(sanitize_raise):
+    """Violations surface at the next wait point: simulate a scheduler bug
+    by running guarded tasks directly out of push order."""
+    eng = mx.engine.ThreadedEngine(num_workers=1)
+    try:
+        v = eng.new_variable()
+        t1 = sanitizer.guard_task(eng, lambda: None, (), (v,))
+        t2 = sanitizer.guard_task(eng, lambda: None, (), (v,))
+        with pytest.raises(sanitizer.SanitizerError,
+                           match="out of push order"):
+            t2()                      # write 1 landing before write 0
+        del t1
+    finally:
+        eng.close()
+
+
+def test_engine_hb_cancelled_push_does_not_poison_ordering(sanitize_raise):
+    """A push that fails before reaching the engine rolls its ticket back
+    (engine.push's except path calls guarded.cancel()), so later writes to
+    the same var don't read as out-of-order forever."""
+    eng = mx.engine.ThreadedEngine(num_workers=1)
+    try:
+        v = eng.new_variable()
+        dead = sanitizer.guard_task(eng, lambda: None, (), (v,))
+        dead.cancel()                 # the native enqueue "raised"
+        ran = []
+        nxt = sanitizer.guard_task(eng, lambda: ran.append(1), (), (v,))
+        nxt()                         # must NOT raise out-of-push-order
+        assert ran == [1]
+        # delete_variable prunes the (drained) ledger entry
+        eng.delete_variable(v)
+        assert int(v) not in getattr(eng, "_graftlint_hb").vars
+        # deletion with a pending write defers until that write drains
+        w = eng.new_variable()
+        t1 = sanitizer.guard_task(eng, lambda: None, (), (w,))
+        t2 = sanitizer.guard_task(eng, lambda: None, (), (w,))
+        t1()
+        eng.delete_variable(w)        # t2 still holds ticket 1
+        assert int(w) in eng._graftlint_hb.vars
+        t2()                          # must not misreport push order...
+        assert int(w) not in eng._graftlint_hb.vars   # ...and reaps
+    finally:
+        eng.close()

@@ -227,9 +227,9 @@ def test_sharded_optimizer_matches_eager(opt_name, opt_kw, tol):
         net(nd.array(X))        # materialise deferred shapes
         return net
 
-    np.random.seed(7)               # initializers draw from numpy RNG
+    mx.random.seed(7)     # initializers draw from random.host_rng()
     net_eager = build()
-    np.random.seed(7)
+    mx.random.seed(7)
     net_sharded = build()
     # pair params structurally (creation order): the global name counters
     # make lexicographic sorting unstable across test ordering
@@ -293,9 +293,9 @@ def test_weight_update_sharding_matches_replicated():
         net(nd.array(X))
         return net
 
-    np.random.seed(7)
+    mx.random.seed(7)     # initializers draw from random.host_rng()
     net_a = build()
-    np.random.seed(7)
+    mx.random.seed(7)
     net_b = build()
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 

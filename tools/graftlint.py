@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""graftlint: TPU-footgun static analysis over this repo.
+
+Launcher for the ``mxnet_tpu.lint`` analyzer that works from any cwd:
+
+    tools/graftlint.py mxnet_tpu/ tools/ examples/
+    tools/graftlint.py --check-baseline        # stale-suppression rot
+    tools/graftlint.py --list-rules
+
+The lint package itself is stdlib-only, so it is loaded HERE by file path
+— not through ``import mxnet_tpu``, whose ``__init__`` pulls in jax —
+keeping this tool fast enough for pre-commit hooks.
+"""
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_DIR = os.path.join(_REPO, "mxnet_tpu", "lint")
+
+
+def _load_lint_pkg():
+    """Import mxnet_tpu.lint as a standalone package (no jax)."""
+    name = "graftlint_standalone"
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_PKG_DIR, "__init__.py"),
+        submodule_search_locations=[_PKG_DIR])
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules[name] = pkg
+    spec.loader.exec_module(pkg)
+    return importlib.import_module(name + ".cli")
+
+
+if __name__ == "__main__":
+    sys.exit(_load_lint_pkg().main())
