@@ -41,6 +41,7 @@ import jax
 import numpy as np
 
 from .lint import sanitizer as _san
+from .telemetry import flight as _flight
 
 __all__ = ["wait_for_var", "wait_for_all", "push", "is_sync_dispatch",
            "set_sync_dispatch", "ThreadedEngine", "engine"]
@@ -275,6 +276,11 @@ class ThreadedEngine:
         the native enqueue happen under one push scope so concurrent
         pushers cannot interleave ticket order against engine order.
         """
+        if _flight.enabled():     # opted-out path stays one bool check
+            _flight.record("engine_push",
+                           getattr(fn, "__qualname__", None)
+                           or getattr(fn, "__name__", repr(type(fn))),
+                           reads=len(const_vars), writes=len(mutable_vars))
         with _san.push_scope(self):
             if _san.engine_checker_enabled():
                 fn = _san.guard_task(self, fn, const_vars, mutable_vars)

@@ -276,6 +276,42 @@ class Executor:
         """Hook: sharded executors re-place the PRNG key on their mesh."""
         return key
 
+    def cost_analysis(self):
+        """Analytical XLA cost of THIS executor's programs, ahead of time.
+
+        Lowers the bound inference and train-step programs from
+        shape/dtype specs (no buffers touched, nothing executed, the
+        global PRNG stream not consumed) and returns
+        ``{"eval": {"flops", "bytes_accessed"}, "fwd_bwd": {...}}`` —
+        the numbers the MFU gauges are built from, per bound executor
+        instead of per process.  Entries are omitted where XLA reports
+        no cost (e.g. an empty graph).
+        """
+        import jax
+        from .telemetry import costs as _costs
+        key = jax.random.PRNGKey(0)
+        arg_specs = [jax.ShapeDtypeStruct(self.arg_dict[n].shape,
+                                          self.arg_dict[n].dtype)
+                     for n in self.arg_names]
+        aux_specs = [jax.ShapeDtypeStruct(self.aux_dict[n].shape,
+                                          self.aux_dict[n].dtype)
+                     for n in self.aux_names]
+        key_spec = jax.ShapeDtypeStruct(key.shape, key.dtype)
+        out = {}
+        programs = [("eval", self._eval_jit)]
+        if self._grad_names:
+            programs.append(("fwd_bwd", self._fwd_bwd_ones_jit))
+        for label, watched in programs:
+            try:
+                cost = _costs.capture(
+                    watched._fn, (arg_specs, aux_specs, key_spec), {},
+                    force=True)
+            except Exception:
+                cost = None
+            if cost is not None:
+                out[label] = {"flops": cost[0], "bytes_accessed": cost[1]}
+        return out
+
     def _place(self, name, arr):
         """Ensure the buffer is committed to this executor's device (cross-
         device inputs arrive when the user loads data on another context —

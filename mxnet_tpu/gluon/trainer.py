@@ -118,10 +118,13 @@ class Trainer(object):
 
         if slots:
             # step-boundary span: kvstore buckets and the optimizer
-            # program nest inside it, and memory watermarks are sampled
-            # at its exit (telemetry on only)
+            # program nest inside it; memory watermarks, the XLA cost
+            # window (step_model_flops/step_mfu), and the engine-backlog
+            # gauge resolve at its exit (telemetry on only)
             with _tel.span("trainer_step", cat="step", hist="step_time_us",
-                           memory=True):
+                           memory=True,
+                           args={"slots": len(slots),
+                                 "batch_size": batch_size}):
                 if fused_trainer_enabled() \
                         and self._optimizer.supports_fused():
                     run_fused_step(self, slots)

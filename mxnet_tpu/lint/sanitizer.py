@@ -91,6 +91,10 @@ def _violation(message, site=None):
     try:
         from .. import telemetry as _tel
         _tel.bump("sanitizer_violations")
+        # the flight ring keeps the last violations for post-mortems:
+        # in warn mode the log line scrolls away, the ring does not
+        _tel.flight.record("sanitizer", message[:300],
+                           site=str(site) if site is not None else None)
     except Exception:
         pass
     if _MODE == "raise":

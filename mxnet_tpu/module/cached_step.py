@@ -111,7 +111,8 @@ class CachedTrainStep:
         """Execute one step; *feed* maps data/label names to NDArrays."""
         _tel.bump("module_train_step")
         with _tel.span("module_train_step", cat="step",
-                       hist="step_time_us", memory=True):
+                       hist="step_time_us", memory=True,
+                       args={"params": len(self._pnames)}):
             return self._run(feed)
 
     def _run(self, feed):

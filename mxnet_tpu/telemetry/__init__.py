@@ -1,0 +1,48 @@
+"""Telemetry: the observability plane of the TPU build, as one package.
+
+Three tiers, youngest on top:
+
+* :mod:`.core` — the in-process plane (PR 2): hierarchical contextvar
+  spans feeding a Chrome-trace ring, the typed Counter/Gauge/Histogram
+  registry, the retrace watchdog around every owned jit entry point, and
+  the Chrome/Prometheus/JSON exporters.  Everything here is re-exported
+  at package level: ``from mxnet_tpu import telemetry; telemetry.span``
+  keeps working exactly as when this was a single module.
+* :mod:`.flight` + :mod:`.server` — the post-mortem and live tier:
+  an always-on crash ring with excepthook/SIGTERM/hang dump hooks
+  (``flight_<pid>.json``), and the ``MXNET_TELEMETRY_HTTP`` localhost
+  endpoints (/metrics /healthz /snapshot /trace /flight /stacks) with a
+  background gauge sampler.
+* :mod:`.costs` — XLA cost accounting: ``cost_analysis()`` captured per
+  compiled program, folded into ``step_model_flops`` / ``step_mfu`` /
+  ``step_hbm_bw_util`` at step-span exit against a per-device peak
+  table (``MXNET_PEAK_FLOPS`` / ``MXNET_PEAK_HBM_BW`` override).
+
+Import side effects, all cheap and all opt-out-able: crash hooks are
+chained (``MXNET_FLIGHT_EVENTS=0`` disables), the hang watchdog starts
+iff ``MXNET_HANG_DUMP_SECS`` is set, and the HTTP server starts iff
+``MXNET_TELEMETRY_HTTP`` is set.  docs/OBSERVABILITY.md is the guide.
+"""
+from __future__ import annotations
+
+from . import core, costs, flight, server          # noqa: F401
+from .core import *                                # noqa: F401,F403
+from .core import (_set_profiler_running,          # noqa: F401  (profiler)
+                   current_span, refresh_from_env, retrace_limit)
+from .flight import (dump as dump_flight,          # noqa: F401
+                     install_crash_hooks, start_hang_watchdog,
+                     thread_stacks)
+from .server import (health, start_server,         # noqa: F401
+                     stop_server)
+
+__all__ = list(core.__all__) + [
+    "current_span", "refresh_from_env", "retrace_limit",
+    "core", "costs", "flight", "server",
+    "dump_flight", "install_crash_hooks", "start_hang_watchdog",
+    "thread_stacks", "health", "start_server", "stop_server",
+]
+
+# post-mortem tier wiring (each is a no-op when its env gate says so)
+install_crash_hooks()
+start_hang_watchdog()
+server.start_from_env()

@@ -27,12 +27,24 @@ is organised around four questions instead of one:
    trace + ``ph:"M"`` track-name metadata), :func:`prometheus_text`
    (text exposition), :func:`snapshot`/:func:`dump_snapshot` (JSON),
    consumed by ``tools/trace_report.py``.
+5. **What was the hardware doing?**  Step-span exits close a cost window
+   fed by :class:`_WatchedJit`'s XLA ``cost_analysis()`` capture: the
+   gauges ``step_model_flops`` / ``step_mfu`` / ``step_hbm_bw_util``
+   relate each step to the per-device peak table in
+   :mod:`mxnet_tpu.telemetry.costs`.
+
+The post-mortem / live tier lives in the sibling modules of this package:
+:mod:`..flight` (always-on crash ring + dump hooks, fed from span exits
+and compile events here), :mod:`..server` (the ``MXNET_TELEMETRY_HTTP``
+introspection endpoints), :mod:`..costs` (MFU/roofline accounting).
 
 Gating: ``MXNET_TELEMETRY=1`` enables spans/histograms/watchdog/memory
 sampling.  Counters are ALWAYS on; with telemetry off every other hook is
-one cached-bool check.  Spans also record whenever the classic profiler is
-running (``profiler.set_state('run')``), so existing profiler workflows
-keep working unchanged.
+one cached-bool check (plus, for step/program spans, the one attribute
+compare that keeps the flight recorder's progress clock ticking).  Spans
+also record whenever the classic profiler is running
+(``profiler.set_state('run')``), so existing profiler workflows keep
+working unchanged.
 
 This module is import-light on purpose (stdlib only; jax only touched
 inside memory sampling) — every hot path in the framework imports it.
@@ -43,9 +55,12 @@ import contextvars
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from collections import deque
+
+from . import flight as _flight
 
 __all__ = ["enabled", "set_enabled", "configure", "trace_active",
            "span", "now_us", "add_event", "clear_events",
@@ -53,8 +68,9 @@ __all__ = ["enabled", "set_enabled", "configure", "trace_active",
            "bump", "counter", "counters", "reset_counters",
            "set_gauge", "gauge", "observe", "histogram",
            "watch_jit", "compile_events", "retrace_report",
-           "dump_chrome_trace", "prometheus_text", "snapshot",
-           "dump_snapshot", "reset", "sample_memory",
+           "dump_chrome_trace", "chrome_trace_payload", "prometheus_text",
+           "snapshot", "dump_snapshot", "reset", "sample_memory",
+           "program_cost", "program_costs",
            "COUNTERS", "GAUGES", "HISTOGRAMS", "METRIC_NAMES"]
 
 _LOG = logging.getLogger("mxnet_tpu.telemetry")
@@ -114,10 +130,12 @@ def configure(enabled=None, retrace_limit=None, max_events=None):
 
 
 def refresh_from_env():
-    """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_RETRACE_LIMIT."""
+    """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_RETRACE_LIMIT (and, when
+    the cost module is loaded, its MXNET_PEAK_* overrides)."""
     global _ENABLED, _RETRACE_LIMIT
     _ENABLED = _env_enabled()
     _RETRACE_LIMIT = _env_retrace_limit()
+    _costs().refresh_from_env()
 
 
 def retrace_limit():
@@ -161,6 +179,11 @@ _CAT_PRIORITY = ("step", "program", "kvstore", "io", "operator",
 
 def now_us():
     return (time.perf_counter() - _t0) * 1e6
+
+
+# the flight ring timestamps with this module's clock so its entries line
+# up with the Chrome trace events
+_flight.set_clock(now_us)
 
 
 def add_event(name, cat, start_us, dur_us, tid=None, args=None):
@@ -237,11 +260,19 @@ class span:
         stack = _SPAN_STACK.get()
         self._parent = stack[-1] if stack else None
         self._tok = _SPAN_STACK.set(stack + (self._name,))
+        if self._cat == "step":
+            _open_step_window()
         self._t0 = now_us()
         return self
 
     def __exit__(self, *exc):
         if not self._on:
+            # telemetry off: the flight recorder's progress clock still
+            # ticks for coarse spans (step/program exits are what the
+            # hang watchdog and /healthz reason about) — one string
+            # compare, no timing, no lock
+            if self._cat in ("step", "program"):
+                _flight.note_span(self._name, self._cat)
             return False
         dur = now_us() - self._t0
         _SPAN_STACK.reset(self._tok)
@@ -250,6 +281,9 @@ class span:
         if self._args:
             args.update(self._args)
         add_event(self._name, self._cat, self._t0, dur, args=args)
+        _flight.note_span(self._name, self._cat, dur)
+        if self._cat == "step":
+            _close_step_window(dur)
         if self._hist is not None and _ENABLED:
             observe(self._hist, dur)
         if self._memory and _ENABLED:
@@ -286,14 +320,31 @@ COUNTERS = {
     "sanitizer_violations": "footguns caught at runtime by MXNET_SANITIZE "
                             "(tracer leaks, syncs-under-trace, engine "
                             "ordering)",
+    "flight_dumps": "flight-recorder post-mortem files written (crash, "
+                    "signal, hang, or manual)",
 }
 
 GAUGES = {
     "io_batch_wait_us": "time the training loop waited for the last batch "
                         "(data starvation when this rivals step time)",
     "host_rss_peak_bytes": "process peak resident set size",
-    "device_bytes_in_use": "device allocator bytes in use (0 if the "
-                           "backend does not report memory stats)",
+    "device_bytes_in_use": "device allocator bytes in use, summed over "
+                           "local devices (0 if the backend does not "
+                           "report memory stats)",
+    "device_bytes_in_use_peak": "high-water bytes in use on the most "
+                                "loaded single local device",
+    "engine_pending_tasks": "host-engine tasks queued or running "
+                            "(sampled by the introspection sampler and "
+                            "at step-span exits)",
+    "step_rate_per_s": "training steps completed per second over the "
+                       "sampler's last window",
+    "step_model_flops": "model FLOPs executed by compiled programs "
+                        "during the last step span (XLA cost_analysis)",
+    "step_mfu": "model FLOP utilization of the last step against the "
+                "device peak (0-1; MXNET_PEAK_FLOPS overrides)",
+    "step_hbm_bw_util": "HBM bandwidth utilization of the last step "
+                        "against the device peak (0-1; "
+                        "MXNET_PEAK_HBM_BW overrides)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)
@@ -498,7 +549,26 @@ class _WatchedJit:
                 if fresh:
                     self._max_seen = after
             if fresh:
-                _record_compile(self._name, now_us() - t0, after)
+                wall = now_us() - t0
+                # cost capture pays an AOT lower+compile (partially
+                # cache-absorbed, still real): cap it at the first few
+                # variants per name so a retrace STORM — many compiles,
+                # exactly when extra compile time hurts most — stops
+                # paying after variant 3
+                cost = None
+                if after <= 3 or self._name not in _PROGRAM_COSTS:
+                    cost = _capture_cost(self._fn, self._name,
+                                         args, kwargs)
+                _record_compile(self._name, wall, after, cost)
+        # cost window: a step span is open on this process — attribute
+        # this program execution's FLOPs/bytes to it (dict .get + two
+        # float adds; the window is None outside step spans)
+        win = _STEP_WINDOW
+        if win is not None:
+            cost = _PROGRAM_COSTS.get(self._name)
+            if cost is not None:
+                win[0] += cost[0]
+                win[1] += cost[1]
         return out
 
     def __getattr__(self, item):
@@ -510,7 +580,92 @@ def watch_jit(fn, name):
     return _WatchedJit(fn, name)
 
 
-def _record_compile(name, wall_us, cache_size):
+# --------------------------------------------------------------------------
+# XLA cost accounting (per-program capture + per-step window)
+# --------------------------------------------------------------------------
+#
+# _PROGRAM_COSTS holds the last-compiled (flops, bytes_accessed) per
+# watched-jit name, written on compile events and read on every watched
+# call while a step window is open.  The heavy lifting (ShapeDtypeStruct
+# re-lower, cost_analysis parsing, peak tables) lives in ..costs, loaded
+# lazily so the import-light contract of this module holds.
+
+_PROGRAM_COSTS = {}            # name -> (flops, bytes_accessed)
+_STEP_WINDOW = None            # [flops, bytes] while a step span is open
+_STEP_DEPTH = 0
+_costs_mod = None
+
+
+def _costs():
+    global _costs_mod
+    if _costs_mod is None:
+        from . import costs as _costs_mod_  # noqa: PLC0415
+        _costs_mod = _costs_mod_
+    return _costs_mod
+
+
+def _capture_cost(fn, name, args, kwargs):
+    """Ask XLA what the freshly compiled program costs; never raises."""
+    try:
+        cost = _costs().capture(fn, args, kwargs)
+    except Exception:      # cost accounting must never break a step
+        cost = None
+    if cost is not None:
+        _PROGRAM_COSTS[name] = cost
+    return cost
+
+
+def program_cost(name):
+    """(flops, bytes_accessed) of *name*'s last-compiled program, or
+    None before its first compile (or when capture failed)."""
+    return _PROGRAM_COSTS.get(name)
+
+
+def program_costs():
+    """Snapshot of every captured program cost (JSON-shaped)."""
+    return {name: {"flops": c[0], "bytes_accessed": c[1]}
+            for name, c in sorted(_PROGRAM_COSTS.items())}
+
+
+def _open_step_window():
+    global _STEP_WINDOW, _STEP_DEPTH
+    _STEP_DEPTH += 1
+    if _STEP_DEPTH == 1:
+        _STEP_WINDOW = [0.0, 0.0]
+
+
+def _close_step_window(dur_us):
+    """Step-span exit: convert the window's FLOPs/bytes into the MFU and
+    bandwidth-utilization gauges, and sample the engine backlog."""
+    global _STEP_WINDOW, _STEP_DEPTH
+    _STEP_DEPTH = max(0, _STEP_DEPTH - 1)
+    if _STEP_DEPTH:
+        return
+    win, _STEP_WINDOW = _STEP_WINDOW, None
+    if win is not None and win[0] > 0:
+        try:
+            _costs().finalize_step(win[0], win[1], dur_us)
+        except Exception:
+            pass
+    _sample_engine_pending()
+
+
+def _sample_engine_pending():
+    """engine_pending_tasks gauge — without importing (or creating!) the
+    engine: only an already-live singleton is observed."""
+    eng = sys.modules.get("mxnet_tpu.engine")
+    if eng is None:
+        return
+    singleton = getattr(eng, "_SINGLETON", None)
+    if singleton is None:
+        return
+    try:
+        set_gauge("engine_pending_tasks", singleton.num_pending())
+    except Exception:
+        pass
+
+
+def _record_compile(name, wall_us, cache_size, cost=None):
     with _compile_lock:
         rec = _compiles.setdefault(
             name, {"count": 0, "total_us": 0.0, "last_size": 0})
@@ -526,10 +681,16 @@ def _record_compile(name, wall_us, cache_size):
             _storm_warned.add(name)
     bump("jit_compiles")
     observe("jit_compile_us", wall_us)
+    _flight.record("compile", name, wall_us=round(wall_us, 1),
+                   cache_size=cache_size, compiles=count)
     if trace_active():
         t_end = now_us()
+        cargs = {"cache_size": cache_size, "compiles": count}
+        if cost is not None:
+            cargs["flops"] = cost[0]
+            cargs["bytes_accessed"] = cost[1]
         add_event("compile:%s" % name, "compile", t_end - wall_us, wall_us,
-                  args={"cache_size": cache_size, "compiles": count})
+                  args=cargs)
     if storm:
         bump("retrace_storms")
         _LOG.warning(
@@ -548,23 +709,69 @@ def compile_events():
         return [dict(e) for e in _compile_log]
 
 
-def retrace_report():
-    """Per-callable compile accounting for exporters / trace_report."""
-    with _compile_lock:
-        return {name: {"count": rec["count"],
-                       "total_ms": rec["total_us"] / 1e3,
-                       "cache_size": rec["last_size"],
-                       "storm": name in _storm_warned}
-                for name, rec in _compiles.items()}
+def _acquire(lock, timeout):
+    """Lock acquire with optional timeout — the crash/signal dump path
+    must never deadlock on a lock the interrupted main thread holds."""
+    if timeout is None:
+        lock.acquire()
+        return True
+    return lock.acquire(timeout=timeout)
+
+
+def retrace_report(lock_timeout=None):
+    """Per-callable compile accounting for exporters / trace_report.
+
+    *lock_timeout*: crash-dump callers pass a bound; on timeout the
+    report is built from an unlocked best-effort copy (the holder is the
+    very thread a signal interrupted — it will never release)."""
+    locked = _acquire(_compile_lock, lock_timeout)
+    try:
+        items = list(_compiles.items())
+        warned = set(_storm_warned)
+    except RuntimeError:          # unlocked copy raced a resize
+        return {}
+    finally:
+        if locked:
+            _compile_lock.release()
+    return {name: {"count": rec["count"],
+                   "total_ms": rec["total_us"] / 1e3,
+                   "cache_size": rec["last_size"],
+                   "storm": name in warned}
+            for name, rec in items}
 
 
 # --------------------------------------------------------------------------
 # memory watermarks
 # --------------------------------------------------------------------------
 
+def _device_memory(devices):
+    """(total bytes_in_use, max single-device bytes_in_use) over
+    *devices*; (None, None) when no device reports memory stats."""
+    total, worst, reported = 0, 0, False
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        used = int(stats.get("bytes_in_use", 0))
+        total += used
+        worst = max(worst, used)
+        reported = True
+    return (total, worst) if reported else (None, None)
+
+
 def sample_memory():
     """Record host/device memory watermarks into the gauges (called at
-    step-span boundaries; safe on backends without memory_stats)."""
+    step-span boundaries and by the introspection sampler; safe on
+    backends without memory_stats).
+
+    Device usage is summed over ALL local devices — a multi-chip run
+    reading one device would under-report HBM by 1/N — and the most
+    loaded single device feeds a monotonic high-water gauge (the OOM
+    question is always about the worst chip, not the average).
+    """
     try:
         import resource
         rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
@@ -575,9 +782,11 @@ def sample_memory():
         pass
     try:
         import jax
-        stats = jax.local_devices()[0].memory_stats()
-        if stats:
-            set_gauge("device_bytes_in_use", stats.get("bytes_in_use", 0))
+        total, worst = _device_memory(jax.local_devices())
+        if total is not None:
+            set_gauge("device_bytes_in_use", total)
+            set_gauge("device_bytes_in_use_peak",
+                      max(worst, gauge("device_bytes_in_use_peak")))
     except Exception:
         pass
 
@@ -603,15 +812,34 @@ def _metadata_events():
     return meta
 
 
-def dump_chrome_trace(filename):
-    """Write the merged trace (spans + op events + compile events) with
-    track-name metadata as Chrome trace JSON."""
+def chrome_trace_payload():
+    """The merged trace (spans + op events + compile events) with
+    track-name metadata, as the Chrome trace JSON object."""
     with _lock:
-        payload = {"traceEvents": _metadata_events() + list(_events),
-                   "displayTimeUnit": "ms"}
+        return {"traceEvents": _metadata_events() + list(_events),
+                "displayTimeUnit": "ms"}
+
+
+def dump_chrome_trace(filename):
+    """Write :func:`chrome_trace_payload` to *filename*."""
+    payload = chrome_trace_payload()
     with open(filename, "w") as f:
         json.dump(payload, f)
     return filename
+
+
+def _escape_help(text):
+    """Prometheus exposition-format HELP escaping: a raw newline in a
+    HELP line terminates it mid-text and the next fragment becomes an
+    unparseable sample line — the whole scrape fails."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value):
+    """Label-value escaping per the exposition format (backslash first,
+    then quote and newline)."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
 
 
 def prometheus_text():
@@ -625,20 +853,23 @@ def prometheus_text():
         hists = [(h.name, h.help, h.buckets, list(h.counts),
                   h.total, h.count) for h in _hists.values()]
     for name, val in counter_items:
-        lines.append("# HELP %s %s" % (name, COUNTERS.get(name, name)))
+        lines.append("# HELP %s %s"
+                     % (name, _escape_help(COUNTERS.get(name, name))))
         lines.append("# TYPE %s counter" % name)
         lines.append("%s %d" % (name, val))
     for name, val in gauge_items:
-        lines.append("# HELP %s %s" % (name, GAUGES.get(name, name)))
+        lines.append("# HELP %s %s"
+                     % (name, _escape_help(GAUGES.get(name, name))))
         lines.append("# TYPE %s gauge" % name)
         lines.append("%s %.17g" % (name, val))
     for name, help_, buckets, counts, total, count in hists:
-        lines.append("# HELP %s %s" % (name, help_ or name))
+        lines.append("# HELP %s %s" % (name, _escape_help(help_ or name)))
         lines.append("# TYPE %s histogram" % name)
         cum = 0
         for edge, c in zip(buckets, counts):
             cum += c
-            lines.append('%s_bucket{le="%.17g"} %d' % (name, edge, cum))
+            lines.append('%s_bucket{le="%s"} %d'
+                         % (name, _escape_label("%.17g" % edge), cum))
         cum += counts[-1]
         lines.append('%s_bucket{le="+Inf"} %d' % (name, cum))
         lines.append("%s_sum %.17g" % (name, total))
@@ -646,18 +877,33 @@ def prometheus_text():
     return "\n".join(lines) + "\n"
 
 
-def snapshot():
-    """JSON-serialisable snapshot of the whole telemetry state."""
-    with _mlock:
+def snapshot(lock_timeout=None):
+    """JSON-serialisable snapshot of the whole telemetry state.
+
+    *lock_timeout*: bounds every lock acquire — the flight recorder's
+    signal handler snapshots from the main thread, which may itself be
+    mid-``bump()`` holding ``_mlock``; a plain blocking acquire there
+    would turn SIGTERM into a hang.  On timeout the copies are taken
+    unlocked (worst case: one torn histogram in a post-mortem)."""
+    locked = _acquire(_mlock, lock_timeout)
+    try:
         counters_ = dict(_counters)
         gauges_ = dict(_gauges)
         hists_ = {n: h.to_dict() for n, h in _hists.items()}
+    except RuntimeError:          # unlocked copy raced a resize
+        counters_, gauges_, hists_ = {}, {}, {}
+    finally:
+        if locked:
+            _mlock.release()
+    costs_ = {"programs": program_costs(),
+              "peaks": _costs().peaks_if_resolved()}
     return {"enabled": _ENABLED,
             "retrace_limit": _RETRACE_LIMIT,
             "counters": counters_,
             "gauges": gauges_,
             "histograms": hists_,
-            "retraces": retrace_report()}
+            "retraces": retrace_report(lock_timeout),
+            "costs": costs_}
 
 
 def dump_snapshot(filename):
@@ -668,6 +914,7 @@ def dump_snapshot(filename):
 
 def reset():
     """Clear events, metrics, and watchdog state (tests / new session)."""
+    global _STEP_WINDOW, _STEP_DEPTH
     clear_events()
     reset_counters()
     with _mlock:
@@ -677,3 +924,7 @@ def reset():
         _compiles.clear()
         _compile_log.clear()
         _storm_warned.clear()
+    _PROGRAM_COSTS.clear()
+    _STEP_WINDOW = None
+    _STEP_DEPTH = 0
+    _flight.reset()

@@ -1,0 +1,196 @@
+"""XLA cost accounting: per-program FLOPs/bytes → MFU and roofline.
+
+"Fast" is meaningless without a denominator.  XLA's compiler already
+computes an analytical cost model for every program it emits — the same
+style of model TVM (arxiv 1802.04799) and the Julia-to-TPU pipeline
+(arxiv 1810.09868) build their schedulers on — and hands it to us for
+free via ``compiled.cost_analysis()``.  This module turns that into the
+three judgement numbers every perf PR gets measured against:
+
+* ``step_model_flops``  — FLOPs the step's compiled programs executed
+* ``step_mfu``          — model FLOP utilization: flops / (dur × peak)
+* ``step_hbm_bw_util``  — bytes-accessed / (dur × peak HBM bandwidth)
+
+Capture happens once per compile event (``core._WatchedJit`` calls
+:func:`capture`): the freshly compiled program is re-lowered from
+``ShapeDtypeStruct`` specs — metadata only, safe even when the call
+donated and deleted its input buffers — and its cost analysis cached per
+watched-jit name.  Every subsequent watched call inside an open step
+span adds its cached cost to the step window; ``core`` closes the window
+at step-span exit by calling :func:`finalize_step`.
+
+Peaks come from a per-device-kind table (per JAX device, i.e. per TPU
+core on v2/v3 and per chip from v4 on), multiplied by the local device
+count — MFU of an 8-chip step is measured against 8 chips.  Override
+with ``MXNET_PEAK_FLOPS`` / ``MXNET_PEAK_HBM_BW`` (aggregate values,
+used verbatim), which is also how CPU runs get an honest denominator:
+the CPU table entry is a placeholder, not a measurement.
+
+Known approximations, accepted on purpose:
+
+* cost is cached per watched-jit *name*; a name whose cache holds many
+  shape variants reports its most recently compiled variant.
+* ``cost_analysis`` counts model FLOPs (what the HLO asks for), not
+  hardware FLOPs — that is exactly what MFU wants (padding and
+  recomputation are waste, not work).
+"""
+from __future__ import annotations
+
+import os
+
+from . import core
+
+__all__ = ["capture", "finalize_step", "peaks", "peaks_if_resolved",
+           "refresh_from_env", "PEAK_TABLE"]
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+# (peak FLOP/s, peak HBM bytes/s) per JAX device, keyed on device_kind.
+# bf16/dense numbers from the published per-chip specs, halved for the
+# two-core-per-chip generations where jax exposes cores as devices.
+PEAK_TABLE = {
+    "TPU v2":      (22.5e12, 350e9),
+    "TPU v3":      (61.5e12, 450e9),
+    "TPU v4":      (275e12, 1228e9),
+    "TPU v4 lite": (137.5e12, 614e9),
+    "TPU v5":      (459e12, 2765e9),
+    "TPU v5p":     (459e12, 2765e9),
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v5e":     (197e12, 819e9),
+    "TPU v6 lite": (918e12, 1640e9),
+    "TPU v6e":     (918e12, 1640e9),
+    # CPU: order-of-magnitude placeholder (a modern server socket's f32
+    # peak); pin MXNET_PEAK_FLOPS for a real CPU MFU
+    "cpu":         (1e11, 50e9),
+}
+_FALLBACK = PEAK_TABLE["cpu"]
+
+
+def _env_float(name):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if value > 0 else None
+
+
+def _env_capture_enabled():
+    return os.environ.get("MXNET_COST_ANALYSIS", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+# cached at import (JG006 cached-value pattern: finalize_step is on the
+# step path); core.refresh_from_env() funnels into refresh_from_env()
+_ENV_PEAK_FLOPS = _env_float("MXNET_PEAK_FLOPS")
+_ENV_PEAK_BW = _env_float("MXNET_PEAK_HBM_BW")
+_CAPTURE = _env_capture_enabled()
+_peaks = None                   # resolved {"flops","hbm_bw",...} or None
+
+
+def refresh_from_env():
+    """Re-read MXNET_PEAK_FLOPS / MXNET_PEAK_HBM_BW /
+    MXNET_COST_ANALYSIS and drop the resolved-peak cache."""
+    global _ENV_PEAK_FLOPS, _ENV_PEAK_BW, _CAPTURE, _peaks
+    _ENV_PEAK_FLOPS = _env_float("MXNET_PEAK_FLOPS")
+    _ENV_PEAK_BW = _env_float("MXNET_PEAK_HBM_BW")
+    _CAPTURE = _env_capture_enabled()
+    _peaks = None
+
+
+# --------------------------------------------------------------------------
+# per-program capture
+# --------------------------------------------------------------------------
+
+def _spec(leaf):
+    """Shape/dtype skeleton of one pytree leaf.  Works on donated (and
+    already deleted) jax arrays: aval metadata survives buffer death."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return leaf              # python scalar etc: trace as-is
+    import jax
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _normalize(analysis):
+    """cost_analysis() shape varies by jax version: dict, or a
+    one-per-partition list of dicts."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not isinstance(analysis, dict):
+        return None
+    flops = float(analysis.get("flops", 0.0) or 0.0)
+    nbytes = float(analysis.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0 and nbytes <= 0:
+        return None
+    return (flops, nbytes)
+
+
+def capture(fn, args, kwargs, force=False):
+    """(flops, bytes_accessed) of *fn* compiled for *args*/*kwargs*, or
+    None.  Called by the watchdog ON COMPILE EVENTS ONLY — the re-lower
+    here re-traces the function once, which is noise next to the XLA
+    compile that just happened, and buys shape-safe AOT introspection.
+    *force* bypasses the ``MXNET_COST_ANALYSIS`` gate for explicit API
+    calls (``Executor.cost_analysis``).
+    """
+    if not (_CAPTURE or force):
+        return None
+    import jax
+    sargs, skwargs = jax.tree_util.tree_map(_spec, (tuple(args),
+                                                    dict(kwargs)))
+    compiled = fn.lower(*sargs, **skwargs).compile()
+    return _normalize(compiled.cost_analysis())
+
+
+# --------------------------------------------------------------------------
+# peaks + step finalization
+# --------------------------------------------------------------------------
+
+def peaks():
+    """The aggregate (all local devices) peak FLOP/s and HBM bytes/s this
+    process is measured against, resolved once and cached."""
+    global _peaks
+    if _peaks is not None:
+        return _peaks
+    kind, n_dev = "unknown", 1
+    try:
+        import jax
+        devs = jax.local_devices()
+        n_dev = max(1, len(devs))
+        kind = getattr(devs[0], "device_kind", "unknown") or "unknown"
+    except Exception:
+        pass
+    table_flops, table_bw = PEAK_TABLE.get(kind, _FALLBACK)
+    flops = _ENV_PEAK_FLOPS if _ENV_PEAK_FLOPS is not None \
+        else table_flops * n_dev
+    bw = _ENV_PEAK_BW if _ENV_PEAK_BW is not None else table_bw * n_dev
+    _peaks = {"flops": flops, "hbm_bw": bw,
+              "device_kind": kind, "n_devices": n_dev,
+              "source": {"flops": "env" if _ENV_PEAK_FLOPS is not None
+                         else "table",
+                         "hbm_bw": "env" if _ENV_PEAK_BW is not None
+                         else "table"}}
+    return _peaks
+
+
+def peaks_if_resolved():
+    """The cached peak dict without triggering device discovery (jax
+    may not even be initialized when a snapshot is taken)."""
+    return _peaks
+
+
+def finalize_step(flops, nbytes, dur_us):
+    """Close one step's cost window into the three gauges."""
+    core.set_gauge("step_model_flops", flops)
+    dur_s = dur_us / 1e6
+    if dur_s <= 0:
+        return
+    pk = peaks()
+    if flops > 0 and pk["flops"] > 0:
+        core.set_gauge("step_mfu", flops / (dur_s * pk["flops"]))
+    if nbytes > 0 and pk["hbm_bw"] > 0:
+        core.set_gauge("step_hbm_bw_util", nbytes / (dur_s * pk["hbm_bw"]))

@@ -1,0 +1,357 @@
+"""Flight recorder: always-on crash ring + post-mortem dump hooks.
+
+The telemetry plane (``..core``) answers questions when a run ends
+cleanly and someone remembers to dump.  Production jobs mostly don't die
+cleanly: they segfault a worker, OOM, get SIGTERMed by the scheduler, or
+hang in a collective.  This module keeps the last N interesting events in
+a fixed-size ring at near-zero cost, and dumps
+
+    flight_<pid>.json  =  ring + telemetry snapshot + every thread's
+                          Python stack
+
+whenever the process is about to die (uncaught exception on any thread,
+SIGTERM/SIGABRT) or looks wedged (no step-span exit for
+``MXNET_HANG_DUMP_SECS`` seconds).
+
+Design constraints, in order:
+
+* **Always on.**  Unlike spans (gated on ``MXNET_TELEMETRY``), the ring
+  records whenever the process runs; ``MXNET_FLIGHT_EVENTS=0`` is the
+  opt-out.  A crash you did not anticipate is the one you most need
+  forensics for.
+* **Lock-cheap.**  ``deque(maxlen=N).append`` is a single GIL-atomic
+  operation — no lock on the record path, ever.  Readers (dump, the
+  ``/flight`` endpoint) take a list() copy, which deque iteration makes
+  safe enough for forensics (worst case: one racing eviction re-read).
+* **Fail silent.**  Every dump path swallows its own errors: the flight
+  recorder must never turn a SIGTERM into a hang or mask the original
+  exception.
+
+Feeders: span exits and compile events (``core``), host-engine pushes
+(``mxnet_tpu.engine``), sanitizer violations (``mxnet_tpu.lint``).
+Stdlib-only, and no sibling import at module level — ``core`` imports
+this module, not vice versa (the snapshot needed at dump time is fetched
+lazily).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+__all__ = ["record", "note_span", "events", "configure", "capacity",
+           "enabled", "step_count", "last_step_age", "payload", "dump",
+           "thread_stacks", "install_crash_hooks", "start_hang_watchdog",
+           "reset"]
+
+DEFAULT_EVENTS = 2048
+
+
+def _env_capacity():
+    try:
+        return max(0, int(os.environ.get("MXNET_FLIGHT_EVENTS",
+                                         DEFAULT_EVENTS)))
+    except ValueError:
+        return DEFAULT_EVENTS
+
+
+def _env_hang_secs():
+    try:
+        return max(0.0, float(os.environ.get("MXNET_HANG_DUMP_SECS", 0)))
+    except ValueError:
+        return 0.0
+
+
+_CAPACITY = _env_capacity()
+_ring = deque(maxlen=_CAPACITY or 1)
+_DUMP_DIR = os.environ.get("MXNET_FLIGHT_DIR", "") or None
+
+# core injects its trace clock so ring timestamps line up with the
+# Chrome traceEvents; standalone (tests importing flight directly) falls
+# back to a private epoch
+_t0 = time.perf_counter()
+_clock = lambda: (time.perf_counter() - _t0) * 1e6   # noqa: E731
+
+
+def set_clock(fn):
+    global _clock
+    _clock = fn
+
+
+def enabled():
+    return _CAPACITY > 0
+
+
+def capacity():
+    return _CAPACITY
+
+
+def configure(max_events=None):
+    """Resize (or 0-disable) the ring; tests and notebooks."""
+    global _CAPACITY, _ring
+    if max_events is not None:
+        _CAPACITY = max(0, int(max_events))
+        _ring = deque(list(_ring)[-(_CAPACITY or 1):],
+                      maxlen=_CAPACITY or 1)
+
+
+# --------------------------------------------------------------------------
+# the ring
+# --------------------------------------------------------------------------
+
+def record(kind, name, **fields):
+    """Append one event; the single deque.append is the whole cost."""
+    if not _CAPACITY:
+        return
+    ev = {"ts_us": round(_clock(), 1), "kind": kind, "name": name}
+    if fields:
+        ev.update(fields)
+    _ring.append(ev)
+
+
+# step-progress clock for the hang watchdog and /healthz: monotonic
+# timestamp + count of step-span exits.  Single-writer in practice (the
+# training thread); worst case under races is a skewed age, never a crash.
+_last_step = [0.0]
+_steps = [0]
+
+
+def note_span(name, cat, dur_us=None):
+    """Span-exit feeder called by ``core.span.__exit__`` — with a
+    duration on the traced path, without one on the telemetry-off path
+    (where only step/program progress is worth the append)."""
+    if cat == "step":
+        _steps[0] += 1
+        _last_step[0] = time.monotonic()
+    if not _CAPACITY:
+        return
+    ev = {"ts_us": round(_clock(), 1), "kind": "span", "name": name,
+          "cat": cat}
+    if dur_us is not None:
+        ev["dur_us"] = round(dur_us, 1)
+    _ring.append(ev)
+
+
+def events():
+    """A list copy of the ring, oldest first."""
+    return list(_ring)
+
+
+def step_count():
+    return _steps[0]
+
+
+def last_step_age():
+    """Seconds since the last step-span exit; None before the first."""
+    if not _steps[0]:
+        return None
+    return time.monotonic() - _last_step[0]
+
+
+def reset():
+    """Clear ring + progress clock (tests); hooks stay installed."""
+    _ring.clear()
+    _steps[0] = 0
+    _last_step[0] = 0.0
+
+
+# --------------------------------------------------------------------------
+# post-mortem payload + dump
+# --------------------------------------------------------------------------
+
+def thread_stacks():
+    """Python stack of every live thread, keyed "<name>-<ident>"."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for ident, frame in sys._current_frames().items():
+        label = "%s-%d" % (names.get(ident, "unknown"), ident)
+        stacks[label] = traceback.format_stack(frame)
+    return stacks
+
+
+def payload(reason):
+    """Everything a post-mortem needs, JSON-shaped.
+
+    The snapshot is taken with bounded lock acquires: a signal handler
+    runs on the main thread BETWEEN bytecodes, so any telemetry lock the
+    interrupted code holds would never be released — a blocking acquire
+    here would turn SIGTERM into a hang."""
+    try:
+        from . import core
+        snap = core.snapshot(lock_timeout=1.0)
+    except Exception:
+        snap = None
+    return {"version": 1,
+            "reason": reason,
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "unix_time": time.time(),
+            "steps": _steps[0],
+            "last_step_age_s": last_step_age(),
+            "ring": events(),
+            "snapshot": snap,
+            "stacks": thread_stacks()}
+
+
+def dump(reason="manual", directory=None):
+    """Write ``flight_<pid>.json`` (MXNET_FLIGHT_DIR or cwd); returns the
+    path.  One file per pid — a later dump (e.g. the excepthook after a
+    hang dump) overwrites with the more recent state, atomically via a
+    same-directory rename so a reader never sees a torn file."""
+    directory = directory or _DUMP_DIR or os.getcwd()
+    path = os.path.join(directory, "flight_%d.json" % os.getpid())
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload(reason), f, indent=1, default=repr)
+    os.replace(tmp, path)
+    try:
+        # best-effort counter bump: same signal-context rule as above —
+        # never block on a lock the interrupted main thread may hold
+        from . import core
+        if core._mlock.acquire(timeout=0.5):
+            try:
+                core._counters["flight_dumps"] = \
+                    core._counters.get("flight_dumps", 0) + 1
+            finally:
+                core._mlock.release()
+    except Exception:
+        pass
+    return path
+
+
+def _safe_dump(reason):
+    try:
+        return dump(reason)
+    except Exception:           # forensics must never mask the crash
+        return None
+
+
+# --------------------------------------------------------------------------
+# crash hooks
+# --------------------------------------------------------------------------
+
+_excepthooks_installed = False
+_signals_installed = False
+_CRASH_SIGNALS = ("SIGTERM", "SIGABRT")
+
+
+def install_crash_hooks():
+    """Chain the flight dump into ``sys.excepthook``,
+    ``threading.excepthook``, and the default SIGTERM/SIGABRT handlers.
+
+    Idempotent, and the two halves are tracked separately: signal
+    handlers can only be installed from the main thread, so a first call
+    from a worker thread (lazy import) installs the excepthooks and a
+    later main-thread call still gets to claim the signals.  Signals are
+    only taken over while their disposition is SIG_DFL — an application
+    that registered its own SIGTERM handling keeps it.  The dump runs
+    first, then the previous behavior (print-traceback / process death)
+    proceeds unchanged.
+    """
+    global _excepthooks_installed, _signals_installed
+    if not _CAPACITY:
+        return
+    if not _excepthooks_installed:
+        _excepthooks_installed = True
+
+        prev_except = sys.excepthook
+
+        def _excepthook(exc_type, exc, tb):
+            record("crash", getattr(exc_type, "__name__", str(exc_type)),
+                   message=str(exc)[:500])
+            _safe_dump("excepthook:%s"
+                       % getattr(exc_type, "__name__", "?"))
+            prev_except(exc_type, exc, tb)
+
+        sys.excepthook = _excepthook
+
+        prev_thread_except = threading.excepthook
+
+        def _thread_excepthook(args):
+            record("crash", getattr(args.exc_type, "__name__", "?"),
+                   thread=getattr(args.thread, "name", "?"),
+                   message=str(args.exc_value)[:500])
+            _safe_dump("thread-excepthook:%s"
+                       % getattr(args.exc_type, "__name__", "?"))
+            prev_thread_except(args)
+
+        threading.excepthook = _thread_excepthook
+
+    if _signals_installed \
+            or threading.current_thread() is not threading.main_thread():
+        return                   # signal.signal only works on main
+    _signals_installed = True
+    for signame in _CRASH_SIGNALS:
+        signum = getattr(signal, signame, None)
+        if signum is None:
+            continue
+        try:
+            if signal.getsignal(signum) is signal.SIG_DFL:
+                signal.signal(signum, _signal_handler)
+        except (ValueError, OSError):   # exotic embedding; skip
+            pass
+
+
+def _signal_handler(signum, frame):
+    record("signal", signal.Signals(signum).name)
+    _safe_dump("signal:%s" % signal.Signals(signum).name)
+    # restore the default disposition and re-raise so the exit status
+    # still says "killed by signal N" (process managers key off it)
+    signal.signal(signum, signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+# --------------------------------------------------------------------------
+# hang watchdog
+# --------------------------------------------------------------------------
+
+_watchdog = None
+
+
+def start_hang_watchdog(secs=None):
+    """Daemon thread that dumps the flight file when step-span exits stop
+    for *secs* (default ``MXNET_HANG_DUMP_SECS``; unset/0 = no-op).
+
+    Fires once per stall: after a dump it re-arms only when a new step
+    lands, so a long shutdown tail doesn't spray dumps.  Hung steps with
+    telemetry off are still seen — the step-progress clock ticks on the
+    span off path too.
+    """
+    global _watchdog
+    if secs is None:
+        secs = _env_hang_secs()
+    if secs <= 0 or not _CAPACITY or _watchdog is not None:
+        return None
+    stop = threading.Event()
+
+    def _watch():
+        fired_at = -1                       # step count at last dump
+        poll = min(1.0, secs / 4.0)
+        while not stop.wait(poll):
+            age = last_step_age()
+            if age is None or age < secs:
+                continue
+            if _steps[0] == fired_at:       # still the same stall
+                continue
+            fired_at = _steps[0]
+            record("hang", "no step-span exit",
+                   stalled_s=round(age, 3))
+            _safe_dump("hang:%.0fs" % age)
+
+    thread = threading.Thread(target=_watch, name="mxnet-flight-watchdog",
+                              daemon=True)
+    thread.start()
+    _watchdog = (thread, stop)
+    return thread
+
+
+def stop_hang_watchdog():
+    global _watchdog
+    if _watchdog is not None:
+        _watchdog[1].set()
+        _watchdog = None

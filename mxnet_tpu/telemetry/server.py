@@ -1,0 +1,296 @@
+"""Live introspection: an in-process HTTP server + background sampler.
+
+``MXNET_TELEMETRY_HTTP=<port>`` starts a stdlib ``http.server`` daemon
+thread bound to localhost (port 0 = ephemeral, read it back from
+``server.port``) so a live job can be asked what it is doing without
+touching the training loop:
+
+    /metrics    Prometheus text exposition (scrape target)
+    /healthz    liveness verdict: steps progressing? retrace storm?
+                sanitizer violations?  200 when healthy, 503 when not
+    /snapshot   full telemetry snapshot (counters/gauges/histograms/
+                retraces/costs) as JSON
+    /trace      the Chrome traceEvents buffer (load in Perfetto)
+    /flight     the flight-recorder payload (ring + stacks + snapshot)
+    /stacks     every thread's Python stack, plain text
+
+A background sampler (default 500 ms, ``MXNET_TELEMETRY_SAMPLE_MS``)
+keeps the passive gauges honest between steps: host-engine backlog
+(``engine_pending_tasks``), device memory watermarks, and the
+``step_rate_per_s`` moving rate.  The sampler only *observes* — it looks
+the engine and jax up in ``sys.modules`` and never imports, so a process
+that never touched the engine never pays for one.
+
+Localhost-only on purpose: these endpoints expose argv and stack traces.
+Front with a real proxy if you need the metrics off-host.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import core, flight
+
+__all__ = ["IntrospectionServer", "start_server", "stop_server",
+           "get_server", "health", "start_from_env",
+           "start_sampler", "stop_sampler", "sample_once"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+
+def _env_port():
+    raw = os.environ.get("MXNET_TELEMETRY_HTTP", "").strip()
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    return port if 0 <= port <= 65535 else None
+
+
+def _env_sampler_ms():
+    try:
+        return max(50.0, float(os.environ.get("MXNET_TELEMETRY_SAMPLE_MS",
+                                              500)))
+    except ValueError:
+        return 500.0
+
+
+def _env_stall_secs():
+    try:
+        return max(1.0, float(os.environ.get("MXNET_HEALTH_STALL_SECS",
+                                             120)))
+    except ValueError:
+        return 120.0
+
+
+_STALL_SECS = _env_stall_secs()
+
+
+# --------------------------------------------------------------------------
+# health verdict
+# --------------------------------------------------------------------------
+
+def health():
+    """(ok, detail-dict).  Healthy means: if training has started, a step
+    landed within MXNET_HEALTH_STALL_SECS; no retrace storm; no sanitizer
+    violations.  A process that never steps (pure inference, a notebook)
+    is healthy by the step criterion."""
+    age = flight.last_step_age()
+    stalled = age is not None and age > _STALL_SECS
+    storms = core.counter("retrace_storms")
+    violations = core.counter("sanitizer_violations")
+    ok = not stalled and storms == 0 and violations == 0
+    return ok, {
+        "ok": ok,
+        "steps": {"count": flight.step_count(),
+                  "last_step_age_s": None if age is None
+                  else round(age, 3),
+                  "stalled": stalled,
+                  "stall_limit_s": _STALL_SECS},
+        "retrace_storms": storms,
+        "sanitizer_violations": violations,
+        "engine_pending_tasks": core.gauge("engine_pending_tasks"),
+        "flight_dumps": core.counter("flight_dumps"),
+    }
+
+
+# --------------------------------------------------------------------------
+# HTTP server
+# --------------------------------------------------------------------------
+
+_INDEX = ("mxnet_tpu introspection\n"
+          "endpoints: /metrics /healthz /snapshot /trace /flight /stacks\n")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet-tpu-introspect/1"
+
+    def log_message(self, *args):            # quiet: we ARE the telemetry
+        pass
+
+    def _reply(self, code, content_type, body):
+        if isinstance(body, str):
+            body = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, obj, code=200):
+        self._reply(code, "application/json",
+                    json.dumps(obj, default=repr))
+
+    def do_GET(self):                        # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._reply(200, "text/plain; charset=utf-8", _INDEX)
+            elif path == "/metrics":
+                self._reply(200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            core.prometheus_text())
+            elif path == "/healthz":
+                ok, detail = health()
+                self._reply_json(detail, 200 if ok else 503)
+            elif path == "/snapshot":
+                self._reply_json(core.snapshot())
+            elif path == "/trace":
+                self._reply_json(core.chrome_trace_payload())
+            elif path == "/flight":
+                self._reply_json(flight.payload("http"))
+            elif path == "/stacks":
+                stacks = flight.thread_stacks()
+                text = "\n".join("--- %s ---\n%s" % (k, "".join(v))
+                                 for k, v in sorted(stacks.items()))
+                self._reply(200, "text/plain; charset=utf-8", text)
+            else:
+                self._reply(404, "text/plain; charset=utf-8",
+                            "unknown endpoint\n" + _INDEX)
+        except BrokenPipeError:              # client went away mid-reply
+            pass
+        except Exception as exc:             # introspection never kills
+            try:
+                self._reply(500, "text/plain; charset=utf-8",
+                            "introspection error: %r" % (exc,))
+            except Exception:
+                pass
+
+
+class IntrospectionServer:
+    """One ThreadingHTTPServer on localhost + its serve thread."""
+
+    def __init__(self, port):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mxnet-introspect-http", daemon=True)
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def start_server(port=None, sample_ms=None):
+    """Start (or return the running) introspection server; also starts
+    the background sampler.  *port* 0 binds an ephemeral port."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            if port is None:
+                port = _env_port()
+            if port is None:
+                raise ValueError(
+                    "no port: pass one or set MXNET_TELEMETRY_HTTP")
+            _server = IntrospectionServer(port).start()
+            _LOG.info("introspection server on http://127.0.0.1:%d "
+                      "(/metrics /healthz /snapshot /trace /flight "
+                      "/stacks)", _server.port)
+        server = _server
+    start_sampler(sample_ms)
+    return server
+
+
+def get_server():
+    return _server
+
+
+def stop_server():
+    global _server
+    with _server_lock:
+        server, _server = _server, None
+    if server is not None:
+        server.stop()
+    stop_sampler()
+
+
+def start_from_env():
+    """Import-time hook: start iff MXNET_TELEMETRY_HTTP is set."""
+    if _env_port() is None:
+        return None
+    try:
+        return start_server()
+    except OSError as exc:       # port taken: log, never break import
+        _LOG.warning("introspection server failed to bind: %s", exc)
+        return None
+
+
+# --------------------------------------------------------------------------
+# background sampler
+# --------------------------------------------------------------------------
+
+_sampler = None
+_sampler_lock = threading.Lock()
+
+
+def sample_once(rate_state=None):
+    """One sampler tick: engine backlog, device memory, step rate.
+    *rate_state* is the (prev_steps, prev_monotonic) carried between
+    ticks; returns the updated tuple."""
+    core._sample_engine_pending()
+    if "jax" in sys.modules:     # observe-only: never initialize jax
+        core.sample_memory()
+    now = time.monotonic()
+    steps = flight.step_count()
+    if rate_state is not None:
+        prev_steps, prev_t = rate_state
+        dt = now - prev_t
+        if dt > 0:
+            core.set_gauge("step_rate_per_s",
+                           max(0, steps - prev_steps) / dt)
+    return (steps, now)
+
+
+def start_sampler(sample_ms=None):
+    """Start the daemon sampler thread (idempotent)."""
+    global _sampler
+    with _sampler_lock:
+        if _sampler is not None:
+            return _sampler[0]
+        if sample_ms is None:
+            sample_ms = _env_sampler_ms()
+        interval = max(0.05, sample_ms / 1e3)
+        stop = threading.Event()
+
+        def _loop():
+            state = (flight.step_count(), time.monotonic())
+            while not stop.wait(interval):
+                try:
+                    state = sample_once(state)
+                except Exception:    # a dying backend must not kill us
+                    pass
+
+        thread = threading.Thread(target=_loop,
+                                  name="mxnet-telemetry-sampler",
+                                  daemon=True)
+        thread.start()
+        _sampler = (thread, stop)
+        return thread
+
+
+def stop_sampler():
+    global _sampler
+    with _sampler_lock:
+        sampler, _sampler = _sampler, None
+    if sampler is not None:
+        sampler[1].set()

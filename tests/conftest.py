@@ -16,6 +16,14 @@ if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# flight-recorder dumps (crashing worker subprocesses in dist tests,
+# timeout SIGTERMs) go to a session temp dir, not the repo checkout;
+# tests that assert on the dump location override this per-subprocess
+if "MXNET_FLIGHT_DIR" not in os.environ:
+    import tempfile
+    os.environ["MXNET_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="mxnet-flight-")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,211 @@
+"""Flight recorder (ISSUE 4 tentpole 1): always-on crash ring, crash
+hooks, hang watchdog.
+
+Acceptance contract: SIGTERMing (or excepthooking) a 3-step training
+subprocess leaves ``flight_<pid>.json`` containing the event ring, the
+telemetry snapshot, and every thread's Python stack; the hang watchdog
+dumps when step-span exits stop.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import flight
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TRAIN_SNIPPET = """
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+net = nn.Sequential()
+net.add(nn.Dense(4, activation="relu"))
+net.add(nn.Dense(2))
+net.initialize()
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1})
+loss_fn = gluon.loss.L2Loss()
+for _ in range(%(steps)d):
+    x = mx.nd.array(np.ones((4, 3), np.float32))
+    y = mx.nd.array(np.ones((4, 2), np.float32))
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(4)
+"""
+
+
+def _run_script(tmp_path, body, steps=3, extra_env=None, **popen):
+    script = tmp_path / "job.py"
+    script.write_text(_TRAIN_SNIPPET % {"steps": steps} + body)
+    env = dict(os.environ, MXNET_TELEMETRY="1",
+               MXNET_FLIGHT_DIR=str(tmp_path),
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.update(extra_env or {})
+    return subprocess.Popen([sys.executable, str(script)],
+                            cwd=str(tmp_path), env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, **popen)
+
+
+def _load_flight(tmp_path, pid):
+    path = tmp_path / ("flight_%d.json" % pid)
+    assert path.exists(), "no flight file; dir: %s" % os.listdir(tmp_path)
+    return json.loads(path.read_text())
+
+
+def _assert_postmortem(dump, min_steps=3):
+    """The three things the acceptance criteria name: ring events,
+    snapshot, all-thread stacks."""
+    kinds = {e["kind"] for e in dump["ring"]}
+    assert "span" in kinds, kinds            # step spans made the ring
+    assert "compile" in kinds, kinds         # watched-jit compile events
+    assert dump["steps"] >= min_steps
+    snap = dump["snapshot"]
+    assert snap["counters"]["xla_program_calls"] > 0
+    assert "gauges" in snap and "retraces" in snap
+    stacks = dump["stacks"]
+    assert stacks, "no thread stacks captured"
+    assert any(k.startswith("MainThread") for k in stacks)
+    for frames in stacks.values():           # each stack is a real trace
+        assert frames and any("File" in ln for ln in frames)
+
+
+# ---- crash hooks (subprocess) --------------------------------------------
+
+def test_excepthook_dumps_flight_file(tmp_path):
+    proc = _run_script(tmp_path, "raise RuntimeError('boom')\n")
+    _, err = proc.communicate(timeout=120)
+    assert proc.returncode != 0
+    assert b"RuntimeError: boom" in err      # original traceback intact
+    dump = _load_flight(tmp_path, proc.pid)
+    assert dump["reason"] == "excepthook:RuntimeError"
+    assert any(e["kind"] == "crash" and e["name"] == "RuntimeError"
+               for e in dump["ring"])
+    _assert_postmortem(dump)
+
+
+def test_sigterm_dumps_flight_file(tmp_path):
+    proc = _run_script(
+        tmp_path,
+        "import sys, time\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(120)\n")
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # handler re-raises after dumping: exit status still says SIGTERM
+    assert proc.returncode == -signal.SIGTERM
+    dump = _load_flight(tmp_path, proc.pid)
+    assert dump["reason"] == "signal:SIGTERM"
+    assert any(e["kind"] == "signal" and e["name"] == "SIGTERM"
+               for e in dump["ring"])
+    _assert_postmortem(dump)
+
+
+@pytest.mark.slow
+def test_hang_watchdog_dumps_on_stall(tmp_path):
+    proc = _run_script(
+        tmp_path,
+        "import time\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(120)\n",                 # the 'hang'
+        extra_env={"MXNET_HANG_DUMP_SECS": "1"})
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        path = tmp_path / ("flight_%d.json" % proc.pid)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not path.exists():
+            time.sleep(0.25)
+        assert path.exists(), "watchdog never dumped"
+        # the file is atomically replaced, so a parse either sees the
+        # full dump or (rarely) the previous full dump — never torn
+        dump = json.loads(path.read_text())
+        assert dump["reason"].startswith("hang:")
+        assert any(e["kind"] == "hang" for e in dump["ring"])
+        assert dump["last_step_age_s"] >= 1.0
+    finally:
+        proc.kill()
+        proc.communicate(timeout=30)
+
+
+# ---- ring behavior (in-process) ------------------------------------------
+
+@pytest.fixture
+def tel(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    telemetry.refresh_from_env()
+    telemetry.reset()
+    yield telemetry
+    telemetry.reset()
+    monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+    telemetry.refresh_from_env()
+
+
+def test_ring_is_bounded_and_fifo(tel):
+    old = flight.capacity()
+    flight.configure(max_events=8)
+    try:
+        for i in range(20):
+            flight.record("test", "ev%d" % i)
+        evs = flight.events()
+        assert len(evs) == 8
+        assert evs[0]["name"] == "ev12" and evs[-1]["name"] == "ev19"
+    finally:
+        flight.configure(max_events=old)
+
+
+def test_span_exits_feed_ring_and_progress_clock(tel):
+    assert flight.step_count() == 0
+    assert flight.last_step_age() is None
+    with tel.span("unit_step", cat="step"):
+        pass
+    assert flight.step_count() == 1
+    assert flight.last_step_age() < 10
+    names = [(e["kind"], e["name"]) for e in flight.events()]
+    assert ("span", "unit_step") in names
+
+
+def test_progress_clock_ticks_with_telemetry_off():
+    """The hang watchdog must see steps even when spans are inert."""
+    telemetry.reset()
+    telemetry.set_enabled(False)
+    assert not telemetry.trace_active()
+    with telemetry.span("off_step", cat="step"):
+        pass
+    assert flight.step_count() == 1
+    assert any(e["name"] == "off_step" for e in flight.events())
+    telemetry.reset()
+
+
+def test_engine_pushes_land_in_ring(tel):
+    from mxnet_tpu import engine
+    eng = engine.engine()
+    var = eng.new_variable()
+    eng.push(lambda: None, mutable_vars=(var,))
+    eng.wait_for_all()
+    assert any(e["kind"] == "engine_push" for e in flight.events())
+
+
+def test_manual_dump_roundtrip(tel, tmp_path):
+    with tel.span("unit_step", cat="step"):
+        pass
+    path = telemetry.dump_flight("manual", directory=str(tmp_path))
+    dump = json.loads(open(path).read())
+    assert dump["reason"] == "manual"
+    assert dump["pid"] == os.getpid()
+    assert dump["ring"] and dump["stacks"] and dump["snapshot"]
+    assert tel.counter("flight_dumps") == 1

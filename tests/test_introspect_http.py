@@ -1,0 +1,147 @@
+"""Introspection HTTP server (ISSUE 4 tentpole 2): live /metrics,
+/healthz, /snapshot, /trace, /flight, /stacks + the background sampler.
+
+Acceptance contract: scrape /metrics and /healthz from the LIVE server
+and parse them.
+"""
+import json
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+from mxnet_tpu.telemetry import flight, server
+
+
+@pytest.fixture
+def live_server(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    telemetry.refresh_from_env()
+    telemetry.reset()
+    srv = server.start_server(port=0, sample_ms=100)
+    yield srv
+    server.stop_server()
+    telemetry.reset()
+    monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+    telemetry.refresh_from_env()
+
+
+def _get(srv, path):
+    url = "http://127.0.0.1:%d%s" % (srv.port, path)
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+# one sample line: name{labels} value  |  name value
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE+.\-]+|inf$')
+
+
+def test_metrics_scrape_parses(live_server):
+    telemetry.bump("xla_program_calls", 7)
+    telemetry.set_gauge("io_batch_wait_us", 42.0)
+    telemetry.observe("step_time_us", 1234.0)
+
+    status, ctype, body = _get(live_server, "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "xla_program_calls 7" in text
+    # every non-comment line is a well-formed sample — the exposition
+    # format promise /metrics makes to a Prometheus scraper
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE "))
+            assert "\n" not in line
+        else:
+            assert _SAMPLE_RE.match(line), "unparseable: %r" % line
+    assert 'step_time_us_bucket{le="+Inf"} 1' in text
+
+
+def test_healthz_healthy_and_unhealthy(live_server):
+    status, _, body = _get(live_server, "/healthz")
+    health = json.loads(body)
+    assert status == 200
+    assert health["ok"] is True
+    assert health["steps"]["count"] == 0
+    assert health["steps"]["stalled"] is False
+    assert health["retrace_storms"] == 0
+    assert health["sanitizer_violations"] == 0
+
+    telemetry.bump("sanitizer_violations")     # a footgun fired
+    try:
+        with pytest.raises(urllib.error.HTTPError) as einfo:
+            _get(live_server, "/healthz")
+        assert einfo.value.code == 503
+        sick = json.loads(einfo.value.read())
+        assert sick["ok"] is False
+        assert sick["sanitizer_violations"] == 1
+    finally:
+        telemetry.reset_counters()
+
+
+def test_snapshot_trace_flight_stacks_endpoints(live_server):
+    with telemetry.span("http_step", cat="step"):
+        a = nd.array(np.ones((4, 4), np.float32))
+        nd.dot(a, a).wait_to_read()
+
+    status, _, body = _get(live_server, "/snapshot")
+    snap = json.loads(body)
+    assert status == 200 and snap["enabled"] is True
+    assert "costs" in snap and "counters" in snap
+
+    status, _, body = _get(live_server, "/trace")
+    trace = json.loads(body)
+    assert any(e.get("name") == "http_step"
+               for e in trace["traceEvents"])
+
+    status, _, body = _get(live_server, "/flight")
+    fl = json.loads(body)
+    assert fl["reason"] == "http"
+    assert any(e["name"] == "http_step" for e in fl["ring"])
+    assert any(k.startswith("MainThread") for k in fl["stacks"])
+
+    status, ctype, body = _get(live_server, "/stacks")
+    assert status == 200 and ctype.startswith("text/plain")
+    assert b"MainThread" in body and b"File" in body
+
+    with pytest.raises(urllib.error.HTTPError) as einfo:
+        _get(live_server, "/no_such")
+    assert einfo.value.code == 404
+
+
+def test_sampler_feeds_engine_and_step_rate_gauges(live_server):
+    from mxnet_tpu import engine
+    eng = engine.engine()
+    var = eng.new_variable()
+    eng.push(lambda: None, mutable_vars=(var,))
+    eng.wait_for_all()
+
+    with telemetry.span("rate_step", cat="step"):
+        pass
+    state = server.sample_once((flight.step_count() - 1, 0.0))
+    gauges = telemetry.snapshot()["gauges"]
+    assert "engine_pending_tasks" in gauges     # wired, not test-only
+    assert gauges["engine_pending_tasks"] == 0  # drained
+    assert gauges["step_rate_per_s"] > 0        # 1 step since prev tick
+    assert state[0] == flight.step_count()
+
+
+def test_step_exit_samples_engine_backlog(live_server):
+    """Satellite: engine_pending_tasks is refreshed at step-span exits,
+    not only by the sampler thread."""
+    from mxnet_tpu import engine
+    engine.engine()                             # singleton exists
+    telemetry.snapshot()
+    with telemetry.span("exit_step", cat="step"):
+        pass
+    assert "engine_pending_tasks" in telemetry.snapshot()["gauges"]
+
+
+def test_start_from_env_no_op_without_gate(monkeypatch):
+    monkeypatch.delenv("MXNET_TELEMETRY_HTTP", raising=False)
+    assert server.start_from_env() is None

@@ -3,8 +3,8 @@
 
 Reads the ``traceEvents`` JSON produced by ``profiler.dump_profile()`` /
 ``telemetry.dump_chrome_trace()`` (and optionally the JSON snapshot from
-``telemetry.dump_snapshot()``) and prints the four tables that answer
-"where did the step go":
+``telemetry.dump_snapshot()``) and prints the tables that answer "where
+did the step go" — and "what could the hardware have done":
 
   * step-time percentiles  — spans of category ``step`` (``trainer_step``,
     ``module_train_step``)
@@ -15,13 +15,23 @@ Reads the ``traceEvents`` JSON produced by ``profiler.dump_profile()`` /
     bytes (how much gradient actually moved per reduce program)
   * retrace report         — watched-jit compile events (``compile:*``
     trace events, enriched by the snapshot's per-callable accounting)
+  * MFU / roofline         — the snapshot's XLA cost accounting: step
+    FLOPs, MFU and HBM-bandwidth utilization against the device peaks,
+    plus per-program arithmetic intensity vs. the machine balance point
+    (is each program compute- or memory-bound?)
+
+Degrades gracefully: an empty or missing ``traceEvents`` array, or a
+snapshot from an older build lacking the newer keys, prints "(no ...)"
+placeholders instead of a traceback — this tool runs in CI pipelines on
+whatever artifacts a dead job left behind.  ``--json`` emits the same
+report machine-readable for CI consumption.
 
 Stdlib-only on purpose: the report must run anywhere the trace file can
 be copied, with no jax / framework import.
 
 Usage:
     python tools/trace_report.py trace.json [--snapshot snap.json]
-                                 [--top 10]
+                                 [--top 10] [--json]
 """
 from __future__ import annotations
 
@@ -32,13 +42,35 @@ from collections import defaultdict
 
 
 def load_events(path):
-    with open(path) as f:
-        payload = json.load(f)
+    """The 'X' trace events of *path*, or [] for anything unreadable —
+    a truncated dump from a crashed job must not crash the reporter."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as exc:
+        print("trace_report: unreadable trace %s (%s)" % (path, exc),
+              file=sys.stderr)
+        return []
     # both legal Chrome formats: {"traceEvents": [...]} and a bare array
     events = payload.get("traceEvents", []) if isinstance(payload, dict) \
         else payload
+    if not isinstance(events, list):
+        return []
     return [e for e in events
-            if isinstance(e, dict) and e.get("ph") == "X"]
+            if isinstance(e, dict) and e.get("ph") == "X"
+            and isinstance(e.get("ts"), (int, float))
+            and isinstance(e.get("dur"), (int, float))]
+
+
+def load_snapshot(path):
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError) as exc:
+        print("trace_report: unreadable snapshot %s (%s)" % (path, exc),
+              file=sys.stderr)
+        return None
+    return snap if isinstance(snap, dict) else None
 
 
 def percentile(sorted_vals, q):
@@ -79,7 +111,7 @@ def self_times(events):
         track.sort(key=lambda e: (e["ts"], -e["dur"]))
         stack = []                                # [(end_ts, name)]
         for e in track:
-            ts, dur, name = e["ts"], e["dur"], e["name"]
+            ts, dur, name = e["ts"], e["dur"], e.get("name", "?")
             while stack and stack[-1][0] <= ts:
                 stack.pop()
             rec = agg[name]
@@ -94,10 +126,11 @@ def self_times(events):
 
 
 def bucket_stats(events):
-    buckets = [e for e in events if e["name"] == "kvstore_bucket_reduce"]
-    sizes = [e.get("args", {}).get("bytes", 0) for e in buckets]
+    buckets = [e for e in events
+               if e.get("name") == "kvstore_bucket_reduce"]
     if not buckets:
         return None
+    sizes = [e.get("args", {}).get("bytes", 0) or 0 for e in buckets]
     return {"reduces": len(buckets),
             "total_bytes": sum(sizes),
             "avg_bytes": sum(sizes) / len(buckets),
@@ -111,14 +144,55 @@ def retrace_stats(events, snapshot):
     for e in events:
         if e.get("cat") != "compile":
             continue
-        name = e["name"].split(":", 1)[-1]
+        name = e.get("name", "?").split(":", 1)[-1]
         rec = out.setdefault(name, {"count": 0, "total_ms": 0.0,
                                     "storm": False})
         rec["count"] += 1
         rec["total_ms"] += e["dur"] / 1e3
-    for name, rec in (snapshot or {}).get("retraces", {}).items():
-        out[name] = {"count": rec["count"], "total_ms": rec["total_ms"],
-                     "storm": rec.get("storm", False)}
+    retraces = (snapshot or {}).get("retraces")
+    if isinstance(retraces, dict):
+        for name, rec in retraces.items():
+            if not isinstance(rec, dict):
+                continue
+            out[name] = {"count": rec.get("count", 0),
+                         "total_ms": rec.get("total_ms", 0.0),
+                         "storm": rec.get("storm", False)}
+    return out
+
+
+def mfu_stats(snapshot):
+    """The cost-accounting view: step gauges + per-program roofline.
+
+    Tolerates snapshots from builds predating cost accounting (missing
+    ``costs``/gauge keys → None)."""
+    if not isinstance(snapshot, dict):
+        return None
+    gauges = snapshot.get("gauges") or {}
+    costs = snapshot.get("costs") or {}
+    programs = costs.get("programs") or {}
+    peaks = costs.get("peaks") or None
+    out = {"step_model_flops": gauges.get("step_model_flops"),
+           "step_mfu": gauges.get("step_mfu"),
+           "step_hbm_bw_util": gauges.get("step_hbm_bw_util"),
+           "peaks": peaks, "programs": []}
+    balance = None
+    if peaks and peaks.get("hbm_bw"):
+        balance = peaks.get("flops", 0) / peaks["hbm_bw"]
+        out["machine_balance_flops_per_byte"] = balance
+    for name, rec in sorted(programs.items()):
+        if not isinstance(rec, dict):
+            continue
+        flops = rec.get("flops", 0) or 0
+        nbytes = rec.get("bytes_accessed", 0) or 0
+        row = {"program": name, "flops": flops,
+               "bytes_accessed": nbytes,
+               "flops_per_byte": flops / nbytes if nbytes else None}
+        if balance and row["flops_per_byte"] is not None:
+            row["bound"] = ("compute" if row["flops_per_byte"] >= balance
+                            else "memory")
+        out["programs"].append(row)
+    if out["step_model_flops"] is None and not out["programs"]:
+        return None
     return out
 
 
@@ -130,11 +204,44 @@ def _fmt_bytes(n):
     return "%.1fGiB" % n
 
 
-def render(events, snapshot, top):
+def _fmt_big(n):
+    """1.23e9-style short form for FLOP counts."""
+    if n is None:
+        return "-"
+    for thresh, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                           (1e3, "k")):
+        if abs(n) >= thresh:
+            return "%.2f%s" % (n / thresh, suffix)
+    return "%.0f" % n
+
+
+def build_report(events, snapshot, top):
+    """All sections as one JSON-shaped dict (the --json payload)."""
+    rows = sorted(self_times(events).items(),
+                  key=lambda kv: kv[1]["self_ms"], reverse=True)[:top]
+    report = {"steps": step_stats(events),
+              "self_times": [dict(r, name=name) for name, r in rows],
+              "buckets": bucket_stats(events),
+              "retraces": retrace_stats(events, snapshot),
+              "mfu": mfu_stats(snapshot),
+              "data_pipeline": None}
+    gauges = (snapshot or {}).get("gauges") or {}
+    wait = gauges.get("io_batch_wait_us")
+    st = report["steps"]
+    if wait is not None and st and st["count"]:
+        mean_step = st["total_ms"] / st["count"]
+        report["data_pipeline"] = {
+            "last_batch_wait_ms": wait / 1e3,
+            "mean_step_ms": mean_step,
+            "verdict": "DATA-STARVED" if wait / 1e3 > mean_step else "ok"}
+    return report
+
+
+def render(report, top):
     lines = []
 
     lines.append("== step time ==")
-    st = step_stats(events)
+    st = report["steps"]
     if st:
         lines.append("steps %d  p50 %.3fms  p90 %.3fms  p99 %.3fms  "
                      "max %.3fms  total %.3fms"
@@ -145,21 +252,19 @@ def render(events, snapshot, top):
 
     lines.append("")
     lines.append("== top %d ops by self time ==" % top)
-    rows = sorted(self_times(events).items(),
-                  key=lambda kv: kv[1]["self_ms"], reverse=True)[:top]
-    if rows:
+    if report["self_times"]:
         lines.append("%-32s %8s %12s %12s" % ("name", "calls",
                                               "total_ms", "self_ms"))
-        for name, r in rows:
+        for r in report["self_times"]:
             lines.append("%-32s %8d %12.3f %12.3f"
-                         % (name[:32], r["calls"], r["total_ms"],
+                         % (r["name"][:32], r["calls"], r["total_ms"],
                             r["self_ms"]))
     else:
         lines.append("(no span events in trace)")
 
     lines.append("")
     lines.append("== kvstore bucket traffic ==")
-    bs = bucket_stats(events)
+    bs = report["buckets"]
     if bs:
         lines.append("reduces %d  bytes %s  avg %s  max %s  wall %.3fms"
                      % (bs["reduces"], _fmt_bytes(bs["total_bytes"]),
@@ -170,7 +275,7 @@ def render(events, snapshot, top):
 
     lines.append("")
     lines.append("== retrace report ==")
-    rt = retrace_stats(events, snapshot)
+    rt = report["retraces"]
     if rt:
         lines.append("%-32s %9s %12s %6s" % ("callable", "compiles",
                                              "compile_ms", "storm"))
@@ -181,16 +286,45 @@ def render(events, snapshot, top):
     else:
         lines.append("(no compile events recorded)")
 
-    if snapshot:
-        gauges = snapshot.get("gauges", {})
-        wait = gauges.get("io_batch_wait_us")
-        if wait is not None and st and st["count"]:
-            mean_step = st["total_ms"] / st["count"]
-            lines.append("")
-            lines.append("== data pipeline ==")
-            verdict = "DATA-STARVED" if wait / 1e3 > mean_step else "ok"
-            lines.append("last batch wait %.3fms vs mean step %.3fms -> %s"
-                         % (wait / 1e3, mean_step, verdict))
+    lines.append("")
+    lines.append("== mfu / roofline ==")
+    mfu = report["mfu"]
+    if mfu:
+        parts = ["step flops %s" % _fmt_big(mfu["step_model_flops"])]
+        if mfu["step_mfu"] is not None:
+            parts.append("MFU %.2f%%" % (mfu["step_mfu"] * 100))
+        if mfu["step_hbm_bw_util"] is not None:
+            parts.append("HBM BW %.2f%%"
+                         % (mfu["step_hbm_bw_util"] * 100))
+        peaks = mfu.get("peaks")
+        if peaks:
+            parts.append("peak %sFLOP/s (%s x%d)"
+                         % (_fmt_big(peaks.get("flops")),
+                            peaks.get("device_kind", "?"),
+                            peaks.get("n_devices", 1)))
+        lines.append("  ".join(parts))
+        if mfu["programs"]:
+            lines.append("%-32s %10s %10s %8s %8s"
+                         % ("program", "flops", "bytes", "FLOP/B",
+                            "bound"))
+            for r in mfu["programs"]:
+                lines.append("%-32s %10s %10s %8s %8s"
+                             % (r["program"][:32], _fmt_big(r["flops"]),
+                                _fmt_bytes(r["bytes_accessed"]),
+                                "-" if r["flops_per_byte"] is None
+                                else "%.1f" % r["flops_per_byte"],
+                                r.get("bound", "-")))
+    else:
+        lines.append("(no cost accounting in snapshot — run with "
+                     "MXNET_TELEMETRY=1 on a build with telemetry.costs)")
+
+    dp = report["data_pipeline"]
+    if dp:
+        lines.append("")
+        lines.append("== data pipeline ==")
+        lines.append("last batch wait %.3fms vs mean step %.3fms -> %s"
+                     % (dp["last_batch_wait_ms"], dp["mean_step_ms"],
+                        dp["verdict"]))
 
     return "\n".join(lines)
 
@@ -204,14 +338,19 @@ def main(argv=None):
                     help="JSON from telemetry.dump_snapshot()")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the self-time table (default 10)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout (CI)")
     args = ap.parse_args(argv)
 
     events = load_events(args.trace)
-    snapshot = None
-    if args.snapshot:
-        with open(args.snapshot) as f:
-            snapshot = json.load(f)
-    print(render(events, snapshot, args.top))
+    snapshot = load_snapshot(args.snapshot) if args.snapshot else None
+    report = build_report(events, snapshot, args.top)
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    elif not events and not snapshot:
+        print("no events")
+    else:
+        print(render(report, args.top))
     return 0
 
 
