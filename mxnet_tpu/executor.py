@@ -566,3 +566,50 @@ Executor.forward = _profiled(Executor.forward, "executor_forward")
 Executor.backward = _profiled(Executor.backward, "executor_backward")
 Executor.forward_backward = _profiled(Executor.forward_backward,
                                       "executor_forward_backward")
+
+
+def _tracecheck_executor():
+    """Specimen bound executor for graftcheck: a tiny two-layer MLP with
+    grads on the weights (data stays grad_req null, like Module binds)."""
+    from . import symbol as S
+    data = S.var("data")
+    net = S.FullyConnected(data, num_hidden=8, name="tc_fc1")
+    net = S.relu(net)
+    net = S.FullyConnected(net, num_hidden=4, name="tc_fc2")
+    net = S.sum(net)
+    grad_req = {"data": "null"}
+    ex = net.simple_bind(Context("cpu"), grad_req=grad_req, data=(4, 16))
+    return ex
+
+
+def tracecheck_programs():
+    """AOT specimens for graftcheck: every program a bound executor
+    ships — eval, train, fwd_vjp (residuals out), bwd (residuals in),
+    and both fused fwd+bwd forms (implicit ones-grads used by Module.fit,
+    explicit out_grads used by ``forward_backward(out_grads=...)``).
+
+    The bwd program's input is the vjp residual pytree; its avals come
+    from ``jax.eval_shape`` over the fwd_vjp program — shape metadata
+    only, nothing executed.
+    """
+    ex = _tracecheck_executor()
+    key = _random.next_key()
+    arg_specs = [jax.ShapeDtypeStruct(ex.arg_dict[n].shape,
+                                      ex.arg_dict[n].dtype)
+                 for n in ex.arg_names]
+    aux_specs = [jax.ShapeDtypeStruct(ex.aux_dict[n].shape,
+                                      ex.aux_dict[n].dtype)
+                 for n in ex.aux_names]
+    key_spec = jax.ShapeDtypeStruct(key.shape, key.dtype)
+    fwd = (arg_specs, aux_specs, key_spec)
+    outs_spec, _aux_spec, vjp_spec = jax.eval_shape(
+        ex._fwd_train_jit._fn, *fwd)
+    return [
+        ("executor_eval", ex._eval_jit, fwd, {}),
+        ("executor_train", ex._train_jit, fwd, {}),
+        ("executor_fwd_vjp", ex._fwd_train_jit, fwd, {}),
+        ("executor_bwd", ex._bwd_jit, (vjp_spec, tuple(outs_spec)), {}),
+        ("executor_fwd_bwd_ones", ex._fwd_bwd_ones_jit, fwd, {}),
+        ("executor_fwd_bwd", ex._fwd_bwd_jit,
+         fwd + (tuple(outs_spec),), {}),
+    ]

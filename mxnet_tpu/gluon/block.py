@@ -382,6 +382,22 @@ class _CachedOp(object):
             return out_vals, new_aux
         return fn
 
+    def _jitted(self, train):
+        """The compiled replay program for *train* mode, built on first
+        use (shared by ``__call__`` and the graftcheck AOT driver, so the
+        trace tier analyzes the exact program this op ships)."""
+        if train not in self._jit:
+            pure = self._pure(train)
+            from ..base import mirror_enabled
+            if mirror_enabled():
+                # MXNET_BACKWARD_DO_MIRROR (ref graph_executor.cc:281-304):
+                # rematerialise forward activations in backward instead of
+                # keeping them live — jax.checkpoint is the XLA-native form
+                pure = jax.checkpoint(pure)
+            self._jit[train] = _tel.watch_jit(jax.jit(pure),
+                                              self._watch_name)
+        return self._jit[train]
+
     def __call__(self, *args):
         grad_params = self._grad_params
         aux_params = self._aux_params
@@ -394,17 +410,7 @@ class _CachedOp(object):
         train = autograd.is_training()
         recording = autograd.is_recording()
 
-        if train not in self._jit:
-            pure = self._pure(train)
-            from ..base import mirror_enabled
-            if mirror_enabled():
-                # MXNET_BACKWARD_DO_MIRROR (ref graph_executor.cc:281-304):
-                # rematerialise forward activations in backward instead of
-                # keeping them live — jax.checkpoint is the XLA-native form
-                pure = jax.checkpoint(pure)
-            self._jit[train] = _tel.watch_jit(jax.jit(pure),
-                                              self._watch_name)
-        jitted = self._jit[train]
+        jitted = self._jitted(train)
 
         if recording:
             def diff_fn(gvals, ivals):
@@ -432,6 +438,27 @@ class _CachedOp(object):
             p._data._set_data(v)
         out, _ = _regroup(outputs, self._fmt)
         return out
+
+
+def tracecheck_programs():
+    """AOT specimens for graftcheck: the hybridized-block replay program
+    (``gluon_cached_op``), built through the same ``_CachedOp._jitted``
+    path ``__call__`` uses.  A tiny Dense block stands in; its weight
+    buffers exist (initialize allocates) but the program is only traced,
+    never executed."""
+    from . import nn
+    net = nn.Dense(8, in_units=16)
+    net.initialize()
+    net._build_cached_op()
+    co = net._cached_op
+    x = nd.zeros((4, 16))
+    _flat, co._in_fmt = _flatten([x])
+    jitted = co._jitted(False)
+    grad_vals = tuple(p._data._data for p in co._grad_params)
+    aux_vals = tuple(p._data._data for p in co._aux_params)
+    key = _random.next_key()
+    return [("gluon_cached_op", jitted,
+             (grad_vals, aux_vals, (x._data,), key), {})]
 
 
 def _hybrid_forward_dispatch(self, ins):

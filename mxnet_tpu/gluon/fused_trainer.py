@@ -48,12 +48,28 @@ from ..optimizer import _state_raw, _state_writeback, static_hypers
 __all__ = ["fused_trainer_enabled", "fused_step_fn", "run_fused_step"]
 
 
-def fused_trainer_enabled():
+def _env_enabled():
     return os.environ.get("MXNET_FUSED_TRAINER", "1").strip().lower() \
         not in ("0", "false", "off", "no")
 
 
+# cached at import (the JG006 cached-value pattern): Trainer.step consults
+# this once per step and must not re-parse the environment each time
+_ENABLED = _env_enabled()
+
+
+def refresh_from_env():
+    """Re-read MXNET_FUSED_TRAINER (tests / late configuration)."""
+    global _ENABLED
+    _ENABLED = _env_enabled()
+
+
+def fused_trainer_enabled():
+    return _ENABLED
+
+
 _STEP_CACHE = {}      # signature -> (weakref to optimizer, jitted step)
+_TRACECHECK_KEEPALIVE = []    # graftcheck specimen optimizers (see below)
 
 
 def _signature(opt, params_raw, states_raw, donate):
@@ -106,6 +122,29 @@ def fused_step_fn(opt, params_raw, states_raw, donate):
                         "fused_trainer_step")
     _STEP_CACHE[sig] = (opt_ref, fn)
     return fn
+
+
+def tracecheck_programs():
+    """AOT specimens for graftcheck: the donated whole-model fused step
+    over a tiny two-slot model (momentum SGD — weight AND slot state
+    paths exercised), built through the same ``fused_step_fn`` cache the
+    Trainer uses, with the device-backend donation layout."""
+    from .. import ndarray as nd
+    from ..optimizer import SGD
+    opt = SGD(momentum=0.9, learning_rate=0.05)
+    # the compiled step holds the optimizer only via weakref: keep the
+    # specimen alive past this call or the driver's trace would observe
+    # a collected owner
+    _TRACECHECK_KEEPALIVE[:] = [opt]
+    params_nd = [nd.zeros((32, 16)), nd.zeros((32,))]
+    states_raw = [_state_raw(opt.create_state(i, w))
+                  for i, w in enumerate(params_nd)]
+    params_raw = [w._data for w in params_nd]
+    hyper = {"lr": np.zeros(2, np.float32), "wd": np.zeros(2, np.float32),
+             "t": np.ones(2, np.int32), "rescale": np.float32(1.0)}
+    fn = fused_step_fn(opt, params_raw, states_raw, donate=True)
+    return [("fused_trainer_step", fn,
+             (params_raw, params_raw, states_raw, hyper), {})]
 
 
 def run_fused_step(trainer, slots):

@@ -128,6 +128,18 @@ _bucket_reduce = _tel.watch_jit(jax.jit(_bucket_reduce),
                                 "kvstore_bucket_reduce")
 
 
+def tracecheck_programs():
+    """AOT specimens for graftcheck: the two owned kvstore programs — the
+    per-key stack-sum and the bucketed flat reduce (two device copies,
+    two keys of different shapes, like a real small bucket)."""
+    a = jax.ShapeDtypeStruct((64, 32), jnp.float32)
+    b = jax.ShapeDtypeStruct((128,), jnp.float32)
+    return [
+        ("kvstore_stack_sum", _stack_sum, ([a, a],), {}),
+        ("kvstore_bucket_reduce", _bucket_reduce, (((a, b), (a, b)),), {}),
+    ]
+
+
 def _ctx_group_sum(vals):
     """Reduce a list of NDArrays (possibly on different devices).
 

@@ -10,9 +10,16 @@ Runtime sanitizer (``mxnet_tpu.lint.sanitizer``): ``MXNET_SANITIZE=1``
 turns tracer leaks / host-syncs-under-trace and engine-ordering violations
 into hard errors with the offending user frame; ``=warn`` logs instead.
 
+Trace tier (``mxnet_tpu.lint.tracecheck``): ``--trace`` /
+``tools/graftcheck.py`` lowers every owned jit entry point AOT on CPU
+from ShapeDtypeStruct specimens and walks the jaxprs with the JX rules;
+``MXNET_TRACECHECK=1`` runs the same rules (plus the JX105
+retrace-explainer) on every ``watch_jit`` compile event at runtime.
+
 Rules: JG001 host-sync-under-trace, JG002 naked-jit, JG003 retrace-hazard,
-JG004 donation-after-use, JG005 global-PRNG, JG006 env-read-in-hot-path.
-Docs: docs/LINT.md.
+JG004 donation-after-use, JG005 global-PRNG, JG006 env-read-in-hot-path;
+JX101 baked-constant, JX102 dtype-widening, JX103 host-callback, JX104
+donation-waste, JX105 retrace-explainer.  Docs: docs/LINT.md.
 
 The analyzer halves (``core``/``rules``) load lazily (PEP 562): the
 runtime imports ``lint.sanitizer`` on every ``import mxnet_tpu``, and that
@@ -21,9 +28,10 @@ path must not pay for the ast/tokenize machinery it never uses.
 
 _CORE_EXPORTS = ("Baseline", "Finding", "default_baseline_path",
                  "iter_python_files", "lint_file", "lint_paths",
-                 "lint_source", "load_baseline", "repo_root")
+                 "lint_source", "lint_sources", "load_baseline",
+                 "repo_root")
 
-__all__ = list(_CORE_EXPORTS) + ["RULES"]
+__all__ = list(_CORE_EXPORTS) + ["RULES", "TRACE_RULES"]
 
 
 def __getattr__(name):
@@ -33,5 +41,8 @@ def __getattr__(name):
     if name == "RULES":
         from .rules import RULES
         return RULES
+    if name == "TRACE_RULES":
+        from .tracecheck import TRACE_RULES
+        return TRACE_RULES
     raise AttributeError("module %r has no attribute %r"
                          % (__name__, name))

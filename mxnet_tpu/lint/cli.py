@@ -1,5 +1,12 @@
 """graftlint CLI: ``python -m mxnet_tpu.lint`` / ``tools/graftlint.py``.
 
+Two tiers share this front end, its output formats, and the baseline:
+
+* AST tier (default): the JG rules over source files — stdlib-only.
+* Trace tier (``--trace`` / ``tools/graftcheck.py``): the JX rules over
+  the *lowered programs* of every owned jit entry point, AOT on CPU —
+  imports jax and mxnet_tpu.
+
 Exit codes: 0 clean (against the baseline), 1 findings (or stale baseline
 entries under ``--check-baseline``), 2 usage error.
 """
@@ -8,6 +15,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from .core import (Baseline, default_baseline_path, iter_python_files,
@@ -38,7 +46,36 @@ def build_parser():
                         "longer fire (stale-suppression rot)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
+    p.add_argument("--trace", action="store_true",
+                   help="run the trace tier instead: lower every owned "
+                        "XLA entry point AOT (CPU) and run the JX rules "
+                        "over the jaxprs (imports jax; paths select entry "
+                        "groups, e.g. 'executor kvstore')")
+    p.add_argument("--diff", default=None, metavar="GIT_REF",
+                   help="lint only .py files changed vs GIT_REF "
+                        "(working tree included) — fast pre-commit mode")
     return p
+
+
+def _changed_files(root, ref):
+    """Repo-relative .py files changed between *ref* and the working
+    tree — committed, staged, unstaged, AND untracked (a pre-commit run
+    must see the brand-new file that was never ``git add``-ed) — or None
+    on git failure."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    names = set(diff.stdout.splitlines()) | set(untracked.stdout.splitlines())
+    return sorted(n.strip() for n in names if n.strip().endswith(".py"))
 
 
 def main(argv=None):
@@ -48,6 +85,11 @@ def main(argv=None):
         from .rules import RULES
         for code, rule in sorted(RULES.items()):
             print("%s  %-24s %s" % (code, rule.name, rule.rationale))
+        # the JX catalogue lives in tracecheck, which is import-light on
+        # purpose (jax only loads when programs are actually traced)
+        from .tracecheck import TRACE_RULES
+        for code, rule in sorted(TRACE_RULES.items()):
+            print("%s  %-24s %s" % (code, rule.name, rule.rationale))
         return 0
 
     select = None
@@ -55,37 +97,103 @@ def main(argv=None):
         select = {c.strip().upper() for c in args.select.split(",")
                   if c.strip()}
 
-    paths = args.paths or [
-        p for p in (os.path.join(repo_root(), d)
-                    for d in ("mxnet_tpu", "tools", "examples"))
-        if os.path.isdir(p)]
-    for p in paths:
-        if not os.path.exists(p):
-            print("graftlint: no such path: %s" % p, file=sys.stderr)
-            return 2
-
     root = repo_root()
-    files = iter_python_files(paths)
-    if not files:
-        # scanning nothing must not read as lint-passing (a mis-wired CI
-        # hook pointing at a .pyc or an emptied directory)
-        print("graftlint: no Python files under %s" % ", ".join(paths),
+
+    if args.trace and args.diff is not None:
+        # the trace tier analyzes whole programs, not files — a silently
+        # ignored --diff would read as "scoped to my changes" when it ran
+        # everything
+        print("graftcheck: --diff applies to the AST tier only "
+              "(trace programs have no file scope); drop one of the two",
               file=sys.stderr)
         return 2
-    findings = lint_paths(files, select=select, rel_root=root)
 
-    # the scan scope: baseline entries outside it were NOT re-checked, so
-    # they must be neither judged stale nor dropped by --write-baseline.
-    # Entries whose file no longer exists can never fire again — they are
-    # in scope (and therefore stale / rewritten away) on every run.
-    scanned = {os.path.relpath(p, root).replace(os.sep, "/")
-               for p in files}
+    if args.trace:
+        from . import tracecheck
+        entries = None
+        if args.paths:
+            known = {g for g, _m in tracecheck.ENTRY_POINTS}
+            bad = sorted(set(args.paths) - known)
+            if bad:
+                print("graftcheck: unknown entry group(s): %s (known: %s)"
+                      % (", ".join(bad), ", ".join(sorted(known))),
+                      file=sys.stderr)
+                return 2
+            entries = set(args.paths)
+        findings, names = tracecheck.check_entry_points(entries=entries,
+                                                        select=select)
+        scanned = {"trace://%s" % n for n in names} \
+            | {f.path for f in findings}
+        # the full-run staleness sweep covers entries whose program was
+        # renamed away — but a JX000 means some provider DIDN'T run, and
+        # sweeping then would drop that group's entries un-re-checked
+        full_trace = entries is None \
+            and not any(f.rule == "JX000" for f in findings)
+        distinct = sorted(set(names))
+        print("graftcheck: analyzed %d owned program(s) (%d specimen "
+              "trace(s)): %s"
+              % (len(distinct), len(names), ", ".join(distinct)),
+              file=sys.stderr)
+    else:
+        paths = args.paths or [
+            p for p in (os.path.join(repo_root(), d)
+                        for d in ("mxnet_tpu", "tools", "examples"))
+            if os.path.isdir(p)]
+        # validate the scan roots BEFORE --diff filtering: a typo'd root
+        # must stay a usage error, not "no changed files" + exit 0
+        for p in paths:
+            if not os.path.exists(p):
+                print("graftlint: no such path: %s" % p, file=sys.stderr)
+                return 2
+        if args.diff is not None:
+            changed = _changed_files(root, args.diff)
+            if changed is None:
+                print("graftlint: git diff against %r failed" % args.diff,
+                      file=sys.stderr)
+                return 2
+            roots = [os.path.relpath(p, root).replace(os.sep, "/")
+                     for p in paths]
+            paths = [os.path.join(root, rel) for rel in changed
+                     if os.path.exists(os.path.join(root, rel))
+                     and any(rel == r or rel.startswith(r.rstrip("/") + "/")
+                             for r in roots)]
+            if not paths:
+                print("graftlint: no changed Python files vs %s"
+                      % args.diff)
+                return 0
+
+        files = iter_python_files(paths)
+        if not files:
+            # scanning nothing must not read as lint-passing (a mis-wired
+            # CI hook pointing at a .pyc or an emptied directory)
+            print("graftlint: no Python files under %s" % ", ".join(paths),
+                  file=sys.stderr)
+            return 2
+        findings = lint_paths(files, select=select, rel_root=root)
+
+        # the scan scope: baseline entries outside it were NOT re-checked,
+        # so they must be neither judged stale nor dropped by
+        # --write-baseline.  Entries whose file no longer exists can never
+        # fire again — they are in scope (stale / rewritten away) always.
+        scanned = {os.path.relpath(p, root).replace(os.sep, "/")
+                   for p in files}
+        full_trace = False
 
     baseline_path = args.baseline or default_baseline_path()
 
     def scope_of(baseline):
-        return scanned | {path for (_r, path, _s) in baseline.counts
-                          if not os.path.exists(os.path.join(root, path))}
+        extra = set()
+        for (_r, path, _s) in baseline.counts:
+            if path.startswith("trace://"):
+                # trace-tier entries are only re-checked by a FULL --trace
+                # run; an AST run must not judge them stale (and a scoped
+                # trace run only re-checked its own groups)
+                if full_trace:
+                    extra.add(path)
+            elif not args.trace \
+                    and not os.path.exists(os.path.join(root, path)):
+                extra.add(path)
+        return scanned | extra
 
     if args.write_baseline:
         prior = load_baseline(baseline_path)

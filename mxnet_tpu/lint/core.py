@@ -30,8 +30,8 @@ import os
 import re
 import tokenize
 
-__all__ = ["Finding", "SourceModule", "lint_source", "lint_file",
-           "lint_paths", "iter_python_files", "Baseline",
+__all__ = ["Finding", "SourceModule", "lint_source", "lint_sources",
+           "lint_file", "lint_paths", "iter_python_files", "Baseline",
            "load_baseline", "default_baseline_path", "repo_root"]
 
 # codes are comma-separated (spaces allowed around commas only): a
@@ -190,18 +190,52 @@ def iter_python_files(paths):
     return out
 
 
-def lint_source(source, path="<string>", select=None):
-    """Run every (selected) rule over one source string."""
+def _check_module(mod, select):
+    """Run every (selected) rule over one parsed module."""
     from . import rules as _rules
-    mod = SourceModule(path, source)
     findings = []
     for code, rule in sorted(_rules.RULES.items()):
         if select is not None and code not in select:
             continue
         findings.extend(rule.check(mod))
-    findings = [f for f in findings if not mod.suppressed(f)]
+    return [f for f in findings if not mod.suppressed(f)]
+
+
+def _check_project(mods, select):
+    """Run the rules over a set of modules linked as one project: when
+    more than one module is in scope, cross-module `from mxnet_tpu.x
+    import f` edges propagate hot-path and traced-ness between them
+    before any rule runs (JG001/JG006 see through file boundaries)."""
+    from . import rules as _rules
+    if len(mods) > 1:
+        _rules.link_project(mods)
+    findings = []
+    for mod in mods:
+        findings.extend(_check_module(mod, select))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def lint_source(source, path="<string>", select=None):
+    """Run every (selected) rule over one source string."""
+    findings = _check_module(SourceModule(path, source), select)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_sources(named_sources, select=None):
+    """Lint several in-memory modules as ONE project — the cross-module
+    call-graph propagation applies.  *named_sources*: [(path, source)]
+    where the path's dotted form (``pkg/mod.py`` -> ``pkg.mod``) is the
+    import identity other modules resolve against."""
+    mods, findings = [], []
+    for path, source in named_sources:
+        try:
+            mods.append(SourceModule(path, source))
+        except SyntaxError as exc:
+            findings.append(Finding("JG000", path, exc.lineno or 1, 1,
+                                    "file does not parse: %s" % exc.msg))
+    return findings + _check_project(mods, select)
 
 
 def lint_file(path, select=None, rel_root=None):
@@ -216,10 +250,18 @@ def lint_file(path, select=None, rel_root=None):
 
 
 def lint_paths(paths, select=None, rel_root=None):
-    findings = []
+    mods, findings = [], []
     for path in iter_python_files(paths):
-        findings.extend(lint_file(path, select=select, rel_root=rel_root))
-    return findings
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(path, rel_root) if rel_root else path
+        rel = rel.replace(os.sep, "/")
+        try:
+            mods.append(SourceModule(rel, source))
+        except SyntaxError as exc:
+            findings.append(Finding("JG000", rel, exc.lineno or 1, 1,
+                                    "file does not parse: %s" % exc.msg))
+    return findings + _check_project(mods, select)
 
 
 # ---------------------------------------------------------------------------

@@ -44,7 +44,8 @@ import re
 
 from .core import parent
 
-__all__ = ["RULES", "Rule", "register", "ModuleFacts", "HOT_NAME_RE"]
+__all__ = ["RULES", "Rule", "register", "ModuleFacts", "HOT_NAME_RE",
+           "link_project"]
 
 RULES = {}
 
@@ -287,6 +288,159 @@ def _literal_strs(node):
 
 
 # ---------------------------------------------------------------------------
+# cross-module project linking
+# ---------------------------------------------------------------------------
+#
+# The same-module call graph misses the common refactor where step() lives
+# in one file and its helper in another: `from mxnet_tpu.kvstore import f`
+# severs hot-path propagation at the file boundary.  link_project() runs
+# once per multi-file scan, resolves import edges BETWEEN the scanned
+# modules, computes global hot/traced fixpoints over (module, FunctionDef)
+# nodes, and annotates each SourceModule with the defs forced hot (JG006)
+# or traced (JG001) from outside.  Seeds and annotations are def-precise
+# (a jitted inner `def step` must not smear traced-ness onto an unrelated
+# same-named eager method); only call RESOLUTION is by name — a call edge
+# lands on every same-named def in the target module, because the import
+# surface carries no def identity.
+
+def _module_dotted(path):
+    """``mxnet_tpu/gluon/trainer.py`` -> ``mxnet_tpu.gluon.trainer``;
+    ``pkg/__init__.py`` -> ``pkg``.  None for non-.py paths."""
+    if not path.endswith(".py"):
+        return None
+    parts = path[:-3].replace("\\", "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p) or None
+
+
+def _import_targets(mod, modname):
+    """local name -> dotted-target parts, with relative imports resolved
+    against *modname*'s package (AST levels, not the alias-string
+    encoding, which cannot distinguish `from . import x` from `from ..x
+    import f`)."""
+    # an __init__.py IS its package: its dotted name (``pkg``, the
+    # ``__init__`` segment already stripped) is the base one dot resolves
+    # against; for a plain module the base is the containing package
+    if mod.path.replace("\\", "/").endswith("/__init__.py"):
+        package = modname.split(".")
+    else:
+        package = modname.split(".")[:-1]
+    targets = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    targets[a.asname] = a.name.split(".")
+                else:
+                    head = a.name.split(".")[0]
+                    targets[head] = [head]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                if node.level - 1 > len(package):
+                    continue
+                base = package[:len(package) - (node.level - 1)]
+            else:
+                base = []
+            if node.module:
+                base = base + node.module.split(".")
+            for a in node.names:
+                targets[a.asname or a.name] = base + [a.name]
+    return targets
+
+
+def _resolve_call_target(func, imports, defs, modname, index):
+    """(module, funcname) a call lands in, if it is a def in a scanned
+    module — via a bare same-module name, an imported name, or a dotted
+    module alias chain."""
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.reverse()
+    base = imports.get(node.id)
+    if base is None:
+        if not parts and node.id in defs.get(modname, ()):
+            return (modname, node.id)
+        return None
+    full = base + parts
+    for cut in range(len(full) - 1, 0, -1):
+        m = ".".join(full[:cut])
+        if m in index:
+            fn = full[cut]
+            return (m, fn) if fn in defs.get(m, ()) else None
+    return None
+
+
+def _fixpoint(seeds, edges):
+    reached = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        node = frontier.pop()
+        for nxt in edges.get(node, ()):
+            if nxt not in reached:
+                reached.add(nxt)
+                frontier.append(nxt)
+    return reached
+
+
+def link_project(mods):
+    """Annotate each SourceModule in *mods* with ``external_hot`` /
+    ``external_traced``: the FunctionDef nodes on a hot path or under a
+    jax trace once cross-module edges are followed.  Rules consult the
+    annotations lazily, so linking must run before any rule does."""
+    index = {}
+    for mod in mods:
+        name = _module_dotted(mod.path)
+        if name:
+            index[name] = mod
+    if len(index) < 2:
+        return
+    defs = {}
+    for m, mod in index.items():
+        by_name = {}
+        for fd in _facts(mod).funcdefs:
+            by_name.setdefault(fd.name, []).append(fd)
+        defs[m] = by_name
+    edges, hot_seeds, traced_seeds = {}, set(), set()
+    for modname, mod in index.items():
+        facts = _facts(mod)
+        imports = _import_targets(mod, modname)
+        for fd in facts.funcdefs:
+            node = (modname, fd)
+            if HOT_NAME_RE.search(fd.name):
+                hot_seeds.add(node)
+            if fd in facts.traced_defs:
+                traced_seeds.add(node)
+            outs = edges.setdefault(node, set())
+            for sub in ast.walk(fd):
+                if isinstance(sub, ast.Call):
+                    tgt = _resolve_call_target(sub.func, imports, defs,
+                                               modname, index)
+                    if tgt is None:
+                        continue
+                    m, f = tgt
+                    outs.update((m, tfd) for tfd in defs[m].get(f, ())
+                                if (m, tfd) != node)
+    hot = _fixpoint(hot_seeds, edges)
+    traced = _fixpoint(traced_seeds, edges)
+    for modname, mod in index.items():
+        mod.external_hot = {fd for m, fd in hot if m == modname}
+        mod.external_traced = {fd for m, fd in traced if m == modname}
+
+
+def _project_traced_defs(mod, facts):
+    """Defs under a trace once project links are considered: the local
+    (def-precise) analysis plus any def the project fixpoint reached."""
+    traced = set(facts.traced_defs)
+    traced.update(getattr(mod, "external_traced", None) or ())
+    return traced
+
+
+# ---------------------------------------------------------------------------
 # JG001 host-sync-under-trace
 # ---------------------------------------------------------------------------
 
@@ -313,7 +467,7 @@ def _walk_own_body(fd):
           "host materialization inside a jit trace bakes constants into "
           "the compiled program or crashes with a tracer error")
 def _jg001(mod, facts):
-    for fd in facts.traced_defs:
+    for fd in _project_traced_defs(mod, facts):
         for node in _walk_own_body(fd):
             if not isinstance(node, ast.Call):
                 continue
@@ -663,8 +817,11 @@ def _inside_loop(node):
 
 
 def _hot_functions(facts):
-    """Hot seed = hot-looking name; propagate hotness down the same-module
-    call graph (a helper called from step() is on the step path)."""
+    """Hot seed = hot-looking name, or a def the cross-module project
+    link marked hot (its caller's step path runs through another file);
+    propagate hotness down the same-module call graph (a helper called
+    from step() is on the step path)."""
+    external = getattr(facts.mod, "external_hot", None) or ()
     by_name = {}
     for fd in facts.funcdefs:
         by_name.setdefault(fd.name, []).append(fd)
@@ -677,7 +834,8 @@ def _hot_functions(facts):
                 if key and key in by_name:
                     callees.add(key)
         calls_from[fd] = callees
-    hot = {fd for fd in facts.funcdefs if HOT_NAME_RE.search(fd.name)}
+    hot = {fd for fd in facts.funcdefs
+           if HOT_NAME_RE.search(fd.name) or fd in external}
     grew = True
     while grew:
         grew = False

@@ -1,0 +1,645 @@
+"""tracecheck: trace-time jaxpr/HLO analysis of the owned XLA entry points.
+
+graftlint (``rules.py``) works on source text; whole classes of silent
+performance/correctness bugs only exist in the *lowered program* and are
+invisible to an AST pass — a closure-baked weight matrix, an accidental
+f64 widening, a host callback compiled into the train step, a donated
+buffer that can never alias an output.  The reference framework closed
+the same gap with graph-level passes over NNVM IR rather than C++ lint
+(SURVEY layer map; cf. TVM/NNVM graph passes and Grappler's analyzers in
+PAPERS.md).  This module is that tier for the JAX rebuild: it lowers the
+programs the framework actually ships to XLA — AOT, on CPU, from
+``ShapeDtypeStruct`` specimens, no TPU and no real data — and walks the
+resulting jaxprs with a rule registry mirroring graftlint's.
+
+Rule catalogue (rationale in docs/LINT.md):
+
+JX101 baked-constant          large arrays captured by closure become
+                              jaxpr constants: copied into every compiled
+                              variant, silently stale after updates.
+JX102 dtype-widening          f64/i64 appearing in a program whose inputs
+                              are all <=32-bit: 2x HBM + matmul slowdown,
+                              usually one forgotten ``np.float64`` scalar.
+JX103 host-callback           ``pure_callback``/``io_callback``/
+                              ``debug.print`` compiled into an owned hot
+                              program: a host round-trip per step.
+JX104 donation-waste          donated args that cannot alias any output
+                              (buffer freed for nothing), large
+                              non-donated args that alias outputs in a
+                              program that already donates, and dead
+                              (pass-through / constant) outputs.
+JX105 retrace-explainer       on a ``watch_jit`` recompile, diff the new
+                              avals/statics against the cached variants
+                              and NAME the axis that changed — turns the
+                              telemetry retrace-storm warning into a
+                              diagnosis.  Runtime-only (``MXNET_TRACECHECK``).
+
+Two drivers share the registry:
+
+* AOT (``check_entry_points`` / ``tools/graftcheck.py`` /
+  ``python -m mxnet_tpu.lint --trace``): every owned jit entry point
+  declares a ``tracecheck_programs()`` provider next to the jit itself
+  (executor, fused trainer, optimizer, kvstore, module cached step,
+  gluon cached op); the driver traces each with specimen shapes and runs
+  JX101-JX104.  CI gates on zero findings (tests/test_tracecheck_clean.py).
+* Runtime (``on_compile``): ``telemetry._WatchedJit`` calls in on every
+  compile event when ``MXNET_TRACECHECK`` is truthy; findings are booked
+  into the ``tracecheck_findings`` counter, the flight ring, and one
+  structured log line each — JX105 included, because only the runtime
+  hook sees *two* variants to diff.
+
+Import-light on purpose: jax is imported inside functions only, so the
+stdlib-only lint CLI can show the JX catalogue (``--list-rules``) without
+initializing a backend.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from .core import Finding
+
+__all__ = ["TRACE_RULES", "TraceRule", "TraceConfig", "ProgramRecord",
+           "trace_program", "run_rules", "check_entry_points",
+           "iter_owned_programs", "on_compile", "signature",
+           "explain_retrace", "ENTRY_POINTS"]
+# NOTE: the MXNET_TRACECHECK gate itself lives in telemetry.core
+# (_env_tracecheck) — the hook's caller owns the env parsing.
+
+_LOG = logging.getLogger("mxnet_tpu.lint.tracecheck")
+
+_WIDE_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+
+class TraceConfig:
+    """Thresholds for the size-gated rules.
+
+    The defaults are deliberately conservative: the AOT driver runs tiny
+    specimen models, so an owned entry point only fires when it bakes or
+    wastes something *structurally* (a closure-captured table, an
+    unaliasable donation), never because a real model is large.  Tests
+    shrink the thresholds to exercise the rules on toy programs.
+    """
+
+    __slots__ = ("const_bytes", "donation_bytes", "passthrough_bytes")
+
+    def __init__(self, const_bytes=64 << 10, donation_bytes=1 << 20,
+                 passthrough_bytes=64 << 10):
+        self.const_bytes = const_bytes
+        self.donation_bytes = donation_bytes
+        self.passthrough_bytes = passthrough_bytes
+
+
+DEFAULT_CONFIG = TraceConfig()
+
+
+# ---------------------------------------------------------------------------
+# rule registry (mirrors rules.RULES)
+# ---------------------------------------------------------------------------
+
+TRACE_RULES = {}
+
+
+class TraceRule:
+    __slots__ = ("code", "name", "rationale", "_check")
+
+    def __init__(self, code, name, rationale, check):
+        self.code, self.name, self.rationale = code, name, rationale
+        self._check = check
+
+    def check(self, record, config):
+        if self._check is None:        # runtime-only rule (JX105)
+            return []
+        return list(self._check(record, config))
+
+
+def trace_rule(code, name, rationale):
+    def deco(fn):
+        TRACE_RULES[code] = TraceRule(code, name, rationale, fn)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# program record: one traced entry point
+# ---------------------------------------------------------------------------
+
+def _spec(leaf):
+    """ShapeDtypeStruct skeleton of one pytree leaf (python scalars pass
+    through and trace as weak-typed scalars, exactly like at runtime)."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return leaf
+    import jax
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _aval_nbytes(aval):
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    dtype = getattr(aval, "dtype", None)
+    return n * (dtype.itemsize if dtype is not None else 1)
+
+
+def _aval_key(aval):
+    return (tuple(getattr(aval, "shape", ())), str(getattr(aval, "dtype",
+                                                           "?")))
+
+
+def _fmt_aval(aval):
+    return "%s[%s]" % (getattr(aval, "dtype", "?"),
+                       ",".join(str(d) for d in getattr(aval, "shape", ())))
+
+
+class ProgramRecord:
+    """One owned program, traced: jaxpr + flat arg labels/avals/donation."""
+
+    __slots__ = ("name", "origin", "closed_jaxpr", "arg_labels", "in_avals",
+                 "donated", "out_avals")
+
+    def __init__(self, name, origin, closed_jaxpr, arg_labels, in_avals,
+                 donated, out_avals):
+        self.name = name
+        self.origin = origin
+        self.closed_jaxpr = closed_jaxpr
+        self.arg_labels = arg_labels      # flat, parallel to in_avals
+        self.in_avals = in_avals
+        self.donated = donated            # set of flat arg indices
+        self.out_avals = out_avals
+
+    @property
+    def jaxpr(self):
+        return self.closed_jaxpr.jaxpr
+
+    @property
+    def consts(self):
+        return self.closed_jaxpr.consts
+
+    def label(self, i):
+        if 0 <= i < len(self.arg_labels):
+            return self.arg_labels[i]
+        return "arg[%d]" % i
+
+    def finding(self, rule, message, key=""):
+        """A Finding whose fingerprint is stable across runs: the path is
+        the program identity, the snippet a short structural key (NOT the
+        prose message, which may carry sizes that drift)."""
+        return Finding(rule, "trace://%s" % self.name, 0, 0,
+                       "%s [%s]: %s" % (self.name, self.origin, message),
+                       snippet=key or rule)
+
+
+def trace_program(name, fn, args, kwargs=None, origin=""):
+    """Trace *fn* (a jitted callable or its watch_jit wrapper) with
+    ShapeDtypeStruct skeletons of *args*/*kwargs* and return the
+    :class:`ProgramRecord` the JX rules analyze.  Nothing is compiled or
+    executed; lowering metadata supplies per-argument donation flags.
+    """
+    import jax
+    kwargs = dict(kwargs or {})
+    fn = getattr(fn, "_fn", fn)          # unwrap telemetry._WatchedJit
+    sargs, skwargs = jax.tree_util.tree_map(_spec, (tuple(args), kwargs))
+    traced = fn.trace(*sargs, **skwargs)
+    closed = traced.jaxpr
+    lowered = traced.lower()
+
+    flat, _ = jax.tree_util.tree_flatten_with_path((sargs, skwargs))
+    labels = []
+    for path, _leaf in flat:
+        label = jax.tree_util.keystr(path)
+        # keystr yields "[0][1]['lr']": [0]=args/[1]=kwargs bucket, next
+        # index the position — keep it verbatim but drop the bucket
+        labels.append("arg%s" % label[3:] if label.startswith("[0]")
+                      else "kwarg%s" % label[3:])
+
+    donated = set()
+    info_leaves = jax.tree_util.tree_leaves(
+        lowered.args_info, is_leaf=lambda v: hasattr(v, "donated"))
+    for i, info in enumerate(info_leaves):
+        if getattr(info, "donated", False):
+            donated.add(i)
+
+    return ProgramRecord(name, origin, closed, labels,
+                         list(closed.in_avals), donated,
+                         list(closed.out_avals))
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn in *jaxpr* and its nested sub-jaxprs (pjit bodies, scan
+    carries, cond branches, custom-vjp closures, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(eqn):
+    for val in eqn.params.values():
+        yield from _extract_jaxprs(val)
+
+
+def _extract_jaxprs(val):
+    # a ClosedJaxpr has .jaxpr; a raw Jaxpr has .eqns
+    inner = getattr(val, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        yield inner
+    elif hasattr(val, "eqns"):
+        yield val
+    elif isinstance(val, (tuple, list)):
+        for item in val:
+            yield from _extract_jaxprs(item)
+
+
+# ---------------------------------------------------------------------------
+# JX101 baked-constant
+# ---------------------------------------------------------------------------
+
+@trace_rule("JX101", "baked-constant",
+            "large arrays captured by closure become jaxpr constants — "
+            "copied into every compiled variant and silently stale after "
+            "host-side updates; pass them as arguments")
+def _jx101(rec, cfg):
+    for var, const in zip(rec.jaxpr.constvars, rec.consts):
+        nbytes = _aval_nbytes(var.aval)
+        if nbytes < cfg.const_bytes:
+            continue
+        yield rec.finding(
+            "JX101",
+            "%s constant (%d bytes) baked into the program — a closure "
+            "capture; the compiled program holds a frozen copy that host "
+            "mutations never reach. Pass it as an argument instead."
+            % (_fmt_aval(var.aval), nbytes),
+            key="const:%s" % _fmt_aval(var.aval))
+
+
+# ---------------------------------------------------------------------------
+# JX102 dtype-widening
+# ---------------------------------------------------------------------------
+
+@trace_rule("JX102", "dtype-widening",
+            "f64/i64 values inside a program whose inputs are all "
+            "<=32-bit: doubled HBM traffic and slow double-precision "
+            "units, usually one forgotten numpy float64 scalar")
+def _jx102(rec, cfg):
+    def wide(aval):
+        return str(getattr(aval, "dtype", "")) in _WIDE_DTYPES
+
+    if any(wide(a) for a in rec.in_avals):
+        return          # wide inputs: the caller asked for 64-bit
+    seen = set()
+    for var, _const in zip(rec.jaxpr.constvars, rec.consts):
+        if wide(var.aval):
+            key = ("const", str(var.aval.dtype))
+            if key not in seen:
+                seen.add(key)
+                yield rec.finding(
+                    "JX102",
+                    "closure constant is %s while every program input is "
+                    "<=32-bit — the widening happens before the program "
+                    "boundary" % _fmt_aval(var.aval),
+                    key="widen-const:%s" % var.aval.dtype)
+    for eqn in _iter_eqns(rec.jaxpr):
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is None or not wide(aval):
+                continue
+            key = (eqn.primitive.name, str(aval.dtype))
+            if key in seen:
+                continue
+            seen.add(key)
+            yield rec.finding(
+                "JX102",
+                "'%s' produces %s in a program whose inputs are all "
+                "<=32-bit — check for a python float / np.float64 scalar "
+                "or an explicit astype widening the lattice"
+                % (eqn.primitive.name, _fmt_aval(aval)),
+                key="widen:%s:%s" % (eqn.primitive.name, aval.dtype))
+
+
+# ---------------------------------------------------------------------------
+# JX103 host-callback-in-hot-program
+# ---------------------------------------------------------------------------
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback"}
+
+@trace_rule("JX103", "host-callback",
+            "pure_callback/io_callback/debug.print compiled into an owned "
+            "hot program: every execution round-trips through the host — "
+            "the async dispatch pipeline stalls behind python")
+def _jx103(rec, cfg):
+    seen = set()
+    for eqn in _iter_eqns(rec.jaxpr):
+        prim = eqn.primitive.name
+        if prim not in _CALLBACK_PRIMS or prim in seen:
+            continue
+        seen.add(prim)
+        yield rec.finding(
+            "JX103",
+            "'%s' is compiled into this program: a host python call per "
+            "execution. Debug prints belong outside the jit; data-dependent "
+            "host logic belongs between programs, not inside them." % prim,
+            key="callback:%s" % prim)
+
+
+# ---------------------------------------------------------------------------
+# JX104 donation-waste
+# ---------------------------------------------------------------------------
+
+@trace_rule("JX104", "donation-waste",
+            "donated buffers that cannot alias any output (freed for "
+            "nothing), large aliasable args left undonated in a program "
+            "that already donates, and dead pass-through/constant outputs")
+def _jx104(rec, cfg):
+    # multiset of output avals available for aliasing
+    pool = {}
+    for aval in rec.out_avals:
+        key = _aval_key(aval)
+        pool[key] = pool.get(key, 0) + 1
+
+    # donated args consume matching outputs first (they will alias)
+    for i in sorted(rec.donated):
+        aval = rec.in_avals[i]
+        key = _aval_key(aval)
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+        else:
+            yield rec.finding(
+                "JX104",
+                "%s (%s) is donated but no output has a matching "
+                "shape/dtype — XLA frees the buffer without reusing it, "
+                "and the caller lost the ability to read it for nothing"
+                % (rec.label(i), _fmt_aval(aval)),
+                key="donate-unaliasable:%s" % rec.label(i))
+
+    # a program that already donates, leaving a LARGE aliasable arg
+    # undonated, is leaving HBM on the table (grads kept for grad_req=add
+    # are the legitimate exception — suppress or baseline those)
+    if rec.donated:
+        for i, aval in enumerate(rec.in_avals):
+            if i in rec.donated:
+                continue
+            nbytes = _aval_nbytes(aval)
+            if nbytes < cfg.donation_bytes:
+                continue
+            key = _aval_key(aval)
+            if pool.get(key, 0) > 0:
+                pool[key] -= 1
+                yield rec.finding(
+                    "JX104",
+                    "%s (%s, %d bytes) aliases an output aval but is not "
+                    "donated in a program that donates other args — "
+                    "donating it would save one HBM-resident copy"
+                    % (rec.label(i), _fmt_aval(aval), nbytes),
+                    key="donate-missed:%s" % rec.label(i))
+
+    # dead outputs: identity pass-through of an input, or a constant
+    invar_pos = {id(v): i for i, v in enumerate(rec.jaxpr.invars)}
+    for k, var in enumerate(rec.jaxpr.outvars):
+        aval = getattr(var, "aval", None)
+        if aval is None or _aval_nbytes(aval) < cfg.passthrough_bytes:
+            continue
+        if id(var) in invar_pos:
+            i = invar_pos[id(var)]
+            if i in rec.donated:
+                continue   # donated pass-through: XLA aliases it, free
+            yield rec.finding(
+                "JX104",
+                "output #%d (%s) is an unmodified pass-through of input "
+                "%s — XLA must still materialize a fresh output copy; "
+                "drop it from the returns and reuse the input at the "
+                "call site" % (k, _fmt_aval(aval), rec.label(i)),
+                key="dead-output:passthrough:%d" % k)
+        elif hasattr(var, "val"):     # Literal output
+            yield rec.finding(
+                "JX104",
+                "output #%d (%s) is a compile-time constant — computed "
+                "nowhere, transferred every call" % (k, _fmt_aval(aval)),
+                key="dead-output:const:%d" % k)
+
+
+# ---------------------------------------------------------------------------
+# JX105 retrace-explainer (runtime-only; registered for the catalogue)
+# ---------------------------------------------------------------------------
+
+TRACE_RULES["JX105"] = TraceRule(
+    "JX105", "retrace-explainer",
+    "on a watch_jit recompile, diff the new avals/static args against "
+    "the cached variants and name the axis that changed (runtime tier, "
+    "MXNET_TRACECHECK)", None)
+
+
+def signature(args, kwargs):
+    """Flat trace signature of a call: [(label, kind, detail...)] —
+    arrays collapse to shape/dtype, everything else to type + repr."""
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        (tuple(args), dict(kwargs or {})))
+    sig = []
+    for path, leaf in flat:
+        label = jax.tree_util.keystr(path)
+        label = ("arg%s" % label[3:]) if label.startswith("[0]") \
+            else ("kwarg%s" % label[3:])
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((label, "array", tuple(shape), str(dtype)))
+        else:
+            sig.append((label, "static", type(leaf).__name__,
+                        repr(leaf)[:80]))
+    return sig
+
+
+def _diff_entries(old, new):
+    """Human sentences for what changed between two signature entries."""
+    label = new[0]
+    if old[1] == "array" and new[1] == "array":
+        msgs = []
+        if old[2] != new[2]:
+            axes = [("axis %d: %s->%s" % (d, o, n))
+                    for d, (o, n) in enumerate(zip(old[2], new[2]))
+                    if o != n]
+            if len(old[2]) != len(new[2]):
+                axes.append("rank %d->%d" % (len(old[2]), len(new[2])))
+            msgs.append("%s shape %s->%s (%s)"
+                        % (label, old[2], new[2], ", ".join(axes)))
+        if old[3] != new[3]:
+            msgs.append("%s dtype %s->%s" % (label, old[3], new[3]))
+        return msgs
+    if old[1] != new[1]:
+        return ["%s changed kind %s->%s" % (label, old[1], new[1])]
+    if old[2:] != new[2:]:
+        return ["%s static value %s -> %s (each distinct hashable value "
+                "is a separate compiled variant)" % (label, old[3], new[3])]
+    return []
+
+
+def explain_retrace(name, history, new_sig):
+    """Diff *new_sig* against its closest cached variant and name the
+    axis of change.  Returns the one-line diagnosis."""
+    def diffs_against(old):
+        old_map = {e[0]: e for e in old}
+        new_map = {e[0]: e for e in new_sig}
+        out = []
+        for label, entry in new_map.items():
+            if label in old_map:
+                out.extend(_diff_entries(old_map[label], entry))
+            else:
+                out.append("%s appeared (structure change)" % label)
+        for label in old_map:
+            if label not in new_map:
+                out.append("%s disappeared (structure change)" % label)
+        return out
+
+    best = min((diffs_against(old) for old in history), key=len)
+    if not best:
+        return ("recompile of '%s' with no visible shape/dtype/structure "
+                "change — suspect weak_type promotion, sharding change, or "
+                "a non-pytree closure input" % name)
+    shown = "; ".join(best[:4])
+    if len(best) > 4:
+        shown += "; ... %d more" % (len(best) - 4)
+    return ("recompile of '%s' caused by: %s — pad or bucket the changing "
+            "axis so the compiled program is reused" % (name, shown))
+
+
+# ---------------------------------------------------------------------------
+# running rules
+# ---------------------------------------------------------------------------
+
+def run_rules(record, select=None, config=None):
+    cfg = config or DEFAULT_CONFIG
+    findings = []
+    for code, rule in sorted(TRACE_RULES.items()):
+        if select is not None and code not in select:
+            continue
+        findings.extend(rule.check(record, cfg))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# AOT driver over the owned entry points
+# ---------------------------------------------------------------------------
+
+# (group, module) — each module owns jits and exposes tracecheck_programs()
+# yielding (name, fn, args, kwargs) specimens for every program it ships.
+ENTRY_POINTS = (
+    ("kvstore", "mxnet_tpu.kvstore"),
+    ("optimizer", "mxnet_tpu.optimizer"),
+    ("fused_trainer", "mxnet_tpu.gluon.fused_trainer"),
+    ("executor", "mxnet_tpu.executor"),
+    ("module_cached_step", "mxnet_tpu.module.cached_step"),
+    ("gluon_cached_op", "mxnet_tpu.gluon.block"),
+)
+
+
+def iter_owned_programs(entries=None):
+    """Yield (group, ProgramRecord-or-Finding) over every owned entry
+    point.  A provider that fails to build/trace yields a JX000 finding —
+    silent skips would read as coverage."""
+    import importlib
+    for group, modpath in ENTRY_POINTS:
+        if entries is not None and group not in entries:
+            continue
+        origin = modpath.replace(".", "/") + ".py"
+        try:
+            mod = importlib.import_module(modpath)
+            programs = list(mod.tracecheck_programs())
+        except Exception as exc:
+            yield group, Finding(
+                "JX000", "trace://%s" % group, 0, 0,
+                "entry point provider %s failed: %r" % (modpath, exc),
+                snippet="provider:%s" % group)
+            continue
+        for name, fn, args, kwargs in programs:
+            try:
+                yield group, trace_program(name, fn, args, kwargs,
+                                           origin=origin)
+            except Exception as exc:
+                yield group, Finding(
+                    "JX000", "trace://%s" % name, 0, 0,
+                    "tracing '%s' (%s) failed: %r" % (name, origin, exc),
+                    snippet="trace:%s" % name)
+
+
+def check_entry_points(entries=None, select=None, config=None):
+    """Run the JX rules over every owned program; returns (findings,
+    program_names) — names prove coverage to the CI gate."""
+    findings, names = [], []
+    for _group, item in iter_owned_programs(entries):
+        if isinstance(item, Finding):
+            findings.append(item)
+            continue
+        names.append(item.name)
+        findings.extend(run_rules(item, select=select, config=config))
+    findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    return findings, names
+
+
+# ---------------------------------------------------------------------------
+# runtime hook (MXNET_TRACECHECK): called by telemetry on compile events
+# ---------------------------------------------------------------------------
+
+_SIG_HISTORY = {}    # (watch name, id(jit)) -> [signature, ...] (last 8)
+_RUNTIME_CONFIG = DEFAULT_CONFIG
+
+
+def reset_runtime():
+    _SIG_HISTORY.clear()
+
+
+def on_compile(name, fn, args, kwargs):
+    """Analyze the program a watched jit just compiled.
+
+    Called from ``telemetry._WatchedJit`` on cache growth when
+    ``MXNET_TRACECHECK`` is truthy.  JX105 diffs the call signature
+    against this name's previous variants; JX101-JX104 re-trace the
+    function from specs (cheap next to the XLA compile that just
+    happened).  Findings are booked into the ``tracecheck_findings``
+    counter, the flight ring, and one structured log line each; this
+    function never raises into the training step.
+    """
+    findings = []
+    try:
+        sig = signature(args, kwargs)
+    except Exception:
+        sig = None
+    # keyed per jitted fn, not per watch name: distinct programs sharing
+    # a name (a cached op's train/eval pair, every optimizer instance
+    # under "optimizer_update_step") are separate compile caches — their
+    # first compiles are not recompiles of each other
+    history = _SIG_HISTORY.setdefault((name, id(fn)), [])
+    if sig is not None:
+        if history:
+            findings.append(Finding(
+                "JX105", "trace://%s" % name, 0, 0,
+                explain_retrace(name, history, sig), snippet=name))
+        history.append(sig)
+        del history[:-8]
+    try:
+        record = trace_program(name, fn, args, kwargs)
+        findings.extend(run_rules(record, config=_RUNTIME_CONFIG))
+    except Exception:
+        pass                   # analysis must never break a step
+    _book(findings)
+    return findings
+
+
+def _book(findings):
+    if not findings:
+        return
+    try:
+        from .. import telemetry as _tel
+        from ..telemetry import flight as _flight
+        _tel.bump("tracecheck_findings", len(findings))
+        for f in findings:
+            _flight.record("tracecheck", f.rule, detail=f.message[:200])
+            _LOG.warning("tracecheck %s", json.dumps(
+                {"rule": f.rule, "program": f.path[len("trace://"):],
+                 "finding": f.message}, sort_keys=True))
+    except Exception:
+        pass
+
+

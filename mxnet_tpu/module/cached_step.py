@@ -48,6 +48,37 @@ def fused_step_enabled():
         not in ("0", "false", "off", "no")
 
 
+def tracecheck_programs():
+    """AOT specimens for graftcheck: the whole fwd+bwd+update program
+    Module.fit ships, bound to the specimen executor with a momentum-SGD
+    updater (same construction path as the real bind; the constructor
+    never executes anything)."""
+    import jax as _jax
+    from .. import optimizer as opt_mod
+    from ..executor import _tracecheck_executor
+    ex = _tracecheck_executor()
+    updater = opt_mod.get_updater(opt_mod.SGD(momentum=0.9,
+                                              learning_rate=0.05))
+    pnames = [n for n in ex.arg_names if n in set(ex._grad_names)]
+    cts = CachedTrainStep(ex, updater, ["data"] + pnames)
+    spec = lambda a: _jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+    params = [spec(ex.arg_dict[n]) for n in cts._pnames]
+    rest = [spec(ex.arg_dict[n]) for n in cts._rest_names]
+    aux_vals = [spec(ex.aux_dict[n]) for n in ex.aux_names]
+    states = [_jax.tree_util.tree_map(
+        spec, _state_raw(updater.optimizer.create_state(
+            i, ex.arg_dict[n])))
+        for i, n in enumerate(cts._pnames)]
+    key = _random.next_key()
+    n = len(cts._pnames)
+    hyper = {"lr": np.zeros(n, np.float32), "wd": np.zeros(n, np.float32),
+             "t": np.ones(n, np.int32),
+             "key": _jax.ShapeDtypeStruct((n,) + key.shape, key.dtype),
+             "rng": spec(key)}
+    return [("module_cached_step", cts._step_jit,
+             (params, rest, aux_vals, states, hyper), {})]
+
+
 class CachedTrainStep:
     """One compiled train step bound to (executor, updater, param set)."""
 

@@ -33,6 +33,7 @@ from .ops import optim_ops as _kern
 # per-instance jitted update_step programs; kept OUT of the instance so
 # optimizers stay picklable (dist set_optimizer, dump_optimizer states)
 _JIT_UPDATE_CACHE = weakref.WeakKeyDictionary()
+_TRACECHECK_KEEPALIVE = []    # graftcheck specimen optimizers (see below)
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
            "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum",
@@ -272,33 +273,63 @@ class Optimizer:
             # traced fn would freeze one key into the compiled program
             from . import random as _random
             hyper["key"] = _random.next_key()
-        import jax
-        # cache key: static scalar hypers are BAKED into the trace, so a
-        # mid-training mutation (opt.clip_gradient = ...) must rebuild.
-        # Recomputing the fingerprint here costs a ~20-attr scan per slot
-        # — micro vs the jit dispatch it gates, and the price of honoring
-        # mutations without a __setattr__ hook on every optimizer.
-        statics = static_hypers(self)
-        cached = _JIT_UPDATE_CACHE.get(self)
-        if cached is None or cached[0] != statics:
-            # weakref.proxy: the cached value must not strongly reference
-            # the key or this WeakKeyDictionary can never evict
-            _self = weakref.proxy(self)
-
-            def _step(w, g, s, h):
-                prev = _self.rescale_grad
-                _self.rescale_grad = h["rescale"]   # trace-time only
-                try:
-                    return _self.update_step(w, g, s, h)
-                finally:
-                    _self.rescale_grad = prev
-            cached = (statics,
-                      _tel.watch_jit(jax.jit(_step), "optimizer_update_step"))
-            _JIT_UPDATE_CACHE[self] = cached
-        new_w, new_state = cached[1](weight._data, grad._data,
-                                     _state_raw(state), hyper)
+        new_w, new_state = _jitted_update_step(self)(
+            weight._data, grad._data, _state_raw(state), hyper)
         weight._set_data(new_w)
         _state_writeback(state, new_state)
+
+
+def _jitted_update_step(opt):
+    """The per-slot jitted update program for *opt*.
+
+    Shared by ``Optimizer.update`` (the eager per-slot hot path) and the
+    graftcheck AOT driver (``tracecheck_programs``), so the program the
+    trace tier analyzes IS the program the framework ships.
+
+    Cache key: static scalar hypers are BAKED into the trace, so a
+    mid-training mutation (opt.clip_gradient = ...) must rebuild.
+    Recomputing the fingerprint here costs a ~20-attr scan per slot
+    — micro vs the jit dispatch it gates, and the price of honoring
+    mutations without a __setattr__ hook on every optimizer.
+    """
+    import jax
+    statics = static_hypers(opt)
+    cached = _JIT_UPDATE_CACHE.get(opt)
+    if cached is None or cached[0] != statics:
+        # weakref.proxy: the cached value must not strongly reference
+        # the key or this WeakKeyDictionary can never evict
+        _self = weakref.proxy(opt)
+
+        def _step(w, g, s, h):
+            prev = _self.rescale_grad
+            _self.rescale_grad = h["rescale"]   # trace-time only
+            try:
+                return _self.update_step(w, g, s, h)
+            finally:
+                _self.rescale_grad = prev
+        cached = (statics,
+                  _tel.watch_jit(jax.jit(_step), "optimizer_update_step"))
+        _JIT_UPDATE_CACHE[opt] = cached
+    return cached[1]
+
+
+def tracecheck_programs():
+    """AOT specimens for graftcheck: the per-slot jitted update program,
+    for a momentum-SGD and an Adam instance (one no-state and one
+    multi-slot-state layout)."""
+    specimens = []
+    # the jitted step references its optimizer via weakref.proxy: pin the
+    # specimens so the driver's later trace doesn't observe a dead owner
+    _TRACECHECK_KEEPALIVE[:] = [SGD(momentum=0.9, learning_rate=0.05),
+                                Adam(learning_rate=1e-3)]
+    for opt in _TRACECHECK_KEEPALIVE:
+        w = nd.zeros((16, 8))
+        state = opt.create_state(0, w)
+        hyper = {"lr": 0.05, "wd": 0.0, "t": 1,
+                 "rescale": np.float32(1.0)}
+        specimens.append(("optimizer_update_step", _jitted_update_step(opt),
+                          (w._data, w._data, _state_raw(state), hyper), {}))
+    return specimens
 
 
 @register

@@ -101,8 +101,14 @@ def _env_max_events():
         return 200_000
 
 
+def _env_tracecheck():
+    return os.environ.get("MXNET_TRACECHECK", "0").strip().lower() \
+        in _TRUTHY
+
+
 _ENABLED = _env_enabled()
 _RETRACE_LIMIT = _env_retrace_limit()
+_TRACECHECK = _env_tracecheck()
 _PROF_RUNNING = False          # mirrored by profiler.set_state
 
 
@@ -130,11 +136,13 @@ def configure(enabled=None, retrace_limit=None, max_events=None):
 
 
 def refresh_from_env():
-    """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_RETRACE_LIMIT (and, when
-    the cost module is loaded, its MXNET_PEAK_* overrides)."""
-    global _ENABLED, _RETRACE_LIMIT
+    """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_RETRACE_LIMIT /
+    MXNET_TRACECHECK (and, when the cost module is loaded, its
+    MXNET_PEAK_* overrides)."""
+    global _ENABLED, _RETRACE_LIMIT, _TRACECHECK
     _ENABLED = _env_enabled()
     _RETRACE_LIMIT = _env_retrace_limit()
+    _TRACECHECK = _env_tracecheck()
     _costs().refresh_from_env()
 
 
@@ -322,6 +330,8 @@ COUNTERS = {
                             "ordering)",
     "flight_dumps": "flight-recorder post-mortem files written (crash, "
                     "signal, hang, or manual)",
+    "tracecheck_findings": "trace-tier (JX rule) findings booked by the "
+                           "MXNET_TRACECHECK compile hook",
 }
 
 GAUGES = {
@@ -532,7 +542,10 @@ class _WatchedJit:
         self._max_seen = 0
 
     def __call__(self, *args, **kwargs):
-        if not _ENABLED:
+        # MXNET_TRACECHECK rides the same compile-event detection even
+        # with telemetry off (its findings are counter-booked, and
+        # counters are always on)
+        if not (_ENABLED or _TRACECHECK):
             return self._fn(*args, **kwargs)
         size_fn = getattr(self._fn, "_cache_size", None)
         if size_fn is None:
@@ -555,11 +568,18 @@ class _WatchedJit:
                 # variants per name so a retrace STORM — many compiles,
                 # exactly when extra compile time hurts most — stops
                 # paying after variant 3
+                # (skipped entirely on the MXNET_TRACECHECK-only path:
+                # the captured flops/bytes are only ever read by step
+                # spans, which need telemetry on — don't pay a second
+                # XLA compile for numbers nobody will consume)
                 cost = None
-                if after <= 3 or self._name not in _PROGRAM_COSTS:
+                if _ENABLED and (after <= 3
+                                 or self._name not in _PROGRAM_COSTS):
                     cost = _capture_cost(self._fn, self._name,
                                          args, kwargs)
                 _record_compile(self._name, wall, after, cost)
+                if _TRACECHECK:
+                    _run_tracecheck(self._name, self._fn, args, kwargs)
         # cost window: a step span is open on this process — attribute
         # this program execution's FLOPs/bytes to it (dict .get + two
         # float adds; the window is None outside step spans)
@@ -578,6 +598,18 @@ class _WatchedJit:
 def watch_jit(fn, name):
     """Register *fn* (a ``jax.jit`` product) with the retrace watchdog."""
     return _WatchedJit(fn, name)
+
+
+def _run_tracecheck(name, fn, args, kwargs):
+    """MXNET_TRACECHECK compile hook: hand the freshly compiled program
+    to the lint trace tier (JX rules + the JX105 retrace explainer).
+    Lazy import — the lint package must never load on the normal path —
+    and exception-proof: analysis must never break a training step."""
+    try:
+        from ..lint import tracecheck as _tc
+        _tc.on_compile(name, fn, args, kwargs)
+    except Exception:
+        pass
 
 
 # --------------------------------------------------------------------------

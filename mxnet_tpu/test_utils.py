@@ -17,6 +17,7 @@ import numpy as np
 from .base import MXNetError
 from .context import Context, cpu, current_context
 from . import ndarray as nd
+from . import random as _random
 from .ndarray import NDArray
 from . import symbol as sym
 from .symbol import Symbol
@@ -89,22 +90,32 @@ def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
            a[index] if a.shape else a, names[1], b[index] if b.shape else b))
 
 
+def _randint(low, high, size=None):
+    """Seed-governed integer draw: ``integers`` on the post-seed
+    Generator, ``randint`` on the pre-seed legacy ``np.random`` module
+    (the one draw whose name differs between the two surfaces)."""
+    rng = _random.host_rng()
+    draw = getattr(rng, "integers", None) or rng.randint
+    return draw(low, high, size=size)
+
+
 def rand_shape_nd(ndim, dim=10):
-    return tuple(np.random.randint(1, dim + 1, size=ndim))
+    return tuple(int(d) for d in _randint(1, dim + 1, size=ndim))
 
 
 def rand_shape_2d(dim0=10, dim1=10):
-    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1))
+    return tuple(int(_randint(1, d + 1)) for d in (dim0, dim1))
 
 
 def rand_shape_3d(dim0=10, dim1=10, dim2=10):
-    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+    return tuple(int(_randint(1, d + 1)) for d in (dim0, dim1, dim2))
 
 
 def random_arrays(*shapes):
     """Random numpy float32 arrays (reference test_utils.py)."""
-    arrays = [np.random.randn(*s).astype(np.float32) if s else
-              np.float32(np.random.randn()) for s in shapes]
+    rng = _random.host_rng()
+    arrays = [rng.standard_normal(s).astype(np.float32) if s else
+              np.float32(rng.standard_normal()) for s in shapes]
     return arrays[0] if len(arrays) == 1 else arrays
 
 
@@ -112,7 +123,7 @@ def rand_ndarray(shape, stype="default", density=None, dtype=None,
                  ctx=None):
     """Random dense/sparse NDArray (reference rand_ndarray/rand_sparse)."""
     if stype == "default":
-        return nd.array(np.random.uniform(-1, 1, shape), ctx=ctx,
+        return nd.array(_random.host_rng().uniform(-1, 1, shape), ctx=ctx,
                         dtype=dtype or np.float32)
     arr, _ = rand_sparse_ndarray(shape, stype, density=density, dtype=dtype)
     return arr
@@ -130,11 +141,12 @@ def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
         if rsp_indices is not None:
             indices = np.asarray(rsp_indices)
         else:
-            idx_mask = np.random.rand(num_rows) < density
+            idx_mask = _random.host_rng().random(num_rows) < density
             indices = np.nonzero(idx_mask)[0]
         dense = np.zeros(shape, dtype=dtype)
         if len(indices):
-            vals = np.random.uniform(-1, 1, (len(indices),) + shape[1:])
+            vals = _random.host_rng().uniform(
+                -1, 1, (len(indices),) + shape[1:])
             if data_init is not None:
                 vals[:] = data_init
             dense[indices] = vals
@@ -143,8 +155,9 @@ def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
             if len(indices) else sp.zeros("row_sparse", shape, dtype=dtype)
         return arr, dense
     if stype == "csr":
-        dense = np.random.uniform(0, 1, shape).astype(dtype)
-        dense[np.random.rand(*shape) >= density] = 0
+        rng = _random.host_rng()
+        dense = rng.uniform(0, 1, shape).astype(dtype)
+        dense[rng.random(shape) >= density] = 0
         arr = sp.csr_matrix(dense, shape=shape, dtype=dtype)
         return arr, dense
     raise ValueError("unknown stype %s" % stype)
@@ -373,7 +386,9 @@ def retry(n):
                 except AssertionError as e:
                     if i == n - 1:
                         raise e
-                    np.random.seed(np.random.randint(0, 100000))
+                    # perturb the framework seed so the retry draws fresh
+                    # data (host Generator AND traced key stream move)
+                    _random.seed(int(_randint(0, 100000)))
         return wrapper
     return decorate
 

@@ -15,7 +15,17 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, profiler
-from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import fused_trainer, nn
+
+
+def _set_fused_env(value):
+    """Set/unset MXNET_FUSED_TRAINER and refresh the import-time cached
+    bool (the JG006 cached-value pattern) so the change takes effect."""
+    if value is None:
+        os.environ.pop("MXNET_FUSED_TRAINER", None)
+    else:
+        os.environ["MXNET_FUSED_TRAINER"] = value
+    fused_trainer.refresh_from_env()
 
 
 def _net(n_layers=3, width=8):
@@ -30,7 +40,7 @@ def _train(optimizer, opt_params, fused, steps=4, n_layers=3, width=8,
            batch_size=16, kvstore="device", lr_schedule=None, seed=0):
     """Run a small regression net for *steps*; return params + states."""
     prev_env = os.environ.get("MXNET_FUSED_TRAINER")
-    os.environ["MXNET_FUSED_TRAINER"] = "1" if fused else "0"
+    _set_fused_env("1" if fused else "0")
     try:
         np.random.seed(seed)
         mx.random.seed(seed)
@@ -68,10 +78,7 @@ def _train(optimizer, opt_params, fused, steps=4, n_layers=3, width=8,
             states[idx] = leaves
         return params, states, trainer
     finally:
-        if prev_env is None:
-            del os.environ["MXNET_FUSED_TRAINER"]
-        else:
-            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+        _set_fused_env(prev_env)
 
 
 def _assert_bitwise(fast, slow, what):
@@ -129,7 +136,7 @@ def test_fused_program_call_count():
     """>= 20-parameter model, one step: <= 4 XLA program calls
     (ISSUE 1 acceptance gate, via the new profiler counters)."""
     prev_env = os.environ.get("MXNET_FUSED_TRAINER")
-    os.environ["MXNET_FUSED_TRAINER"] = "1"
+    _set_fused_env("1")
     try:
         np.random.seed(0)
         net = _net(n_layers=12, width=8)   # 12 Dense layers -> 24 params
@@ -154,17 +161,14 @@ def test_fused_program_call_count():
         assert calls <= 4, "fused step issued %d program calls" % calls
         assert profiler.counter("trainer_fused_step") >= 2
     finally:
-        if prev_env is None:
-            del os.environ["MXNET_FUSED_TRAINER"]
-        else:
-            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+        _set_fused_env(prev_env)
 
 
 def test_loop_program_call_count_is_per_slot():
     """The fallback loop really is O(n_params) — the collapse the fused
     path claims is measurable, not definitional."""
     prev_env = os.environ.get("MXNET_FUSED_TRAINER")
-    os.environ["MXNET_FUSED_TRAINER"] = "0"
+    _set_fused_env("0")
     try:
         np.random.seed(0)
         net = _net(n_layers=12, width=8)
@@ -183,10 +187,7 @@ def test_loop_program_call_count_is_per_slot():
         delta = profiler.counter("xla_program_calls") - before
         assert delta >= n_params
     finally:
-        if prev_env is None:
-            del os.environ["MXNET_FUSED_TRAINER"]
-        else:
-            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+        _set_fused_env(prev_env)
 
 
 def test_ignore_stale_grad():
@@ -229,14 +230,11 @@ def test_ignore_stale_grad():
 def test_stale_grad_loop_path_parity():
     """ignore_stale_grad behaves identically on the fallback loop."""
     prev_env = os.environ.get("MXNET_FUSED_TRAINER")
-    os.environ["MXNET_FUSED_TRAINER"] = "0"
+    _set_fused_env("0")
     try:
         test_ignore_stale_grad()
     finally:
-        if prev_env is None:
-            del os.environ["MXNET_FUSED_TRAINER"]
-        else:
-            os.environ["MXNET_FUSED_TRAINER"] = prev_env
+        _set_fused_env(prev_env)
 
 
 def test_loop_path_honors_hyper_mutation():

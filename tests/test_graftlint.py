@@ -589,3 +589,263 @@ def test_engine_hb_cancelled_push_does_not_poison_ordering(sanitize_raise):
         assert int(w) not in eng._graftlint_hb.vars   # ...and reaps
     finally:
         eng.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-module project linking (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+from mxnet_tpu.lint import lint_sources  # noqa: E402
+
+
+def project_codes(named, select=None):
+    findings = lint_sources(
+        [(path, textwrap.dedent(src)) for path, src in named], select)
+    return [(f.path, f.rule) for f in findings]
+
+
+def test_cross_module_jg001_through_import_edge():
+    """A jitted step in one file calls a helper imported from another:
+    the host sync inside the helper fires JG001 in the helper's file."""
+    helper = """
+    def normalize(x):
+        scale = float(x.mean())       # host sync when called under trace
+        return x / scale
+    """
+    step = """
+    import jax
+    from mxnet_tpu.helpers_mod import normalize
+
+    @jax.jit
+    def step(x):
+        return normalize(x) * 2.0
+    """
+    found = project_codes([("mxnet_tpu/helpers_mod.py", helper),
+                           ("mxnet_tpu/step_mod.py", step)], {"JG001"})
+    assert ("mxnet_tpu/helpers_mod.py", "JG001") in found
+
+
+def test_cross_module_jg001_quiet_without_traced_caller():
+    """Same two files, but the caller is NOT jitted: the helper's float()
+    is ordinary eager host code — no finding in either file."""
+    helper = """
+    def normalize(x):
+        scale = float(x.mean())
+        return x / scale
+    """
+    caller = """
+    from mxnet_tpu.helpers_mod import normalize
+
+    def evaluate(x):
+        return normalize(x) * 2.0
+    """
+    assert project_codes([("mxnet_tpu/helpers_mod.py", helper),
+                          ("mxnet_tpu/eval_mod.py", caller)],
+                         {"JG001"}) == []
+
+
+def test_cross_module_jg006_hot_path_through_import_edge():
+    """step() in one file calls a flag helper imported from another: the
+    env read inside the helper is now on the step path -> JG006 there."""
+    flags = """
+    import os
+
+    def fused_enabled():
+        return os.environ.get("FUSED", "1") == "1"
+    """
+    trainer = """
+    from mxnet_tpu.flags_mod import fused_enabled
+
+    def step(batch):
+        if fused_enabled():
+            return batch
+        return None
+    """
+    found = project_codes([("mxnet_tpu/flags_mod.py", flags),
+                           ("mxnet_tpu/trainer_mod.py", trainer)],
+                          {"JG006"})
+    assert ("mxnet_tpu/flags_mod.py", "JG006") in found
+
+
+def test_cross_module_jg006_quiet_off_the_hot_path():
+    flags = """
+    import os
+
+    def fused_enabled():
+        return os.environ.get("FUSED", "1") == "1"
+    """
+    setup = """
+    from mxnet_tpu.flags_mod import fused_enabled
+
+    def build_config():
+        return {"fused": fused_enabled()}
+    """
+    assert project_codes([("mxnet_tpu/flags_mod.py", flags),
+                          ("mxnet_tpu/setup_mod.py", setup)],
+                         {"JG006"}) == []
+
+
+def test_cross_module_relative_import_from_package_init():
+    """An __init__.py IS its package: ``from .flags_mod import f`` there
+    resolves against the package itself, not its parent — the edge from a
+    hot def in __init__.py must reach the helper's file."""
+    flags = """
+    import os
+
+    def fused_enabled():
+        return os.environ.get("FUSED", "1") == "1"
+    """
+    init = """
+    from .flags_mod import fused_enabled
+
+    def step(batch):
+        if fused_enabled():
+            return batch
+        return None
+    """
+    found = project_codes([("mxnet_tpu/flags_mod.py", flags),
+                           ("mxnet_tpu/__init__.py", init)],
+                          {"JG006"})
+    assert ("mxnet_tpu/flags_mod.py", "JG006") in found
+
+
+def test_cross_module_linking_is_def_precise():
+    """A jitted inner `def step` must not smear traced-ness onto an
+    unrelated same-named eager method (the ShardedTrainer.step false
+    positive): the eager step's float() stays quiet, in a linked
+    multi-module project."""
+    sharded = """
+    import jax
+
+    def make_step(fn):
+        def step(params, batch):
+            return fn(params, batch)
+        return jax.jit(step)
+
+    class Trainer:
+        def step(self, batch):
+            loss = self._fn(batch)
+            return float(loss)        # step-boundary sync: legitimate
+    """
+    other = """
+    from mxnet_tpu.sharded_mod import make_step
+
+    def build(fn):
+        return make_step(fn)
+    """
+    assert project_codes([("mxnet_tpu/sharded_mod.py", sharded),
+                          ("mxnet_tpu/build_mod.py", other)],
+                         {"JG001"}) == []
+
+
+def test_single_file_scan_has_no_cross_module_annotations():
+    """lint_source (one module) must behave exactly as before the
+    project linker existed — linking requires >= 2 modules."""
+    src = """
+    import os
+
+    def helper():
+        return os.environ.get("FLAG")
+    """
+    assert codes(src, {"JG006"}) == []
+
+
+# ---------------------------------------------------------------------------
+# --diff mode (ISSUE 5 satellite): pre-commit-speed scans
+# ---------------------------------------------------------------------------
+
+def _git(repo, *argv):
+    subprocess.run(
+        ["git", "-C", str(repo)] + list(argv), check=True,
+        capture_output=True,
+        env=dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                 GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t"))
+
+
+def _run_cli(argv):
+    import io
+    from contextlib import redirect_stdout
+    from mxnet_tpu.lint import cli
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(argv)
+    return rc, buf.getvalue()
+
+
+def test_diff_mode_lints_only_changed_files(tmp_path, monkeypatch):
+    """--diff <ref> scans exactly the .py files changed vs the ref: a
+    committed-dirty-but-untouched file is skipped, a working-tree edit is
+    caught — the contract that makes it safe as a fast pre-commit hook."""
+    from mxnet_tpu.lint import cli
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    _git(tmp_path, "init", "-q")
+    (pkg / "changed.py").write_text("x = 1\n")
+    (pkg / "legacy.py").write_text(
+        "import numpy as np\nv = np.random.rand(3)\n")       # JG005
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+
+    # nothing changed: clean exit, nothing scanned (NOT a usage error)
+    rc, out = _run_cli(["--diff", "HEAD", "--no-baseline"])
+    assert rc == 0 and "no changed Python files" in out
+
+    # a working-tree edit introduces a finding -> caught; legacy.py's
+    # pre-existing finding is out of the diff -> not reported
+    (pkg / "changed.py").write_text(
+        "import numpy as np\ny = np.random.rand(3)\n")
+    rc, out = _run_cli(["--diff", "HEAD", "--no-baseline", "-f", "json"])
+    assert rc == 1
+    paths = {f["path"] for f in json.loads(out)["new"]}
+    assert paths == {"mxnet_tpu/changed.py"}
+
+
+def test_diff_mode_bad_ref_is_usage_error(tmp_path, monkeypatch):
+    from mxnet_tpu.lint import cli
+    (tmp_path / "mxnet_tpu").mkdir()
+    _git(tmp_path, "init", "-q")
+    monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+    rc, _out = _run_cli(["--diff", "no-such-ref", "--no-baseline"])
+    assert rc == 2
+
+
+def test_diff_mode_bad_path_is_usage_error(tmp_path, monkeypatch):
+    """A typo'd scan root under --diff must stay exit 2 — falling through
+    to 'no changed Python files' + exit 0 would silently disable lint in
+    a pre-commit hook forever."""
+    from mxnet_tpu.lint import cli
+    (tmp_path / "mxnet_tpu").mkdir()
+    _git(tmp_path, "init", "-q")
+    monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+    rc, _out = _run_cli(["--diff", "HEAD", "--no-baseline",
+                         str(tmp_path / "mxnet_tpo")])
+    assert rc == 2
+
+
+def test_diff_mode_catches_untracked_files(tmp_path, monkeypatch):
+    """A brand-new file that was never ``git add``-ed is exactly what a
+    pre-commit run must see — ``git diff`` alone would skip it."""
+    from mxnet_tpu.lint import cli
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    _git(tmp_path, "init", "-q")
+    (pkg / "old.py").write_text("x = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+
+    (pkg / "brand_new.py").write_text(
+        "import numpy as np\nz = np.random.rand(3)\n")        # JG005
+    rc, out = _run_cli(["--diff", "HEAD", "--no-baseline", "-f", "json"])
+    assert rc == 1
+    paths = {f["path"] for f in json.loads(out)["new"]}
+    assert paths == {"mxnet_tpu/brand_new.py"}
+
+
+def test_trace_rejects_diff_as_usage_error(capsys):
+    """--trace analyzes whole programs, not files; silently ignoring
+    --diff would read as 'scoped to my changes' when it ran everything."""
+    rc, _out = _run_cli(["--trace", "--diff", "HEAD"])
+    assert rc == 2
+    assert "AST tier only" in capsys.readouterr().err

@@ -1,0 +1,332 @@
+"""tracecheck (the JX trace tier): per-rule fixtures + runtime hook.
+
+Every AOT JX rule gets a seeded BAD program that fires and a clean twin
+that stays quiet (ISSUE 5 acceptance) — the programs are traced for real
+through ``tracecheck.trace_program`` (jax.jit + ShapeDtypeStruct, nothing
+executed), not mocked jaxprs.  JX105 is exercised both as a unit
+(``explain_retrace`` names the changed axis) and end-to-end through the
+``MXNET_TRACECHECK`` compile hook off ``telemetry.watch_jit``.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.lint import tracecheck
+from mxnet_tpu.lint.tracecheck import (TraceConfig, explain_retrace,
+                                       run_rules, signature, trace_program)
+
+# toy-sized thresholds: the fixtures below are a few KB, not the MBs the
+# production defaults gate on
+CFG = TraceConfig(const_bytes=256, donation_bytes=64, passthrough_bytes=64)
+
+
+def rules_for(fn, args, select, config=CFG, kwargs=None):
+    rec = trace_program("fixture", fn, args, kwargs)
+    return [f.rule for f in run_rules(rec, select={select}, config=config)]
+
+
+def spec(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# JX101 baked-constant
+# ---------------------------------------------------------------------------
+
+def test_jx101_fires_on_closure_baked_array():
+    table = jnp.asarray(np.ones((16, 16), np.float32))     # 1 KiB const
+
+    def fwd(x):
+        return x @ table
+
+    assert "JX101" in rules_for(jax.jit(fwd), (spec((4, 16)),), "JX101")
+
+
+def test_jx101_quiet_when_passed_as_argument():
+    def fwd(x, table):
+        return x @ table
+
+    assert rules_for(jax.jit(fwd), (spec((4, 16)), spec((16, 16))),
+                     "JX101") == []
+
+
+def test_jx101_quiet_below_threshold():
+    scale = jnp.asarray(np.float32(3.0))    # tiny closure scalar: fine
+
+    def fwd(x):
+        return x * scale
+
+    assert rules_for(jax.jit(fwd), (spec((4, 16)),), "JX101") == []
+
+
+# ---------------------------------------------------------------------------
+# JX102 dtype-widening
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def x64():
+    # f64 exists only with x64 enabled; restore so no other test sees it
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
+def test_jx102_fires_on_widening_from_f32_inputs(x64):
+    def fwd(x):
+        acc = x.astype(jnp.float64)          # the forgotten widening
+        return (acc * 2.0).sum().astype(jnp.float32)
+
+    assert "JX102" in rules_for(jax.jit(fwd), (spec((4, 16)),), "JX102")
+
+
+def test_jx102_quiet_on_all_f32(x64):
+    def fwd(x):
+        return (x * 2.0).sum()
+
+    assert rules_for(jax.jit(fwd), (spec((4, 16)),), "JX102") == []
+
+
+def test_jx102_quiet_when_caller_asked_for_f64(x64):
+    # wide INPUTS mean 64-bit was requested — not an accident to report
+    def fwd(x):
+        return (x * 2.0).sum()
+
+    assert rules_for(jax.jit(fwd), (spec((4, 16), jnp.float64),),
+                     "JX102") == []
+
+
+# ---------------------------------------------------------------------------
+# JX103 host-callback-in-hot-program
+# ---------------------------------------------------------------------------
+
+def test_jx103_fires_on_debug_print():
+    def fwd(x):
+        jax.debug.print("x sum {}", x.sum())
+        return x * 2.0
+
+    assert "JX103" in rules_for(jax.jit(fwd), (spec((4, 16)),), "JX103")
+
+
+def test_jx103_fires_on_pure_callback():
+    def fwd(x):
+        y = jax.pure_callback(lambda a: np.asarray(a) * 2.0,
+                              jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1.0
+
+    assert "JX103" in rules_for(jax.jit(fwd), (spec((4, 16)),), "JX103")
+
+
+def test_jx103_quiet_on_pure_program():
+    def fwd(x):
+        return x * 2.0
+
+    assert rules_for(jax.jit(fwd), (spec((4, 16)),), "JX103") == []
+
+
+# ---------------------------------------------------------------------------
+# JX104 donation-waste
+# ---------------------------------------------------------------------------
+
+def test_jx104_fires_on_unaliasable_donation():
+    # donated (64,) input but the only output is a scalar: freed for
+    # nothing, and the caller lost the buffer
+    def fwd(s):
+        return s.sum()
+
+    assert "JX104" in rules_for(jax.jit(fwd, donate_argnums=0),
+                                (spec((64,)),), "JX104")
+
+
+def test_jx104_fires_on_missed_donation():
+    # b is donated, a is just as aliasable and large — one HBM copy wasted
+    def fwd(a, b):
+        return a + 1.0, b + 1.0
+
+    assert "JX104" in rules_for(jax.jit(fwd, donate_argnums=1),
+                                (spec((64,)), spec((64,))), "JX104")
+
+
+def test_jx104_fires_on_passthrough_output():
+    def fwd(a, b):
+        return a, a + b
+
+    assert "JX104" in rules_for(jax.jit(fwd),
+                                (spec((64,)), spec((64,))), "JX104")
+
+
+def test_jx104_quiet_on_full_donation():
+    def fwd(a, b):
+        return a + 1.0, b + 1.0
+
+    assert rules_for(jax.jit(fwd, donate_argnums=(0, 1)),
+                     (spec((64,)), spec((64,))), "JX104") == []
+
+
+def test_jx104_quiet_on_donated_passthrough():
+    # a donated pass-through aliases for free — nothing to report
+    def fwd(a):
+        return a, a.sum()
+
+    assert rules_for(jax.jit(fwd, donate_argnums=0),
+                     (spec((64,)),), "JX104") == []
+
+
+# ---------------------------------------------------------------------------
+# JX105 retrace-explainer
+# ---------------------------------------------------------------------------
+
+def test_jx105_names_the_changed_axis():
+    old = signature((np.zeros((8, 64), np.float32),), {})
+    new = signature((np.zeros((16, 64), np.float32),), {})
+    msg = explain_retrace("step", [old], new)
+    assert "axis 0: 8->16" in msg and "step" in msg
+
+
+def test_jx105_names_dtype_and_static_changes():
+    old = signature((np.zeros(4, np.float32),), {"mode": "train"})
+    new_dtype = signature((np.zeros(4, np.float16),), {"mode": "train"})
+    assert "float32->float16" in explain_retrace("s", [old], new_dtype)
+    new_static = signature((np.zeros(4, np.float32),), {"mode": "eval"})
+    assert "static value" in explain_retrace("s", [old], new_static)
+
+
+def test_jx105_diffs_against_closest_variant():
+    # two cached variants; the new call matches one except for ONE axis —
+    # the diagnosis must name that axis, not diff the farther variant
+    a = signature((np.zeros((8, 64), np.float32),), {})
+    b = signature((np.zeros((8, 32), np.float16),), {})
+    new = signature((np.zeros((9, 64), np.float32),), {})
+    msg = explain_retrace("step", [a, b], new)
+    assert "axis 0: 8->9" in msg and "float16" not in msg
+
+
+def test_jx105_no_visible_change_message():
+    sig = signature((np.zeros(4, np.float32),), {})
+    assert "no visible" in explain_retrace("step", [sig], sig)
+
+
+def test_runtime_hook_books_jx105_on_recompile(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACECHECK", "1")
+    tel.refresh_from_env()
+    tracecheck.reset_runtime()
+    try:
+        def fwd(x):
+            return x * 2.0
+
+        wf = tel.watch_jit(jax.jit(fwd), "tc_hook_step")
+        before = tel.counter("tracecheck_findings")
+        wf(jnp.ones((4, 8)))                  # first compile: no history
+        wf(jnp.ones((6, 8)))                  # recompile -> JX105
+        assert tel.counter("tracecheck_findings") >= before + 1
+        from mxnet_tpu.telemetry import flight
+        kinds = [e for e in flight._ring if e.get("kind") == "tracecheck"]
+        assert any(e.get("name") == "JX105" for e in kinds)
+    finally:
+        monkeypatch.delenv("MXNET_TRACECHECK")
+        tel.refresh_from_env()
+        tracecheck.reset_runtime()
+
+
+def test_runtime_hook_separates_programs_sharing_a_name(monkeypatch):
+    """Two distinct jits under one watch name (a cached op's train/eval
+    pair, every optimizer instance under 'optimizer_update_step') are
+    separate compile caches: each one's FIRST compile must not read as a
+    recompile of the other."""
+    monkeypatch.setenv("MXNET_TRACECHECK", "1")
+    tel.refresh_from_env()
+    tracecheck.reset_runtime()
+    try:
+        wa = tel.watch_jit(jax.jit(lambda x: x * 2.0), "tc_shared_name")
+        wb = tel.watch_jit(jax.jit(lambda x: x + 1.0), "tc_shared_name")
+        before = tel.counter("tracecheck_findings")
+        wa(jnp.ones((4, 8)))
+        wb(jnp.ones((6, 8)))      # other program, other shape: no JX105
+        assert tel.counter("tracecheck_findings") == before
+    finally:
+        monkeypatch.delenv("MXNET_TRACECHECK")
+        tel.refresh_from_env()
+        tracecheck.reset_runtime()
+
+
+def test_runtime_hook_off_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_TRACECHECK", raising=False)
+    tel.refresh_from_env()
+    tracecheck.reset_runtime()
+
+    def fwd(x):
+        return x + 1.0
+
+    wf = tel.watch_jit(jax.jit(fwd), "tc_off_step")
+    before = tel.counter("tracecheck_findings")
+    wf(jnp.ones((4, 8)))
+    wf(jnp.ones((6, 8)))
+    assert tel.counter("tracecheck_findings") == before
+    assert not tracecheck._SIG_HISTORY.get("tc_off_step")
+
+
+# ---------------------------------------------------------------------------
+# AOT driver plumbing
+# ---------------------------------------------------------------------------
+
+def test_scoped_entry_group_traces_only_its_programs():
+    findings, names = tracecheck.check_entry_points(entries={"kvstore"})
+    assert set(names) == {"kvstore_stack_sum", "kvstore_bucket_reduce"}
+    assert findings == []
+
+
+def test_cli_trace_rejects_unknown_group():
+    from mxnet_tpu.lint import cli
+    assert cli.main(["--trace", "nonesuch"]) == 2
+
+
+def test_cli_trace_json_smoke():
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.lint", "--trace", "kvstore",
+         "-f", "json", "--no-baseline"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["new"] == []
+    assert "kvstore_stack_sum" in out.stderr      # coverage line
+
+
+def test_provider_failure_suppresses_baseline_sweep(tmp_path, monkeypatch):
+    """A full --trace run with a JX000 (a provider that didn't run) must
+    NOT retire trace:// baseline entries: --write-baseline keeps the
+    un-re-checked entry instead of silently dropping a group's ledger."""
+    from mxnet_tpu.lint import cli
+    from mxnet_tpu.lint.core import Finding
+    baseline = tmp_path / "base.json"
+    baseline.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "JX104", "path": "trace://executor_train",
+         "snippet": "donate-missed:arg[0]", "count": 1}]}))
+    monkeypatch.setattr(
+        tracecheck, "check_entry_points",
+        lambda entries=None, select=None: (
+            [Finding("JX000", "trace://executor", 0, 0, "provider failed",
+                     snippet="provider:executor")], []))
+    cli.main(["--trace", "--write-baseline", "--baseline", str(baseline)])
+    kept = json.dumps(json.loads(baseline.read_text()))
+    assert "trace://executor_train" in kept
+
+
+def test_list_rules_shows_jx_catalogue():
+    from mxnet_tpu.lint import cli
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["--list-rules"]) == 0
+    text = buf.getvalue()
+    for code in ("JX101", "JX102", "JX103", "JX104", "JX105"):
+        assert code in text

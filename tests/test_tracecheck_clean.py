@@ -1,0 +1,40 @@
+"""Tier-1 gate: the programs the framework ships are JX-clean.
+
+Lowers EVERY owned jit entry point AOT on CPU (ShapeDtypeStruct
+specimens, nothing executed) and fails on any JX finding — the trace-tier
+twin of tests/test_lint_clean.py.  A provider that cannot build or trace
+its program surfaces as a JX000 finding rather than silently shrinking
+coverage, and the coverage list itself is asserted so removing an entry
+point from the driver (instead of migrating it) also fails.
+"""
+from mxnet_tpu.lint import tracecheck
+
+# every program the framework owns, by watch_jit/driver name; growing the
+# framework's jit surface means growing BOTH tracecheck.ENTRY_POINTS and
+# this list (ISSUE 5 acceptance: coverage is part of the contract)
+OWNED_PROGRAMS = {
+    "executor_eval",
+    "executor_train",
+    "executor_fwd_vjp",
+    "executor_bwd",
+    "executor_fwd_bwd_ones",
+    "executor_fwd_bwd",
+    "fused_trainer_step",
+    "gluon_cached_op",
+    "kvstore_stack_sum",
+    "kvstore_bucket_reduce",
+    "module_cached_step",
+    "optimizer_update_step",
+}
+
+
+def test_owned_programs_are_jx_clean():
+    findings, names = tracecheck.check_entry_points()
+    assert not findings, (
+        "trace-tier findings in shipped programs (fix the program — the "
+        "JX baseline is reserved for justified legacy entries):\n"
+        + "\n".join(f.format_text() for f in findings))
+    missing = OWNED_PROGRAMS - set(names)
+    assert not missing, (
+        "owned entry points not analyzed (provider lost or renamed): %s"
+        % sorted(missing))

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""graftcheck: trace-time program analysis of the owned XLA entry points.
+
+Launcher for ``python -m mxnet_tpu.lint --trace``: lowers every jit
+program the framework ships (fused trainer step, optimizer update,
+executor eval/train/fwd_vjp/bwd, kvstore reduces, gluon/module cached
+ops) from ShapeDtypeStruct specimens — AOT, on CPU, no TPU and no real
+data — and walks the jaxprs with the JX rule registry (JX101
+baked-constant, JX102 dtype-widening, JX103 host-callback, JX104
+donation-waste; JX105 retrace-explainer runs at runtime via
+``MXNET_TRACECHECK``).  See docs/LINT.md §trace tier.
+
+    tools/graftcheck.py                     # all entry points, vs baseline
+    tools/graftcheck.py executor kvstore    # only those entry groups
+    tools/graftcheck.py -f json             # machine-readable findings
+    tools/graftcheck.py --select JX104      # one rule
+
+Unlike tools/graftlint.py this imports jax and mxnet_tpu (it must — the
+programs under analysis are built by the framework itself); the CPU
+backend is forced so it runs in CI and on dev boxes without TPUs.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["--trace"] + sys.argv[1:]))

@@ -46,19 +46,21 @@ def build_net():
 
 
 def run_mode(fused, steps, warmup, batch_size, optimizer, side=None):
+    from mxnet_tpu.gluon import fused_trainer
     prev_env = os.environ.get("MXNET_FUSED_TRAINER")
     os.environ["MXNET_FUSED_TRAINER"] = "1" if fused else "0"
+    fused_trainer.refresh_from_env()
     try:
-        np.random.seed(0)
-        mx.random.seed(0)
+        mx.random.seed(0)              # also pins host_rng below
+        rng = mx.random.host_rng()
         net = build_net()
         net.initialize(init=mx.initializer.Xavier())
         trainer = gluon.Trainer(net.collect_params(), optimizer,
                                 {"learning_rate": 0.05})
         loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-        x = mx.nd.array(np.random.randn(batch_size, 3, 16, 16)
+        x = mx.nd.array(rng.standard_normal((batch_size, 3, 16, 16))
                         .astype(np.float32))
-        y = mx.nd.array(np.random.randint(0, 10, (batch_size,))
+        y = mx.nd.array(rng.integers(0, 10, (batch_size,))
                         .astype(np.float32))
 
         def one_step(measure_calls=False):
@@ -89,6 +91,7 @@ def run_mode(fused, steps, warmup, batch_size, optimizer, side=None):
             del os.environ["MXNET_FUSED_TRAINER"]
         else:
             os.environ["MXNET_FUSED_TRAINER"] = prev_env
+        fused_trainer.refresh_from_env()
 
 
 def main():
