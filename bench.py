@@ -200,7 +200,13 @@ def _measure(require_chip, probe_error=None):
     if on_cpu:
         # CPU fallback: fwd-only so a JSON line always comes out quickly;
         # the train series stays chip-only. probe_error marks this as a
-        # FAILED measurement, not a result.
+        # FAILED measurement, not a result; probe_forensics (structured,
+        # from the parent's pre-fallback sweep) says WHY it failed.
+        raw_forensics = os.environ.get("BENCH_PROBE_FORENSICS", "")
+        try:
+            forensics = json.loads(raw_forensics) if raw_forensics else None
+        except ValueError:
+            forensics = {"unparseable": raw_forensics[:400]}
         print(json.dumps({
             "metric": "resnet50_infer_cpu_fallback",
             "value": round(infer_rate, 2),
@@ -209,6 +215,7 @@ def _measure(require_chip, probe_error=None):
             "device": "cpu",
             "batch": batch,
             "probe_error": probe_error or "unknown probe failure",
+            "probe_forensics": forensics,
         }))
         return
 
@@ -331,6 +338,49 @@ def _run_child(role, timeout, extra_env=None):
         proc.returncode, (err or "")[-300:].strip().replace("\n", " | "))
 
 
+def _enum_devices(timeout=45):
+    """Ask a FRESH child process what jax can actually see, with a hard
+    timeout — the r03-r05 failure mode IS backend init hanging, so the
+    enumeration itself must be expendable.  Returns a small dict for the
+    fallback JSON: platform/kind/count on success, the classified error
+    otherwise.  This is the difference between 'probe timed out' and a
+    diagnosable artifact: it separates 'tunnel never answered' from
+    'tunnel answered with zero TPU devices' from 'plugin import crashed'.
+    """
+    env = dict(os.environ)
+    env["BENCH_ROLE"] = "enum"
+    env.pop("JAX_PLATFORMS", None)       # probe what the plugin offers
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": "device enumeration hung for %ds (backend init "
+                         "never returned: tunnel accepted the client but "
+                         "served no PJRT)" % timeout}
+    parsed = _extract_json(proc.stdout or "")
+    if parsed is not None:
+        return parsed
+    return {"error": "enum child died rc=%d: %s"
+            % (proc.returncode,
+               (proc.stderr or "")[-300:].strip().replace("\n", " | "))}
+
+
+def _enum_role():
+    """BENCH_ROLE=enum child body: one JSON line, nothing else."""
+    out = {}
+    try:
+        import jax
+        devs = jax.devices()
+        out = {"platform": devs[0].platform if devs else None,
+               "device_count": len(devs),
+               "device_kinds": sorted({str(getattr(d, "device_kind", "?"))
+                                       for d in devs})}
+    except Exception as exc:
+        out = {"error": repr(exc)[:400]}
+    print(json.dumps(out))
+
+
 def _forensics():
     """Why is the tunnel wedged? Cheap evidence for the fallback JSON."""
     notes = []
@@ -362,6 +412,9 @@ def _forensics():
 
 def main():
     role = os.environ.get("BENCH_ROLE", "")
+    if role == "enum":
+        _enum_role()
+        return
     if role == "chip":
         _measure(require_chip=True)
         return
@@ -388,15 +441,32 @@ def main():
               file=sys.stderr)
         time.sleep(min(10.0, max(0.0, deadline - time.time())))
 
-    probe_error = "%s ;; forensics: %s" % (last_err, _forensics())
+    # Structured forensics BEFORE the CPU fallback runs: the probe's
+    # timeout cause, what a fresh child can enumerate, and the host
+    # socket/log evidence — so a "10 img/s" artifact explains itself.
+    forensics = {
+        "cause": last_err,
+        "attempts": attempt,
+        "probe_budget_s": total_budget,
+        "device_enum": _enum_devices(),
+        "env": {k: os.environ[k] for k in
+                ("JAX_PLATFORMS", "BENCH_PROBE_BUDGET") if k in os.environ},
+        "host": _forensics(),
+    }
+    print("bench: probe forensics: %s" % json.dumps(forensics,
+                                                    sort_keys=True),
+          file=sys.stderr)
+    probe_error = "%s ;; forensics: %s" % (last_err, forensics["host"])
     parsed, err = _run_child(
         "cpu", 600,
-        {"JAX_PLATFORMS": "cpu", "BENCH_PROBE_ERROR": probe_error})
+        {"JAX_PLATFORMS": "cpu", "BENCH_PROBE_ERROR": probe_error,
+         "BENCH_PROBE_FORENSICS": json.dumps(forensics)})
     if parsed is None:
         # Last resort: a JSON line must always come out for the driver.
         print(json.dumps({
             "metric": "bench_failed", "value": 0, "unit": "img/s",
             "vs_baseline": None, "probe_error": probe_error,
+            "probe_forensics": forensics,
             "cpu_fallback_error": err,
         }))
 

@@ -66,8 +66,11 @@ from . import recordio
 from . import image
 from . import gluon
 from . import parallel
-# models and test_utils are opt-in imports (mxnet_tpu.models /
-# mxnet_tpu.test_utils), keeping `import mxnet_tpu` lean like the reference.
+# models, test_utils, and serving are opt-in imports (mxnet_tpu.models /
+# mxnet_tpu.test_utils / mxnet_tpu.serving), keeping `import mxnet_tpu`
+# lean like the reference; the serving tier (AOT predict programs +
+# continuous batching, docs/SERVING.md) spins up threads and compiles
+# programs, so it only loads when a process opts into being a server.
 from . import telemetry
 from . import profiler
 from . import monitor

@@ -260,13 +260,17 @@ class ThreadedEngine:
 
     # -- tasks -------------------------------------------------------------
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             tag=None):
         """Schedule ``fn()`` after its dependencies resolve.
 
         ``const_vars`` are read-dependencies (may run concurrently with
         other readers); ``mutable_vars`` are write-dependencies
         (serialized in push order per variable).  Exceptions raised by
         ``fn`` are captured and re-raised at the next wait point.
+        *tag* names the task in the flight ring (callers pushing
+        lambdas — e.g. the serving batcher — would otherwise all read
+        as ``<lambda>`` in a post-mortem).
 
         Under ``MXNET_SANITIZE`` every task is wrapped in a happens-before
         checker that asserts the declared contract as it executes (writes
@@ -278,7 +282,7 @@ class ThreadedEngine:
         """
         if _flight.enabled():     # opted-out path stays one bool check
             _flight.record("engine_push",
-                           getattr(fn, "__qualname__", None)
+                           tag or getattr(fn, "__qualname__", None)
                            or getattr(fn, "__name__", repr(type(fn))),
                            reads=len(const_vars), writes=len(mutable_vars))
         with _san.push_scope(self):

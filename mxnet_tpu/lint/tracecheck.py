@@ -532,6 +532,8 @@ ENTRY_POINTS = (
     ("executor", "mxnet_tpu.executor"),
     ("module_cached_step", "mxnet_tpu.module.cached_step"),
     ("gluon_cached_op", "mxnet_tpu.gluon.block"),
+    ("predict", "mxnet_tpu.predict"),
+    ("serving", "mxnet_tpu.serving.program"),
 )
 
 

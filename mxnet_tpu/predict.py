@@ -32,6 +32,10 @@ class Predictor(object):
         ctx = ctx or cpu()
         self._ctx = ctx
         self._input_names = list(input_shapes)
+        # kept for the serving tier: bucket-padded AOT variants re-infer
+        # batch-dependent arg shapes from the symbol (serving/program.py)
+        self._symbol = symbol
+        self._input_shapes = {n: tuple(s) for n, s in input_shapes.items()}
         args = {}
         shapes = dict(input_shapes)
         arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
@@ -85,6 +89,49 @@ class Predictor(object):
         if self._exe.outputs is None:
             raise MXNetError("run forward() first")
         return self._exe.outputs[index]
+
+
+def _tracecheck_predictor():
+    """Specimen Predictor for graftcheck: a tiny MLP with a loss head, so
+    the zero-bound ``*_label`` path is part of the traced program exactly
+    as a real checkpoint binds it.  Params are zeros — nothing is
+    executed, only shapes/dtypes matter."""
+    from . import ndarray as nd_mod
+    from . import symbol as S
+    data = S.Variable("data")
+    net = S.FullyConnected(data, num_hidden=8, name="pt_fc1")
+    net = S.Activation(net, act_type="relu")
+    net = S.FullyConnected(net, num_hidden=4, name="pt_fc2")
+    net = S.SoftmaxOutput(net, name="softmax")
+    input_shapes = {"data": (2, 16)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**input_shapes)
+    arg_params = {
+        name: nd_mod.zeros(shape)
+        for name, shape in zip(net.list_arguments(), arg_shapes)
+        if name not in input_shapes and not name.endswith("_label")}
+    aux_params = {
+        name: nd_mod.zeros(shape)
+        for name, shape in zip(net.list_auxiliary_states(), aux_shapes)}
+    return Predictor(net, arg_params, aux_params, input_shapes)
+
+
+def tracecheck_programs():
+    """AOT specimen for graftcheck: the predictor's eval program through
+    the Predictor construction path (checkpoint-shaped params, zero-bound
+    loss labels) — the one owned jit surface the executor specimens do
+    not exercise."""
+    import jax
+
+    from . import random as _random
+    pred = _tracecheck_predictor()
+    ex = pred._exe
+    key = _random.next_key()
+    spec = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+    arg_specs = [spec(ex.arg_dict[n]) for n in ex.arg_names]
+    aux_specs = [spec(ex.aux_dict[n]) for n in ex.aux_names]
+    key_spec = jax.ShapeDtypeStruct(key.shape, key.dtype)
+    return [("predictor_forward", ex._eval_jit,
+             (arg_specs, aux_specs, key_spec), {})]
 
 
 class _EmbeddedPredictor(object):

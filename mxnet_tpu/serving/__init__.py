@@ -1,0 +1,97 @@
+"""mxnet_tpu.serving: AOT predict programs + continuous batching.
+
+The production serving tier over :class:`mxnet_tpu.predict.Predictor`
+(ROADMAP open item 1 — the "millions of users" gap; reference analogue:
+the dedicated ``c_predict_api`` deployment ABI, PAPER layer 9):
+
+* :mod:`.program` — per-model **AOT compilation** of the predictor's
+  eval program into bucket-padded batch-shape variants from
+  ``ShapeDtypeStruct`` specimens.  The request path calls compiled XLA
+  executables directly: no jit dispatch, no tracing, retraces
+  structurally impossible.  graftcheck covers every serving program
+  through the same ``tracecheck_programs()`` provider machinery as the
+  training entry points.
+* :mod:`.batcher` — a bounded request queue + per-model scheduler with
+  **continuous/dynamic batching**: requests coalesce up to the next
+  bucket boundary or ``MXNET_SERVE_BATCH_TIMEOUT_MS``, dispatch as
+  host-engine tasks serialized on the slot's engine variable, and split
+  back per request.  A full queue sheds load (HTTP 503) instead of
+  buffering unbounded latency.
+* :mod:`.slots` — **multi-tenant model slots**: named load / unload /
+  reload of checkpoints with per-model latency percentiles, batch
+  occupancy, and MFU accounting.
+* :mod:`.http` — the ``/v1`` **ops surface**, served by the PR-4
+  introspection server (``MXNET_TELEMETRY_HTTP``): model listing +
+  stats, predict, and management actions.
+
+Quick start::
+
+    import mxnet_tpu.serving as serving
+    serving.load("mlp", prefix="ckpt/mlp", epoch=3,
+                 input_shapes={"data": (1, 784)})
+    probs = serving.predict("mlp", {"data": batch})[0]
+
+Env knobs (docs/env_var.md): ``MXNET_SERVE_MAX_BATCH``,
+``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
+``MXNET_SERVE_QUEUE_CAP``.  docs/SERVING.md is the guide.
+"""
+from __future__ import annotations
+
+from . import batcher, http, program, slots                # noqa: F401
+from .batcher import ContinuousBatcher, Overloaded         # noqa: F401
+from .program import PredictProgram, bucket_sizes          # noqa: F401
+from .slots import (ModelRegistry, ModelSlot,              # noqa: F401
+                    get_registry, reset_registry)
+
+__all__ = ["PredictProgram", "ContinuousBatcher", "Overloaded",
+           "ModelRegistry", "ModelSlot", "bucket_sizes",
+           "get_registry", "reset_registry",
+           "load", "unload", "reload_model", "predict", "submit",
+           "stats", "handle_http", "refresh_gauges", "refresh_from_env"]
+
+
+def load(name, **kwargs):
+    """Load a checkpoint into the process registry (see
+    :meth:`.slots.ModelRegistry.load`)."""
+    return get_registry().load(name, **kwargs)
+
+
+def unload(name, drain=True):
+    return get_registry().unload(name, drain=drain)
+
+
+def reload_model(name, **kwargs):
+    return get_registry().reload(name, **kwargs)
+
+
+def predict(name, inputs, timeout=60.0):
+    """Sync predict against a loaded slot: returns the output list."""
+    return get_registry().predict(name, inputs, timeout=timeout)
+
+
+def submit(name, inputs):
+    """Async predict: returns the request future."""
+    return get_registry().submit(name, inputs)
+
+
+def stats():
+    return get_registry().stats()
+
+
+def handle_http(method, path, body=None):
+    """Entry point the introspection server delegates /v1 paths to."""
+    return http.handle(method, path, body)
+
+
+def refresh_gauges():
+    """Refresh the aggregate serving gauges (called by the introspection
+    sampler through ``sys.modules`` — observe-only, creates nothing)."""
+    registry = slots._registry
+    if registry is not None:
+        registry.refresh_gauges()
+
+
+def refresh_from_env():
+    """Re-read every MXNET_SERVE_* knob (tests / live reconfig)."""
+    program.refresh_from_env()
+    batcher.refresh_from_env()

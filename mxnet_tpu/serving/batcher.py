@@ -1,0 +1,324 @@
+"""Continuous batching: a bounded request queue + per-model scheduler.
+
+The throughput unit of a TPU is a well-filled batch; the latency unit of
+a service is one request.  The scheduler here converts between them the
+way production inference stacks do (and the way the reference's
+multi-threaded `c_predict_api` deployments were driven):
+
+* ``submit`` enqueues a request into a **bounded** queue and returns a
+  future.  A full queue rejects immediately (:class:`Overloaded` — the
+  HTTP tier turns it into a 503) instead of buffering unbounded latency:
+  backpressure is the contract, not a failure mode.
+* A scheduler thread coalesces whatever is in flight **up to the next
+  bucket boundary or a deadline**: it dispatches as soon as the pending
+  rows fill the largest bucket (``MXNET_SERVE_MAX_BATCH``), or when the
+  oldest pending request has waited ``MXNET_SERVE_BATCH_TIMEOUT_MS``
+  (the empty-queue flush).  Requests are never split across batches;
+  a request bigger than the largest bucket dispatches alone through the
+  program's straight-through path.
+* The assembled batch executes as a **host-engine task** serialized on
+  the slot's engine variable (write-dependency), so batch k+1 is being
+  assembled — and its inputs padded — while batch k still runs: the
+  continuous half of continuous batching.  Without the native engine the
+  task degrades to inline execution on the scheduler thread, same
+  semantics, no pipelining.
+
+Every request/batch is booked into the telemetry registry (counters,
+``serving_latency_us`` and ``serving_batch_occupancy`` histograms) and,
+per-model, into the slot metrics the ``/v1/models`` endpoint reports.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+
+__all__ = ["Overloaded", "ContinuousBatcher", "refresh_from_env",
+           "DEFAULT_BATCH_TIMEOUT_MS", "DEFAULT_QUEUE_CAP"]
+
+DEFAULT_BATCH_TIMEOUT_MS = 5.0
+DEFAULT_QUEUE_CAP = 256
+
+
+class Overloaded(MXNetError):
+    """Bounded queue full: shed the request now (HTTP 503), don't buffer
+    unbounded latency."""
+
+
+def _env_timeout_ms():
+    try:
+        return max(0.0, float(os.environ.get("MXNET_SERVE_BATCH_TIMEOUT_MS",
+                                             DEFAULT_BATCH_TIMEOUT_MS)))
+    except ValueError:
+        return DEFAULT_BATCH_TIMEOUT_MS
+
+
+def _env_queue_cap():
+    try:
+        return max(1, int(os.environ.get("MXNET_SERVE_QUEUE_CAP",
+                                         DEFAULT_QUEUE_CAP)))
+    except ValueError:
+        return DEFAULT_QUEUE_CAP
+
+
+# cached at import (JG006 cached-value pattern)
+_TIMEOUT_MS = _env_timeout_ms()
+_QUEUE_CAP = _env_queue_cap()
+
+
+def refresh_from_env():
+    global _TIMEOUT_MS, _QUEUE_CAP
+    _TIMEOUT_MS = _env_timeout_ms()
+    _QUEUE_CAP = _env_queue_cap()
+
+
+class _Request:
+    """One in-flight predict request: host inputs + a completion event."""
+
+    __slots__ = ("inputs", "n", "t_submit", "t_done", "outputs", "error",
+                 "_done")
+
+    def __init__(self, inputs, n):
+        self.inputs = inputs
+        self.n = n
+        self.t_submit = time.perf_counter()
+        self.t_done = None
+        self.outputs = None
+        self.error = None
+        self._done = threading.Event()
+
+    def wait(self, timeout=None):
+        """Block for the result; raises the request's error if it failed."""
+        if not self._done.wait(timeout):
+            raise MXNetError("predict request timed out after %ss"
+                             % timeout)
+        if self.error is not None:
+            raise self.error
+        return self.outputs
+
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def latency_us(self):
+        """Submit-to-completion latency (in-flight: elapsed so far)."""
+        end = self.t_done if self.t_done is not None else time.perf_counter()
+        return (end - self.t_submit) * 1e6
+
+    def _finish(self, outputs=None, error=None):
+        if self.t_done is None:      # dispatcher may have stamped it
+            self.t_done = time.perf_counter()
+        self.outputs = outputs
+        self.error = error
+        self._done.set()
+
+
+class ContinuousBatcher:
+    """The per-model queue + scheduler thread (owned by a ModelSlot)."""
+
+    def __init__(self, program, name, metrics=None, queue_cap=None,
+                 timeout_ms=None, use_engine=True):
+        self._program = program
+        self._name = name
+        self._metrics = metrics
+        self._cap = _QUEUE_CAP if queue_cap is None else max(1, queue_cap)
+        timeout_ms = _TIMEOUT_MS if timeout_ms is None else timeout_ms
+        self._timeout_s = max(0.0, timeout_ms) / 1e3
+        self._queue = deque()
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._use_engine = use_engine
+        self._eng = None
+        self._var = None
+        self._thread = threading.Thread(
+            target=self._loop, name="mxnet-serve-%s" % name, daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._use_engine:
+            try:
+                from .. import engine as _engine
+                self._eng = _engine.engine()
+                self._var = self._eng.new_variable()
+            except Exception:        # engine unavailable: inline dispatch
+                self._eng = None
+                self._var = None
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        """Stop the scheduler.  *drain* processes what is queued first;
+        otherwise pending requests fail with an unload error."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                dropped, self._queue = list(self._queue), deque()
+            else:
+                dropped = []
+            self._cond.notify_all()
+        for req in dropped:
+            req._finish(error=MXNetError(
+                "model %r unloaded before the request ran" % self._name))
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        if self._eng is not None and self._var is not None:
+            try:
+                self._eng.wait_for_var(self._var)
+                self._eng.delete_variable(self._var)
+            except Exception:
+                pass
+            self._var = None
+
+    def set_program(self, program):
+        """Hot-swap the compiled program table (ModelSlot.reload): takes
+        effect at the next batch boundary."""
+        with self._cond:
+            self._program = program
+
+    # -- client side -------------------------------------------------------
+
+    def queue_depth(self):
+        with self._cond:
+            return len(self._queue)
+
+    def submit(self, inputs, n):
+        """Enqueue *n* rows; returns the request future.  Raises
+        :class:`Overloaded` when the bounded queue is full."""
+        req = _Request(inputs, n)
+        with self._cond:
+            if self._stopping:
+                raise MXNetError("model %r is unloading" % self._name)
+            if len(self._queue) >= self._cap:
+                if self._metrics is not None:
+                    self._metrics.count("overloads")
+                _telemetry.bump("serving_overloads")
+                raise Overloaded(
+                    "serving queue for %r is full (%d requests); "
+                    "retry later" % (self._name, self._cap))
+            self._queue.append(req)
+            self._cond.notify_all()
+        if self._metrics is not None:
+            self._metrics.count("requests")
+        _telemetry.bump("serving_requests")
+        return req
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _packable_rows(self):
+        """Rows the head of the queue can contribute to ONE batch (whole
+        requests only, capped at max_batch; an oversize head saturates)."""
+        max_b = self._program.max_batch
+        total = 0
+        for req in self._queue:
+            if req.n > max_b:
+                return max_b if total == 0 else total
+            if total + req.n > max_b:
+                return total
+            total += req.n
+        return total
+
+    def _take_batch(self):
+        """Pop the requests forming the next batch (caller holds _cond)."""
+        max_b = self._program.max_batch
+        batch, total = [], 0
+        while self._queue:
+            req = self._queue[0]
+            if req.n > max_b:
+                if batch:
+                    break                     # oversize goes alone, next
+                batch.append(self._queue.popleft())
+                total = req.n
+                break
+            if total + req.n > max_b:
+                break
+            batch.append(self._queue.popleft())
+            total += req.n
+        return batch, total
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait()
+                if not self._queue and self._stopping:
+                    return
+                # coalesce: dispatch when the pending rows fill the top
+                # bucket, or when the oldest request's deadline lapses
+                # (the empty-queue timeout flush)
+                deadline = self._queue[0].t_submit + self._timeout_s
+                while (not self._stopping
+                       and self._packable_rows() < self._program.max_batch):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch, total = self._take_batch()
+                program = self._program
+            if batch:
+                self._dispatch(program, batch, total)
+
+    def _dispatch(self, program, batch, total):
+        """Hand one assembled batch to the host engine (serialized on the
+        slot variable) or run it inline when no engine is available."""
+        task = lambda: self._run_batch(program, batch, total)  # noqa: E731
+        if self._eng is not None and self._var is not None:
+            try:
+                self._eng.push(task, mutable_vars=(self._var,),
+                               tag="serving:%s" % self._name)
+                return
+            except Exception:      # engine shutting down: degrade inline
+                pass
+        task()
+
+    def _run_batch(self, program, batch, total):
+        """Execute one coalesced batch and split results per request.
+        Never raises: failures land in the request futures."""
+        try:
+            if len(batch) == 1:
+                inputs = batch[0].inputs
+            else:
+                import numpy as np
+                names = list(batch[0].inputs)
+                inputs = {name: np.concatenate(
+                    [req.inputs[name] for req in batch], axis=0)
+                    for name in names}
+            if total > program.max_batch:
+                outs, bucket, cost = program.run_straight(inputs, total)
+            else:
+                outs, bucket, cost = program.run(inputs, total)
+        except BaseException as exc:  # noqa: BLE001 — futures carry it
+            if self._metrics is not None:
+                self._metrics.count("errors", len(batch))
+            _telemetry.bump("serving_errors", len(batch))
+            err = exc if isinstance(exc, MXNetError) else MXNetError(
+                "predict batch failed: %r" % (exc,))
+            for req in batch:
+                req._finish(error=err)
+            return
+        # book ALL accounting BEFORE waking any waiter: a client reading
+        # counters/stats the instant predict() returns must see this
+        # batch (the futures' latency stamp is taken here, so the booked
+        # number is the one the waiter observes)
+        offset, slices = 0, []
+        for req in batch:
+            slices.append([o[offset:offset + req.n] for o in outs])
+            offset += req.n
+            req.t_done = time.perf_counter()
+            latency = req.latency_us
+            _telemetry.observe("serving_latency_us", latency)
+            if self._metrics is not None:
+                self._metrics.latency(latency)
+        occupancy = 100.0 * total / max(bucket, total)
+        _telemetry.bump("serving_batches")
+        _telemetry.observe("serving_batch_occupancy", occupancy)
+        if self._metrics is not None:
+            self._metrics.batch(rows=total, bucket=bucket,
+                                padded=max(0, bucket - total),
+                                cost=cost, n_requests=len(batch))
+        for req, outputs in zip(batch, slices):
+            req._finish(outputs=outputs)

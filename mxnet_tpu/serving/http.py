@@ -1,0 +1,181 @@
+"""The /v1 ops surface: model management + predict over the PR-4 server.
+
+The introspection server (``telemetry/server.py``) owns the socket and
+the localhost-only policy; this module owns the routes.  The server
+delegates any ``/v1/...`` path here through ``sys.modules`` — a process
+that never imported ``mxnet_tpu.serving`` answers 404 with a hint and
+pays nothing, preserving the server's observe-only contract.  The one
+exception is ``POST .../load``, which the server routes through
+:func:`mxnet_tpu.serving.handle_http` after importing the package —
+an explicit operator action is allowed to initialize the serving tier.
+
+Routes (all JSON):
+
+    GET  /v1/models                        every slot's stats
+    GET  /v1/models/<name>                 one slot's stats
+    POST /v1/models/<name>/predict         {"inputs": {name: [[...]]}}
+                                           (or the input dict directly)
+    POST /v1/models/<name>/load            {"prefix", "epoch",
+                                            "input_shapes", "buckets"?}
+    POST /v1/models/<name>/unload          {}
+    POST /v1/models/<name>/reload          {"prefix"?, "epoch"?}
+
+Status codes are the contract the load generator and any real LB probe
+rely on: 200 ok, 400 malformed, 404 unknown model/route, 503 overloaded
+(bounded queue full — retry later), 500 internal.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import MXNetError
+from .batcher import Overloaded
+from .slots import get_registry
+
+__all__ = ["handle"]
+
+
+def _json(code, obj):
+    return code, "application/json", json.dumps(obj, default=repr)
+
+
+def _error(code, message):
+    return _json(code, {"error": message})
+
+
+def handle(method, path, body=None):
+    """Dispatch one /v1 request; returns (status, content_type, payload).
+    Never raises — the server's handler just writes what it gets."""
+    try:
+        return _route(method, path, body)
+    except Overloaded as exc:
+        return _error(503, str(exc))
+    except MXNetError as exc:
+        message = str(exc)
+        if "is not loaded" in message:
+            return _error(404, message)
+        if "timed out" in message:
+            # capacity, not a malformed request: retryable for an LB
+            return _error(504, message)
+        return _error(400, message)
+    except Exception as exc:   # ops surface never takes the process down
+        return _error(500, "serving error: %r" % (exc,))
+
+
+def _route(method, path, body):
+    parts = [p for p in path.split("/") if p]      # ["v1", "models", ...]
+    if len(parts) < 2 or parts[0] != "v1" or parts[1] != "models":
+        return _error(404, "unknown route %r" % path)
+    registry = get_registry()
+    if len(parts) == 2:
+        if method != "GET":
+            return _error(400, "use GET on /v1/models")
+        return _json(200, {"models": registry.stats()})
+    name = parts[2]
+    if len(parts) == 3:
+        if method != "GET":
+            return _error(400, "use GET on /v1/models/<name>")
+        return _json(200, {name: registry.get(name).stats()})
+    action = parts[3]
+    if len(parts) > 4:
+        return _error(404, "unknown route %r" % path)
+    if action == "predict":
+        if method != "POST":
+            return _error(400, "predict is POST-only")
+        return _predict(registry, name, body)
+    if method != "POST":
+        return _error(400, "%s is POST-only" % action)
+    if action == "load":
+        return _load(registry, name, body)
+    if action == "unload":
+        registry.unload(name)
+        return _json(200, {"unloaded": name})
+    if action == "reload":
+        spec = _parse_body(body)
+        registry.reload(name, prefix=spec.get("prefix"),
+                        epoch=spec.get("epoch"))
+        return _json(200, {"reloaded": name})
+    return _error(404, "unknown action %r" % action)
+
+
+def _parse_body(body):
+    if not body:
+        return {}
+    try:
+        obj = json.loads(body)
+    except ValueError as exc:
+        raise MXNetError("request body is not JSON: %s" % exc)
+    if not isinstance(obj, dict):
+        raise MXNetError("request body must be a JSON object")
+    return obj
+
+
+def _predict(registry, name, body):
+    slot = registry.get(name)
+    obj = _parse_body(body)
+    raw = obj.get("inputs", obj)
+    if not isinstance(raw, dict) or not raw:
+        raise MXNetError(
+            'predict body must be {"inputs": {name: [[...]], ...}}')
+    timeout = _number(obj, "timeout_s", 60.0)
+    inputs = {}
+    for key, val in raw.items():
+        if key in ("inputs", "timeout_s"):
+            continue
+        dtype = slot.program._ex.arg_dict[key].dtype \
+            if key in slot.program._ex.arg_dict else np.float32
+        try:
+            inputs[key] = np.asarray(val, dtype)
+        except (TypeError, ValueError) as exc:
+            raise MXNetError("input %r is not a numeric array: %s"
+                             % (key, exc))
+    request = slot.submit(inputs)
+    outs = request.wait(timeout)
+    return _json(200, {
+        "model": name,
+        "batch": request.n,
+        "latency_us": round(request.latency_us, 1),
+        "outputs": {out_name: out.tolist() for out_name, out
+                    in zip(slot.program.output_names, outs)},
+    })
+
+
+def _number(spec, key, default=None):
+    """Client-controlled numeric field: a bad value is a 400 (malformed
+    request), never a 500 (server fault a balancer would retry)."""
+    val = spec.get(key, default)
+    if val is None:
+        return None
+    try:
+        return float(val)
+    except (TypeError, ValueError):
+        raise MXNetError("%r must be a number, got %r" % (key, val))
+
+
+def _load(registry, name, body):
+    spec = _parse_body(body)
+    if "prefix" not in spec or "input_shapes" not in spec:
+        raise MXNetError(
+            'load body needs {"prefix": ..., "epoch": ..., '
+            '"input_shapes": {name: [dims]}}')
+    try:
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in spec["input_shapes"].items()}
+        buckets = spec.get("buckets")
+        if buckets is not None:
+            buckets = [int(b) for b in buckets]
+    except (TypeError, ValueError) as exc:
+        raise MXNetError("malformed load body: %s" % exc)
+    epoch = _number(spec, "epoch", 0)
+    max_batch = _number(spec, "max_batch")
+    queue_cap = _number(spec, "queue_cap")
+    slot = registry.load(
+        name, prefix=spec["prefix"], epoch=int(epoch),
+        input_shapes=shapes, buckets=buckets,
+        max_batch=None if max_batch is None else int(max_batch),
+        queue_cap=None if queue_cap is None else int(queue_cap),
+        timeout_ms=_number(spec, "timeout_ms"))
+    return _json(200, {"loaded": name,
+                       "buckets": list(slot.program.buckets)})

@@ -1,0 +1,316 @@
+"""Multi-tenant model slots: named load/unload/reload over checkpoints.
+
+The registry is the serving analogue of the reference's model-zoo-backed
+deployment loop: every slot owns one :class:`~.program.PredictProgram`
+(the AOT bucket table), one :class:`~.batcher.ContinuousBatcher` (queue
++ scheduler), and its own metrics.  Slots are independent — one model's
+overload or reload never blocks another's request path — and the
+process-wide registry is what the ``/v1/models`` ops surface reports.
+
+``reload`` swaps weights without dropping traffic: the new predictor's
+program table is compiled *first* (the expensive part), then swapped at
+a batch boundary; in-flight batches finish on the old program.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .batcher import ContinuousBatcher
+from .program import PredictProgram
+
+__all__ = ["ModelSlot", "ModelRegistry", "SlotMetrics", "get_registry",
+           "reset_registry"]
+
+
+class SlotMetrics:
+    """Per-model accounting behind ``/v1/models/<name>`` — counters plus
+    a latency histogram reusing the telemetry Histogram/percentile
+    machinery (an unregistered instance: per-model series stay out of
+    the flat global registry and live in the slot's JSON instead)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {"requests": 0, "batches": 0, "rows": 0,
+                        "padded_rows": 0, "overloads": 0, "errors": 0}
+        self._latency = _telemetry.Histogram("latency_us")
+        self._occupancy_sum = 0.0
+        self._flops = 0.0
+        self.t_loaded = time.perf_counter()
+
+    def count(self, key, n=1):
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def latency(self, us):
+        self._latency.observe(us)
+
+    def batch(self, rows, bucket, padded, cost=None, n_requests=1):
+        with self._lock:
+            self._counts["batches"] += 1
+            self._counts["rows"] += rows
+            self._counts["padded_rows"] += padded
+            self._occupancy_sum += rows / max(bucket, rows)
+            if cost is not None:
+                self._flops += cost[0]
+        if padded:
+            _telemetry.bump("serving_padded_rows", padded)
+
+    def snapshot(self):
+        with self._lock:
+            counts = dict(self._counts)
+            occ_sum = self._occupancy_sum
+            flops = self._flops
+        batches = counts["batches"]
+        elapsed = max(time.perf_counter() - self.t_loaded, 1e-9)
+        mfu = None
+        if flops > 0:
+            try:
+                from ..telemetry import costs as _costs
+                peak = _costs.peaks()["flops"]
+                if peak > 0:
+                    mfu = flops / (elapsed * peak)
+            except Exception:
+                pass
+        lat = self._latency
+        return dict(counts, **{
+            "latency_us": {"p50": lat.percentile(50),
+                           "p90": lat.percentile(90),
+                           "p99": lat.percentile(99),
+                           "mean": (lat.total / lat.count)
+                           if lat.count else 0.0,
+                           "count": lat.count},
+            "batch_occupancy_mean": (occ_sum / batches) if batches else None,
+            "model_flops_total": flops,
+            "mfu_since_load": mfu,
+            "uptime_s": round(elapsed, 3),
+        })
+
+
+class ModelSlot:
+    """One named, loaded model: predictor + AOT program + batcher."""
+
+    def __init__(self, name, predictor, source=None, buckets=None,
+                 max_batch=None, queue_cap=None, timeout_ms=None,
+                 use_engine=True):
+        self.name = name
+        self.source = dict(source or {})
+        self.metrics = SlotMetrics()
+        self._lock = threading.Lock()
+        self.predictor = predictor
+        self.program = PredictProgram(predictor, buckets=buckets,
+                                      max_batch=max_batch, name=name)
+        self.batcher = ContinuousBatcher(
+            self.program, name, metrics=self.metrics,
+            queue_cap=queue_cap, timeout_ms=timeout_ms,
+            use_engine=use_engine)
+        self.status = "ready"
+
+    def start(self):
+        self.batcher.start()
+        return self
+
+    def submit(self, inputs):
+        """Async predict: returns the request future."""
+        n = self.program.check_rows(inputs)
+        return self.batcher.submit(inputs, n)
+
+    def predict(self, inputs, timeout=60.0):
+        """Sync predict: submit + wait; returns the output list."""
+        return self.submit(inputs).wait(timeout)
+
+    def swap(self, predictor):
+        """Replace the weights/program behind this slot (reload): the
+        new table is already compiled when the batcher flips over."""
+        program = PredictProgram(predictor, buckets=self.program.buckets,
+                                 name=self.name)
+        with self._lock:
+            self.predictor = predictor
+            self.program = program
+        self.batcher.set_program(program)
+
+    def stats(self):
+        detail = self.metrics.snapshot()
+        detail.update({
+            "status": self.status,
+            "buckets": list(self.program.buckets),
+            "max_batch": self.program.max_batch,
+            "queue_depth": self.batcher.queue_depth(),
+            "inputs": {n: list(s)
+                       for n, s in self.program._input_shapes.items()},
+            "outputs": self.program.output_names,
+            "source": self.source,
+            "program_costs": self.program.costs(),
+        })
+        return detail
+
+
+class ModelRegistry:
+    """The process-wide name -> ModelSlot table (the /v1 ops surface)."""
+
+    def __init__(self):
+        self._slots = {}
+        self._lock = threading.Lock()
+
+    # -- management --------------------------------------------------------
+
+    def load(self, name, prefix=None, epoch=0, input_shapes=None,
+             predictor=None, ctx=None, buckets=None, max_batch=None,
+             queue_cap=None, timeout_ms=None, use_engine=True):
+        """Load a checkpoint (or adopt a built Predictor) under *name*.
+        Compilation of the whole bucket table happens here, not on the
+        first request."""
+        if predictor is None:
+            if prefix is None or input_shapes is None:
+                raise MXNetError(
+                    "load(%r) needs prefix+input_shapes or a predictor"
+                    % name)
+            from ..predict import Predictor
+            predictor = Predictor.load(prefix, epoch, input_shapes,
+                                       ctx=ctx)
+        with self._lock:
+            if name in self._slots:
+                raise MXNetError(
+                    "model %r is already loaded (reload() to swap "
+                    "weights, unload() first to change shapes)" % name)
+        slot = ModelSlot(name, predictor,
+                         source={"prefix": prefix, "epoch": epoch},
+                         buckets=buckets, max_batch=max_batch,
+                         queue_cap=queue_cap, timeout_ms=timeout_ms,
+                         use_engine=use_engine).start()
+        with self._lock:
+            if name in self._slots:      # lost a concurrent load race
+                slot.batcher.stop(drain=False)
+                raise MXNetError("model %r is already loaded" % name)
+            self._slots[name] = slot
+        self.refresh_gauges()
+        _telemetry.flight.record("serving_load", name,
+                                 buckets=len(slot.program.buckets))
+        return slot
+
+    def unload(self, name, drain=True):
+        """Remove a slot; *drain* finishes queued requests first."""
+        with self._lock:
+            slot = self._slots.pop(name, None)
+        if slot is None:
+            raise MXNetError("model %r is not loaded" % name)
+        slot.status = "unloading"
+        slot.batcher.stop(drain=drain)
+        self.refresh_gauges()
+        _telemetry.flight.record("serving_unload", name)
+        return slot
+
+    def reload(self, name, prefix=None, epoch=None, ctx=None):
+        """Swap a slot's weights from its (or a new) checkpoint without
+        dropping queued traffic."""
+        slot = self.get(name)
+        src = dict(slot.source)
+        if prefix is not None:
+            src["prefix"] = prefix
+        if epoch is not None:
+            src["epoch"] = epoch
+        if not src.get("prefix"):
+            raise MXNetError(
+                "model %r was loaded from an in-memory predictor; "
+                "reload needs an explicit prefix" % name)
+        from ..predict import Predictor
+        predictor = Predictor.load(
+            src["prefix"], src.get("epoch") or 0,
+            {n: tuple(s) for n, s in slot.program._input_shapes.items()},
+            ctx=ctx)
+        slot.swap(predictor)
+        slot.source = src
+        _telemetry.flight.record("serving_reload", name)
+        return slot
+
+    # -- access ------------------------------------------------------------
+
+    def get(self, name):
+        with self._lock:
+            slot = self._slots.get(name)
+        if slot is None:
+            raise MXNetError("model %r is not loaded (have %s)"
+                             % (name, self.names()))
+        return slot
+
+    def names(self):
+        with self._lock:
+            return sorted(self._slots)
+
+    def predict(self, name, inputs, timeout=60.0):
+        return self.get(name).predict(inputs, timeout=timeout)
+
+    def submit(self, name, inputs):
+        return self.get(name).submit(inputs)
+
+    def stats(self):
+        with self._lock:
+            slots = dict(self._slots)
+        return {name: slot.stats() for name, slot in sorted(slots.items())}
+
+    def queue_depth_total(self):
+        with self._lock:
+            slots = list(self._slots.values())
+        return sum(s.batcher.queue_depth() for s in slots)
+
+    def refresh_gauges(self):
+        """Feed the aggregate serving gauges (also called by the
+        introspection sampler via ``serving.refresh_gauges``)."""
+        with self._lock:
+            n = len(self._slots)
+            slots = list(self._slots.values())
+        _telemetry.set_gauge("serving_models_loaded", n)
+        _telemetry.set_gauge(
+            "serving_queue_depth",
+            sum(s.batcher.queue_depth() for s in slots))
+
+    def shutdown(self, drain=True):
+        """Unload everything (tests / process teardown)."""
+        for name in self.names():
+            try:
+                self.unload(name, drain=drain)
+            except MXNetError:
+                pass
+
+
+_registry = None
+_registry_lock = threading.Lock()
+_atexit_installed = False
+
+
+def _atexit_shutdown():  # pragma: no cover - interpreter teardown
+    """Stop every batcher before the engine's own atexit drain runs
+    (atexit is LIFO and the engine registers at import, long before any
+    registry exists) — a script that exits with models still loaded must
+    not race scheduler threads against engine shutdown."""
+    registry = _registry
+    if registry is not None:
+        try:
+            registry.shutdown(drain=False)
+        except Exception:
+            pass
+
+
+def get_registry():
+    """The process-wide model registry (created on first use)."""
+    global _registry, _atexit_installed
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = ModelRegistry()
+                if not _atexit_installed:
+                    import atexit
+                    atexit.register(_atexit_shutdown)
+                    _atexit_installed = True
+    return _registry
+
+
+def reset_registry():
+    """Tear down and forget the singleton (tests)."""
+    global _registry
+    with _registry_lock:
+        registry, _registry = _registry, None
+    if registry is not None:
+        registry.shutdown(drain=False)

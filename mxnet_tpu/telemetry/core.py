@@ -332,6 +332,18 @@ COUNTERS = {
                     "signal, hang, or manual)",
     "tracecheck_findings": "trace-tier (JX rule) findings booked by the "
                            "MXNET_TRACECHECK compile hook",
+    "serving_requests": "predict requests accepted into a serving queue",
+    "serving_batches": "coalesced batches dispatched by the serving "
+                       "scheduler",
+    "serving_overloads": "requests shed (503) by a full bounded serving "
+                         "queue",
+    "serving_errors": "predict requests that finished with an error",
+    "serving_straight_through": "oversize requests run unpadded outside "
+                                "the bucket table (the jit escape hatch)",
+    "serving_padded_rows": "padding rows added to reach serving bucket "
+                           "boundaries (throughput spent on waste)",
+    "serving_warmup_compiles": "AOT bucket variants compiled at model "
+                               "load/warmup",
 }
 
 GAUGES = {
@@ -355,6 +367,10 @@ GAUGES = {
     "step_hbm_bw_util": "HBM bandwidth utilization of the last step "
                         "against the device peak (0-1; "
                         "MXNET_PEAK_HBM_BW overrides)",
+    "serving_queue_depth": "requests waiting in serving queues, summed "
+                           "over model slots",
+    "serving_models_loaded": "model slots currently loaded in the "
+                             "serving registry",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)
@@ -363,11 +379,18 @@ _US_BUCKETS = (50.0, 100.0, 250.0, 500.0, 1e3, 2.5e3, 5e3, 1e4, 2.5e4,
 _BYTE_BUCKETS = (1 << 10, 16 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20,
                  64 << 20, 256 << 20)
 
+_PCT_BUCKETS = (10.0, 25.0, 50.0, 75.0, 90.0, 100.0)
+
 HISTOGRAMS = {
     "step_time_us": ("trainer/module step wall time", _US_BUCKETS),
     "eager_dispatch_us": ("eager op dispatch latency", _US_BUCKETS),
     "jit_compile_us": ("watched-jit trace+compile wall time", _US_BUCKETS),
     "bucket_bytes": ("kvstore bucket payload sizes", _BYTE_BUCKETS),
+    "serving_latency_us": ("predict request latency, submit to result",
+                           _US_BUCKETS),
+    "serving_batch_occupancy": ("dispatched rows as a percent of bucket "
+                                "capacity per serving batch",
+                                _PCT_BUCKETS),
 }
 
 METRIC_NAMES = frozenset(COUNTERS) | frozenset(GAUGES) \

@@ -40,8 +40,8 @@ import os
 
 from . import core
 
-__all__ = ["capture", "finalize_step", "peaks", "peaks_if_resolved",
-           "refresh_from_env", "PEAK_TABLE"]
+__all__ = ["capture", "analyze_compiled", "finalize_step", "peaks",
+           "peaks_if_resolved", "refresh_from_env", "PEAK_TABLE"]
 
 _TRUTHY = ("1", "true", "on", "yes")
 
@@ -143,7 +143,19 @@ def capture(fn, args, kwargs, force=False):
     sargs, skwargs = jax.tree_util.tree_map(_spec, (tuple(args),
                                                     dict(kwargs)))
     compiled = fn.lower(*sargs, **skwargs).compile()
-    return _normalize(compiled.cost_analysis())
+    return analyze_compiled(compiled)
+
+
+def analyze_compiled(compiled):
+    """(flops, bytes_accessed) of an ALREADY-compiled executable, or
+    None — the AOT twin of :func:`capture` for callers that hold the
+    executable themselves (the serving bucket table compiles its
+    variants ahead of time and should not pay a second lower+compile
+    just to read the cost model)."""
+    try:
+        return _normalize(compiled.cost_analysis())
+    except Exception:
+        return None
 
 
 # --------------------------------------------------------------------------

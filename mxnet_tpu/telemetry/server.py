@@ -106,7 +106,27 @@ def health():
 # --------------------------------------------------------------------------
 
 _INDEX = ("mxnet_tpu introspection\n"
-          "endpoints: /metrics /healthz /snapshot /trace /flight /stacks\n")
+          "endpoints: /metrics /healthz /snapshot /trace /flight /stacks\n"
+          "serving:   /v1/models  /v1/models/<name>[/predict|/load|"
+          "/unload|/reload]\n")
+
+
+def _serving_reply(method, path, body, allow_import=False):
+    """Delegate a /v1 path to the serving tier.  GETs and predicts only
+    observe (``sys.modules`` lookup — a process that never imported
+    serving answers 404 and initializes nothing); *allow_import* is set
+    for the explicit management POSTs, where the operator is asking this
+    process to BECOME a server."""
+    serving = sys.modules.get("mxnet_tpu.serving")
+    if serving is None and allow_import:
+        import importlib
+        serving = importlib.import_module("mxnet_tpu.serving")
+    if serving is None:
+        return (404, "application/json",
+                json.dumps({"error": "serving tier not initialized "
+                            "(import mxnet_tpu.serving and load a model, "
+                            "or POST /v1/models/<name>/load)"}))
+    return serving.handle_http(method, path, body)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -115,11 +135,13 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):            # quiet: we ARE the telemetry
         pass
 
-    def _reply(self, code, content_type, body):
+    def _reply(self, code, content_type, body, headers=()):
         if isinstance(body, str):
             body = body.encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", content_type)
+        for key, value in headers:
+            self.send_header(key, value)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -151,12 +173,43 @@ class _Handler(BaseHTTPRequestHandler):
                 text = "\n".join("--- %s ---\n%s" % (k, "".join(v))
                                  for k, v in sorted(stacks.items()))
                 self._reply(200, "text/plain; charset=utf-8", text)
+            elif path.startswith("/v1/"):
+                self._reply(*_serving_reply("GET", path, None))
             else:
                 self._reply(404, "text/plain; charset=utf-8",
                             "unknown endpoint\n" + _INDEX)
         except BrokenPipeError:              # client went away mid-reply
             pass
         except Exception as exc:             # introspection never kills
+            try:
+                self._reply(500, "text/plain; charset=utf-8",
+                            "introspection error: %r" % (exc,))
+            except Exception:
+                pass
+
+    def do_POST(self):                       # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            body = self.rfile.read(length) if length > 0 else b""
+            if path.startswith("/v1/"):
+                # management actions (load/unload/reload) may initialize
+                # the serving tier; predict stays observe-only
+                allow_import = path.rsplit("/", 1)[-1] == "load"
+                code, ctype, payload = _serving_reply("POST", path, body,
+                                                      allow_import)
+                # shed load politely: retry soon
+                headers = (("Retry-After", "1"),) if code == 503 else ()
+                self._reply(code, ctype, payload, headers=headers)
+            else:
+                self._reply(404, "text/plain; charset=utf-8",
+                            "unknown endpoint\n" + _INDEX)
+        except BrokenPipeError:
+            pass
+        except Exception as exc:
             try:
                 self._reply(500, "text/plain; charset=utf-8",
                             "introspection error: %r" % (exc,))
@@ -250,6 +303,12 @@ def sample_once(rate_state=None):
     core._sample_engine_pending()
     if "jax" in sys.modules:     # observe-only: never initialize jax
         core.sample_memory()
+    serving = sys.modules.get("mxnet_tpu.serving")
+    if serving is not None:      # observe-only: refresh queue-depth gauges
+        try:
+            serving.refresh_gauges()
+        except Exception:
+            pass
     now = time.monotonic()
     steps = flight.step_count()
     if rate_state is not None:

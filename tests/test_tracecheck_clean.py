@@ -25,6 +25,8 @@ OWNED_PROGRAMS = {
     "kvstore_bucket_reduce",
     "module_cached_step",
     "optimizer_update_step",
+    "predictor_forward",
+    "serving_predict",
 }
 
 

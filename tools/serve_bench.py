@@ -1,0 +1,272 @@
+"""serve_bench: closed- and open-loop load generator for mxnet_tpu.serving.
+
+Prints ONE JSON line with the numbers a serving tier is judged by:
+p50/p99 request latency, sustained QPS, and mean batch occupancy —
+the ROADMAP item-1 acceptance artifact, tier-1-safe on CPU with a tiny
+MLP (no checkpoint needed: the bench builds and saves its own).
+
+Two phases, both against the same loaded model slot:
+
+* **closed loop** (``--clients N --requests R``): N threads each issue R
+  sequential predicts with random batch sizes — latency under
+  think-time-free saturation, the scheduler's coalescing at its busiest.
+* **open loop** (``--qps Q --duration S``): Poisson arrivals at target
+  rate Q, submitted async — latency at a fixed offered load, the number
+  a capacity plan actually needs (closed-loop QPS self-throttles; open
+  loop shows queueing delay growing before the 503 cliff).
+
+The retrace contract is asserted here the same way tests assert it: the
+``jit_compiles`` + ``serving_warmup_compiles`` counters must not move
+after warmup — every request lands on an AOT-compiled bucket executable
+(``retraces_after_warmup`` in the output JSON; nonzero means the bucket
+table leaks).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py
+    python tools/serve_bench.py --clients 8 --requests 50 --qps 200 \
+        --duration 5 --http     # drive through the live /v1 HTTP surface
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FEATURES = 16
+CLASSES = 8
+MODEL = "bench_mlp"
+
+
+def build_checkpoint(tmpdir, seed=0):
+    """A tiny MLP checkpoint in reference save_checkpoint format."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import save_checkpoint
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="sb_fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="sb_fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    shapes = {"data": (1, FEATURES)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    host = np.random.RandomState(seed)
+    args = {name: mx.nd.array((host.randn(*shape) * 0.1)
+                              .astype(np.float32))
+            for name, shape in zip(net.list_arguments(), arg_shapes)
+            if name not in shapes and not name.endswith("_label")}
+    prefix = os.path.join(tmpdir, "serve_bench_mlp")
+    save_checkpoint(prefix, 0, net, args, {})
+    return prefix
+
+
+def _percentiles(latencies_us):
+    if not latencies_us:
+        return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
+    arr = np.sort(np.asarray(latencies_us, np.float64)) / 1e3
+    return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "mean_ms": round(float(arr.mean()), 3)}
+
+
+class _Driver:
+    """Issue predicts either in-process or through the live HTTP server."""
+
+    def __init__(self, use_http, port=None):
+        self.use_http = use_http
+        self.port = port
+
+    def predict(self, x):
+        if not self.use_http:
+            import mxnet_tpu.serving as serving
+            return serving.predict(MODEL, {"data": x}, timeout=60.0)
+        import urllib.request
+        body = json.dumps({"inputs": {"data": x.tolist()}}).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/v1/models/%s/predict" % (self.port, MODEL),
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            json.loads(resp.read())
+
+
+def closed_loop(driver, clients, requests, max_rows, seed):
+    """N clients, zero think time; returns (latencies_us, wall_s, errors)."""
+    latencies = [[] for _ in range(clients)]
+    errors = [0] * clients
+    barrier = threading.Barrier(clients + 1)
+
+    def client(idx):
+        rng = np.random.RandomState(seed + idx)
+        xs = [rng.randn(int(rng.randint(1, max_rows + 1)), FEATURES)
+              .astype(np.float32) for _ in range(requests)]
+        barrier.wait()
+        for x in xs:
+            t0 = time.perf_counter()
+            try:
+                driver.predict(x)
+            except Exception:
+                errors[idx] += 1
+                continue
+            latencies[idx].append((time.perf_counter() - t0) * 1e6)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = [v for chunk in latencies for v in chunk]
+    return flat, wall, sum(errors)
+
+
+def open_loop(qps, duration, max_rows, seed):
+    """Poisson arrivals at target *qps* for *duration* seconds, submitted
+    async in-process; measures queueing + service latency at a fixed
+    offered load.  Returns (latencies_us, wall_s, errors, offered)."""
+    import mxnet_tpu.serving as serving
+    rng = np.random.RandomState(seed)
+    pending, latencies = [], []
+    errors = offered = 0
+    t_end = time.perf_counter() + duration
+    next_at = time.perf_counter()
+    while time.perf_counter() < t_end:
+        now = time.perf_counter()
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.005))
+            continue
+        next_at += rng.exponential(1.0 / qps)
+        x = rng.randn(int(rng.randint(1, max_rows + 1)),
+                      FEATURES).astype(np.float32)
+        offered += 1
+        try:
+            pending.append(serving.submit(MODEL, {"data": x}))
+        except Exception:      # Overloaded: shed — that IS the contract
+            errors += 1
+    t0_drain = time.perf_counter()
+    for req in pending:
+        try:
+            req.wait(60.0)
+            latencies.append(req.latency_us)
+        except Exception:
+            errors += 1
+    wall = duration + (time.perf_counter() - t0_drain)
+    return latencies, wall, errors, offered
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--requests", type=int, default=25,
+                        help="closed-loop requests per client")
+    parser.add_argument("--qps", type=float, default=100.0,
+                        help="open-loop offered load")
+    parser.add_argument("--duration", type=float, default=2.0,
+                        help="open-loop seconds")
+    parser.add_argument("--max-rows", type=int, default=4,
+                        help="max rows per request (random 1..N)")
+    parser.add_argument("--max-batch", type=int, default=16,
+                        help="serving bucket ceiling")
+    parser.add_argument("--timeout-ms", type=float, default=2.0,
+                        help="batch coalescing deadline")
+    parser.add_argument("--http", action="store_true",
+                        help="drive the closed loop through the live "
+                             "/v1 HTTP surface")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    # telemetry ON is load-bearing, not decoration: with it off the
+    # retrace watchdog skips compile detection entirely and the
+    # zero-retrace gate below would pass vacuously
+    os.environ.setdefault("MXNET_TELEMETRY", "1")
+    import mxnet_tpu.serving as serving
+    from mxnet_tpu import telemetry
+    telemetry.set_enabled(True)
+
+    with tempfile.TemporaryDirectory(prefix="serve-bench-") as tmpdir:
+        prefix = build_checkpoint(tmpdir, args.seed)
+        t0 = time.perf_counter()
+        slot = serving.load(MODEL, prefix=prefix, epoch=0,
+                            input_shapes={"data": (1, FEATURES)},
+                            max_batch=args.max_batch,
+                            timeout_ms=args.timeout_ms)
+        load_s = time.perf_counter() - t0
+
+        port = None
+        if args.http:
+            from mxnet_tpu.telemetry import server as tserver
+            port = tserver.start_server(port=0).port
+        driver = _Driver(args.http, port)
+
+        # settle everything lazy (engine threads, first executions) so
+        # the retrace assertion below only sees request-path behavior
+        driver.predict(np.zeros((1, FEATURES), np.float32))
+        driver.predict(np.zeros((args.max_rows, FEATURES), np.float32))
+        compiles_after_warmup = (telemetry.counter("jit_compiles")
+                                 + telemetry.counter(
+                                     "serving_warmup_compiles"))
+
+        closed_lat, closed_wall, closed_err = closed_loop(
+            driver, args.clients, args.requests, args.max_rows, args.seed)
+        open_lat, open_wall, open_err, offered = open_loop(
+            args.qps, args.duration, args.max_rows, args.seed + 1000)
+
+        retraces = (telemetry.counter("jit_compiles")
+                    + telemetry.counter("serving_warmup_compiles")
+                    - compiles_after_warmup)
+        stats = slot.stats()
+        report = {
+            "metric": "serve_bench",
+            "model": MODEL,
+            "buckets": list(slot.program.buckets),
+            "load_compile_s": round(load_s, 3),
+            "transport": "http" if args.http else "inproc",
+            "closed_loop": dict(
+                _percentiles(closed_lat),
+                clients=args.clients,
+                requests=len(closed_lat),
+                errors=closed_err,
+                qps=round(len(closed_lat) / closed_wall, 1)
+                if closed_wall > 0 else None),
+            "open_loop": dict(
+                _percentiles(open_lat),
+                offered_qps=args.qps,
+                offered=offered,
+                completed=len(open_lat),
+                shed_or_failed=open_err,
+                qps=round(len(open_lat) / open_wall, 1)
+                if open_wall > 0 else None),
+            "mean_batch_occupancy": round(
+                stats["batch_occupancy_mean"], 4)
+            if stats["batch_occupancy_mean"] is not None else None,
+            "padded_rows": stats["padded_rows"],
+            "batches": stats["batches"],
+            "rows": stats["rows"],
+            "mfu_since_load": stats["mfu_since_load"],
+            "retraces_after_warmup": retraces,
+        }
+        device = None
+        try:
+            import jax
+            device = jax.devices()[0].platform
+        except Exception:
+            pass
+        report["device"] = device
+        serving.unload(MODEL)
+        print(json.dumps(report))
+        return 0 if retraces == 0 and not closed_err else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
