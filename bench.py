@@ -338,15 +338,11 @@ def _run_child(role, timeout, extra_env=None):
         proc.returncode, (err or "")[-300:].strip().replace("\n", " | "))
 
 
-def _enum_devices(timeout=45):
-    """Ask a FRESH child process what jax can actually see, with a hard
-    timeout — the r03-r05 failure mode IS backend init hanging, so the
-    enumeration itself must be expendable.  Returns a small dict for the
-    fallback JSON: platform/kind/count on success, the classified error
-    otherwise.  This is the difference between 'probe timed out' and a
-    diagnosable artifact: it separates 'tunnel never answered' from
-    'tunnel answered with zero TPU devices' from 'plugin import crashed'.
-    """
+def _enum_devices_once(timeout):
+    """One fresh-child enumeration attempt; returns the parsed dict or a
+    classified error dict.  It separates 'tunnel never answered' from
+    'tunnel answered with zero TPU devices' from 'plugin import
+    crashed'."""
     env = dict(os.environ)
     env["BENCH_ROLE"] = "enum"
     env.pop("JAX_PLATFORMS", None)       # probe what the plugin offers
@@ -364,6 +360,36 @@ def _enum_devices(timeout=45):
     return {"error": "enum child died rc=%d: %s"
             % (proc.returncode,
                (proc.stderr or "")[-300:].strip().replace("\n", " | "))}
+
+
+def _enum_devices(timeout=45, attempts=2, backoff=5.0):
+    """Ask a FRESH child process what jax can actually see, with a hard
+    per-attempt timeout — the r03-r05 failure mode IS backend init
+    hanging, so the enumeration itself must be expendable.
+
+    A transiently wedged tunnel often recovers within seconds, so the
+    probe retries with exponential backoff (*attempts* total) before the
+    caller falls back to CPU; EVERY attempt's outcome is recorded in the
+    returned dict so the probe_forensics block shows the retry history,
+    not just the last word.
+    """
+    history = []
+    for i in range(max(1, attempts)):
+        result = _enum_devices_once(timeout)
+        history.append(dict(result, attempt=i + 1))
+        if "error" not in result:
+            break
+        if i + 1 < attempts:
+            delay = backoff * (2 ** i)
+            print("bench: device enumeration attempt %d/%d failed (%s); "
+                  "retrying in %.0fs" % (i + 1, attempts,
+                                         result["error"], delay),
+                  file=sys.stderr)
+            time.sleep(delay)
+    final = dict(history[-1])
+    final.pop("attempt", None)
+    final["attempts"] = history
+    return final
 
 
 def _enum_role():

@@ -66,6 +66,7 @@ from . import recordio
 from . import image
 from . import gluon
 from . import parallel
+from . import checkpoint
 # models, test_utils, and serving are opt-in imports (mxnet_tpu.models /
 # mxnet_tpu.test_utils / mxnet_tpu.serving), keeping `import mxnet_tpu`
 # lean like the reference; the serving tier (AOT predict programs +

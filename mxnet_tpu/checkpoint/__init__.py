@@ -1,0 +1,38 @@
+"""Preemption-safe training: async sharded checkpoints + elastic resume.
+
+The robustness layer of the training stack (ROADMAP item 5; reference
+capability: MXNet's ``kvstore.save_optimizer_states`` /
+``model.load_checkpoint``, PAPER.md layers 3/7):
+
+    mgr = checkpoint.CheckpointManager(dir, trainer=trainer,
+                                       data_iter=it, every_steps=50)
+    start = mgr.restore() or 0            # elastic: shard count may differ
+    checkpoint.install_preemption_handler(mgr)
+    for step in range(start, n_steps):
+        ... forward / backward ...
+        trainer.step(batch_size)          # boundaries auto-save + honor
+                                          # a pending SIGTERM
+
+See :mod:`.manager` for the full contract, :mod:`.reshard` for the
+elastic shard layout, :mod:`.hooks` for the training-loop integration,
+and docs/CHECKPOINT.md for formats and failure modes.  The live view is
+``GET /checkpoints`` on the introspection server.
+"""
+from __future__ import annotations
+
+from . import hooks, reshard                     # noqa: F401
+from .manager import CheckpointManager, install_preemption_handler
+
+__all__ = ["CheckpointManager", "install_preemption_handler",
+           "http_view", "hooks", "reshard"]
+
+
+def http_view():
+    """The ``/checkpoints`` introspection payload: the active manager's
+    description, or an inactive stub."""
+    manager = hooks.active()
+    if manager is None:
+        return {"active": False, "checkpoints": []}
+    view = manager.describe()
+    view["active"] = True
+    return view

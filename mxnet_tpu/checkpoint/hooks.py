@@ -1,0 +1,48 @@
+"""Step-boundary hooks: the training loops' one-line checkpoint contract.
+
+``gluon.Trainer.step`` and the ``module`` fit loop call
+:func:`note_step_boundary` after every completed optimizer step.  A step
+boundary is the ONLY place training state is consistent enough to
+snapshot (params, optimizer slots, and the data cursor all agree on the
+same step), so it is where the active :class:`~.manager.CheckpointManager`
+
+* advances its internal step counter,
+* fires a periodic async snapshot (``every_steps``), and
+* honors a pending SIGTERM by writing the final synchronous checkpoint
+  and then re-raising the signal (the preemption path).
+
+This module deliberately imports NOTHING: the training hot paths pay one
+global read when no manager is registered, and there is no import cycle
+between ``gluon``/``module`` and the checkpoint package.
+"""
+from __future__ import annotations
+
+__all__ = ["register", "unregister", "active", "note_step_boundary"]
+
+_manager = None
+
+
+def register(manager):
+    """Make *manager* the process's active checkpoint manager (one at a
+    time; the latest registration wins, like signal handlers)."""
+    global _manager
+    _manager = manager
+
+
+def unregister(manager):
+    """Remove *manager* if it is still the active one."""
+    global _manager
+    if _manager is manager:
+        _manager = None
+
+
+def active():
+    """The registered CheckpointManager, or None."""
+    return _manager
+
+
+def note_step_boundary(epoch=None, batch=None):
+    """Called by training loops after each completed optimizer step."""
+    m = _manager
+    if m is not None:
+        m._on_step_boundary(epoch=epoch, batch=batch)

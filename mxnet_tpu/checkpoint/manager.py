@@ -1,0 +1,743 @@
+"""Preemption-safe training checkpoints: async, sharded, atomic, elastic.
+
+Reference capability surface: MXNet's ``kvstore.save_optimizer_states``
+/ ``model.load_checkpoint`` (PAPER.md layers 3/7), rebuilt for a
+production TPU fleet where the scheduler WILL SIGTERM the job:
+
+* **Async sharded snapshots.**  ``CheckpointManager.save()`` copies
+  params, per-slot optimizer state (the fused-trainer state tree plus
+  its update counts), the data-iterator cursor, the RNG state, and the
+  telemetry step clock device→host ON THE CALLER (so the snapshot is a
+  consistent cut of one step, immune to later donated-buffer rebinds),
+  then hands the host tree to a background writer thread.  Optimizer
+  state is written as one shard per replica (``reshard.py`` layout);
+  every shard carries a CRC32 in ``manifest.json``; the commit is
+  write-to-tmp + ``os.rename`` like ``telemetry/flight.py``; transient
+  write failures retry with exponential backoff; retention keeps the
+  newest ``MXNET_CKPT_KEEP`` complete checkpoints.
+* **Preemption path.**  ``install_preemption_handler()`` chains a
+  SIGTERM handler in front of the flight recorder's: the signal only
+  *requests* a final synchronous checkpoint, which the next step
+  boundary (``hooks.note_step_boundary`` — called by ``Trainer.step``
+  and the module fit loop) writes before re-raising into the previous
+  handler (flight dump + death by SIGTERM, so exit status still says
+  "killed").  A grace timer (``MXNET_CKPT_GRACE_SECS``) guarantees the
+  process dies even when no boundary ever arrives — wedged collective,
+  stuck ``engine.push`` — without touching any lock the interrupted
+  thread may hold.
+* **Elastic resume.**  ``restore()`` walks checkpoints newest-first,
+  validates sizes + checksums against the manifest, falls back to the
+  previous complete checkpoint on any corruption (never crashes), and
+  tolerates a changed replica count by streaming the saved shards into
+  the current layout (see ``reshard.py``).  Restoring cursor + RNG makes
+  the post-resume loss trajectory bitwise-identical on CPU.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import queue
+import shutil
+import signal
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import random as _random
+from .. import telemetry as _tel
+from ..ndarray import NDArray
+from ..telemetry import flight as _flight
+from . import hooks, reshard
+
+__all__ = ["CheckpointManager", "install_preemption_handler"]
+
+_MANIFEST = "manifest.json"
+_CKPT_PREFIX = "ckpt-"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# host-tree conversion: NDArray-structured state <-> pure numpy trees
+# ---------------------------------------------------------------------------
+
+def _tree_to_np(tree):
+    """Optimizer state tree -> numpy tree (the device→host cut)."""
+    if tree is None:
+        return None
+    if isinstance(tree, NDArray):
+        return np.asarray(tree.asnumpy())
+    if isinstance(tree, (list, tuple)):
+        return tuple(_tree_to_np(t) for t in tree)
+    raise TypeError("unsupported optimizer state leaf %r" % type(tree))
+
+
+def _np_to_state(tree, ctx):
+    """Numpy tree -> NDArray state tree on *ctx* (None = default ctx)."""
+    if tree is None:
+        return None
+    if isinstance(tree, np.ndarray):
+        return nd.array(tree, ctx=ctx, dtype=tree.dtype)
+    return tuple(_np_to_state(t, ctx) for t in tree)
+
+
+class CheckpointManager:
+    """Snapshot/restore a training run; one instance per run.
+
+    Exactly one of *trainer* (``gluon.Trainer``) or *module*
+    (``module.BaseModule`` after ``init_optimizer``) supplies the
+    params + optimizer state; *data_iter* (anything implementing the
+    ``DataIter`` checkpoint-state protocol) is optional but required for
+    bitwise-resumable input pipelines.
+
+    Constructing the manager registers it with ``checkpoint.hooks`` so
+    the training loops' step-boundary notifications reach it.  Call
+    :meth:`close` when the run is over: it drains pending writes, stops
+    the writer thread, detaches the hooks, and restores the previous
+    SIGTERM handler — a merely superseded manager (a newer one
+    registered) keeps its thread and references alive until closed.
+    """
+
+    def __init__(self, directory, trainer=None, module=None, data_iter=None,
+                 every_steps=None, keep=None, num_shards=None,
+                 retries=None):
+        if (trainer is None) == (module is None):
+            raise ValueError("pass exactly one of trainer= or module=")
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._trainer = trainer
+        self._module = module
+        self._data_iter = data_iter
+        self._every_steps = int(every_steps
+                                if every_steps is not None
+                                else _env_int("MXNET_CKPT_EVERY_STEPS", 0))
+        self._keep = max(1, int(keep if keep is not None
+                                else _env_int("MXNET_CKPT_KEEP", 3)))
+        if num_shards is None:
+            num_shards = _env_int("MXNET_CKPT_SHARDS", 0)
+        if not num_shards:
+            import jax
+            num_shards = max(1, jax.local_device_count())
+        self._n_shards = max(1, int(num_shards))
+        self._retries = max(1, int(retries if retries is not None
+                                   else _env_int("MXNET_CKPT_RETRIES", 3)))
+        self._grace_secs = _env_float("MXNET_CKPT_GRACE_SECS", 30.0)
+
+        self._step = 0
+        self._epoch = None
+        self._batch = None
+        self.last_committed_step = None
+        self.last_error = None
+        self._last_enqueued = None
+        self._active_tmp = None
+
+        self._preempt_at = None
+        self._final_done = False
+        self._grace_timer = None
+        self._sigterm_installed = False
+        self._prev_sigterm = None
+
+        self._queue = queue.Queue(maxsize=2)   # backpressure bounds host mem
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        name="mxnet-ckpt-writer",
+                                        daemon=True)
+        self._writer.start()
+        hooks.register(self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        """Drain pending writes, stop the writer thread, detach from the
+        step-boundary hooks, and give SIGTERM back to the previous
+        handler (a closed manager would otherwise pin its
+        trainer/module — and swallow preemption signals its boundaries
+        can no longer honor — for the process lifetime)."""
+        self.wait()
+        hooks.unregister(self)
+        if self._writer.is_alive():
+            self._queue.put(None)        # writer-loop stop sentinel
+            self._writer.join(timeout=10.0)
+        if self._sigterm_installed:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+                self._sigterm_installed = False
+            except (ValueError, OSError):
+                pass                     # not the main thread: leave it
+        # a pending preemption dies with the manager: the armed grace
+        # timer would otherwise os._exit a process that moved on to
+        # post-run work after detaching
+        self._final_done = True
+        if self._grace_timer is not None:
+            self._grace_timer.cancel()
+            self._grace_timer = None
+        self._preempt_at = None
+
+    def wait(self):
+        """Block until every enqueued snapshot has been committed (or
+        exhausted its retries)."""
+        self._queue.join()
+
+    @property
+    def step(self):
+        return self._step
+
+    # -- snapshot capture (caller thread: the device→host cut) -------------
+
+    def _capture(self, step, reason):
+        if self._trainer is not None:
+            params, optim, state = self._capture_trainer()
+        else:
+            params, optim, state = self._capture_module()
+        state["reason"] = reason
+        state["epoch"] = self._epoch
+        state["batch"] = self._batch
+        if self._data_iter is not None:
+            get = getattr(self._data_iter, "get_checkpoint_state", None)
+            state["iterator"] = get() if get is not None else None
+        state["rng"] = _random.get_state()
+        state["telemetry_steps"] = _flight.step_count()
+        return {"step": int(step), "n_shards": self._n_shards,
+                "params": params, "optim": optim, "state": state}
+
+    def _capture_trainer(self):
+        t = self._trainer
+        params = {"%d:%s" % (slot, p.name): p.data().asnumpy()
+                  for slot, p in enumerate(t._params)}
+        optim = {slot: _tree_to_np(st)
+                 for slot, st in t._updater.states.items()}
+        opt = t._optimizer
+        state = {"kind": "trainer",
+                 "index_update_count": {int(k): int(v) for k, v in
+                                        opt._index_update_count.items()},
+                 "num_update": int(opt.num_update)}
+        kv = t._kvstore
+        if kv is not None:
+            state["kvstore_updater"] = kv.get_checkpoint_state()
+        return params, optim, state
+
+    def _capture_module(self):
+        m = self._module
+        arg, aux = m.get_params()
+        params = {"arg:%s" % k: v.asnumpy() for k, v in arg.items()}
+        params.update({"aux:%s" % k: v.asnumpy() for k, v in aux.items()})
+        optim, counts, num_update = {}, {}, 0
+        upd = getattr(m, "_updater", None)
+        if upd is not None:
+            optim = {slot: _tree_to_np(st) for slot, st in
+                     upd.states.items()}
+        opt = getattr(m, "_optimizer", None)
+        if opt is not None:
+            counts = {k: int(v) for k, v in
+                      opt._index_update_count.items()}
+            num_update = int(opt.num_update)
+        state = {"kind": "module", "index_update_count": counts,
+                 "num_update": num_update}
+        kv = getattr(m, "_kvstore", None)
+        if kv is not None:
+            state["kvstore_updater"] = kv.get_checkpoint_state()
+        return params, optim, state
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step=None, sync=False, reason="periodic"):
+        """Snapshot now; serialize + commit on the background writer.
+
+        ``sync=True`` blocks until the commit (or its final retry)
+        finishes and returns whether *step* is on disk.  Saving the same
+        step twice is a no-op (the periodic trigger and an explicit
+        ``maybe_save`` may both fire on one boundary).
+        """
+        if step is None:
+            step = self._step
+        else:
+            step = int(step)
+            self._step = max(self._step, step)
+        if self._last_enqueued == step:
+            if sync:                     # already queued: wait it out
+                self._queue.join()
+                return self.last_committed_step == step
+            return True
+        snap = self._capture(step, reason)
+        self._last_enqueued = step
+        self._queue.put(snap)
+        if sync:
+            self._queue.join()
+            return self.last_committed_step is not None \
+                and self.last_committed_step >= step
+        return True
+
+    def maybe_save(self, step=None):
+        """Periodic trigger: save iff ``every_steps`` divides *step*."""
+        if step is not None:
+            self._step = max(self._step, int(step))
+        if self._every_steps and self._step \
+                and self._step % self._every_steps == 0:
+            return self.save(self._step)
+        return False
+
+    # -- background writer -------------------------------------------------
+
+    def _writer_loop(self):
+        while True:
+            snap = self._queue.get()
+            if snap is None:          # close() stop sentinel
+                self._queue.task_done()
+                return
+            try:
+                self._write_with_retry(snap)
+            finally:
+                self._queue.task_done()
+
+    def _write_with_retry(self, snap):
+        delay = 0.1
+        for attempt in range(self._retries):
+            try:
+                self._commit(snap)
+                self.last_error = None
+                return True
+            except Exception as exc:   # transient IO: retry with backoff
+                self.last_error = repr(exc)
+                self._cleanup_tmp()
+                if attempt + 1 < self._retries:
+                    _tel.bump("checkpoint_write_retries")
+                    time.sleep(delay)
+                    delay *= 2
+        _flight.record("checkpoint", "write-failed", step=snap["step"],
+                       error=self.last_error)
+        # un-dedupe: a later explicit save(step) must re-attempt this
+        # step instead of no-op'ing against a write that never landed
+        if self._last_enqueued == snap["step"]:
+            self._last_enqueued = None
+        return False
+
+    def _put_file(self, tmp, name, obj, files):
+        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        with open(os.path.join(tmp, name), "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        files[name] = {"bytes": len(blob),
+                       "crc32": zlib.crc32(blob) & 0xFFFFFFFF}
+
+    def _commit(self, snap):
+        """One atomic checkpoint: shards + manifest into a tmp dir, then
+        a same-filesystem rename (the ``flight.py`` torn-read rule)."""
+        t0 = time.monotonic()
+        step = snap["step"]
+        final = os.path.join(self._dir, "%s%010d" % (_CKPT_PREFIX, step))
+        tmp = final + ".tmp-%d" % os.getpid()
+        self._active_tmp = tmp
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        files = {}
+        self._put_file(tmp, "params.pkl", snap["params"], files)
+        shards = reshard.shard_states(snap["optim"], snap["n_shards"])
+        for k, payload in enumerate(shards):
+            self._put_file(tmp, "optim-%05d-of-%05d.pkl" % (k, len(shards)),
+                           payload, files)
+        self._put_file(tmp, "state.pkl", snap["state"], files)
+        manifest = {"version": 1, "step": step,
+                    "n_shards": snap["n_shards"],
+                    "created_unix": time.time(),
+                    "files": files, "complete": True}
+        mpath = os.path.join(tmp, _MANIFEST)
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+            fh.flush()
+            os.fsync(fh.fileno())
+        if os.path.isdir(final):       # re-save of the same step
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        self._active_tmp = None
+        self.last_committed_step = step
+        total = sum(f["bytes"] for f in files.values())
+        _tel.bump("checkpoint_saves")
+        _tel.set_gauge("checkpoint_last_step", step)
+        _tel.set_gauge("checkpoint_bytes", total)
+        _tel.set_gauge("checkpoint_write_seconds",
+                       time.monotonic() - t0)
+        _flight.record("checkpoint", "commit", step=step, bytes=total,
+                       shards=len(shards), reason=snap["state"]["reason"])
+        self._retain()
+
+    def _cleanup_tmp(self):
+        tmp, self._active_tmp = self._active_tmp, None
+        if tmp and os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _retain(self):
+        """Keep the newest ``keep`` complete checkpoints; sweep the rest
+        plus any abandoned tmp dirs (not the one mid-write)."""
+        complete = [(s, p) for s, p, m in self._list_checkpoints()
+                    if m is not None and m.get("complete")]
+        for _, path in complete[self._keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+        for name in os.listdir(self._dir):
+            path = os.path.join(self._dir, name)
+            if ".tmp-" in name and path != self._active_tmp \
+                    and os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    # -- restore -----------------------------------------------------------
+
+    def _list_checkpoints(self):
+        """[(step, path, manifest-or-None)] newest first."""
+        entries = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return entries
+        for name in names:
+            if not name.startswith(_CKPT_PREFIX) or ".tmp-" in name:
+                continue
+            path = os.path.join(self._dir, name)
+            if not os.path.isdir(path):
+                continue
+            try:
+                step = int(name[len(_CKPT_PREFIX):])
+            except ValueError:
+                continue
+            manifest = None
+            try:
+                with open(os.path.join(path, _MANIFEST)) as fh:
+                    manifest = json.load(fh)
+            except Exception:
+                pass
+            entries.append((step, path, manifest))
+        entries.sort(key=lambda e: e[0], reverse=True)
+        return entries
+
+    def _read_verified(self, path, manifest, name):
+        meta = manifest["files"][name]
+        with open(os.path.join(path, name), "rb") as fh:
+            blob = fh.read()
+        if len(blob) != meta["bytes"] \
+                or (zlib.crc32(blob) & 0xFFFFFFFF) != meta["crc32"]:
+            raise IOError("shard %s failed checksum" % name)
+        return pickle.loads(blob)
+
+    def _load(self, path, manifest):
+        """Validated payload of one checkpoint dir; raises on any
+        missing/corrupt shard.  Optimizer shards are streamed one file
+        at a time into the merged dict — the elastic-restore half of the
+        ``reshard`` layout: the saved shard count never has to match the
+        current one."""
+        if not manifest or not manifest.get("complete"):
+            raise IOError("manifest missing or incomplete")
+        names = set(manifest["files"])
+        for name in names:
+            if not os.path.exists(os.path.join(path, name)):
+                raise IOError("shard %s missing" % name)
+        params = self._read_verified(path, manifest, "params.pkl")
+        state = self._read_verified(path, manifest, "state.pkl")
+        optim = {}
+        for name in sorted(n for n in names if n.startswith("optim-")):
+            reshard.merge_into(optim,
+                               self._read_verified(path, manifest, name))
+        return {"step": int(manifest["step"]),
+                "saved_shards": int(manifest.get("n_shards", 1)),
+                "params": params, "optim": optim, "state": state}
+
+    def restore(self):
+        """Load the newest complete-and-valid checkpoint into the
+        trainer/module, iterator, and RNG.  Partial or corrupt
+        checkpoints fall back to the previous complete one; returns the
+        restored step, or None when nothing restorable exists."""
+        for step, path, manifest in self._list_checkpoints():
+            try:
+                payload = self._load(path, manifest)
+                self._apply(payload)
+            except Exception as exc:
+                _tel.bump("checkpoint_restore_fallbacks")
+                _flight.record("checkpoint", "restore-fallback",
+                               step=step, error=repr(exc)[:300])
+                continue
+            self._step = step
+            self.last_committed_step = step
+            self._last_enqueued = step      # don't re-save what we loaded
+            if payload["saved_shards"] != self._n_shards:
+                moves = reshard.redistribution_plan(
+                    payload["optim"].keys(), payload["saved_shards"],
+                    self._n_shards)
+                _flight.record("checkpoint", "reshard",
+                               from_shards=payload["saved_shards"],
+                               to_shards=self._n_shards, moves=len(moves))
+            _tel.bump("checkpoint_restores")
+            _tel.set_gauge("checkpoint_last_step", step)
+            return step
+        return None
+
+    def _apply(self, payload):
+        state = payload["state"]
+        if state["kind"] == "trainer":
+            if self._trainer is None:
+                raise ValueError("trainer checkpoint but manager wraps "
+                                 "a module")
+            self._apply_trainer(payload)
+        else:
+            if self._module is None:
+                raise ValueError("module checkpoint but manager wraps "
+                                 "a trainer")
+            self._apply_module(payload)
+        self._epoch = state.get("epoch")
+        self._batch = state.get("batch")
+        # cursor/RNG/clock phase: NON-fatal.  The model state above
+        # applied cleanly, so the checkpoint is good — an incompatible
+        # iterator state (the user swapped iterator types across the
+        # restart) must not trigger a fallback to an older checkpoint
+        # that would fail the same way on top of already-applied params.
+        # The run resumes with restored weights and a restarted stream.
+        try:
+            if self._data_iter is not None \
+                    and state.get("iterator") is not None:
+                self._data_iter.set_checkpoint_state(state["iterator"])
+            if state.get("rng") is not None:
+                _random.set_state(state["rng"])
+        except Exception as exc:
+            _flight.record("checkpoint", "cursor-restore-skipped",
+                           error=repr(exc)[:300])
+        _flight.restore_progress(int(state.get("telemetry_steps") or 0))
+
+    def _apply_trainer(self, payload):
+        t = self._trainer
+        by_slot = {}
+        for key, arr in payload["params"].items():
+            slot_s, _, name = key.partition(":")
+            by_slot[int(slot_s)] = (name, arr)
+        # validate EVERY slot before mutating ANY: a rejected checkpoint
+        # must leave the live trainer untouched so the fallback to an
+        # older checkpoint (or to a fresh start) sees unpoisoned params
+        for slot, p in enumerate(t._params):
+            ent = by_slot.get(slot)
+            if ent is None:
+                continue
+            name, arr = ent
+            if p.shape is not None and all(s > 0 for s in p.shape) \
+                    and tuple(p.shape) != arr.shape:
+                # slot is the binding contract; a shape clash means a
+                # different model → fall back to an older checkpoint
+                raise ValueError(
+                    "checkpoint slot %d (%s) has shape %s, trainer "
+                    "parameter %s expects %s"
+                    % (slot, name, arr.shape, p.name, p.shape))
+        # params first: set_data finishes deferred initialization (a
+        # fresh model that never ran forward), which _init_kvstore needs
+        for slot, p in enumerate(t._params):
+            ent = by_slot.get(slot)
+            if ent is None:
+                continue
+            _, arr = ent
+            ctx = p._data.context if p._data is not None else None
+            p.set_data(nd.array(arr, ctx=ctx, dtype=arr.dtype))
+        if not t._kv_initialized:
+            t._init_kvstore()
+        upd = t._updater
+        upd.states = {}
+        for slot, tree in payload["optim"].items():
+            ctx = t._params[slot].data().context \
+                if 0 <= slot < len(t._params) else None
+            upd.states[slot] = _np_to_state(tree, ctx)
+        upd.states_synced = dict.fromkeys(upd.states, True)
+        self._apply_counts(t._optimizer, payload["state"])
+        self._apply_kvstore(t._kvstore, payload["state"])
+
+    def _apply_module(self, payload):
+        m = self._module
+        arg = {k[4:]: nd.array(v, dtype=v.dtype)
+               for k, v in payload["params"].items()
+               if k.startswith("arg:")}
+        aux = {k[4:]: nd.array(v, dtype=v.dtype)
+               for k, v in payload["params"].items()
+               if k.startswith("aux:")}
+        m.set_params(arg, aux, allow_missing=False, force_init=True)
+        upd = getattr(m, "_updater", None)
+        if upd is not None and payload["optim"]:
+            upd.states = {slot: _np_to_state(tree, None)
+                          for slot, tree in payload["optim"].items()}
+            upd.states_synced = dict.fromkeys(upd.states, True)
+        opt = getattr(m, "_optimizer", None)
+        if opt is not None:
+            self._apply_counts(opt, payload["state"])
+        self._apply_kvstore(getattr(m, "_kvstore", None),
+                            payload["state"])
+
+    @staticmethod
+    def _apply_kvstore(kv, state):
+        """Restore the server-side updater blob — non-fatally: params
+        and updater state are already applied, so a kvstore mismatch
+        (no updater installed yet, dist store) degrades with a flight
+        event instead of poisoning the fallback path."""
+        blob = state.get("kvstore_updater")
+        if blob is None or kv is None:
+            return
+        try:
+            kv.set_checkpoint_state(blob)
+        except Exception as exc:
+            _flight.record("checkpoint", "kvstore-restore-skipped",
+                           error=repr(exc)[:300])
+
+    @staticmethod
+    def _apply_counts(opt, state):
+        """Restore the fused-trainer step cache: per-slot update counts
+        feed ``hyper['t']`` (Adam bias correction etc.) — losing them
+        breaks bitwise resume.  Keys are preserved as saved: int slots
+        on the trainer path, param-name strings on the module
+        update_on_kvstore path."""
+        counts = state.get("index_update_count") or {}
+        opt._index_update_count = {k: int(v) for k, v in counts.items()}
+        opt.num_update = int(state.get("num_update") or 0)
+
+    # -- preemption path ---------------------------------------------------
+
+    def install_preemption_handler(self):
+        """Chain a SIGTERM handler in FRONT of whatever is installed
+        (normally the flight recorder's).  Main thread only, idempotent.
+        """
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError("signal handlers install on the main "
+                               "thread only")
+        if self._sigterm_installed:
+            return
+        self._prev_sigterm = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM, self._on_sigterm)
+        self._sigterm_installed = True
+
+    def preempt_pending(self):
+        return self._preempt_at is not None
+
+    def _arm_grace_timer(self):
+        """(Re-)start the hang-free deadline: cancel any running timer,
+        arm a fresh daemon Timer on ``_grace_expired`` (no-op when the
+        window is 0 = wait indefinitely)."""
+        if self._grace_timer is not None:
+            self._grace_timer.cancel()
+            self._grace_timer = None
+        if self._grace_secs > 0:
+            t = threading.Timer(self._grace_secs, self._grace_expired)
+            t.daemon = True
+            t.start()
+            self._grace_timer = t
+
+    def _on_sigterm(self, signum, frame):
+        """Signal context: set the flag, arm the grace timer, return.
+        No locks, no allocation-heavy work — the interrupted main thread
+        may be mid-``engine.push`` holding arbitrary locks."""
+        if self._preempt_at is not None:    # second SIGTERM: stop waiting
+            self._chain_sigterm()
+            return
+        self._preempt_at = time.monotonic()
+        _flight.record("signal", "SIGTERM-checkpoint",
+                       grace_s=self._grace_secs)
+        self._arm_grace_timer()
+
+    def _grace_expired(self):
+        """The grace window ran out — either no step boundary arrived
+        (wedged collective / stuck engine push) or the final save
+        itself exceeded its re-armed window (wedged disk).  Die
+        hang-free: flight dump with bounded lock acquires, then a hard
+        exit — NEVER a synchronous checkpoint from here, the training
+        state is mid-step and the main thread may hold the locks we'd
+        need."""
+        if self._final_done:
+            return
+        _flight.record("checkpoint", "grace-expired",
+                       waited_s=self._grace_secs)
+        try:
+            _flight.dump("preempt:grace-expired")
+        except Exception:
+            pass
+        os._exit(128 + int(signal.SIGTERM))
+
+    def _on_step_boundary(self, epoch=None, batch=None):
+        """The hooks.note_step_boundary target: one completed optimizer
+        step.  Ordinary steps advance the counter and maybe fire the
+        periodic async save; with a preemption pending this is the safe
+        point — final synchronous checkpoint, then re-raise."""
+        self._step += 1
+        if epoch is not None:
+            self._epoch = epoch
+        if batch is not None:
+            self._batch = batch
+        if self._preempt_at is not None:
+            # a boundary DID arrive inside the window: the original
+            # timer's remainder must not hard-kill the final save
+            # mid-commit.  Re-arm a fresh full window over the save
+            # itself so a wedged writer still can't hang preemption.
+            self._arm_grace_timer()
+            try:
+                self.save(self._step, sync=True, reason="sigterm")
+            except Exception:
+                pass                     # dying matters more than saving
+            self._final_done = True
+            if self._grace_timer is not None:
+                self._grace_timer.cancel()
+            self._chain_sigterm()
+            return
+        self.maybe_save()
+
+    def _chain_sigterm(self):
+        """Re-raise into the previous handler: the flight recorder dumps
+        and re-kills so the exit status still says SIGTERM; a default
+        disposition is restored and re-raised directly.  Either way this
+        never returns to the training loop."""
+        prev = self._prev_sigterm
+        try:
+            if callable(prev):
+                prev(signal.SIGTERM, None)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+        except Exception:
+            pass
+        os._exit(128 + int(signal.SIGTERM))
+
+    # -- introspection -----------------------------------------------------
+
+    def describe(self):
+        """JSON-shaped view for the ``/checkpoints`` endpoint."""
+        entries = []
+        for step, path, manifest in self._list_checkpoints():
+            ent = {"step": step, "path": path,
+                   "complete": bool(manifest and manifest.get("complete"))}
+            if manifest:
+                ent["n_shards"] = manifest.get("n_shards")
+                ent["bytes"] = sum(f.get("bytes", 0) for f in
+                                   manifest.get("files", {}).values())
+                ent["created_unix"] = manifest.get("created_unix")
+            entries.append(ent)
+        return {"directory": self._dir,
+                "step": self._step,
+                "last_committed_step": self.last_committed_step,
+                "every_steps": self._every_steps,
+                "n_shards": self._n_shards,
+                "keep": self._keep,
+                "preempt_pending": self.preempt_pending(),
+                "last_error": self.last_error,
+                "checkpoints": entries}
+
+
+def install_preemption_handler(manager=None):
+    """Install the SIGTERM-to-final-checkpoint handler for *manager*
+    (default: the hooks-registered one)."""
+    manager = manager if manager is not None else hooks.active()
+    if manager is None:
+        raise ValueError("no active CheckpointManager to install for")
+    manager.install_preemption_handler()
+    return manager

@@ -1,0 +1,84 @@
+"""Elastic shard layout for per-replica optimizer state.
+
+ZeRO-style weight-update sharding ("Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training", PAPERS.md arxiv 2004.13336)
+makes optimizer state per-replica: replica ``k`` of ``n`` owns the
+update of its slot subset, so a checkpoint must write ``n`` optimizer
+shards — and a restore onto ``m != n`` replicas must *redistribute*
+them.
+
+The redistribution here follows the portable-collectives playbook
+("Memory-efficient array redistribution through portable collective
+communication", PAPERS.md arxiv 2112.01075) at host/file granularity
+instead of chip granularity: the transfer is decomposed into per-shard
+chunks that are streamed one file at a time and re-keyed into the target
+layout, so no step of a restore ever materializes more than one source
+shard beyond the state being accumulated — never an all-gathered
+``n``-shard blob followed by an ``m``-way split.
+
+Slot→shard assignment is round-robin over the *sorted* slot ids.  That
+keeps the layout a pure function of (slots, n_shards) — every writer and
+every reader derives the same plan with no layout metadata beyond
+``n_shards`` in the manifest — and keeps shard payload sizes balanced
+for the common case of interleaved large/small parameters.
+"""
+from __future__ import annotations
+
+__all__ = ["assign_slots", "shard_states", "merge_into",
+           "redistribution_plan"]
+
+
+def assign_slots(slots, n_shards):
+    """Round-robin shard assignment: ``[[slots of shard 0], ...]``.
+
+    Deterministic in (slots, n_shards): slot ids are sorted first, so
+    dict iteration order of the caller never changes the layout.
+    """
+    n_shards = max(1, int(n_shards))
+    shards = [[] for _ in range(n_shards)]
+    for i, slot in enumerate(sorted(slots)):
+        shards[i % n_shards].append(slot)
+    return shards
+
+
+def shard_states(states, n_shards):
+    """Partition a ``{slot: state-tree}`` dict into per-replica payload
+    dicts, one per shard (empty shards are kept — the manifest's shard
+    count IS the device count of the saving job)."""
+    return [{slot: states[slot] for slot in shard}
+            for shard in assign_slots(states.keys(), n_shards)]
+
+
+def merge_into(acc, shard_payload):
+    """Fold one loaded shard into the accumulating ``{slot: tree}`` dict
+    (the streaming half of the redistribution: callers load shard files
+    one at a time and release each before the next).  Duplicate slots
+    across shards mean a corrupt layout and raise."""
+    for slot, tree in shard_payload.items():
+        if slot in acc:
+            raise ValueError("slot %r appears in two optimizer shards "
+                             "(corrupt shard layout)" % (slot,))
+        acc[slot] = tree
+    return acc
+
+
+def redistribution_plan(slots, n_from, n_to):
+    """Chunk moves for an ``n_from`` → ``n_to`` replica-count change:
+    ``[(slot, src_shard, dst_shard), ...]`` with no-op moves elided.
+
+    Purely descriptive on a single host (the restore path merges and
+    re-buckets via :func:`assign_slots`), but it is also the exact
+    per-chunk transfer schedule a multi-host restore would execute, and
+    tests pin the invariant that every slot lands in exactly one target
+    shard.
+    """
+    src = {}
+    for shard_idx, members in enumerate(assign_slots(slots, n_from)):
+        for slot in members:
+            src[slot] = shard_idx
+    moves = []
+    for shard_idx, members in enumerate(assign_slots(slots, n_to)):
+        for slot in members:
+            if src[slot] != shard_idx:
+                moves.append((slot, src[slot], shard_idx))
+    return moves

@@ -11,9 +11,12 @@ over the mesh before the update.
 """
 from __future__ import annotations
 
+import pickle
+
 from .. import kvstore as kvs
 from .. import optimizer as opt
 from .. import telemetry as _tel
+from ..checkpoint import hooks as _ckpt_hooks
 from .fused_trainer import fused_trainer_enabled, run_fused_step
 from .parameter import Parameter, ParameterDict
 
@@ -132,6 +135,10 @@ class Trainer(object):
                     self._loop_step(slots)
         for _, param in slots:
             param._fresh_grad = False
+        # step boundary: params/optimizer/iterator agree on one step —
+        # the active CheckpointManager snapshots here and honors a
+        # pending SIGTERM (one global read when no manager is installed)
+        _ckpt_hooks.note_step_boundary()
 
     def _loop_step(self, slots):
         """Per-slot fallback: one kvstore round + one eager Updater
@@ -147,16 +154,45 @@ class Trainer(object):
                 self._updater(slot, grad, param.data())
 
     def save_states(self, fname):
-        """Serialise Updater state (optimizer moments etc.) to *fname*."""
+        """Serialise optimizer state (moments etc.) to *fname*.
+
+        Writes the Updater's per-slot state trees AND the fused-trainer
+        step cache — the per-slot update counts that feed ``hyper['t']``
+        into the fused program (Adam/Nadam bias correction).  The legacy
+        format serialized only the ``_updater`` states, so a
+        save→load→step round-trip silently reset ``t`` and diverged from
+        an uninterrupted run.
+        """
         if self._optimizer is None:
             raise AssertionError("trainer has no optimizer")
+        payload = {
+            "__mxnet_trainer_states__": 2,
+            "updater": self._updater.get_states(),
+            "index_update_count":
+                {int(k): int(v) for k, v in
+                 self._optimizer._index_update_count.items()},
+            "num_update": int(self._optimizer.num_update),
+        }
         with open(fname, "wb") as fh:
-            fh.write(self._updater.get_states())
+            fh.write(pickle.dumps(payload))
 
     def load_states(self, fname):
-        """Restore Updater state written by :meth:`save_states`."""
+        """Restore state written by :meth:`save_states` (either format:
+        the versioned dict, or a legacy raw Updater blob)."""
         if not self._kv_initialized:
             self._init_kvstore()
         with open(fname, "rb") as fh:
-            self._updater.set_states(fh.read())
-        self._optimizer = self._updater.optimizer
+            raw = fh.read()
+        payload = pickle.loads(raw)
+        if isinstance(payload, dict) \
+                and "__mxnet_trainer_states__" in payload:
+            self._updater.set_states(payload["updater"])
+            self._optimizer = self._updater.optimizer
+            self._optimizer._index_update_count = \
+                dict(payload["index_update_count"])
+            self._optimizer.num_update = int(payload["num_update"])
+        else:
+            # legacy blob: reuse the decoded payload — a second
+            # set_states(raw) would re-materialize every state NDArray
+            self._updater.set_states_payload(payload)
+            self._optimizer = self._updater.optimizer

@@ -132,6 +132,19 @@ class DataIter:
     def reset(self):
         pass
 
+    # -- checkpoint-state protocol (mxnet_tpu.checkpoint) ------------------
+    # A resumable iterator returns a picklable cursor dict; the manager
+    # stores it in the checkpoint and feeds it back on restore so the
+    # post-resume batch sequence is bitwise-identical.  The base class
+    # opts out (None = "not resumable": save records nothing, restore
+    # skips) so wrapper/native iterators degrade gracefully.
+
+    def get_checkpoint_state(self):
+        return None
+
+    def set_checkpoint_state(self, state):
+        pass
+
     def next(self):
         return _timed_batch(self._produce_next)
 
@@ -208,6 +221,16 @@ class ResizeIter(_BatchView):
             self.current_batch = self.data_iter.next()
         self.cur += 1
         return True
+
+    def get_checkpoint_state(self):
+        inner = self.data_iter.get_checkpoint_state()
+        if inner is None:
+            return None
+        return {"kind": "ResizeIter", "cur": int(self.cur), "inner": inner}
+
+    def set_checkpoint_state(self, state):
+        self.cur = int(state["cur"])
+        self.data_iter.set_checkpoint_state(state["inner"])
 
 
 class _Slot:
@@ -455,6 +478,28 @@ class NDArrayIter(DataIter):
             return overrun
         return 0
 
+    def get_checkpoint_state(self):
+        """Cursor + the epoch's shuffle permutation: restoring both (with
+        the global host RNG snapshotted separately by the checkpoint
+        manager) makes the remaining batch sequence of this epoch — and
+        every reshuffle after it — bitwise-identical."""
+        return {"kind": "NDArrayIter", "cursor": int(self.cursor),
+                "idx": np.asarray(self.idx).copy()}
+
+    def set_checkpoint_state(self, state):
+        idx = np.asarray(state["idx"]).copy()
+        if idx.shape[0] != self.idx.shape[0]:
+            # dataset changed size between save and resume: raising here
+            # routes into the checkpoint manager's non-fatal skip (the
+            # stream restarts) instead of silently slicing garbage
+            # batches from a stale permutation
+            raise ValueError(
+                "checkpoint cursor covers %d samples, iterator has %d"
+                % (idx.shape[0], self.idx.shape[0]))
+        self.idx = idx
+        self.num_data = idx.shape[0]
+        self.cursor = int(state["cursor"])
+
 
 class _WrappedArrayIter(DataIter):
     """Shared shell for CSVIter/MNISTIter: parse files once, then delegate
@@ -471,6 +516,12 @@ class _WrappedArrayIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+    def get_checkpoint_state(self):
+        return self._inner.get_checkpoint_state()
+
+    def set_checkpoint_state(self, state):
+        self._inner.set_checkpoint_state(state)
 
 
 class CSVIter(_WrappedArrayIter):

@@ -394,6 +394,44 @@ class KVStore:
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 
+    # -- checkpoint-state protocol (mxnet_tpu.checkpoint) ------------------
+    # Server-side optimizer state (update_on_kvstore mode) as host bytes:
+    # the sharded-checkpoint analogue of save/load_optimizer_states, so a
+    # CheckpointManager captures the KVStore-resident Updater alongside
+    # the params it updates.  None = nothing to save (no updater).
+
+    def get_checkpoint_state(self):
+        if self._updater is None:
+            return None
+        # include the update counts: the server optimizer's bias
+        # correction (`t`) must survive a resume bitwise.  Keys pass
+        # through untouched — updaters fed through _updater_key may be
+        # keyed by int slot OR param-name string (the module
+        # update_on_kvstore path), and pickle preserves either.
+        blob = self._updater.get_states(dump_optimizer=False)
+        counts = num_update = None
+        srv_opt = getattr(self._updater, "optimizer", None)
+        if srv_opt is not None:
+            counts = dict(srv_opt._index_update_count)
+            num_update = int(srv_opt.num_update)
+        return pickle.dumps({"updater": blob,
+                             "index_update_count": counts,
+                             "num_update": num_update})
+
+    def set_checkpoint_state(self, blob):
+        if blob is None:
+            return
+        assert self._updater is not None, \
+            "restoring kvstore optimizer state needs an updater installed"
+        payload = pickle.loads(blob)
+        self._updater.set_states(payload["updater"])
+        srv_opt = getattr(self._updater, "optimizer", None)
+        if srv_opt is not None \
+                and payload.get("index_update_count") is not None:
+            srv_opt._index_update_count = \
+                dict(payload["index_update_count"])
+            srv_opt.num_update = int(payload["num_update"])
+
     def barrier(self):
         self._barrier_count += 1
 
@@ -669,6 +707,18 @@ class KVStoreDist(KVStore):
 
     def load_optimizer_states(self, fname):
         raise MXNetError("Cannot load states for distributed training")
+
+    def get_checkpoint_state(self):
+        """Dist optimizer state lives on the remote servers — there is
+        nothing host-local to shard into the checkpoint (same contract
+        as save_optimizer_states, but checkpointing degrades instead of
+        raising: params still snapshot)."""
+        return None
+
+    def set_checkpoint_state(self, blob):
+        if blob is not None:
+            raise MXNetError("cannot restore optimizer state into a "
+                             "distributed kvstore")
 
     def barrier(self):
         self._barrier_count += 1

@@ -12,6 +12,7 @@ import time
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from ..checkpoint import hooks as _ckpt_hooks
 from ..initializer import Uniform
 from ..model import BatchEndParam
 
@@ -169,6 +170,9 @@ class BaseModule:
             self.update_metric(train_metric, batch.label)
             if monitor is not None:
                 monitor.toc_print()
+            # step boundary (see gluon/trainer.py): checkpoint snapshot
+            # point + pending-SIGTERM honor, with the epoch cursor
+            _ckpt_hooks.note_step_boundary(epoch=epoch, batch=nbatch)
             _fire(batch_end_callback,
                   BatchEndParam(epoch=epoch, nbatch=nbatch,
                                 eval_metric=train_metric, locals=locals()))

@@ -681,7 +681,12 @@ class Updater:
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def set_states(self, states):
-        payload = pickle.loads(states)
+        self.set_states_payload(pickle.loads(states))
+
+    def set_states_payload(self, payload):
+        """Install an already-decoded get_states payload (callers that
+        sniffed the blob's format avoid a second full deserialization —
+        unpickling re-materializes every state NDArray on device)."""
         if isinstance(payload, tuple) and len(payload) == 2:
             self.states, maybe_opt = payload
             if maybe_opt is not None:

@@ -13,7 +13,8 @@ import threading
 import jax
 import numpy as np
 
-__all__ = ["seed", "next_key", "current_seed", "key_scope", "host_rng"]
+__all__ = ["seed", "next_key", "current_seed", "key_scope", "host_rng",
+           "get_state", "set_state"]
 
 _lock = threading.Lock()
 _seed = 0
@@ -78,3 +79,38 @@ class key_scope:
 
 def current_seed():
     return _seed
+
+
+def get_state():
+    """Full RNG state as a host-side picklable dict (checkpointing).
+
+    Captures the root jax key (as numpy), the seeded host Generator's
+    bit-generator state, and — when :func:`seed` was never called — the
+    legacy ``np.random`` module state, so a restored run replays the
+    exact draw sequence (shuffles, initializers, key splits) either way.
+    """
+    with _lock:
+        return {
+            "seed": _seed,
+            "key": None if _key is None else np.asarray(_key),
+            "host": None if _host_rng is None
+            else _host_rng.bit_generator.state,
+            "host_legacy": np.random.get_state() if _host_rng is None
+            else None,
+        }
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (checkpoint resume)."""
+    global _seed, _key, _host_rng
+    with _lock:
+        _seed = int(state["seed"])
+        _key = None if state["key"] is None \
+            else jax.numpy.asarray(np.asarray(state["key"]))
+        if state.get("host") is not None:
+            _host_rng = np.random.default_rng(_seed)
+            _host_rng.bit_generator.state = state["host"]
+        else:
+            _host_rng = None
+            if state.get("host_legacy") is not None:
+                np.random.set_state(state["host_legacy"])

@@ -344,6 +344,14 @@ COUNTERS = {
                            "boundaries (throughput spent on waste)",
     "serving_warmup_compiles": "AOT bucket variants compiled at model "
                                "load/warmup",
+    "checkpoint_saves": "checkpoints committed to disk (periodic async "
+                        "or SIGTERM-final synchronous)",
+    "checkpoint_restores": "successful CheckpointManager.restore() "
+                           "loads",
+    "checkpoint_write_retries": "transient checkpoint write failures "
+                                "retried with backoff",
+    "checkpoint_restore_fallbacks": "corrupt/partial checkpoints skipped "
+                                    "in favor of an older complete one",
 }
 
 GAUGES = {
@@ -371,6 +379,12 @@ GAUGES = {
                            "over model slots",
     "serving_models_loaded": "model slots currently loaded in the "
                              "serving registry",
+    "checkpoint_last_step": "training step of the last committed (or "
+                            "restored) checkpoint",
+    "checkpoint_write_seconds": "background-writer wall seconds for the "
+                                "last committed checkpoint",
+    "checkpoint_bytes": "total serialized bytes of the last committed "
+                        "checkpoint (all shards + manifest'd files)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)

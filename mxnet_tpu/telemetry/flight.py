@@ -47,7 +47,7 @@ from collections import deque
 __all__ = ["record", "note_span", "events", "configure", "capacity",
            "enabled", "step_count", "last_step_age", "payload", "dump",
            "thread_stacks", "install_crash_hooks", "start_hang_watchdog",
-           "reset"]
+           "reset", "restore_progress"]
 
 DEFAULT_EVENTS = 2048
 
@@ -158,6 +158,14 @@ def reset():
     _ring.clear()
     _steps[0] = 0
     _last_step[0] = 0.0
+
+
+def restore_progress(steps):
+    """Seed the step clock from a restored checkpoint so post-resume
+    flight dumps and ``/healthz`` report fleet-cumulative steps instead
+    of restarting from zero; the stall age restarts now."""
+    _steps[0] = max(0, int(steps))
+    _last_step[0] = time.monotonic()
 
 
 # --------------------------------------------------------------------------

@@ -13,6 +13,9 @@ touching the training loop:
     /trace      the Chrome traceEvents buffer (load in Perfetto)
     /flight     the flight-recorder payload (ring + stacks + snapshot)
     /stacks     every thread's Python stack, plain text
+    /checkpoints  the active CheckpointManager: committed checkpoints,
+                last step, preemption state (an inactive stub before a
+                manager is constructed)
 
 A background sampler (default 500 ms, ``MXNET_TELEMETRY_SAMPLE_MS``)
 keeps the passive gauges honest between steps: host-engine backlog
@@ -106,7 +109,8 @@ def health():
 # --------------------------------------------------------------------------
 
 _INDEX = ("mxnet_tpu introspection\n"
-          "endpoints: /metrics /healthz /snapshot /trace /flight /stacks\n"
+          "endpoints: /metrics /healthz /snapshot /trace /flight /stacks "
+          "/checkpoints\n"
           "serving:   /v1/models  /v1/models/<name>[/predict|/load|"
           "/unload|/reload]\n")
 
@@ -168,6 +172,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(core.chrome_trace_payload())
             elif path == "/flight":
                 self._reply_json(flight.payload("http"))
+            elif path == "/checkpoints":
+                # observe-only sys.modules lookup, like /v1 — never
+                # initializes anything.  `import mxnet_tpu` pulls the
+                # checkpoint package in, so in practice this answers the
+                # inactive stub until a CheckpointManager exists; the
+                # 404 arm only covers a standalone-telemetry embedding.
+                ckpt = sys.modules.get("mxnet_tpu.checkpoint")
+                if ckpt is None:
+                    self._reply_json(
+                        {"error": "checkpoint subsystem not initialized "
+                                  "(construct a CheckpointManager)"}, 404)
+                else:
+                    self._reply_json(ckpt.http_view())
             elif path == "/stacks":
                 stacks = flight.thread_stacks()
                 text = "\n".join("--- %s ---\n%s" % (k, "".join(v))

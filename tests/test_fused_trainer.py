@@ -254,6 +254,85 @@ def test_loop_path_honors_hyper_mutation():
     np.testing.assert_allclose(w2.asnumpy(), -1.0 * np.ones(4))
 
 
+def _trajectory(fused, total_steps, reload_at=None, tmp_path=None):
+    """Per-step losses of an adam run; optionally checkpoint the trainer
+    via save_states/load_states into a FRESH trainer at *reload_at*."""
+    prev_env = os.environ.get("MXNET_FUSED_TRAINER")
+    _set_fused_env("1" if fused else "0")
+    try:
+        np.random.seed(0)
+        mx.random.seed(0)
+        rng = np.random.RandomState(1)
+        X = rng.randn(total_steps, 8, 6).astype(np.float32)
+        Y = rng.randn(total_steps, 8, 3).astype(np.float32)
+
+        def fresh():
+            net = _net(3, 8)
+            net.initialize(init=mx.initializer.Xavier())
+            tr = gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.05})
+            return net, tr
+
+        net, trainer = fresh()
+        loss_fn = gluon.loss.L2Loss()
+        losses = []
+        for step in range(total_steps):
+            if reload_at is not None and step == reload_at:
+                fname = str(tmp_path / "trainer.states")
+                trainer.save_states(fname)
+                weights = [p.data().asnumpy()
+                           for p in net.collect_params().values()]
+                net, trainer = fresh()
+                for p, w in zip(net.collect_params().values(), weights):
+                    p.set_data(mx.nd.array(w))
+                trainer.load_states(fname)
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(X[step])),
+                               mx.nd.array(Y[step]))
+            loss.backward()
+            trainer.step(8)
+            losses.append(float(np.float64(loss.asnumpy().sum())))
+        return losses
+    finally:
+        _set_fused_env(prev_env)
+
+
+def test_save_load_step_bitwise_roundtrip(tmp_path):
+    """save_states → fresh trainer → load_states → step must continue
+    the trajectory BITWISE for a t-dependent optimizer (adam): the
+    serialized payload has to carry the fused-trainer step cache (the
+    per-slot update counts feeding hyper['t']), not just the legacy
+    ``_updater`` state trees.  Gated on both the fused path and the
+    ``MXNET_FUSED_TRAINER=0`` oracle, which must agree with each other.
+    """
+    ref_by_path = {}
+    for fused in (True, False):
+        ref = _trajectory(fused, 5)
+        resumed = _trajectory(fused, 5, reload_at=3, tmp_path=tmp_path)
+        assert resumed == ref, \
+            "save/load diverged the trajectory (fused=%s)" % fused
+        ref_by_path[fused] = ref
+    assert ref_by_path[True] == ref_by_path[False]
+
+
+def test_load_states_accepts_legacy_blob(tmp_path):
+    """Pre-versioning states files (a raw Updater.get_states pickle, no
+    version marker) still load."""
+    _, _, trainer = _train("sgd", (("learning_rate", 0.1),
+                                   ("momentum", 0.9)), fused=True)
+    f = str(tmp_path / "legacy.states")
+    with open(f, "wb") as fh:
+        fh.write(trainer._updater.get_states())
+    _, _, fresh = _train("sgd", (("learning_rate", 0.1),
+                                 ("momentum", 0.9)), fused=True, steps=1)
+    fresh.load_states(f)
+    for idx, st in trainer._updater.states.items():
+        if st is None:
+            continue
+        np.testing.assert_array_equal(st.asnumpy(),
+                                      fresh._updater.states[idx].asnumpy())
+
+
 def test_fused_save_load_states_roundtrip(tmp_path):
     """Checkpointed Updater state written by the fused path loads into a
     fresh Trainer (same layout as the loop path)."""

@@ -114,6 +114,15 @@ def test_snapshot_trace_flight_stacks_endpoints(live_server):
     assert einfo.value.code == 404
 
 
+def test_checkpoints_endpoint(live_server):
+    # mxnet_tpu.checkpoint is imported with the package, so the endpoint
+    # answers the inactive stub (or the live manager when one exists)
+    status, ctype, body = _get(live_server, "/checkpoints")
+    assert status == 200 and ctype == "application/json"
+    view = json.loads(body)
+    assert "checkpoints" in view and "active" in view
+
+
 def test_sampler_feeds_engine_and_step_rate_gauges(live_server):
     from mxnet_tpu import engine
     eng = engine.engine()
