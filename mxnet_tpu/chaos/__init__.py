@@ -1,0 +1,220 @@
+"""mxnet_tpu.chaos: deterministic, seedable fault injection.
+
+Fault tolerance that is never exercised is a comment, not a property.
+This package is the chaos tier that makes the dist transport's recovery
+paths *verifiable*: a seeded plan (``MXNET_CHAOS=<spec>`` or
+:func:`configure`) decides, deterministically, which calls at the owned
+seams fail and how — so a failing chaos run replays exactly from its
+seed + spec, and a transient-faults-only run can be asserted bitwise
+against a fault-free one.
+
+Injection seams wired in this build (each seam asks :func:`decide` and
+applies the returned fault itself, because only the seam knows what
+"drop" or "close" means there):
+
+=================  ======================================================
+``conn.send.<op>`` :meth:`mxnet_tpu.dist_ps.Conn.send` — *op* is the wire
+                   message's op name (``pull``, ``push``, ``barrier``, …)
+``conn.recv``      :meth:`mxnet_tpu.dist_ps.Conn.recv`
+``engine.task``    :meth:`mxnet_tpu.engine.ThreadedEngine.push` — decided
+                   at push time (deterministic order), applied in-task
+``ckpt.io``        each checkpoint shard/manifest file write
+                   (:mod:`mxnet_tpu.checkpoint.manager`)
+``serving.batch``  each coalesced serving batch execution
+                   (:mod:`mxnet_tpu.serving.batcher`)
+=================  ======================================================
+
+Determinism contract: every rule counts its own matching calls, and a
+fault triggers on the count (``@N`` windows) or on a per-fault
+``random.Random`` derived from ``(seed, site, kind, position)`` (``~P``
+probabilities).  Given the same spec, seed, and per-site call sequence,
+the injected-fault sequence is identical — :func:`fault_log` exposes it
+for replay assertions.  Every injected fault is also booked as the
+``chaos_faults`` telemetry counter and a ``chaos`` flight-ring event, so
+post-mortems distinguish injected pain from real failures.
+
+Off path: one module-bool check (:func:`active`); with ``MXNET_CHAOS``
+unset nothing else runs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from random import Random
+
+from .spec import (ChaosSpecError, Fault, Rule, KINDS, SITES,  # noqa: F401
+                   parse_spec, parse_duration)
+
+__all__ = ["ChaosError", "ChaosSpecError", "ChaosPlan", "active",
+           "configure", "refresh_from_env", "decide", "apply_inline",
+           "chaos_task", "fault_log", "plan", "reset", "describe",
+           "KINDS", "SITES", "parse_spec", "parse_duration"]
+
+
+class ChaosError(RuntimeError):
+    """An injected failure (never raised by real code paths): test
+    harnesses assert on this type to separate chaos from genuine bugs."""
+
+
+class ChaosPlan:
+    """A parsed spec + per-rule deterministic trigger state."""
+
+    def __init__(self, spec_text, seed=None):
+        env_seed, rules = parse_spec(spec_text)
+        self.spec = spec_text
+        self.seed = env_seed if env_seed is not None \
+            else (0 if seed is None else int(seed))
+        self.rules = rules
+        self._lock = threading.Lock()
+        self._counts = [0] * len(rules)
+        self._rngs = {}
+        self.log = []           # [(site, rule_site, kind, match_index)]
+
+    def _rng(self, ridx, fidx):
+        key = (ridx, fidx)
+        rng = self._rngs.get(key)
+        if rng is None:
+            rule = self.rules[ridx]
+            token = "%d|%s|%s|%d" % (self.seed, rule.site,
+                                     rule.faults[fidx].kind, fidx)
+            rng = self._rngs[key] = Random(zlib.adler32(token.encode()))
+        return rng
+
+    def decide(self, site):
+        """The fault to inject for this call at *site*, or None.
+
+        Counts every matching rule (so ``@N`` windows are stable no
+        matter which other rules exist); the first triggering fault of
+        the first matching rule wins.
+        """
+        hit = None
+        with self._lock:
+            for ridx, rule in enumerate(self.rules):
+                if not rule.matches(site):
+                    continue
+                self._counts[ridx] += 1
+                n = self._counts[ridx]
+                if hit is not None:
+                    continue        # keep counting later rules anyway
+                for fidx, fault in enumerate(rule.faults):
+                    if fault.lo is not None:
+                        fired = fault.lo <= n <= fault.hi
+                    elif fault.prob is not None:
+                        fired = self._rng(ridx, fidx).random() < fault.prob
+                    else:
+                        fired = True
+                    if fired:
+                        hit = (fault.kind, fault.value, site, n)
+                        self.log.append((site, rule.site, fault.kind, n))
+                        break
+        if hit is not None:
+            self._book(hit)
+        return hit
+
+    def _book(self, hit):
+        kind, _value, site, n = hit
+        try:
+            from ..telemetry import core as _tel
+            from ..telemetry import flight as _flight
+            _tel.bump("chaos_faults")
+            _flight.record("chaos", site, fault=kind, n=n)
+        except Exception:        # booking must never break injection
+            pass
+
+    def reset(self):
+        """Restart counters/RNGs/log (a fresh deterministic replay)."""
+        with self._lock:
+            self._counts = [0] * len(self.rules)
+            self._rngs.clear()
+            self.log = []
+
+    def describe(self):
+        return {"seed": self.seed,
+                "rules": [r.describe() for r in self.rules]}
+
+
+_PLAN = None
+_ACTIVE = False
+_CONF_LOCK = threading.Lock()
+
+
+def active():
+    """One cached-bool check: is any chaos plan installed?"""
+    return _ACTIVE
+
+
+def plan():
+    return _PLAN
+
+
+def configure(spec=None, seed=None):
+    """Install (or with a falsy *spec*, remove) the process chaos plan."""
+    global _PLAN, _ACTIVE
+    with _CONF_LOCK:
+        if not spec:
+            _PLAN, _ACTIVE = None, False
+            return None
+        _PLAN = ChaosPlan(spec, seed=seed)
+        _ACTIVE = _PLAN.rules != []
+        return _PLAN
+
+
+def refresh_from_env():
+    """Re-read ``MXNET_CHAOS`` (import-time default; tests/late config)."""
+    return configure(os.environ.get("MXNET_CHAOS", ""))
+
+
+def decide(site):
+    """The seam-facing entry point: fault tuple ``(kind, value, site,
+    n)`` or None.  Call only after an :func:`active` check."""
+    p = _PLAN
+    return None if p is None else p.decide(site)
+
+
+def apply_inline(act):
+    """Apply a fault generically at a non-socket seam: delays sleep,
+    everything else raises (``fail`` as OSError so transient-IO retry
+    paths engage; the rest as :class:`ChaosError`)."""
+    kind, value = act[0], act[1]
+    if kind in ("delay", "stall"):
+        time.sleep(value)
+        return
+    if kind == "fail":
+        raise OSError("chaos: injected transient IO failure at %s #%d"
+                      % (act[2], act[3]))
+    raise ChaosError("chaos: injected %s at %s #%d"
+                     % (kind, act[2], act[3]))
+
+
+def chaos_task(fn, act):
+    """Wrap an engine task with a fault decided at push time: the
+    decision order is the deterministic push order, the effect happens
+    where the failure matters (inside the task)."""
+    def _chaotic():
+        apply_inline(act)
+        return fn()
+    _chaotic.__qualname__ = (getattr(fn, "__qualname__", None)
+                             or getattr(fn, "__name__", "task")) + "[chaos]"
+    return _chaotic
+
+
+def fault_log():
+    """The injected-fault sequence so far (replay/determinism asserts)."""
+    p = _PLAN
+    return [] if p is None else list(p.log)
+
+
+def reset():
+    p = _PLAN
+    if p is not None:
+        p.reset()
+
+
+def describe():
+    p = _PLAN
+    return None if p is None else p.describe()
+
+
+refresh_from_env()

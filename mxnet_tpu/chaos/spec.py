@@ -1,0 +1,171 @@
+"""``MXNET_CHAOS`` spec grammar: text → fault-injection rules.
+
+One spec string describes every fault a run will inject, so a failing
+chaos run is reproducible from its environment alone::
+
+    MXNET_CHAOS="seed=7;conn.send.pull:drop@2;conn.recv:delay~0.1=2ms"
+
+Grammar (clauses are ';'-separated)::
+
+    spec   := clause (';' clause)*
+    clause := 'seed=' INT
+            | SITE ':' fault (',' fault)*
+    fault  := KIND trigger? ('=' VALUE)?
+    trigger:= '@' N           -- only the N-th matching call (1-based)
+            | '@' N '-' M     -- calls N through M inclusive
+            | '~' P           -- each matching call with probability P
+    VALUE  := duration ('5ms', '0.25s', '10us', bare seconds float)
+
+Sites are dotted and match by prefix: a rule for ``conn.send`` fires on
+``conn.send.pull`` and ``conn.send.push`` alike; ``conn.send.pull``
+fires only on pull frames.  A fault with no trigger fires on every
+matching call.
+
+Kinds (how each is applied is the owning seam's business —
+see :mod:`mxnet_tpu.chaos`):
+
+==========  ==========================================================
+``drop``    conn.send: the frame is silently discarded
+``delay``   sleep VALUE seconds before the operation
+``stall``   alias of ``delay`` (reads better at ``engine.task``)
+``close``   conn.*: close the socket (the peer sees EOF / reset)
+``garbage`` conn.send: replace the frame with garbage bytes
+``exc``     raise :class:`~mxnet_tpu.chaos.ChaosError` at the site
+``fail``    raise ``OSError`` (transient-IO flavor, e.g. ``ckpt.io``)
+==========  ==========================================================
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["ChaosSpecError", "Fault", "Rule", "KINDS", "SITES",
+           "parse_spec", "parse_duration"]
+
+KINDS = frozenset({"drop", "delay", "stall", "close", "garbage",
+                   "exc", "fail"})
+
+# the seams wired up in this build (documentation + spec validation;
+# prefixes of these are fine, arbitrary others are a typo'd spec)
+SITES = ("conn.send", "conn.recv", "engine.task", "ckpt.io",
+         "serving.batch")
+
+_DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)(us|ms|s)?$")
+_FAULT_RE = re.compile(
+    r"^(?P<kind>[a-z]+)"
+    r"(?:@(?P<lo>\d+)(?:-(?P<hi>\d+))?|~(?P<prob>[0-9.]+))?"
+    r"(?:=(?P<value>[^=]+))?$")
+
+
+class ChaosSpecError(ValueError):
+    """The MXNET_CHAOS string does not parse — fail the run loudly; a
+    silently ignored chaos spec would report phantom robustness."""
+
+
+def parse_duration(raw):
+    """'5ms' / '0.25s' / '10us' / bare float → seconds."""
+    m = _DUR_RE.match(raw.strip())
+    if not m:
+        raise ChaosSpecError("bad duration %r (want e.g. 5ms, 0.25s)" % raw)
+    val = float(m.group(1))
+    unit = m.group(2) or "s"
+    return val * {"us": 1e-6, "ms": 1e-3, "s": 1.0}[unit]
+
+
+class Fault:
+    """One fault kind + its trigger window/probability + value."""
+
+    __slots__ = ("kind", "lo", "hi", "prob", "value")
+
+    def __init__(self, kind, lo=None, hi=None, prob=None, value=None):
+        self.kind, self.lo, self.hi = kind, lo, hi
+        self.prob, self.value = prob, value
+
+    def describe(self):
+        out = self.kind
+        if self.lo is not None:
+            out += "@%d" % self.lo
+            if self.hi != self.lo:
+                out += "-%d" % self.hi
+        elif self.prob is not None:
+            out += "~%g" % self.prob
+        if self.value is not None:
+            out += "=%gs" % self.value
+        return out
+
+
+class Rule:
+    """All faults configured for one site prefix."""
+
+    __slots__ = ("site", "faults")
+
+    def __init__(self, site, faults):
+        self.site, self.faults = site, faults
+
+    def matches(self, site):
+        return site == self.site or site.startswith(self.site + ".")
+
+    def describe(self):
+        return "%s:%s" % (self.site,
+                          ",".join(f.describe() for f in self.faults))
+
+
+def _parse_fault(raw, site):
+    m = _FAULT_RE.match(raw.strip())
+    if not m:
+        raise ChaosSpecError("bad fault %r in clause for %r" % (raw, site))
+    kind = m.group("kind")
+    if kind not in KINDS:
+        raise ChaosSpecError(
+            "unknown fault kind %r (know: %s)" % (kind, sorted(KINDS)))
+    lo = hi = prob = None
+    if m.group("lo") is not None:
+        lo = int(m.group("lo"))
+        hi = int(m.group("hi")) if m.group("hi") is not None else lo
+        if lo < 1 or hi < lo:
+            raise ChaosSpecError("bad occurrence window in %r" % raw)
+    elif m.group("prob") is not None:
+        prob = float(m.group("prob"))
+        if not 0.0 <= prob <= 1.0:
+            raise ChaosSpecError("probability out of [0,1] in %r" % raw)
+    value = None
+    if m.group("value") is not None:
+        value = parse_duration(m.group("value"))
+    if kind in ("delay", "stall") and value is None:
+        raise ChaosSpecError("%r needs a duration (e.g. %s=5ms)"
+                             % (kind, kind))
+    return Fault(kind, lo=lo, hi=hi, prob=prob, value=value)
+
+
+def parse_spec(text):
+    """Parse a full MXNET_CHAOS string → (seed-or-None, [Rule])."""
+    seed, rules = None, []
+    for clause in (text or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if clause.startswith("seed="):
+            try:
+                seed = int(clause[5:])
+            except ValueError:
+                raise ChaosSpecError("bad seed in %r" % clause)
+            continue
+        if ":" not in clause:
+            raise ChaosSpecError(
+                "clause %r is neither 'seed=N' nor 'site:fault,...'"
+                % clause)
+        site, _, faults_raw = clause.partition(":")
+        site = site.strip()
+        if not site or not re.match(r"^[a-z0-9_.]+$", site):
+            raise ChaosSpecError("bad site %r" % site)
+        if not any(site == s or site.startswith(s + ".") or
+                   s.startswith(site + ".") or s == site
+                   for s in SITES):
+            raise ChaosSpecError(
+                "site %r matches no known injection seam %s"
+                % (site, list(SITES)))
+        faults = [_parse_fault(f, site)
+                  for f in faults_raw.split(",") if f.strip()]
+        if not faults:
+            raise ChaosSpecError("no faults in clause for %r" % site)
+        rules.append(Rule(site, faults))
+    return seed, rules

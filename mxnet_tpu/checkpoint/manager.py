@@ -46,6 +46,7 @@ import zlib
 
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import ndarray as nd
 from .. import random as _random
 from .. import telemetry as _tel
@@ -327,6 +328,10 @@ class CheckpointManager:
         return False
 
     def _put_file(self, tmp, name, obj, files):
+        if _chaos.active():       # per-file IO seam: `fail` faults land
+            act = _chaos.decide("ckpt.io")   # in the retry-with-backoff
+            if act is not None:              # path like real disk flakes
+                _chaos.apply_inline(act)
         blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         with open(os.path.join(tmp, name), "wb") as fh:
             fh.write(blob)

@@ -17,6 +17,19 @@ optimizer-on-server) is a working, testable surface — the nightly
 dist-invariant tests run against it with real local processes, the same
 way the reference runs ps-lite over localhost.
 
+Failure doctrine (docs/FAULT_TOLERANCE.md): a dead or silent peer must
+surface as a structured :class:`PeerLost` within a bounded time, never as
+a hang.  Every worker-side RPC recv carries a deadline
+(``MXNET_PS_RPC_TIMEOUT_S``); idempotent RPCs (pull, rendezvous reads,
+state snapshots) retry on a *fresh* connection with exponential backoff +
+jitter; scheduler↔server/worker heartbeats feed dead-peer detection and
+the introspection server's ``/peers`` view; and a worker can
+:meth:`~WorkerTransport.refresh_servers` onto a restarted server whose
+shard state is restored through the checkpoint-state protocol
+(``get_state``/``set_state`` — the PR-7 ``kvstore`` analogue).  The
+:mod:`mxnet_tpu.chaos` tier injects faults at ``Conn`` send/recv to prove
+all of this under test.
+
 Role selection uses the reference's env-var contract
 (``DMLC_ROLE``, ``DMLC_PS_ROOT_URI``, ``DMLC_PS_ROOT_PORT``,
 ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``), so launch scripts written for
@@ -29,13 +42,22 @@ import pickle
 import socket
 import struct
 import threading
+import time
+import weakref
+from random import Random as _JitterRandom
 
 import numpy as np
 
+from . import chaos as _chaos
+from .telemetry import core as _tel
+from .telemetry import flight as _flight
+
 __all__ = ["role", "num_workers", "num_servers", "root_addr",
-           "Conn", "ProtocolError", "Scheduler", "Server",
-           "WorkerTransport", "run_scheduler", "run_server",
-           "shard_ranges", "server_of_key", "BIGARRAY_BOUND"]
+           "Conn", "ProtocolError", "PeerLost", "RPCTimeout",
+           "Scheduler", "Server", "WorkerTransport",
+           "run_scheduler", "run_server", "shard_ranges", "server_of_key",
+           "BIGARRAY_BOUND", "peer_view", "refresh_gauges",
+           "refresh_from_env"]
 
 # Wire frame: magic + protocol version + payload length. The magic word
 # rejects stray/rogue connections before any payload is parsed; the
@@ -50,6 +72,108 @@ _MAX_FRAME = 1 << 34          # 16 GiB: above any realistic shard
 class ProtocolError(ConnectionError):
     """Peer spoke garbage: wrong magic/version, oversized frame, or a
     pickle payload outside the allowlist."""
+
+
+class PeerLost(ConnectionError):
+    """A dist peer died or went silent: the structured, catchable form
+    of every transport failure — callers recover (reconnect/restore) or
+    re-raise, but they never hang."""
+
+    def __init__(self, message, role=None, rank=None, addr=None,
+                 reason=None):
+        super().__init__(message)
+        self.role = role
+        self.rank = rank
+        self.addr = addr
+        self.reason = reason
+
+
+class RPCTimeout(PeerLost):
+    """No (complete) reply within the RPC deadline."""
+
+    def __init__(self, message, **kw):
+        kw.setdefault("reason", "rpc-timeout")
+        super().__init__(message, **kw)
+
+
+# ---------------------------------------------------------------------------
+# env knobs — cached at import (JG006 cached-value pattern; these sit on
+# the push/pull hot path).  refresh_from_env() re-reads for tests.
+# ---------------------------------------------------------------------------
+
+def _env_float(name, default, minimum=0.0):
+    try:
+        return max(minimum, float(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_int(name, default, minimum=0):
+    try:
+        return max(minimum, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _read_env():
+    timeout = _env_float("MXNET_PS_RPC_TIMEOUT_S", 60.0)
+    heartbeat = _env_float("MXNET_PS_HEARTBEAT_S", 2.0)
+    return {
+        # 0 = unbounded (None): the pre-hardening behavior, opt-in only
+        "rpc_timeout": timeout if timeout > 0 else None,
+        "rpc_retries": _env_int("MXNET_PS_RPC_RETRIES", 3, minimum=1),
+        "connect_retries": _env_int("MXNET_PS_CONNECT_RETRIES", 100,
+                                    minimum=1),
+        "connect_delay": _env_float("MXNET_PS_CONNECT_DELAY_S", 0.1),
+        "heartbeat": heartbeat,
+        # staleness is the LAST-resort tripwire (a truly silent peer on
+        # a live socket); disconnects detect a dead process instantly.
+        # Keep the window generous so CPU-starved-but-alive peers (cold
+        # jax compiles, loaded CI hosts) are never falsely buried.
+        "dead_after": _env_float("MXNET_PS_DEAD_AFTER_S",
+                                 15.0 * heartbeat if heartbeat else 30.0),
+        "barrier_timeout":
+            _env_float("MXNET_PS_BARRIER_TIMEOUT_S", 600.0) or None,
+    }
+
+
+_ENV = _read_env()
+
+
+def refresh_from_env():
+    """Re-read every MXNET_PS_* knob (tests / late configuration)."""
+    global _ENV
+    _ENV = _read_env()
+
+
+# retry jitter: intentionally unseeded — it desynchronizes thundering
+# herds and never affects numerics, so reproducibility doesn't want it
+_jitter = _JitterRandom()
+
+
+def BIGARRAY_BOUND():
+    """Elements above which a key is range-sharded across all servers
+    (reference: MXNET_KVSTORE_BIGARRAY_BOUND, kvstore_dist.h:60)."""
+    # deliberate re-read: dist tests retune the bound between phases
+    # graftlint: disable=JG006
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
+
+
+def role():
+    return os.environ.get("DMLC_ROLE", "worker")
+
+
+def num_workers():
+    return int(os.environ.get("DMLC_NUM_WORKER", 1))
+
+
+def num_servers():
+    return int(os.environ.get("DMLC_NUM_SERVER", 1))
+
+
+def root_addr():
+    return (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)))
 
 
 # Payloads are numpy arrays + plain containers + framework classes
@@ -78,84 +202,163 @@ def _restricted_loads(blob):
     return _RestrictedUnpickler(io.BytesIO(blob)).load()
 
 
-def BIGARRAY_BOUND():
-    """Elements above which a key is range-sharded across all servers
-    (reference: MXNET_KVSTORE_BIGARRAY_BOUND, kvstore_dist.h:60)."""
-    # deliberate re-read: dist tests retune the bound between phases
-    # graftlint: disable=JG006
-    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
+_UNSET = object()
 
 
-def role():
-    return os.environ.get("DMLC_ROLE", "worker")
-
-
-def num_workers():
-    return int(os.environ.get("DMLC_NUM_WORKER", 1))
-
-
-def num_servers():
-    return int(os.environ.get("DMLC_NUM_SERVER", 1))
-
-
-def root_addr():
-    return (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
-            int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)))
+def _send_site(msg):
+    """Chaos site for one outgoing frame: ``conn.send.<op>`` when the
+    message is a tagged tuple, bare ``conn.send`` otherwise."""
+    if isinstance(msg, tuple) and msg and isinstance(msg[0], str):
+        return "conn.send." + msg[0]
+    return "conn.send"
 
 
 class Conn:
-    """Blocking message channel: (magic, version, length) header +
-    allowlist-restricted pickle payload."""
+    """Message channel: (magic, version, length) header + allowlist-
+    restricted pickle payload.
 
-    def __init__(self, sock):
+    Deadlines: *timeout* (seconds) bounds every recv by default;
+    ``recv(timeout=...)`` overrides per call, and an explicit
+    ``timeout=None`` documents a deliberate unbounded wait (the JG007
+    contract).  A timeout that interrupts a half-read frame poisons the
+    connection — the stream is no longer aligned, so later recvs fail
+    fast instead of decoding garbage.
+    """
+
+    def __init__(self, sock, timeout=None):
         self.sock = sock
         self._wlock = threading.Lock()
+        self._timeout = timeout
+        self._broken = None
+        try:
+            sock.settimeout(timeout)
+        except OSError:       # already-closed test socket: fail at use
+            pass
 
     @classmethod
-    def connect(cls, addr, retries=100, delay=0.1):
-        import time
+    def connect(cls, addr, retries=None, delay=None, timeout=_UNSET):
+        """Dial with bounded retries (``MXNET_PS_CONNECT_RETRIES`` /
+        ``MXNET_PS_CONNECT_DELAY_S``); the resulting connection keeps a
+        bounded recv deadline (``MXNET_PS_RPC_TIMEOUT_S``) instead of
+        reverting to blocking-forever."""
+        env = _ENV
+        if retries is None:
+            retries = env["connect_retries"]
+        if delay is None:
+            delay = env["connect_delay"]
+        if timeout is _UNSET:
+            timeout = env["rpc_timeout"]
         last = None
-        for _ in range(retries):
+        for _ in range(max(1, retries)):
             try:
                 s = socket.create_connection(addr, timeout=60)
-                s.settimeout(None)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                return cls(s)
+                return cls(s, timeout=timeout)
             except OSError as exc:
                 last = exc
                 time.sleep(delay)
-        raise ConnectionError("cannot reach %s:%d: %s" % (addr[0], addr[1], last))
+        raise ConnectionError(
+            "cannot reach %s:%d after %d attempts: %r"
+            % (addr[0], addr[1], max(1, retries), last)) from last
 
     def send(self, msg):
         blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        if self._broken:
+            raise ConnectionError(
+                "connection poisoned (%s); reconnect before reuse"
+                % self._broken)
+        if _chaos.active():
+            act = _chaos.decide(_send_site(msg))
+            if act is not None:
+                kind = act[0]
+                if kind == "drop":
+                    return                  # frame vanishes on the wire
+                if kind in ("delay", "stall"):
+                    time.sleep(act[1])
+                elif kind == "close":
+                    self.close()
+                    raise ConnectionError(
+                        "chaos: connection closed before send")
+                elif kind == "garbage":
+                    with self._wlock:
+                        self.sock.sendall(b"\xde\xad\xbe\xef" * 4)
+                    return
+                else:
+                    _chaos.apply_inline(act)
         with self._wlock:
             self.sock.sendall(
                 _HDR.pack(_MAGIC, _WIRE_VERSION, len(blob)) + blob)
 
-    def recv(self):
-        magic, ver, n = _HDR.unpack(self._read(_HDR.size))
-        if magic != _MAGIC:
-            raise ProtocolError("bad frame magic %r" % (magic,))
-        if ver != _WIRE_VERSION:
-            raise ProtocolError(
-                "peer speaks wire version %d, this process speaks %d"
-                % (ver, _WIRE_VERSION))
-        if n > _MAX_FRAME:
-            raise ProtocolError("frame of %d bytes exceeds limit" % n)
+    def recv(self, timeout=_UNSET):
+        """Receive one message.  *timeout* seconds (default: the
+        connection's deadline); pass an explicit ``timeout=None`` only
+        for documented-deliberate unbounded waits.  Raises
+        :class:`RPCTimeout` on deadline, :class:`ProtocolError` on
+        garbage, :class:`ConnectionError` on EOF."""
+        if self._broken:
+            raise ConnectionError(
+                "connection poisoned (%s); reconnect before reuse"
+                % self._broken)
+        eff = self._timeout if timeout is _UNSET else timeout
+        if _chaos.active():
+            act = _chaos.decide("conn.recv")
+            if act is not None:
+                kind = act[0]
+                if kind in ("delay", "stall"):
+                    time.sleep(act[1])
+                elif kind == "close":
+                    self.close()            # the read below sees EOF
+                else:
+                    _chaos.apply_inline(act)
+        consumed = [0]
         try:
-            return _restricted_loads(self._read(n))
+            try:
+                self.sock.settimeout(eff)
+                hdr = self._read(_HDR.size, consumed)
+                magic, ver, n = _HDR.unpack(hdr)
+                if magic != _MAGIC:
+                    raise ProtocolError("bad frame magic %r" % (magic,))
+                if ver != _WIRE_VERSION:
+                    raise ProtocolError(
+                        "peer speaks wire version %d, this process "
+                        "speaks %d" % (ver, _WIRE_VERSION))
+                if n > _MAX_FRAME:
+                    raise ProtocolError(
+                        "frame of %d bytes exceeds limit" % n)
+                blob = self._read(n, consumed)
+            finally:
+                try:
+                    self.sock.settimeout(self._timeout)
+                except OSError:
+                    pass
+        except socket.timeout as exc:
+            mid = bool(consumed[0])
+            if mid:       # half a frame read: stream alignment is gone
+                self._broken = "mid-frame rpc timeout"
+            _tel.bump("ps_rpc_timeouts")
+            raise RPCTimeout(
+                "no%s reply within %.1fs%s"
+                % ("" if not mid else " complete", eff or 0.0,
+                   " (mid-frame; connection poisoned)" if mid else "")
+            ) from exc
+        try:
+            return _restricted_loads(blob)
         except pickle.UnpicklingError as exc:
             raise ProtocolError(str(exc))
         except Exception as exc:   # truncated/garbage pickle bytes
             raise ProtocolError("undecodable payload: %r" % (exc,))
 
-    def _read(self, n):
+    def _read(self, n, consumed=None):
         buf = bytearray()
         while len(buf) < n:
+            # bounded by the settimeout() in recv(): the one deliberate
+            # raw-socket read funnel  # graftlint: disable=JG007
             chunk = self.sock.recv(n - len(buf))
             if not chunk:
                 raise ConnectionError("peer closed")
             buf.extend(chunk)
+            if consumed is not None:
+                consumed[0] += len(chunk)
         return bytes(buf)
 
     def close(self):
@@ -200,19 +403,137 @@ def placement(key, shape, nserv):
 
 
 # ---------------------------------------------------------------------------
-# Scheduler: rendezvous + barrier + shutdown fan-out
+# local node registry + /peers view (observe-only, no network IO)
+# ---------------------------------------------------------------------------
+
+_NODES = {}               # (role, rank) -> zero-arg dict provider
+_NODES_LOCK = threading.Lock()
+_SCHEDULER_REF = None     # weakref to the in-process Scheduler, if any
+_PEER_SNAPSHOT = None     # (unix_time, table) last fetched by a worker
+
+
+def _register_node(role_name, rank, provider):
+    with _NODES_LOCK:
+        _NODES[(role_name, rank)] = provider
+
+
+def _set_peer_snapshot(table):
+    global _PEER_SNAPSHOT
+    _PEER_SNAPSHOT = (time.time(), table)
+
+
+def peer_view():
+    """Dist/peer health for the introspection server's ``/peers``.
+
+    Observe-only by contract: reports this process's registered nodes,
+    the live table when this process IS the scheduler, and otherwise the
+    last scheduler snapshot the heartbeat thread cached — never a fresh
+    network round trip from the HTTP handler.
+    """
+    with _NODES_LOCK:
+        nodes = dict(_NODES)
+    local = []
+    for (role_name, rank), provider in sorted(nodes.items()):
+        entry = {"role": role_name, "rank": rank}
+        try:
+            entry.update(provider() or {})
+        except Exception:
+            pass
+        local.append(entry)
+    out = {"role": role(), "local_nodes": local,
+           "counters": {name: _tel.counter(name) for name in
+                        ("ps_rpc_timeouts", "ps_rpc_retries",
+                         "ps_peer_lost", "ps_reconnects",
+                         "ps_heartbeats", "chaos_faults")}}
+    sched = _SCHEDULER_REF() if _SCHEDULER_REF is not None else None
+    if sched is not None:
+        out["scheduler"] = sched.peer_table()
+    snap = _PEER_SNAPSHOT
+    if snap is not None:
+        out["peers"] = dict(snap[1],
+                            snapshot_age_s=round(time.time() - snap[0], 3))
+    chaos_desc = _chaos.describe()
+    if chaos_desc is not None:
+        out["chaos"] = chaos_desc
+    return out
+
+
+def refresh_gauges():
+    """Feed the ``ps_dead_peers`` gauge (called by the introspection
+    sampler through ``sys.modules`` — observe-only)."""
+    table = None
+    sched = _SCHEDULER_REF() if _SCHEDULER_REF is not None else None
+    if sched is not None:
+        table = sched.peer_table()
+    elif _PEER_SNAPSHOT is not None:
+        table = _PEER_SNAPSHOT[1]
+    if table is None:
+        return
+    dead = sum(1 for group in ("workers", "servers")
+               for info in table.get(group, {}).values()
+               if info.get("dead"))
+    _tel.set_gauge("ps_dead_peers", dead)
+
+
+def _start_heartbeat(role_name, rank):
+    """Daemon thread: a dedicated scheduler connection carrying periodic
+    one-way ``heartbeat`` frames (and, every few ticks, a ``peers``
+    request whose reply feeds the cached /peers snapshot).  Returns a
+    stop Event, or None when heartbeats are disabled."""
+    env = _ENV
+    if env["heartbeat"] <= 0:
+        return None
+    stop = threading.Event()
+
+    def _loop():
+        try:
+            conn = Conn.connect(root_addr(), retries=20,
+                                timeout=max(env["dead_after"], 5.0))
+            conn.send(("hb_register", role_name, rank))
+        except (OSError, ConnectionError):
+            return                     # no scheduler: nothing to feed
+        tick = 0
+        while not stop.wait(env["heartbeat"]):
+            tick += 1
+            try:
+                conn.send(("heartbeat",))
+                _tel.bump("ps_heartbeats")
+                if tick % 5 == 0:
+                    conn.send(("peers",))
+                    reply = conn.recv(timeout=max(env["dead_after"], 5.0))
+                    if reply and reply[0] == "peers":
+                        _set_peer_snapshot(reply[1])
+            except (OSError, ConnectionError):
+                return                 # scheduler gone; RPCs will notice
+        conn.close()
+
+    threading.Thread(target=_loop, name="mxps-hb-%s-%s"
+                     % (role_name, rank), daemon=True).start()
+    return stop
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: rendezvous + barrier + heartbeats + shutdown fan-out
 # ---------------------------------------------------------------------------
 
 class Scheduler:
-    """Assigns ranks, publishes the server address list, serves barriers.
+    """Assigns ranks, publishes the server address list, serves barriers,
+    and tracks peer liveness.
 
     Lifecycle: all S servers and N workers connect and register; the
     scheduler replies with (rank, server_addrs).  Workers keep the
     connection for barrier()/finalize; when every worker has finalized,
-    servers are told to shut down and the scheduler exits.
+    servers are told to shut down and the scheduler exits.  Each peer
+    additionally opens a heartbeat connection (``hb_register``); a peer
+    whose heartbeats stop for ``MXNET_PS_DEAD_AFTER_S`` (or whose
+    heartbeat link drops) is marked dead — dead workers fail any pending
+    or future barrier *immediately* (``barrier_failed``), and a dead
+    server's rank is handed to the next ``reg_server`` so a restarted
+    server can take over its shard.
     """
 
     def __init__(self, nworkers, nservers, port=None):
+        global _SCHEDULER_REF
         self.nworkers, self.nservers = nworkers, nservers
         self.lsock = socket.socket()
         self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -228,7 +549,43 @@ class Scheduler:
         self._finalized = 0
         self._finalized_ranks = set()
         self.dead_workers = set()
+        self.dead_servers = set()
+        self._hb = {}             # (role, rank) -> last monotonic
         self._done = threading.Event()
+        _SCHEDULER_REF = weakref.ref(self)
+        _register_node("scheduler", 0, self._node_info)
+
+    def _node_info(self):
+        with self._lock:
+            return {"nworkers": self.nworkers, "nservers": self.nservers,
+                    "finalized": len(self._finalized_ranks),
+                    "dead_workers": sorted(self.dead_workers),
+                    "dead_servers": sorted(self.dead_servers)}
+
+    def peer_table(self):
+        """JSON-able liveness table (the /peers payload's core)."""
+        now = time.monotonic()
+        with self._lock:
+            workers = {}
+            for r in range(self.nworkers):
+                seen = self._hb.get(("worker", r))
+                workers[str(r)] = {
+                    "last_heartbeat_age_s":
+                        None if seen is None else round(now - seen, 3),
+                    "registered": r in self.worker_conns,
+                    "dead": r in self.dead_workers,
+                    "finalized": r in self._finalized_ranks}
+            servers = {}
+            for r in range(self.nservers):
+                seen = self._hb.get(("server", r))
+                servers[str(r)] = {
+                    "last_heartbeat_age_s":
+                        None if seen is None else round(now - seen, 3),
+                    "addr": self.server_addrs[r],
+                    "dead": r in self.dead_servers}
+            return {"nworkers": self.nworkers, "nservers": self.nservers,
+                    "workers": workers, "servers": servers,
+                    "barrier_waiters": len(self._barrier_waiters)}
 
     def run(self):
         # Accept until shutdown rather than counting to N connections: a
@@ -251,19 +608,108 @@ class Scheduler:
                 pass
         self.lsock.close()
 
+    # -- liveness ----------------------------------------------------------
+
+    def _mark_dead(self, role_name, rank, reason):
+        """Book a dead peer; dead workers fail pending barriers at once
+        (a barrier missing a dead member can never complete — waiting
+        would be the exact hang this module exists to prevent)."""
+        notify = []
+        with self._lock:
+            if role_name == "server":
+                if rank in self.dead_servers:
+                    return
+                self.dead_servers.add(rank)
+            else:
+                if rank in self.dead_workers \
+                        or rank in self._finalized_ranks:
+                    return
+                self.dead_workers.add(rank)
+                if self._barrier_waiters:
+                    notify, self._barrier_waiters = \
+                        self._barrier_waiters, []
+                    self._barrier_gen += 1
+                    self._registered.notify_all()
+                # with every remaining worker finalized or dead the job
+                # can never finalize cleanly: release the servers.  NOT
+                # on staleness though — a stale peer is revivable (GC /
+                # cold-compile pause), and tearing the servers down
+                # would make the revive meaningless.
+                if reason != "heartbeat-stale" \
+                        and len(self._finalized_ranks | self.dead_workers) \
+                        == self.nworkers:
+                    self._done.set()
+            dead = sorted(self.dead_workers)
+        _flight.record("peer_dead", "%s-%s" % (role_name, rank),
+                       reason=reason)
+        for c in notify:
+            try:
+                c.send(("barrier_failed", dead))
+            except (OSError, ConnectionError):
+                pass
+
+    def _revive(self, role_name, rank):
+        with self._lock:
+            if role_name == "server":
+                self.dead_servers.discard(rank)
+            else:
+                self.dead_workers.discard(rank)
+
+    def _serve_heartbeats(self, conn, role_name, rank):
+        """Per-peer heartbeat loop: stamp arrivals, declare staleness,
+        answer ``peers`` snapshot requests on the same link."""
+        key = (role_name, rank)
+        with self._lock:
+            self._hb[key] = time.monotonic()
+        stale = False
+        while not self._done.is_set():
+            try:
+                msg = conn.recv(timeout=max(_ENV["dead_after"], 0.05))
+            except RPCTimeout:
+                stale = True
+                self._mark_dead(role_name, rank, "heartbeat-stale")
+                continue
+            except (OSError, ConnectionError):
+                self._mark_dead(role_name, rank, "heartbeat-disconnect")
+                return
+            with self._lock:
+                self._hb[key] = time.monotonic()
+            if stale:           # a long GC pause, not a death: revive
+                stale = False
+                self._revive(role_name, rank)
+            if msg and msg[0] == "peers":
+                try:
+                    conn.send(("peers", self.peer_table()))
+                except (OSError, ConnectionError):
+                    return
+
+    # -- registration + control --------------------------------------------
+
     def _serve(self, conn):
         try:
-            msg = conn.recv()
+            # registration follows connect immediately; a silent socket
+            # here is a rogue peer, not a straggler
+            msg = conn.recv(timeout=max(_ENV["dead_after"] * 5, 30.0))
             kind = msg[0]
-            if kind not in ("reg_server", "reg_worker"):
+            if kind not in ("reg_server", "reg_worker", "hb_register"):
                 raise ProtocolError("first message must register a role")
         except (ConnectionError, TypeError, IndexError, KeyError):
             conn.close()   # rogue peer: drop without consuming a slot
             return
+        if kind == "hb_register":
+            self._serve_heartbeats(conn, str(msg[1]), int(msg[2]))
+            return
         with self._lock:
             if kind == "reg_server":
-                rank = sum(a is not None for a in self.server_addrs)
-                if rank >= self.nservers:
+                if None in self.server_addrs:
+                    rank = self.server_addrs.index(None)
+                elif self.dead_servers:
+                    # a restarted server takes over a dead rank's shard;
+                    # the caller restores its state via set_state
+                    rank = min(self.dead_servers)
+                    self.dead_servers.discard(rank)
+                    self._hb.pop(("server", rank), None)
+                else:
                     conn.close()   # over-registration
                     return
                 self.server_addrs[rank] = msg[1]
@@ -290,46 +736,93 @@ class Scheduler:
         conn.send(("ranked", rank, list(self.server_addrs)))
         if kind == "reg_server":
             return  # servers only hear "shutdown" from us
+        self._serve_worker(conn, rank)
+
+    def _serve_worker(self, conn, rank):
         while True:
             try:
-                msg = conn.recv()
+                # a worker between RPCs is legitimately quiet; liveness
+                # is the heartbeat link's job, not this one's
+                msg = conn.recv(timeout=None)
             except ConnectionError:
                 # liveness surface (ref kvstore.h:328 get_num_dead_node):
                 # a worker whose control connection dropped without
                 # finalizing counts as dead
                 with self._lock:
-                    if rank in self.worker_conns \
-                            and self.worker_conns[rank] is conn \
-                            and rank not in getattr(self, "_finalized_ranks",
-                                                    set()):
-                        self.dead_workers.add(rank)
+                    known = (rank in self.worker_conns
+                             and self.worker_conns[rank] is conn
+                             and rank not in self._finalized_ranks)
+                if known:
+                    self._mark_dead("worker", rank, "control-disconnect")
                 break
+            if msg[0] == "heartbeat":
+                with self._lock:
+                    self._hb[("worker", rank)] = time.monotonic()
+                continue
             if msg[0] == "num_dead":
                 with self._lock:
                     conn.send(("num_dead", len(self.dead_workers)))
                 continue
-            if msg[0] == "barrier":
+            if msg[0] == "servers":
                 with self._lock:
-                    gen = self._barrier_gen
-                    self._barrier_waiters.append(conn)
-                    if len(self._barrier_waiters) == self.nworkers:
-                        for c in self._barrier_waiters:
-                            c.send(("barrier_done",))
-                        self._barrier_waiters = []
-                        self._barrier_gen += 1
-                        self._registered.notify_all()
+                    conn.send(("servers", list(self.server_addrs),
+                               sorted(self.dead_servers)))
+                continue
+            if msg[0] == "peers":
+                conn.send(("peers", self.peer_table()))
+                continue
+            if msg[0] == "barrier":
+                fail = None
+                with self._lock:
+                    departed = self.dead_workers | self._finalized_ranks
+                    if departed:
+                        # can never complete: refuse instead of wedging
+                        # (finalized members are gone just as surely as
+                        # dead ones — and a crashed worker's atexit
+                        # still manages to send finalize, so "finalized"
+                        # does NOT imply "exited cleanly after its last
+                        # barrier")
+                        fail = sorted(departed)
                     else:
-                        while self._barrier_gen == gen:
-                            self._registered.wait()
+                        gen = self._barrier_gen
+                        self._barrier_waiters.append(conn)
+                        if len(self._barrier_waiters) == self.nworkers:
+                            for c in self._barrier_waiters:
+                                c.send(("barrier_done",))
+                            self._barrier_waiters = []
+                            self._barrier_gen += 1
+                            self._registered.notify_all()
+                        else:
+                            while self._barrier_gen == gen \
+                                    and conn in self._barrier_waiters:
+                                self._registered.wait()
+                            # woken by _mark_dead's sweep: it already
+                            # sent barrier_failed on this conn
+                if fail is not None:
+                    conn.send(("barrier_failed", fail))
                 continue
             if msg[0] == "finalize":
+                notify = []
                 with self._lock:
-                    if not hasattr(self, "_finalized_ranks"):
-                        self._finalized_ranks = set()
                     self._finalized_ranks.add(rank)
                     self._finalized += 1
-                    if self._finalized == self.nworkers:
+                    if self._barrier_waiters:
+                        # a member just left for good: the pending
+                        # barrier can never reach nworkers — fail it now
+                        notify, self._barrier_waiters = \
+                            self._barrier_waiters, []
+                        self._barrier_gen += 1
+                        self._registered.notify_all()
+                    departed = sorted(self.dead_workers
+                                      | self._finalized_ranks)
+                    if len(self._finalized_ranks | self.dead_workers) \
+                            == self.nworkers:
                         self._done.set()
+                for c in notify:
+                    try:
+                        c.send(("barrier_failed", departed))
+                    except (OSError, ConnectionError):
+                        pass
                 conn.send(("bye",))
                 break
 
@@ -357,6 +850,14 @@ class Server:
     and the update has been applied — this is the ordering guarantee the
     reference gets from engine dependencies + per-key server counters
     (kvstore_dist_server.h:164-210).
+
+    Checkpoint-state protocol (``get_state``/``set_state``): the whole
+    shard store + updater state as one opaque blob, so a worker can
+    snapshot every server into a PR-7 checkpoint and pour it back into a
+    *restarted* server that re-registered into the dead rank's slot.
+    ``set_state`` also clears the sync-mode pending buffers — restore is
+    a rollback to a consistent cut, and half-aggregated rounds from
+    before the failure must not leak into the resumed run.
     """
 
     def __init__(self, nworkers):
@@ -405,7 +906,61 @@ class Server:
             with self._lock:
                 self.sync = bool(msg[1])
             return ("ok",)
+        if op == "get_state":
+            return ("state", self._get_state())
+        if op == "set_state":
+            self._set_state(msg[1])
+            return ("ok",)
         raise ValueError("bad server op %r" % (op,))
+
+    # -- checkpoint-state protocol -----------------------------------------
+
+    def _get_state(self):
+        with self._lock:
+            payload = {
+                "version": 1,
+                "store": {k: np.array(v) for k, v in self.store.items()},
+                "shapes": dict(self.shapes),
+                "ranges": dict(self.ranges),
+                "sync": self.sync,
+                "updater": None, "index_update_count": None,
+                "num_update": None,
+            }
+            if self.updater is not None:
+                payload["updater"] = self.updater.get_states(
+                    dump_optimizer=False)
+                srv_opt = getattr(self.updater, "optimizer", None)
+                if srv_opt is not None:
+                    payload["index_update_count"] = \
+                        dict(srv_opt._index_update_count)
+                    payload["num_update"] = int(srv_opt.num_update)
+        return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _set_state(self, blob):
+        payload = _restricted_loads(blob)
+        with self._lock:
+            self.store = {k: np.array(v)
+                          for k, v in payload["store"].items()}
+            self.shapes = {k: tuple(s)
+                           for k, s in payload["shapes"].items()}
+            self.ranges = dict(payload["ranges"])
+            self.sync = bool(payload.get("sync", True))
+            self.pending.clear()
+            if payload.get("updater") is not None \
+                    and self.updater is not None:
+                # the inner blob crossed the wire too: decode it through
+                # the SAME allowlist — a raw pickle.loads here would be
+                # the code-exec hole the restricted unpickler exists to
+                # close
+                self.updater.set_states_payload(
+                    _restricted_loads(payload["updater"]))
+                srv_opt = getattr(self.updater, "optimizer", None)
+                if srv_opt is not None \
+                        and payload.get("index_update_count") is not None:
+                    srv_opt._index_update_count = \
+                        dict(payload["index_update_count"])
+                    srv_opt.num_update = int(payload["num_update"])
+            self._cv.notify_all()
 
     def _wait_key(self, key):
         while key not in self.store:
@@ -474,7 +1029,9 @@ class Server:
     def _serve_conn(self, conn):
         while True:
             try:
-                msg = conn.recv()
+                # a server waits on its clients by design: explicit
+                # unbounded recv (the JG007 annotation)
+                msg = conn.recv(timeout=None)
             except ConnectionError:
                 return
             try:
@@ -523,13 +1080,22 @@ def run_server():
 
     sched = Conn.connect(root_addr())
     sched.send(("reg_server", my_addr))
-    sched.recv()  # ("ranked", rank, addrs)
-    # block until scheduler says shutdown
+    # rendezvous waits for the full roster — deliberately unbounded (a
+    # straggler worker is not a failure; scheduler death is an EOF here)
+    msg = sched.recv(timeout=None)  # ("ranked", rank, addrs)
+    rank = int(msg[1])
+    _register_node("server", rank, lambda: {"keys": len(server.store),
+                                            "addr": my_addr})
+    hb_stop = _start_heartbeat("server", rank)
+    # block until scheduler says shutdown (unbounded by design: an idle
+    # server between jobs is healthy; scheduler death is an EOF)
     try:
-        msg = sched.recv()
+        msg = sched.recv(timeout=None)
     except ConnectionError:
         msg = ("shutdown",)
     assert msg[0] == "shutdown"
+    if hb_stop is not None:
+        hb_stop.set()
     stop.set()
     lsock.close()
 
@@ -542,7 +1108,19 @@ def _check(reply):
 
 
 class WorkerTransport:
-    """Worker-side connections: one to the scheduler, one per server."""
+    """Worker-side connections: one to the scheduler, one per server.
+
+    Every RPC recv is bounded by ``MXNET_PS_RPC_TIMEOUT_S``; a timeout
+    or broken connection surfaces as :class:`PeerLost` naming the peer.
+    Idempotent RPCs (pull, pull_rows, state snapshots, set_optimizer,
+    set_sync, init) retry up to ``MXNET_PS_RPC_RETRIES`` times on a
+    *fresh* connection (a late reply on the old socket must never
+    desynchronize the request/reply stream) with exponential backoff +
+    jitter.  Pushes never retry — re-aggregating one worker's
+    contribution would corrupt the sync merge; their failures surface
+    immediately and recovery goes through :meth:`refresh_servers` +
+    the checkpoint-state restore.
+    """
 
     def __init__(self):
         self.sched = Conn.connect(root_addr())
@@ -551,32 +1129,219 @@ class WorkerTransport:
                      or os.environ.get("PMI_RANK"))
         self.sched.send(("reg_worker",
                          int(rank_hint) if rank_hint is not None else None))
-        msg = self.sched.recv()
+        # rendezvous waits for the full roster: deliberately unbounded
+        msg = self.sched.recv(timeout=None)
         assert msg[0] == "ranked"
         self.rank = msg[1]
-        self.server_conns = [Conn.connect(tuple(a)) for a in msg[2]]
+        self.server_addrs = [tuple(a) for a in msg[2]]
+        self.server_conns = [Conn.connect(a) for a in self.server_addrs]
         self.nservers = len(self.server_conns)
         self._ts = {}     # key -> push timestamp counter
         self._lock = threading.Lock()
+        self._hb_stop = _start_heartbeat("worker", self.rank)
+        _register_node("worker", self.rank,
+                       lambda: {"nservers": self.nservers})
+
+    # -- failure plumbing ---------------------------------------------------
+
+    def _peer_lost(self, sidx, op, cause):
+        _tel.bump("ps_peer_lost")
+        addr = self.server_addrs[sidx]
+        _flight.record("peer_lost", "server-%d" % sidx, op=op,
+                       cause=repr(cause))
+        if isinstance(cause, RPCTimeout):
+            reason = "rpc-timeout"
+        else:
+            reason = "disconnected"
+        return PeerLost(
+            "server %d (%s:%s) lost during %r: %r"
+            % (sidx, addr[0], addr[1], op, cause),
+            role="server", rank=sidx, addr=addr, reason=reason)
+
+    def _sched_lost(self, op, cause, reason="disconnected"):
+        _tel.bump("ps_peer_lost")
+        _flight.record("peer_lost", "scheduler", op=op, cause=repr(cause))
+        return PeerLost("scheduler lost during %r: %r" % (op, cause),
+                        role="scheduler", addr=root_addr(), reason=reason)
+
+    def _reconnect_server(self, sidx):
+        """Fresh connection to server *sidx* (drops any half-read or
+        half-written stream state with the old socket)."""
+        old = self.server_conns[sidx]
+        conn = Conn.connect(self.server_addrs[sidx], retries=1, delay=0)
+        self.server_conns[sidx] = conn
+        old.close()
+        _tel.bump("ps_reconnects")
+        return conn
+
+    def _server_rpc(self, sidx, msg, idempotent=False):
+        """One request/reply round to server *sidx*.  See the class
+        docstring for the retry/idempotency doctrine."""
+        attempts = _ENV["rpc_retries"] if idempotent else 1
+        delay = 0.05
+        last = None
+        for attempt in range(attempts):
+            if attempt:
+                _tel.bump("ps_rpc_retries")
+                time.sleep(delay * (0.5 + _jitter.random()))
+                delay *= 2
+                try:
+                    self._reconnect_server(sidx)
+                except (OSError, ConnectionError) as exc:
+                    last = exc
+                    continue
+            conn = self.server_conns[sidx]
+            try:
+                conn.send(msg)
+                return _check(conn.recv(timeout=_ENV["rpc_timeout"]))
+            except ProtocolError:
+                raise                       # a bug, not a dead peer
+            except (OSError, ConnectionError) as exc:
+                last = exc
+        raise self._peer_lost(sidx, msg[0], last) from last
+
+    def _send_to(self, sidx, msg):
+        try:
+            self.server_conns[sidx].send(msg)
+        except ProtocolError:
+            raise
+        except (OSError, ConnectionError) as exc:
+            raise self._peer_lost(sidx, msg[0], exc) from exc
+
+    def _recv_from(self, sidx, op):
+        # 2x the base deadline: a push ack legitimately waits on OTHER
+        # workers' contributions, and a peer absorbing one transient
+        # fault (<= 1 deadline of stall + retry) must not cascade into
+        # a spurious PeerLost here.  A dead server still fails instantly
+        # (TCP reset) — the 2x bound is the acceptance contract for the
+        # silent-peer case.
+        eff = _ENV["rpc_timeout"]
+        try:
+            return _check(self.server_conns[sidx].recv(
+                timeout=None if eff is None else 2.0 * eff))
+        except ProtocolError:
+            raise
+        except (OSError, ConnectionError) as exc:
+            raise self._peer_lost(sidx, op, exc) from exc
 
     # -- scheduler ops ------------------------------------------------------
+
+    def _sched_rpc(self, msg):
+        try:
+            self.sched.send(msg)
+            reply = self.sched.recv(timeout=_ENV["rpc_timeout"])
+        except (OSError, ConnectionError) as exc:
+            raise self._sched_lost(msg[0], exc) from exc
+        return reply
+
     def barrier(self):
-        self.sched.send(("barrier",))
-        msg = self.sched.recv()
-        assert msg[0] == "barrier_done"
+        """Block until every worker arrives — or raise :class:`PeerLost`
+        when the scheduler declares a member dead (``barrier_failed``),
+        the scheduler itself dies, or ``MXNET_PS_BARRIER_TIMEOUT_S``
+        elapses.  A barrier that cannot complete never hangs."""
+        try:
+            self.sched.send(("barrier",))
+        except (OSError, ConnectionError) as exc:
+            raise self._sched_lost("barrier", exc) from exc
+        limit = _ENV["barrier_timeout"]
+        deadline = None if limit is None else time.monotonic() + limit
+        while True:
+            remaining = None if deadline is None \
+                else max(0.05, deadline - time.monotonic())
+            try:
+                msg = self.sched.recv(timeout=remaining)
+            except RPCTimeout as exc:
+                raise self._sched_lost("barrier", exc,
+                                       reason="barrier-timeout") from exc
+            except (OSError, ConnectionError) as exc:
+                raise self._sched_lost("barrier", exc) from exc
+            if msg[0] == "barrier_done":
+                return
+            if msg[0] == "barrier_failed":
+                _tel.bump("ps_peer_lost")
+                raise PeerLost(
+                    "barrier failed: worker(s) %s are dead" % (msg[1],),
+                    role="worker", reason="dead-peers")
 
     def num_dead_nodes(self):
         """Workers whose control link dropped without finalizing
         (ref kvstore.h:328 get_num_dead_node)."""
-        self.sched.send(("num_dead",))
-        msg = self.sched.recv()
+        msg = self._sched_rpc(("num_dead",))
         assert msg[0] == "num_dead"
         return int(msg[1])
 
+    def peer_health(self):
+        """The scheduler's live peer table (also cached for /peers)."""
+        msg = self._sched_rpc(("peers",))
+        assert msg[0] == "peers"
+        _set_peer_snapshot(msg[1])
+        return msg[1]
+
+    def refresh_servers(self, timeout=60.0):
+        """Re-resolve the server address list and redial every server.
+
+        Blocks (bounded by *timeout*) until the scheduler reports no
+        dead server — i.e. a restarted server has re-registered into
+        each dead rank — then replaces ALL server connections.  The
+        caller is responsible for restoring shard state afterwards
+        (``restore_server_state`` / the kvstore checkpoint protocol).
+        """
+        deadline = time.monotonic() + timeout
+        last = None
+        dead = []
+        while True:
+            msg = self._sched_rpc(("servers",))
+            assert msg[0] == "servers"
+            addrs = [None if a is None else tuple(a) for a in msg[1]]
+            dead = list(msg[2])
+            if not dead and all(a is not None for a in addrs):
+                # DIAL-VERIFY before committing: right after a kill the
+                # scheduler may not have noticed the death yet, so a
+                # clean-looking list can still carry the dead server's
+                # stale address — trusting it would leak a bare
+                # ConnectionError out of the recovery path
+                conns, ok = [], True
+                for a in addrs:
+                    try:
+                        conns.append(Conn.connect(a, retries=3,
+                                                  delay=0.05))
+                    except (OSError, ConnectionError) as exc:
+                        last = exc
+                        ok = False
+                        break
+                if ok:
+                    for c in self.server_conns:
+                        c.close()
+                    self.server_addrs = addrs
+                    self.server_conns = conns
+                    _tel.bump("ps_reconnects")
+                    _flight.record("peer_recovered", "servers",
+                                   n=len(addrs))
+                    return
+                for c in conns:
+                    c.close()
+            if time.monotonic() > deadline:
+                _tel.bump("ps_peer_lost")
+                raise PeerLost(
+                    "no (reachable) replacement for server(s) within "
+                    "%.0fs (scheduler-reported dead: %s, last dial "
+                    "error: %r)" % (timeout, dead, last), role="server",
+                    reason="no-replacement")
+            time.sleep(min(0.2, max(_ENV["heartbeat"], 0.05)))
+
+    def reset_timestamps(self):
+        """Zero the per-key push timestamps (recovery: after a server
+        state restore cleared the pending buffers, every worker must
+        restart from the same counter or sync merges mismatch)."""
+        with self._lock:
+            self._ts.clear()
+
     def finalize(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
         try:
             self.sched.send(("finalize",))
-            self.sched.recv()
+            self.sched.recv(timeout=_ENV["rpc_timeout"])
         except (OSError, ConnectionError):
             pass
         for c in self.server_conns:
@@ -584,34 +1349,58 @@ class WorkerTransport:
         self.sched.close()
 
     # -- kv ops -------------------------------------------------------------
+
     def init(self, key, arr):
         flat = np.asarray(arr).ravel()
         for sidx, (lo, hi) in placement(key, arr.shape, self.nservers):
-            c = self.server_conns[sidx]
-            c.send(("init", key, flat[lo:hi], arr.shape, (lo, hi)))
-            _check(c.recv())
+            self._server_rpc(
+                sidx, ("init", key, flat[lo:hi], arr.shape, (lo, hi)),
+                idempotent=True)
 
     def push(self, key, arr, rows=None):
         with self._lock:
             ts = self._ts[key] = self._ts.get(key, -1) + 1
         if rows is not None:
             sidx = server_of_key(key, self.nservers)
-            c = self.server_conns[sidx]
-            c.send(("push", key, ts, np.asarray(arr), np.asarray(rows)))
-            _check(c.recv())
+            self._send_to(sidx, ("push", key, ts, np.asarray(arr),
+                                 np.asarray(rows)))
+            self._recv_from(sidx, "push")
             return
         flat = np.asarray(arr).ravel()
         plc = placement(key, arr.shape, self.nservers)
         for sidx, (lo, hi) in plc:
-            self.server_conns[sidx].send(("push", key, ts, flat[lo:hi], None))
+            self._send_to(sidx, ("push", key, ts, flat[lo:hi], None))
         for sidx, _ in plc:
-            _check(self.server_conns[sidx].recv())
+            self._recv_from(sidx, "push")
 
     def pull(self, key, shape):
+        # pipelined fast path: request every shard, THEN collect — a
+        # key sharded over S servers pays ~max(RTT), not sum(RTT).
+        # Any shard whose round fails falls back to the idempotent
+        # retry machinery (fresh connection) for that server alone.
         plc = placement(key, shape, self.nservers)
+        sent = set()
         for sidx, _ in plc:
-            self.server_conns[sidx].send(("pull", key))
-        shards = [_check(self.server_conns[sidx].recv()) for sidx, _ in plc]
+            try:
+                self.server_conns[sidx].send(("pull", key))
+                sent.add(sidx)
+            except (OSError, ConnectionError):
+                pass                     # retried per-shard below
+        shards = []
+        for sidx, _ in plc:
+            reply = None
+            if sidx in sent:
+                try:
+                    reply = _check(self.server_conns[sidx].recv(
+                        timeout=_ENV["rpc_timeout"]))
+                except ProtocolError:
+                    raise
+                except (OSError, ConnectionError):
+                    reply = None
+            if reply is None:            # slow path: reconnect + retry
+                reply = self._server_rpc(sidx, ("pull", key),
+                                         idempotent=True)
+            shards.append(reply)
         out = np.empty(int(np.prod(shape)), shards[0][1].dtype)
         for (_, (lo, hi)), (tag, val) in zip(plc, shards):
             assert tag == "val"
@@ -620,21 +1409,32 @@ class WorkerTransport:
 
     def pull_rows(self, key, shape, rows):
         sidx = server_of_key(key, self.nservers)
-        c = self.server_conns[sidx]
-        c.send(("pull_rows", key, np.asarray(rows, np.int64)))
-        tag, val = _check(c.recv())
+        tag, val = self._server_rpc(
+            sidx, ("pull_rows", key, np.asarray(rows, np.int64)),
+            idempotent=True)
         assert tag == "val"
         return val
 
     def set_optimizer(self, optimizer):
         blob = pickle.dumps(optimizer, protocol=pickle.HIGHEST_PROTOCOL)
-        for c in self.server_conns:
-            c.send(("set_optimizer", blob))
-        for c in self.server_conns:
-            _check(c.recv())
+        for sidx in range(self.nservers):
+            self._server_rpc(sidx, ("set_optimizer", blob),
+                             idempotent=True)
 
     def set_sync(self, sync):
-        for c in self.server_conns:
-            c.send(("set_sync", sync))
-        for c in self.server_conns:
-            _check(c.recv())
+        for sidx in range(self.nservers):
+            self._server_rpc(sidx, ("set_sync", sync), idempotent=True)
+
+    # -- checkpoint-state protocol ------------------------------------------
+
+    def server_state(self, sidx):
+        """Opaque state blob of server *sidx* (store + updater state)."""
+        tag, blob = self._server_rpc(sidx, ("get_state",),
+                                     idempotent=True)
+        assert tag == "state"
+        return blob
+
+    def restore_server_state(self, sidx, blob):
+        """Pour a ``server_state`` blob back into server *sidx* (e.g. a
+        restarted one); clears its sync-pending buffers."""
+        self._server_rpc(sidx, ("set_state", blob), idempotent=True)

@@ -40,6 +40,7 @@ import weakref
 import jax
 import numpy as np
 
+from . import chaos as _chaos
 from .lint import sanitizer as _san
 from .telemetry import flight as _flight
 
@@ -285,6 +286,10 @@ class ThreadedEngine:
                            tag or getattr(fn, "__qualname__", None)
                            or getattr(fn, "__name__", repr(type(fn))),
                            reads=len(const_vars), writes=len(mutable_vars))
+        if _chaos.active():       # decided HERE (deterministic push
+            act = _chaos.decide("engine.task")   # order), applied in-task
+            if act is not None:
+                fn = _chaos.chaos_task(fn, act)
         with _san.push_scope(self):
             if _san.engine_checker_enabled():
                 fn = _san.guard_task(self, fn, const_vars, mutable_vars)

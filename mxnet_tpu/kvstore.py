@@ -709,16 +709,63 @@ class KVStoreDist(KVStore):
         raise MXNetError("Cannot load states for distributed training")
 
     def get_checkpoint_state(self):
-        """Dist optimizer state lives on the remote servers — there is
-        nothing host-local to shard into the checkpoint (same contract
-        as save_optimizer_states, but checkpointing degrades instead of
-        raising: params still snapshot)."""
-        return None
+        """Snapshot every server's shard state (store + server-side
+        updater) through the dist checkpoint-state protocol.
+
+        Rank 0 gathers one opaque blob per server and returns the
+        combined payload; other ranks return None (one copy in the
+        checkpoint, the same division of labor as ``init``).  This is
+        what makes a *restarted* server recoverable: the blob poured
+        back via :meth:`set_checkpoint_state` restores its shard
+        bitwise.
+        """
+        if self.rank != 0:
+            return None
+        states = [self._trans.server_state(s)
+                  for s in range(self._trans.nservers)]
+        return pickle.dumps({"version": 1, "kind": "dist_servers",
+                             "nservers": len(states), "states": states},
+                            protocol=pickle.HIGHEST_PROTOCOL)
 
     def set_checkpoint_state(self, blob):
-        if blob is not None:
-            raise MXNetError("cannot restore optimizer state into a "
-                             "distributed kvstore")
+        """Restore every server's shard state from a
+        :meth:`get_checkpoint_state` blob (rank 0 performs the RPCs;
+        other ranks pass blob=None and no-op).  Restoring clears the
+        servers' sync-pending buffers — pair it with :meth:`reconnect`
+        (all ranks) so worker push timestamps restart consistently."""
+        if blob is None:
+            return
+        payload = pickle.loads(blob)
+        if not isinstance(payload, dict) \
+                or payload.get("kind") != "dist_servers":
+            raise MXNetError("not a dist kvstore checkpoint-state blob")
+        if len(payload["states"]) != self._trans.nservers:
+            raise MXNetError(
+                "checkpoint has %d server shards, transport has %d "
+                "servers" % (len(payload["states"]),
+                             self._trans.nservers))
+        # a RESTARTED server has no updater yet: reinstall the optimizer
+        # first or the poured-in state would silently degrade it to
+        # overwrite semantics (set_optimizer is idempotent on survivors)
+        if self._optimizer is not None:
+            self._trans.set_optimizer(self._optimizer)
+        for s, st in enumerate(payload["states"]):
+            self._trans.restore_server_state(s, st)
+
+    def reconnect(self, timeout=60.0):
+        """Recover the transport after a :class:`~mxnet_tpu.dist_ps.
+        PeerLost`: wait (bounded) for replacement servers to re-register
+        with the scheduler, redial every server connection, and reset
+        the push-timestamp counters.  EVERY worker must call this; rank
+        0 then restores shard state via :meth:`set_checkpoint_state`
+        before anyone pushes again."""
+        self._trans.refresh_servers(timeout=timeout)
+        self._trans.reset_timestamps()
+
+    def peer_health(self):
+        """The scheduler's live peer table (role/rank/heartbeat ages) —
+        also cached for the introspection server's ``/peers`` view."""
+        return self._trans.peer_health()
 
     def barrier(self):
         self._barrier_count += 1

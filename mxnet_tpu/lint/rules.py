@@ -36,10 +36,17 @@ JG006 env-read-in-hot-path    os.environ reads inside step/update/push/...
                               call paths or loops: a getenv per step is a
                               dict lookup + string parse on the hot path;
                               use the module-level cached-bool pattern.
+JG007 unbounded-blocking-call `.recv(...)` / queue-ish `.get()` with no
+                              timeout in the dist/engine/serving tier: a
+                              dead or silent peer turns the call into a
+                              hang.  Pass a deadline — or an explicit
+                              ``timeout=None`` documenting a deliberate
+                              unbounded wait.
 """
 from __future__ import annotations
 
 import ast
+import os
 import re
 
 from .core import parent
@@ -814,6 +821,60 @@ def _inside_loop(node):
             return False          # a def inside a loop runs later, cold
         p = parent(p)
     return False
+
+
+# ---------------------------------------------------------------------------
+# JG007 unbounded-blocking-call
+# ---------------------------------------------------------------------------
+#
+# Scoped to the modules that talk to peers or schedule work across
+# threads — the places where "blocks forever" means "a dead peer hangs
+# the whole job" (dist_ps.py, engine.py, serving/).  The fix is either a
+# real deadline or an EXPLICIT ``timeout=None`` keyword: the latter
+# reads as "I mean forever" and self-documents the deliberate waits
+# (a server waiting on its clients, a rendezvous waiting on the roster).
+
+_JG007_SCOPE_RE = re.compile(
+    r"(^|/)mxnet_tpu/(dist_ps|engine)\.py$|(^|/)mxnet_tpu/serving/")
+
+_QUEUEISH_RE = re.compile(r"(^|_)(q|queue|inbox|mailbox)$", re.IGNORECASE)
+
+
+@register("JG007", "unbounded-blocking-call",
+          "a recv()/queue.get() with no timeout blocks forever on a dead "
+          "or silent peer; pass a deadline, or an explicit timeout=None "
+          "to document a deliberate unbounded wait")
+def _jg007(mod, facts):
+    if not _JG007_SCOPE_RE.search(mod.path.replace(os.sep, "/")):
+        return
+    for call in facts.calls:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        kwnames = {kw.arg for kw in call.keywords}
+        if func.attr == "recv":
+            if "timeout" in kwnames:
+                continue          # bounded, or explicit timeout=None
+            yield mod.finding(
+                "JG007", call,
+                "'.recv(...)' without a timeout blocks forever on a "
+                "silent peer; pass timeout=<deadline> (or an explicit "
+                "timeout=None where waiting forever is the contract)")
+        elif func.attr == "get":
+            # queue-shaped receivers only: dict .get(key, default) takes
+            # positional args, Queue.get() does not
+            if call.args or "timeout" in kwnames or "block" in kwnames:
+                continue
+            recv_name = func.value
+            base = recv_name.attr if isinstance(recv_name, ast.Attribute) \
+                else getattr(recv_name, "id", None)
+            if base is None or not _QUEUEISH_RE.search(base):
+                continue
+            yield mod.finding(
+                "JG007", call,
+                "'%s.get()' without a timeout blocks forever when the "
+                "producer dies; pass timeout= (or block=False) — or an "
+                "explicit timeout=None for a deliberate wait" % base)
 
 
 def _hot_functions(facts):

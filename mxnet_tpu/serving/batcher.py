@@ -22,6 +22,16 @@ multi-threaded `c_predict_api` deployments were driven):
   continuous half of continuous batching.  Without the native engine the
   task degrades to inline execution on the scheduler thread, same
   semantics, no pipelining.
+* **Failure containment** (docs/FAULT_TOLERANCE.md): each request
+  carries a queue **deadline** (``MXNET_SERVE_REQUEST_TIMEOUT_MS``) —
+  one the scheduler enforces before dispatch, so a stalled executor
+  sheds its backlog as timeouts instead of serving stale work — and a
+  **circuit breaker** opens after ``MXNET_SERVE_BREAKER_THRESHOLD``
+  consecutive batch failures: while open, ``submit`` sheds immediately
+  (:class:`Overloaded` → HTTP 503 + Retry-After) instead of queueing
+  doomed work; after ``MXNET_SERVE_BREAKER_COOLDOWN_S`` the next batch
+  is the half-open probe.  The :mod:`mxnet_tpu.chaos` ``serving.batch``
+  seam injects executor failures to prove both.
 
 Every request/batch is booked into the telemetry registry (counters,
 ``serving_latency_us`` and ``serving_batch_occupancy`` histograms) and,
@@ -34,19 +44,24 @@ import threading
 import time
 from collections import deque
 
+from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 
-__all__ = ["Overloaded", "ContinuousBatcher", "refresh_from_env",
-           "DEFAULT_BATCH_TIMEOUT_MS", "DEFAULT_QUEUE_CAP"]
+__all__ = ["Overloaded", "ContinuousBatcher", "CircuitBreaker",
+           "refresh_from_env", "DEFAULT_BATCH_TIMEOUT_MS",
+           "DEFAULT_QUEUE_CAP", "DEFAULT_BREAKER_THRESHOLD",
+           "DEFAULT_BREAKER_COOLDOWN_S"]
 
 DEFAULT_BATCH_TIMEOUT_MS = 5.0
 DEFAULT_QUEUE_CAP = 256
+DEFAULT_BREAKER_THRESHOLD = 5
+DEFAULT_BREAKER_COOLDOWN_S = 5.0
 
 
 class Overloaded(MXNetError):
-    """Bounded queue full: shed the request now (HTTP 503), don't buffer
-    unbounded latency."""
+    """Bounded queue full or circuit open: shed the request now (HTTP
+    503), don't buffer unbounded latency or queue doomed work."""
 
 
 def _env_timeout_ms():
@@ -65,30 +80,136 @@ def _env_queue_cap():
         return DEFAULT_QUEUE_CAP
 
 
+def _env_request_timeout_ms():
+    try:
+        return max(0.0, float(os.environ.get(
+            "MXNET_SERVE_REQUEST_TIMEOUT_MS", 0.0)))
+    except ValueError:
+        return 0.0
+
+
+def _env_breaker_threshold():
+    try:
+        return max(0, int(os.environ.get("MXNET_SERVE_BREAKER_THRESHOLD",
+                                         DEFAULT_BREAKER_THRESHOLD)))
+    except ValueError:
+        return DEFAULT_BREAKER_THRESHOLD
+
+
+def _env_breaker_cooldown_s():
+    try:
+        return max(0.0, float(os.environ.get(
+            "MXNET_SERVE_BREAKER_COOLDOWN_S", DEFAULT_BREAKER_COOLDOWN_S)))
+    except ValueError:
+        return DEFAULT_BREAKER_COOLDOWN_S
+
+
 # cached at import (JG006 cached-value pattern)
 _TIMEOUT_MS = _env_timeout_ms()
 _QUEUE_CAP = _env_queue_cap()
+_REQUEST_TIMEOUT_MS = _env_request_timeout_ms()
+_BREAKER_THRESHOLD = _env_breaker_threshold()
+_BREAKER_COOLDOWN_S = _env_breaker_cooldown_s()
 
 
 def refresh_from_env():
-    global _TIMEOUT_MS, _QUEUE_CAP
+    global _TIMEOUT_MS, _QUEUE_CAP, _REQUEST_TIMEOUT_MS
+    global _BREAKER_THRESHOLD, _BREAKER_COOLDOWN_S
     _TIMEOUT_MS = _env_timeout_ms()
     _QUEUE_CAP = _env_queue_cap()
+    _REQUEST_TIMEOUT_MS = _env_request_timeout_ms()
+    _BREAKER_THRESHOLD = _env_breaker_threshold()
+    _BREAKER_COOLDOWN_S = _env_breaker_cooldown_s()
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker: *threshold* straight batch failures
+    open it for *cooldown_s*; while open, submissions shed (503).  After
+    the cooldown the next batch is the half-open probe — success closes
+    the breaker, failure re-opens (and re-arms the cooldown).  A
+    threshold of 0 disables the breaker entirely."""
+
+    def __init__(self, threshold=None, cooldown_s=None):
+        self.threshold = _BREAKER_THRESHOLD if threshold is None \
+            else max(0, int(threshold))
+        self.cooldown_s = _BREAKER_COOLDOWN_S if cooldown_s is None \
+            else max(0.0, float(cooldown_s))
+        self._failures = 0
+        self._opened_at = None
+        self._probing = False
+        self._probe_started = 0.0
+        self._lock = threading.Lock()
+
+    def allow(self):
+        if not self.threshold:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self._probing:
+                # ONE probe in flight decides; the rest stay shed until
+                # record() resolves it.  The probe holds a bounded lease
+                # so a probe that dies un-run (queue deadline drop)
+                # cannot wedge the breaker open forever.
+                if now - self._probe_started \
+                        < max(self.cooldown_s, 1.0):
+                    return False
+            if now - self._opened_at >= self.cooldown_s:
+                self._probing = True
+                self._probe_started = now
+                return True
+            return False
+
+    def record(self, ok):
+        if not self.threshold:
+            return
+        with self._lock:
+            self._probing = False
+            if ok:
+                self._failures = 0
+                self._opened_at = None
+                return
+            self._failures += 1
+            if self._failures >= self.threshold:
+                if self._opened_at is None:
+                    _telemetry.bump("serving_breaker_opens")
+                self._opened_at = time.monotonic()   # re-arm cooldown
+
+    def state(self):
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if self._probing \
+                    or time.monotonic() - self._opened_at >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+    def retry_after_s(self):
+        with self._lock:
+            if self._opened_at is None:
+                return 0.0
+            return max(0.0, self.cooldown_s
+                       - (time.monotonic() - self._opened_at))
 
 
 class _Request:
-    """One in-flight predict request: host inputs + a completion event."""
+    """One in-flight predict request: host inputs + a completion event.
+    *deadline* (perf_counter seconds, None = none) bounds its QUEUE
+    time: the scheduler drops it un-run once passed."""
 
     __slots__ = ("inputs", "n", "t_submit", "t_done", "outputs", "error",
-                 "_done")
+                 "deadline", "_done")
 
-    def __init__(self, inputs, n):
+    def __init__(self, inputs, n, timeout_s=None):
         self.inputs = inputs
         self.n = n
         self.t_submit = time.perf_counter()
         self.t_done = None
         self.outputs = None
         self.error = None
+        self.deadline = None if not timeout_s \
+            else self.t_submit + timeout_s
         self._done = threading.Event()
 
     def wait(self, timeout=None):
@@ -121,13 +242,18 @@ class ContinuousBatcher:
     """The per-model queue + scheduler thread (owned by a ModelSlot)."""
 
     def __init__(self, program, name, metrics=None, queue_cap=None,
-                 timeout_ms=None, use_engine=True):
+                 timeout_ms=None, use_engine=True,
+                 request_timeout_ms=None, breaker=None):
         self._program = program
         self._name = name
         self._metrics = metrics
         self._cap = _QUEUE_CAP if queue_cap is None else max(1, queue_cap)
         timeout_ms = _TIMEOUT_MS if timeout_ms is None else timeout_ms
         self._timeout_s = max(0.0, timeout_ms) / 1e3
+        request_timeout_ms = _REQUEST_TIMEOUT_MS \
+            if request_timeout_ms is None else max(0.0, request_timeout_ms)
+        self._request_timeout_s = request_timeout_ms / 1e3
+        self._breaker = CircuitBreaker() if breaker is None else breaker
         self._queue = deque()
         self._cond = threading.Condition()
         self._stopping = False
@@ -186,10 +312,27 @@ class ContinuousBatcher:
         with self._cond:
             return len(self._queue)
 
-    def submit(self, inputs, n):
+    def breaker_state(self):
+        """'closed' / 'open' / 'half-open' (the /v1 stats surface)."""
+        return self._breaker.state()
+
+    def submit(self, inputs, n, timeout_ms=None):
         """Enqueue *n* rows; returns the request future.  Raises
-        :class:`Overloaded` when the bounded queue is full."""
-        req = _Request(inputs, n)
+        :class:`Overloaded` when the bounded queue is full or the
+        circuit breaker is open.  *timeout_ms* overrides the request's
+        queue deadline (default ``MXNET_SERVE_REQUEST_TIMEOUT_MS``;
+        0 = no deadline)."""
+        if not self._breaker.allow():
+            if self._metrics is not None:
+                self._metrics.count("breaker_shed")
+            _telemetry.bump("serving_breaker_shed")
+            raise Overloaded(
+                "circuit breaker open for %r after repeated executor "
+                "failures; retry in %.1fs"
+                % (self._name, self._breaker.retry_after_s()))
+        timeout_s = self._request_timeout_s if timeout_ms is None \
+            else max(0.0, timeout_ms) / 1e3
+        req = _Request(inputs, n, timeout_s=timeout_s)
         with self._cond:
             if self._stopping:
                 raise MXNetError("model %r is unloading" % self._name)
@@ -240,6 +383,34 @@ class ContinuousBatcher:
             total += req.n
         return batch, total
 
+    def _drop_expired(self):
+        """Purge requests whose queue deadline passed (caller holds
+        _cond); returns them for out-of-lock completion.  Dropping
+        BEFORE dispatch is the point: a recovering executor must chew
+        through live work, not a backlog nobody is waiting on."""
+        now = time.perf_counter()
+        if not any(r.deadline is not None and now > r.deadline
+                   for r in self._queue):
+            return []
+        kept, dropped = deque(), []
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                dropped.append(req)
+            else:
+                kept.append(req)
+        self._queue = kept
+        return dropped
+
+    def _fail_expired(self, dropped):
+        for req in dropped:
+            _telemetry.bump("serving_deadline_drops")
+            if self._metrics is not None:
+                self._metrics.count("deadline_drops")
+            req._finish(error=MXNetError(
+                "request timed out in the %r queue after %.0f ms "
+                "(deadline exceeded before dispatch)"
+                % (self._name, req.latency_us / 1e3)))
+
     def _loop(self):
         while True:
             with self._cond:
@@ -257,8 +428,11 @@ class ContinuousBatcher:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
+                expired = self._drop_expired()
                 batch, total = self._take_batch()
                 program = self._program
+            if expired:
+                self._fail_expired(expired)
             if batch:
                 self._dispatch(program, batch, total)
 
@@ -279,6 +453,10 @@ class ContinuousBatcher:
         """Execute one coalesced batch and split results per request.
         Never raises: failures land in the request futures."""
         try:
+            if _chaos.active():
+                act = _chaos.decide("serving.batch")
+                if act is not None:
+                    _chaos.apply_inline(act)
             if len(batch) == 1:
                 inputs = batch[0].inputs
             else:
@@ -292,6 +470,7 @@ class ContinuousBatcher:
             else:
                 outs, bucket, cost = program.run(inputs, total)
         except BaseException as exc:  # noqa: BLE001 — futures carry it
+            self._breaker.record(ok=False)
             if self._metrics is not None:
                 self._metrics.count("errors", len(batch))
             _telemetry.bump("serving_errors", len(batch))
@@ -300,6 +479,7 @@ class ContinuousBatcher:
             for req in batch:
                 req._finish(error=err)
             return
+        self._breaker.record(ok=True)
         # book ALL accounting BEFORE waking any waiter: a client reading
         # counters/stats the instant predict() returns must see this
         # batch (the futures' latency stamp is taken here, so the booked

@@ -120,9 +120,12 @@ def _predict(registry, name, body):
         raise MXNetError(
             'predict body must be {"inputs": {name: [[...]], ...}}')
     timeout = _number(obj, "timeout_s", 60.0)
+    # queue deadline: how long the request may WAIT before dispatch
+    # (docs/FAULT_TOLERANCE.md; default MXNET_SERVE_REQUEST_TIMEOUT_MS)
+    deadline_ms = _number(obj, "deadline_ms")
     inputs = {}
     for key, val in raw.items():
-        if key in ("inputs", "timeout_s"):
+        if key in ("inputs", "timeout_s", "deadline_ms"):
             continue
         dtype = slot.program._ex.arg_dict[key].dtype \
             if key in slot.program._ex.arg_dict else np.float32
@@ -131,7 +134,7 @@ def _predict(registry, name, body):
         except (TypeError, ValueError) as exc:
             raise MXNetError("input %r is not a numeric array: %s"
                              % (key, exc))
-    request = slot.submit(inputs)
+    request = slot.submit(inputs, timeout_ms=deadline_ms)
     outs = request.wait(timeout)
     return _json(200, {
         "model": name,

@@ -34,7 +34,8 @@ class SlotMetrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counts = {"requests": 0, "batches": 0, "rows": 0,
-                        "padded_rows": 0, "overloads": 0, "errors": 0}
+                        "padded_rows": 0, "overloads": 0, "errors": 0,
+                        "deadline_drops": 0, "breaker_shed": 0}
         self._latency = _telemetry.Histogram("latency_us")
         self._occupancy_sum = 0.0
         self._flops = 0.0
@@ -112,10 +113,11 @@ class ModelSlot:
         self.batcher.start()
         return self
 
-    def submit(self, inputs):
-        """Async predict: returns the request future."""
+    def submit(self, inputs, timeout_ms=None):
+        """Async predict: returns the request future.  *timeout_ms*
+        bounds the request's QUEUE time (deadline shed, HTTP 504)."""
         n = self.program.check_rows(inputs)
-        return self.batcher.submit(inputs, n)
+        return self.batcher.submit(inputs, n, timeout_ms=timeout_ms)
 
     def predict(self, inputs, timeout=60.0):
         """Sync predict: submit + wait; returns the output list."""
@@ -138,6 +140,7 @@ class ModelSlot:
             "buckets": list(self.program.buckets),
             "max_batch": self.program.max_batch,
             "queue_depth": self.batcher.queue_depth(),
+            "breaker": self.batcher.breaker_state(),
             "inputs": {n: list(s)
                        for n, s in self.program._input_shapes.items()},
             "outputs": self.program.output_names,

@@ -352,6 +352,24 @@ COUNTERS = {
                                 "retried with backoff",
     "checkpoint_restore_fallbacks": "corrupt/partial checkpoints skipped "
                                     "in favor of an older complete one",
+    "serving_deadline_drops": "queued predict requests dropped un-run "
+                              "because their deadline passed before "
+                              "dispatch",
+    "serving_breaker_opens": "circuit-breaker open transitions after "
+                             "consecutive serving batch failures",
+    "serving_breaker_shed": "predict requests shed (503) by an open "
+                            "serving circuit breaker",
+    "chaos_faults": "faults injected by the MXNET_CHAOS chaos tier "
+                    "(each also lands in the flight ring)",
+    "ps_rpc_timeouts": "dist transport RPC recvs that hit the "
+                       "MXNET_PS_RPC_TIMEOUT_S deadline",
+    "ps_rpc_retries": "idempotent dist RPCs retried on a fresh "
+                      "connection (backoff + jitter)",
+    "ps_peer_lost": "structured PeerLost errors raised by the dist "
+                    "transport (dead/silent peers, failed barriers)",
+    "ps_reconnects": "dist server connections re-established after a "
+                     "failure or refresh_servers recovery",
+    "ps_heartbeats": "heartbeat frames sent to the dist scheduler",
 }
 
 GAUGES = {
@@ -385,6 +403,9 @@ GAUGES = {
                                 "last committed checkpoint",
     "checkpoint_bytes": "total serialized bytes of the last committed "
                         "checkpoint (all shards + manifest'd files)",
+    "ps_dead_peers": "peers the dist scheduler currently considers dead "
+                     "(live on the scheduler; a worker's cached view "
+                     "elsewhere)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)

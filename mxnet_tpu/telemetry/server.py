@@ -110,7 +110,7 @@ def health():
 
 _INDEX = ("mxnet_tpu introspection\n"
           "endpoints: /metrics /healthz /snapshot /trace /flight /stacks "
-          "/checkpoints\n"
+          "/checkpoints /peers\n"
           "serving:   /v1/models  /v1/models/<name>[/predict|/load|"
           "/unload|/reload]\n")
 
@@ -185,6 +185,21 @@ class _Handler(BaseHTTPRequestHandler):
                                   "(construct a CheckpointManager)"}, 404)
                 else:
                     self._reply_json(ckpt.http_view())
+            elif path == "/peers":
+                # observe-only sys.modules lookup, like /checkpoints: a
+                # process that never touched the dist transport answers
+                # 404 and initializes nothing.  peer_view() itself does
+                # no network IO — it reports the heartbeat thread's
+                # cached scheduler snapshot (or the live table when this
+                # process IS the scheduler).
+                dist = sys.modules.get("mxnet_tpu.dist_ps")
+                if dist is None:
+                    self._reply_json(
+                        {"error": "dist transport not initialized "
+                                  "(no mxnet_tpu.dist_ps in this "
+                                  "process)"}, 404)
+                else:
+                    self._reply_json(dist.peer_view())
             elif path == "/stacks":
                 stacks = flight.thread_stacks()
                 text = "\n".join("--- %s ---\n%s" % (k, "".join(v))
@@ -324,6 +339,12 @@ def sample_once(rate_state=None):
     if serving is not None:      # observe-only: refresh queue-depth gauges
         try:
             serving.refresh_gauges()
+        except Exception:
+            pass
+    dist = sys.modules.get("mxnet_tpu.dist_ps")
+    if dist is not None:         # observe-only: ps_dead_peers gauge
+        try:
+            dist.refresh_gauges()
         except Exception:
             pass
     now = time.monotonic()

@@ -1,0 +1,175 @@
+"""Deterministic dist worker for the chaos tier (smoke + kill tests).
+
+A tiny optimizer-on-server training loop over ``dist_sync`` whose loss
+trajectory is a pure function of (nworkers, iters): gradients derive
+from the *pulled* weights, so the authoritative state genuinely lives on
+the servers and a wrong server-state restore diverges bitwise.
+
+Sync discipline: NO scheduler barriers inside the loop — every sync
+point is a *fence push* (a sync-mode push blocks until all workers
+contribute, bounded by the per-RPC deadline), so any sync point a dead
+peer would wedge instead raises :class:`~mxnet_tpu.dist_ps.PeerLost`
+within the deadline.  Double fence around the rank-0 checkpoint gives
+every iteration a consistent end-of-iter cut in ``CHAOS_STATE_DIR``.
+
+Recovery (``CHAOS_EXPECT_KILL=1``): on PeerLost, every worker
+``kv.reconnect()``s (waits for the replacement server to re-register
+with the scheduler), syncs through the shared state dir — deliberately
+NOT through server RPCs, which are exactly what just failed — rank 0
+restores the servers from the last checkpoint blob
+(``kv.set_checkpoint_state``), and everyone rolls its host state back
+to the same cut and resumes.  The resumed trajectory must be bitwise
+identical to an uninterrupted run (the acceptance criterion).
+
+Env contract (set by tools/chaos_smoke.py / tests/test_chaos.py):
+  CHAOS_STATE_DIR    shared scratch dir (required)
+  CHAOS_ITERS        training iterations (default 4)
+  CHAOS_EXPECT_KILL  "1": recover from PeerLost instead of dying
+  MXNET_CHAOS        optional fault spec (inherited by every role)
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import json      # noqa: E402
+import pickle    # noqa: E402
+import time      # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx        # noqa: E402
+from mxnet_tpu import chaos, dist_ps  # noqa: E402
+
+ITERS = int(os.environ.get("CHAOS_ITERS", "4"))
+STATE = os.environ["CHAOS_STATE_DIR"]
+EXPECT_KILL = os.environ.get("CHAOS_EXPECT_KILL") == "1"
+
+# placement (adler32 % 2): w0,w2,fence2 -> server0; w1,fence1 -> server1
+# — both servers hold real keys AND a fence, so killing either one
+# surfaces at the next sync point of every worker.
+KEYS = ["w0", "w1", "w2"]
+SHAPES = {"w0": (8,), "w1": (4, 4), "w2": (6,)}
+RATE = 0.5
+STATE_FILE = os.path.join(STATE, "ckpt.pkl")
+
+
+def _atomic_write(path, data):
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+    os.replace(tmp, path)
+
+
+def _wait_for(paths, timeout=120.0, what="peer files"):
+    deadline = time.monotonic() + timeout
+    while not all(os.path.exists(p) for p in paths):
+        if time.monotonic() > deadline:
+            raise RuntimeError("timed out waiting for %s: %s"
+                               % (what, paths))
+        time.sleep(0.05)
+
+
+def fence(kv, name):
+    """Deadline-bounded barrier: a sync push completes only when every
+    worker has contributed (PeerLost, never a hang, if one cannot)."""
+    kv.push(name, mx.nd.ones((1,)))
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworkers = kv.rank, kv.num_workers
+
+    for i, k in enumerate(KEYS):
+        kv.init(k, mx.nd.ones(SHAPES[k]) * (i + 1))
+    kv.init("fence1", mx.nd.zeros((1,)))
+    kv.init("fence2", mx.nd.zeros((1,)))
+    # optimizer ON the servers: w -= rescale * sum(worker grads)
+    kv.set_optimizer(mx.optimizer.create("test",
+                                         rescale_grad=RATE / nworkers))
+
+    w = {k: (np.ones(SHAPES[k], np.float32) * (i + 1))
+         for i, k in enumerate(KEYS)}
+    losses = []
+    recoveries = []
+    t = 0
+    while t < ITERS:
+        try:
+            grads = {k: w[k] * np.float32(0.25)
+                     + np.float32((rank + 1) * (t + 1) * 0.0625)
+                     for k in KEYS}
+            for k in KEYS:
+                kv.push(k, mx.nd.array(grads[k], dtype="float32"))
+            for k in KEYS:
+                out = mx.nd.zeros(SHAPES[k])
+                kv.pull(k, out=out)
+                w[k] = out.asnumpy().copy()
+            losses.append(float(sum(np.sum(w[k], dtype=np.float64)
+                                    for k in KEYS)))
+            fence(kv, "fence1")
+            t += 1
+            if rank == 0:
+                blob = kv.get_checkpoint_state()
+                _atomic_write(STATE_FILE, pickle.dumps(
+                    {"it": t, "blob": blob, "w": w, "losses": losses}))
+            fence(kv, "fence2")
+        except dist_ps.PeerLost as exc:
+            if not EXPECT_KILL:
+                raise
+            detect_wall = time.time()
+            gen = len(recoveries) + 1
+            # 1. transport recovery: wait for the replacement server to
+            #    re-register, redial, reset push timestamps (all ranks)
+            kv.reconnect(timeout=120)
+            # 2. rank sync through the FILESYSTEM (server RPCs are what
+            #    just failed; the scheduler stays out of it too so no
+            #    anonymous-barrier counts can desynchronize)
+            _atomic_write(os.path.join(STATE, "ready-%d-%d"
+                                       % (gen, rank)), b"1")
+            if rank == 0:
+                _wait_for([os.path.join(STATE, "ready-%d-%d" % (gen, r))
+                           for r in range(nworkers)],
+                          what="worker ready markers")
+                with open(STATE_FILE, "rb") as fh:
+                    saved = pickle.load(fh)
+                # 3. pour the last consistent cut back into the servers
+                kv.set_checkpoint_state(saved["blob"])
+                _atomic_write(os.path.join(STATE, "restored-%d" % gen),
+                              b"1")
+            else:
+                _wait_for([os.path.join(STATE, "restored-%d" % gen)],
+                          what="rank-0 restore marker")
+            # 4. roll host state back to the same cut and resume
+            with open(STATE_FILE, "rb") as fh:
+                saved = pickle.load(fh)
+            t = saved["it"]
+            w = {k: np.array(v) for k, v in saved["w"].items()}
+            losses = list(saved["losses"])
+            recoveries.append({
+                "gen": gen, "detect_wall": detect_wall,
+                "resumed_at_iter": t, "reason": exc.reason,
+                "peer_role": exc.role, "peer_rank": exc.rank})
+            continue
+
+    result = {
+        "rank": rank,
+        "nworkers": nworkers,
+        "iters": t,
+        "losses_hex": [np.float64(x).tobytes().hex() for x in losses],
+        "losses": losses,
+        "recoveries": recoveries,
+        "fault_log": chaos.fault_log(),
+        "chaos": chaos.describe(),
+        "done_wall": time.time(),
+    }
+    _atomic_write(os.path.join(STATE, "result-%d.json" % rank),
+                  json.dumps(result, indent=1).encode())
+    print("worker %d/%d: %d iters, %d recoveries, %d injected faults"
+          % (rank, nworkers, t, len(recoveries), len(chaos.fault_log())),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

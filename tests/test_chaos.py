@@ -1,0 +1,510 @@
+"""Chaos tier (ISSUE 9): deterministic fault injection + a dist
+transport that survives dead peers.
+
+Acceptance contract: ``kill -9`` one server mid-training → every worker
+raises a structured :class:`~mxnet_tpu.dist_ps.PeerLost` within 2x the
+RPC deadline (never a hang); a restarted server re-registers, its shard
+state is restored through the kvstore checkpoint-state protocol, and
+the resumed CPU loss trajectory is bitwise-identical to an
+uninterrupted run.  Same seed + same ``MXNET_CHAOS`` spec → identical
+injected-fault sequence; a transient-faults-only chaos run completes
+bitwise-identical to a no-chaos run (``tools/chaos_smoke.py``).
+"""
+import json
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, dist_ps, engine, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "chaos_dist_worker.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leak():
+    """Every test leaves the process chaos-free."""
+    yield
+    chaos.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# spec grammar + deterministic replay
+# ---------------------------------------------------------------------------
+
+def test_spec_grammar_round_trip():
+    seed, rules = chaos.parse_spec(
+        "seed=42;conn.send.pull:drop@2-4,delay~0.5=5ms;engine.task:exc")
+    assert seed == 42
+    assert [r.site for r in rules] == ["conn.send.pull", "engine.task"]
+    drop, delay = rules[0].faults
+    assert (drop.kind, drop.lo, drop.hi) == ("drop", 2, 4)
+    assert (delay.kind, delay.prob, delay.value) == ("delay", 0.5, 0.005)
+    assert rules[1].faults[0].kind == "exc"
+    assert chaos.parse_duration("250us") == pytest.approx(2.5e-4)
+    assert chaos.parse_duration("1.5") == 1.5
+
+
+@pytest.mark.parametrize("bad", [
+    "seed=x", "conn.send:frobnicate", "nosuchsite:drop",
+    "conn.recv:delay",              # delay needs a duration
+    "conn.recv:drop~1.5",           # probability out of range
+    "conn.recv:drop@0",             # occurrences are 1-based
+    "justgarbage",
+])
+def test_spec_rejects_garbage(bad):
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_spec(bad)
+
+
+def test_same_seed_same_fault_sequence():
+    """The determinism acceptance, in-process: identical spec+seed over
+    an identical call sequence injects the identical fault sequence."""
+    spec = "seed=9;conn.recv:drop~0.3;conn.send.push:delay@2=1us"
+    sites = (["conn.recv"] * 40 + ["conn.send.push"] * 5) * 2
+
+    def run():
+        chaos.configure(spec)
+        for s in sites:
+            chaos.decide(s)
+        return chaos.fault_log()
+
+    log1, log2 = run(), run()
+    assert log1 == log2
+    assert any(entry[2] == "drop" for entry in log1)
+    assert [e for e in log1 if e[2] == "delay"] == \
+        [("conn.send.push", "conn.send.push", "delay", 2)]
+    # a different seed decides differently (probabilistic rules)
+    chaos.configure(spec.replace("seed=9", "seed=10"))
+    for s in sites:
+        chaos.decide(s)
+    assert chaos.fault_log() != log1
+
+
+def test_faults_are_booked_in_counter_and_flight_ring():
+    from mxnet_tpu.telemetry import flight
+    before = telemetry.counter("chaos_faults")
+    chaos.configure("conn.recv:delay@1=1us")
+    assert chaos.decide("conn.recv") is not None
+    assert telemetry.counter("chaos_faults") == before + 1
+    assert any(ev["kind"] == "chaos" and ev["name"] == "conn.recv"
+               for ev in flight.events())
+
+
+# ---------------------------------------------------------------------------
+# Conn deadlines: RPCTimeout + mid-frame poisoning
+# ---------------------------------------------------------------------------
+
+def test_recv_deadline_and_stream_poisoning():
+    a, b = socket.socketpair()
+    ca, cb = dist_ps.Conn(a), dist_ps.Conn(b, timeout=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(dist_ps.RPCTimeout):
+        cb.recv()
+    assert time.monotonic() - t0 < 5.0
+    # nothing was consumed: the stream is still aligned and usable
+    ca.send(("ok", 1))
+    assert cb.recv() == ("ok", 1)
+    # half a header, then silence: the connection must poison itself
+    a.sendall(b"MX")
+    with pytest.raises(dist_ps.RPCTimeout, match="poisoned"):
+        cb.recv()
+    with pytest.raises(ConnectionError, match="poisoned"):
+        cb.recv()
+    with pytest.raises(ConnectionError, match="poisoned"):
+        cb.send(("x",))
+    a.close()
+    b.close()
+    assert isinstance(dist_ps.RPCTimeout("x"), dist_ps.PeerLost)
+    assert isinstance(dist_ps.PeerLost("x"), ConnectionError)
+
+
+def test_connect_failure_carries_last_error():
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    addr = lsock.getsockname()
+    lsock.close()                     # nothing listens here any more
+    with pytest.raises(ConnectionError) as ei:
+        dist_ps.Conn.connect(addr, retries=2, delay=0.01)
+    assert "after 2 attempts" in str(ei.value)
+    assert ei.value.__cause__ is not None   # the underlying OSError
+
+
+def test_chaos_drop_on_send_is_silent_and_close_raises():
+    a, b = socket.socketpair()
+    ca, cb = dist_ps.Conn(a), dist_ps.Conn(b, timeout=0.2)
+    chaos.configure("conn.send.pull:drop@1;conn.send.push:close@1")
+    ca.send(("pull", "k"))            # dropped: peer sees nothing
+    with pytest.raises(dist_ps.RPCTimeout):
+        cb.recv()
+    with pytest.raises(ConnectionError, match="chaos"):
+        ca.send(("push", "k", 0, None, None))
+    a.close()
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# engine.task + ckpt.io + serving.batch seams
+# ---------------------------------------------------------------------------
+
+def test_engine_task_chaos_surfaces_at_wait():
+    chaos.configure("engine.task:exc@1")
+    eng = engine.ThreadedEngine()
+    try:
+        v = eng.new_variable()
+        eng.push(lambda: None, mutable_vars=(v,))
+        with pytest.raises(chaos.ChaosError):
+            eng.wait_for_var(v)
+        # the next task is fault-free and runs normally
+        ran = []
+        eng.push(lambda: ran.append(1), mutable_vars=(v,))
+        eng.wait_for_var(v)
+        assert ran == [1]
+    finally:
+        eng.close()
+
+
+def test_checkpoint_io_chaos_lands_in_retry_path(tmp_path):
+    from tests.test_checkpoint import _build, _run_steps
+    from mxnet_tpu import checkpoint
+    net, tr, it = _build()
+    _run_steps(net, tr, it, 2)
+    before = telemetry.counter("checkpoint_write_retries")
+    chaos.configure("ckpt.io:fail@1")   # first file write of the commit
+    mgr = checkpoint.CheckpointManager(str(tmp_path), trainer=tr,
+                                       data_iter=it, num_shards=2)
+    try:
+        assert mgr.save(2, sync=True), mgr.last_error
+    finally:
+        mgr.close()
+    assert telemetry.counter("checkpoint_write_retries") == before + 1
+    assert mgr.last_committed_step == 2
+
+
+class _FakeProgram:
+    """Minimal program contract the batcher needs (no jax, no model)."""
+
+    max_batch = 4
+    output_names = ["out"]
+
+    def __init__(self):
+        self.fail = False
+        self.runs = 0
+
+    def run(self, inputs, total):
+        self.runs += 1
+        if self.fail:
+            raise RuntimeError("injected executor failure")
+        return [np.asarray(inputs["x"])], self.max_batch, None
+
+    run_straight = run
+
+
+def _submit_and_wait(batcher, n=1, timeout=5.0):
+    req = batcher.submit({"x": np.zeros((n, 2), np.float32)}, n)
+    return req.wait(timeout)
+
+
+def test_serving_circuit_breaker_sheds_and_recovers():
+    from mxnet_tpu.serving import batcher as B
+    prog = _FakeProgram()
+    breaker = B.CircuitBreaker(threshold=2, cooldown_s=0.25)
+    b = B.ContinuousBatcher(prog, "brk", timeout_ms=1, use_engine=False,
+                            breaker=breaker).start()
+    try:
+        assert len(_submit_and_wait(b)) == 1      # healthy
+        assert b.breaker_state() == "closed"
+        prog.fail = True
+        for _ in range(2):                        # threshold failures
+            with pytest.raises(mx.base.MXNetError):
+                _submit_and_wait(b)
+        assert b.breaker_state() == "open"
+        before = telemetry.counter("serving_breaker_shed")
+        with pytest.raises(B.Overloaded, match="circuit breaker"):
+            b.submit({"x": np.zeros((1, 2), np.float32)}, 1)
+        assert telemetry.counter("serving_breaker_shed") == before + 1
+        time.sleep(0.3)                           # cooldown: half-open
+        prog.fail = False
+        assert len(_submit_and_wait(b)) == 1      # probe succeeds
+        assert b.breaker_state() == "closed"
+    finally:
+        b.stop(drain=False)
+
+
+def test_breaker_half_open_admits_exactly_one_probe():
+    from mxnet_tpu.serving import batcher as B
+    br = B.CircuitBreaker(threshold=1, cooldown_s=0.05)
+    br.record(ok=False)
+    assert not br.allow() and br.state() == "open"
+    time.sleep(0.06)
+    assert br.allow()            # the single half-open probe
+    assert not br.allow()        # everyone else stays shed meanwhile
+    assert br.state() == "half-open"
+    br.record(ok=False)          # probe failed: re-open, cooldown re-arms
+    assert not br.allow()
+    time.sleep(0.06)
+    assert br.allow()
+    br.record(ok=True)           # probe succeeded: closed for business
+    assert br.allow() and br.allow() and br.state() == "closed"
+
+
+def test_serving_request_deadline_drops_stale_queue():
+    from mxnet_tpu.serving import batcher as B
+    prog = _FakeProgram()
+    b = B.ContinuousBatcher(prog, "ddl", timeout_ms=1, use_engine=False,
+                            breaker=B.CircuitBreaker(threshold=0))
+    # NOT started yet: requests age in the queue past their deadline
+    req = b.submit({"x": np.zeros((1, 2), np.float32)}, 1, timeout_ms=20)
+    live = b.submit({"x": np.zeros((1, 2), np.float32)}, 1)  # no deadline
+    time.sleep(0.06)
+    before = telemetry.counter("serving_deadline_drops")
+    b.start()
+    try:
+        with pytest.raises(mx.base.MXNetError, match="timed out"):
+            req.wait(5.0)
+        assert len(live.wait(5.0)) == 1           # undeadlined one ran
+        assert telemetry.counter("serving_deadline_drops") == before + 1
+    finally:
+        b.stop(drain=False)
+
+
+def test_serving_batch_chaos_trips_the_breaker():
+    from mxnet_tpu.serving import batcher as B
+    chaos.configure("serving.batch:exc@1-2")
+    prog = _FakeProgram()
+    b = B.ContinuousBatcher(prog, "chaos", timeout_ms=1, use_engine=False,
+                            breaker=B.CircuitBreaker(threshold=2,
+                                                     cooldown_s=30)).start()
+    try:
+        for _ in range(2):
+            with pytest.raises(mx.base.MXNetError):
+                _submit_and_wait(b)
+        assert b.breaker_state() == "open"
+        with pytest.raises(B.Overloaded):
+            b.submit({"x": np.zeros((1, 2), np.float32)}, 1)
+    finally:
+        b.stop(drain=False)
+
+
+def test_barrier_fails_fast_when_peer_departs(monkeypatch):
+    """A crashed worker's atexit still sends finalize — so a finalized
+    member must fail a pending barrier exactly like a dead one (found
+    by a live drive: the surviving worker hung for the full barrier
+    timeout)."""
+    import threading
+    port = _free_port()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.delenv("DMLC_WORKER_RANK", raising=False)
+    sched = dist_ps.Scheduler(2, 1, port=port)
+    threading.Thread(target=sched.run, daemon=True).start()
+    threading.Thread(target=dist_ps.run_server, daemon=True).start()
+    # rendezvous blocks until the FULL roster registers: both worker
+    # transports must dial concurrently (each is its own process in
+    # real deployments)
+    built = {}
+
+    def _build(slot):
+        built[slot] = dist_ps.WorkerTransport()
+
+    builders = [threading.Thread(target=_build, args=(i,), daemon=True)
+                for i in range(2)]
+    for b in builders:
+        b.start()
+    for b in builders:
+        b.join(30)
+    assert sorted(built) == [0, 1], "worker rendezvous wedged"
+    w0, w1 = built[0], built[1]
+    outcome = {}
+
+    def _barrier():
+        try:
+            w1.barrier()
+            outcome["err"] = None
+        except Exception as exc:   # noqa: BLE001
+            outcome["err"] = exc
+
+    t = threading.Thread(target=_barrier, daemon=True)
+    t.start()
+    time.sleep(0.3)               # w1 is parked in the barrier
+    w0.finalize()                 # the "crashed peer's atexit" path
+    t.join(10)
+    assert not t.is_alive(), "barrier hung after the peer departed"
+    assert isinstance(outcome["err"], dist_ps.PeerLost), outcome
+    # a FUTURE barrier from the survivor fails immediately too
+    with pytest.raises(dist_ps.PeerLost):
+        w1.barrier()
+    w1.finalize()
+
+
+# ---------------------------------------------------------------------------
+# /peers introspection
+# ---------------------------------------------------------------------------
+
+def test_peers_endpoint_observe_only():
+    import urllib.request
+    from mxnet_tpu.telemetry import server as tserver
+    srv = tserver.IntrospectionServer(0).start()
+    try:
+        url = "http://127.0.0.1:%d/peers" % srv.port
+        payload = json.loads(urllib.request.urlopen(url).read())
+        # dist_ps is imported in this process: the view answers with the
+        # local role + transport counters, no network IO
+        assert payload["role"] == "worker"
+        assert "ps_rpc_timeouts" in payload["counters"]
+        assert "ps_peer_lost" in payload["counters"]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance: kill -9 a server, recover bitwise
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+RPC_TIMEOUT_S = 3.0
+
+
+def _dist_env(state_dir, port, iters, expect_kill):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": "2",
+        "CHAOS_STATE_DIR": str(state_dir),
+        "CHAOS_ITERS": str(iters),
+        "MXNET_PS_RPC_TIMEOUT_S": str(RPC_TIMEOUT_S),
+        "MXNET_PS_HEARTBEAT_S": "0.5",
+        "MXNET_FLIGHT_DIR": str(state_dir),
+    })
+    env["CHAOS_EXPECT_KILL"] = "1" if expect_kill else ""
+    env.pop("MXNET_CHAOS", None)
+    return env
+
+
+def _spawn(env, role_name, rank=None):
+    e = dict(env, DMLC_ROLE=role_name)
+    if rank is not None:
+        e["DMLC_WORKER_RANK"] = str(rank)
+    return subprocess.Popen([sys.executable, WORKER], env=e)
+
+
+def _load_results(state_dir, nworkers=2):
+    out = []
+    for r in range(nworkers):
+        with open(os.path.join(str(state_dir), "result-%d.json" % r)) as f:
+            out.append(json.load(f))
+    return out
+
+
+def test_kill9_server_peerlost_and_bitwise_recovery(tmp_path):
+    """The ISSUE-9 acceptance test, end to end with real processes."""
+    iters = 6
+    # --- reference: uninterrupted run -----------------------------------
+    from launch import launch
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    env = _dist_env(ref_dir, 0, iters, expect_kill=False)
+    rcs = launch(2, 2, [sys.executable, WORKER], env_extra=env,
+                 timeout=180)
+    assert rcs == [0, 0], "reference run failed: %r" % (rcs,)
+    reference = _load_results(ref_dir)
+
+    # --- killed run ------------------------------------------------------
+    state = tmp_path / "killed"
+    state.mkdir()
+    env = _dist_env(state, _free_port(), iters, expect_kill=True)
+    procs = []
+    try:
+        procs.append(_spawn(env, "scheduler"))
+        victims = [_spawn(env, "server") for _ in range(2)]
+        procs.extend(victims)
+        workers = [_spawn(env, "worker", rank=r) for r in range(2)]
+        procs.extend(workers)
+
+        # wait for the first committed checkpoint (iter >= 2)
+        ckpt = os.path.join(str(state), "ckpt.pkl")
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                with open(ckpt, "rb") as fh:
+                    if pickle.load(fh)["it"] >= 2:
+                        break
+            except (OSError, EOFError, pickle.UnpicklingError, KeyError):
+                pass
+            assert time.monotonic() < deadline, \
+                "no checkpoint appeared — setup wedged"
+            time.sleep(0.05)
+
+        kill_wall = time.time()
+        victims[0].kill()                      # SIGKILL, mid-training
+        replacement = _spawn(env, "server")    # the restarted server
+        procs.append(replacement)
+
+        for w in workers:
+            assert w.wait(timeout=180) == 0, "worker failed post-kill"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    results = _load_results(state)
+    for res in results:
+        # every worker raised PeerLost and recovered
+        assert res["recoveries"], \
+            "rank %d never saw PeerLost" % res["rank"]
+        rec = res["recoveries"][0]
+        assert rec["peer_role"] in ("server", "scheduler", "worker")
+        # ... within 2x the RPC deadline of the kill (+1s clock slack)
+        detect = rec["detect_wall"] - kill_wall
+        assert detect <= 2 * RPC_TIMEOUT_S + 1.0, \
+            "rank %d took %.2fs to surface PeerLost" \
+            % (res["rank"], detect)
+        # ... and the resumed trajectory is bitwise-identical
+        assert res["losses_hex"] == reference[res["rank"]]["losses_hex"], \
+            "rank %d trajectory diverged after recovery:\n%s\n%s" \
+            % (res["rank"], res["losses"],
+               reference[res["rank"]]["losses"])
+    # both workers agree with each other too
+    assert results[0]["losses_hex"] == results[1]["losses_hex"]
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos smoke (the fast variant of tools/chaos_smoke.py)
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_tier1():
+    """Transient-faults-only seeded chaos run: completes (no hang),
+    bitwise-identical to no-chaos, deterministic replay.  The full knob
+    surface lives in tools/chaos_smoke.py; this is the CI-gated fast
+    variant."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py"),
+         "--iters", "2", "--timeout", "150", "--json"],
+        capture_output=True, text=True, timeout=500)
+    assert out.returncode == 0, \
+        "chaos_smoke failed:\n%s\n%s" % (out.stdout, out.stderr)
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["ok"], summary
+    assert summary["injected_faults"] > 0

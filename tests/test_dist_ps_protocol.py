@@ -1,0 +1,171 @@
+"""Direct tests for the dist_ps wire-protocol defenses (ISSUE 9
+satellite): the ``_RestrictedUnpickler`` allowlist and every
+``ProtocolError`` arm — wrong magic, wrong version, oversized frame,
+disallowed global — exercised on purpose rather than incidentally."""
+import pickle
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import dist_ps
+
+
+def _pair(timeout=1.0):
+    a, b = socket.socketpair()
+    return a, b, dist_ps.Conn(b, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# the allowlist itself
+# ---------------------------------------------------------------------------
+
+def test_allowlist_admits_numpy_containers_and_framework_classes():
+    payloads = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        {"a": (1, 2.5, b"x"), "b": [True, None, frozenset({3})]},
+        ("push", "w", 0, np.ones(3), None),
+    ]
+    for obj in payloads:
+        got = dist_ps._restricted_loads(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        if isinstance(obj, np.ndarray):
+            assert np.array_equal(got, obj)
+        elif isinstance(obj, tuple):
+            assert got[0] == obj[0] and len(got) == len(obj)
+            assert np.array_equal(got[3], obj[3])
+        else:
+            assert got == obj
+
+
+def test_allowlist_admits_mxnet_optimizer():
+    import mxnet_tpu as mx
+    opt = mx.optimizer.create("sgd", learning_rate=0.25)
+    got = dist_ps._restricted_loads(
+        pickle.dumps(opt, protocol=pickle.HIGHEST_PROTOCOL))
+    assert type(got) is type(opt)
+    assert got.lr == 0.25
+
+
+def test_allowlist_refuses_code_exec_globals():
+    class Evil:
+        def __reduce__(self):
+            import os as _os
+            return (_os.system, ("true",))
+
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        dist_ps._restricted_loads(pickle.dumps(Evil()))
+
+    # subprocess / builtins.eval style gadgets are refused the same way
+    # (direct find_class probes — the refusal is at name-resolution)
+    up = dist_ps._RestrictedUnpickler.__new__(
+        dist_ps._RestrictedUnpickler)
+    for module, name in (("subprocess", "Popen"), ("builtins", "eval"),
+                         ("builtins", "exec"), ("shutil", "rmtree")):
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            up.find_class(module, name)
+
+
+def test_allowlist_admits_safe_builtins_only():
+    up = dist_ps._RestrictedUnpickler.__new__(dist_ps._RestrictedUnpickler)
+    assert up.find_class("builtins", "dict") is dict
+    assert up.find_class("builtins", "bytearray") is bytearray
+    with pytest.raises(pickle.UnpicklingError):
+        up.find_class("builtins", "getattr")
+    with pytest.raises(pickle.UnpicklingError):
+        up.find_class("importlib", "import_module")
+
+
+# ---------------------------------------------------------------------------
+# frame-level ProtocolError arms
+# ---------------------------------------------------------------------------
+
+def test_wrong_magic_is_protocol_error():
+    a, b, conn = _pair()
+    a.sendall(b"EVIL" + struct.pack("<HQ", 1, 4) + b"xxxx")
+    with pytest.raises(dist_ps.ProtocolError, match="magic"):
+        conn.recv()
+    a.close(); b.close()
+
+
+def test_wrong_wire_version_is_protocol_error():
+    a, b, conn = _pair()
+    blob = pickle.dumps(("barrier",))
+    a.sendall(struct.pack("<4sHQ", b"MXPS", 999, len(blob)) + blob)
+    with pytest.raises(dist_ps.ProtocolError, match="version"):
+        conn.recv()
+    a.close(); b.close()
+
+
+def test_oversized_frame_is_rejected_before_any_read():
+    """A header claiming a >16GiB payload must be refused from the
+    header alone — never allocated, never read."""
+    a, b, conn = _pair()
+    a.sendall(struct.pack("<4sHQ", b"MXPS", 1, (1 << 34) + 1))
+    with pytest.raises(dist_ps.ProtocolError, match="exceeds"):
+        conn.recv()
+    a.close(); b.close()
+
+
+def test_disallowed_global_over_the_wire_is_protocol_error():
+    class Evil:
+        def __reduce__(self):
+            import os as _os
+            return (_os.system, ("true",))
+
+    a, b, conn = _pair()
+    blob = pickle.dumps(Evil())
+    a.sendall(struct.pack("<4sHQ", b"MXPS", 1, len(blob)) + blob)
+    with pytest.raises(dist_ps.ProtocolError, match="disallowed"):
+        conn.recv()
+    a.close(); b.close()
+
+
+def test_truncated_pickle_is_protocol_error_not_crash():
+    a, b, conn = _pair()
+    blob = pickle.dumps(("push", np.ones(4)))[:10]   # torn payload
+    a.sendall(struct.pack("<4sHQ", b"MXPS", 1, len(blob)) + blob)
+    with pytest.raises(dist_ps.ProtocolError,
+                       match="undecodable|truncated|pickle"):
+        conn.recv()
+    a.close(); b.close()
+
+
+def test_set_state_inner_updater_blob_is_restricted(tmp_path):
+    """The checkpoint-state restore path must not smuggle a raw pickle
+    past the allowlist: the inner updater blob crossed the wire too."""
+    import os
+    import mxnet_tpu as mx
+    server = dist_ps.Server(nworkers=1)
+    server.updater = mx.optimizer.get_updater(mx.optimizer.create("sgd"))
+    marker = str(tmp_path / "pwned")
+
+    class Evil:
+        def __reduce__(self):
+            import os as _os
+            return (_os.system, ("touch %s" % marker,))
+
+    inner = pickle.dumps(Evil())
+    outer = pickle.dumps({"version": 1, "store": {}, "shapes": {},
+                          "ranges": {}, "sync": True, "updater": inner,
+                          "index_update_count": None, "num_update": None})
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        server._set_state(outer)
+    assert not os.path.exists(marker), "code-exec gadget ran!"
+    # and a LEGITIMATE updater payload still round-trips
+    w, g = mx.nd.ones((4,)), mx.nd.ones((4,))
+    server.updater(0, g, w)
+    good = server._get_state()
+    server2 = dist_ps.Server(nworkers=1)
+    server2.updater = mx.optimizer.get_updater(mx.optimizer.create("sgd"))
+    server2._set_state(good)
+    assert set(server2.updater.states) == set(server.updater.states)
+
+
+def test_protocol_error_is_not_retried_as_peer_loss():
+    """ProtocolError subclasses ConnectionError but must NOT be eaten by
+    the PeerLost retry machinery — garbage is a bug, not a dead peer."""
+    assert issubclass(dist_ps.ProtocolError, ConnectionError)
+    assert not issubclass(dist_ps.ProtocolError, dist_ps.PeerLost)
+    assert not issubclass(dist_ps.PeerLost, dist_ps.ProtocolError)

@@ -287,6 +287,66 @@ def test_jg006_silent_for_module_level_cached_bool():
 
 
 # ---------------------------------------------------------------------------
+# JG007 unbounded-blocking-call (dist/engine/serving scope)
+# ---------------------------------------------------------------------------
+
+def _codes_at(src, path, select=None):
+    return [f.rule for f in lint_source(textwrap.dedent(src), path=path,
+                                        select=select)]
+
+
+def test_jg007_fires_on_unbounded_recv_and_queue_get():
+    src = """
+    def pump(conn, task_queue):
+        msg = conn.recv()
+        item = task_queue.get()
+        return msg, item
+    """
+    assert _codes_at(src, "mxnet_tpu/dist_ps.py",
+                     {"JG007"}) == ["JG007", "JG007"]
+    # same patterns inside the serving tier
+    assert _codes_at(src, "mxnet_tpu/serving/batcher.py",
+                     {"JG007"}) == ["JG007", "JG007"]
+
+
+def test_jg007_silent_with_deadline_or_explicit_none():
+    src = """
+    def pump(conn, task_queue, d):
+        a = conn.recv(timeout=5.0)
+        b = conn.recv(timeout=None)      # documented-deliberate wait
+        c = task_queue.get(timeout=1.0)
+        e = task_queue.get(block=False)
+        f = d.get("key")                 # dict .get, not a queue
+        g = d.get("key", None)
+        return a, b, c, e, f, g
+    """
+    assert _codes_at(src, "mxnet_tpu/dist_ps.py", {"JG007"}) == []
+
+
+def test_jg007_scoped_to_dist_engine_serving():
+    src = """
+    def pump(conn, queue):
+        return conn.recv(), queue.get()
+    """
+    # outside the transport/scheduling tier the rule stays quiet
+    assert _codes_at(src, "mxnet_tpu/io.py", {"JG007"}) == []
+    assert _codes_at(src, "tools/launch.py", {"JG007"}) == []
+    assert _codes_at(src, "mxnet_tpu/engine.py",
+                     {"JG007"}) == ["JG007", "JG007"]
+
+
+def test_jg007_repo_has_no_unannotated_blocking_calls():
+    """The tentpole burn-down: every remaining unbounded wait in the
+    dist/engine/serving tier is either deadline-bounded, an explicit
+    ``timeout=None``, or carries a justified inline suppression —
+    nothing is baselined."""
+    from mxnet_tpu.lint import lint_paths
+    findings = lint_paths([os.path.join(REPO, "mxnet_tpu")],
+                          select={"JG007"}, rel_root=REPO)
+    assert not findings, "\n".join(f.format_text() for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # suppressions / baseline / CLI
 # ---------------------------------------------------------------------------
 
@@ -365,7 +425,7 @@ def test_baseline_round_trip(tmp_path):
 
 def test_every_rule_registered_with_rationale():
     assert set(RULES) == {"JG001", "JG002", "JG003", "JG004", "JG005",
-                          "JG006"}
+                          "JG006", "JG007"}
     for rule in RULES.values():
         assert rule.name and rule.rationale
 

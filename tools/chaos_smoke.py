@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Seeded chaos smoke of the local dist harness (CI gate).
+
+Runs ``tests/chaos_dist_worker.py`` (scheduler + servers + workers via
+``tools/launch.py``) three times under a hard wall-clock cap:
+
+1. **baseline** — no chaos;
+2. **chaos**    — the seeded transient spec (delays on every recv + one
+   dropped pull-request frame per worker; no permanent kill);
+3. **replay**   — the identical spec + seed again.
+
+Exit is nonzero on ANY of: a hang (the wall-clock cap fires), a worker
+failing, a chaos run whose loss trajectory is not BITWISE identical to
+the baseline (transient faults must be fully absorbed by the deadline +
+retry machinery), a chaos run that injected zero faults (a vacuous
+pass), or a replay whose injected-fault sequence differs from the chaos
+run's (determinism regression).
+
+Heartbeats are disabled for the chaos runs so the worker processes stay
+single-threaded and the per-rule chaos counters — hence the fault log —
+are exactly reproducible.
+
+Usage::
+
+    python tools/chaos_smoke.py [--iters 3] [--workers 2] [--servers 2]
+        [--chaos SPEC] [--timeout 180] [--json]
+"""
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from launch import launch  # noqa: E402
+
+WORKER = os.path.join(REPO, "tests", "chaos_dist_worker.py")
+
+DEFAULT_CHAOS = "seed=11;conn.send.pull:drop@3;conn.recv:delay~0.05=2ms"
+
+
+def run_once(label, state_dir, args, chaos_spec):
+    """One launch under the hard cap; returns per-rank result dicts."""
+    os.makedirs(state_dir, exist_ok=True)
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "CHAOS_STATE_DIR": state_dir,
+        "CHAOS_ITERS": str(args.iters),
+        "MXNET_CHAOS": chaos_spec or "",
+        "MXNET_PS_RPC_TIMEOUT_S": str(args.rpc_timeout),
+        # single-threaded workers => bitwise-reproducible fault logs
+        "MXNET_PS_HEARTBEAT_S": "0",
+        "MXNET_FLIGHT_DIR": state_dir,
+    }
+    try:
+        rcs = launch(args.workers, args.servers,
+                     [sys.executable, WORKER],
+                     env_extra=env, timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            "chaos_smoke: HANG — run %r exceeded the %ds wall-clock cap "
+            "(a dead/silent peer wedged the job; the deadline machinery "
+            "failed)" % (label, args.timeout))
+    if rcs != [0] * args.workers:
+        raise SystemExit("chaos_smoke: run %r worker exit codes %r"
+                         % (label, rcs))
+    results = []
+    for r in range(args.workers):
+        path = os.path.join(state_dir, "result-%d.json" % r)
+        if not os.path.exists(path):
+            raise SystemExit("chaos_smoke: run %r left no result for "
+                             "rank %d" % (label, r))
+        with open(path) as fh:
+            results.append(json.load(fh))
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--servers", type=int, default=2)
+    ap.add_argument("--chaos", default=DEFAULT_CHAOS)
+    ap.add_argument("--rpc-timeout", type=float, default=3.0)
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="hard wall-clock cap per run (hang detector)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir (debugging)")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable summary line")
+    args = ap.parse_args(argv)
+
+    scratch = tempfile.mkdtemp(prefix="mxnet-chaos-smoke-")
+    try:
+        baseline = run_once("baseline", os.path.join(scratch, "base"),
+                            args, chaos_spec="")
+        chaotic = run_once("chaos", os.path.join(scratch, "chaos"),
+                           args, chaos_spec=args.chaos)
+        replay = run_once("replay", os.path.join(scratch, "replay"),
+                          args, chaos_spec=args.chaos)
+
+        problems = []
+        base_traj = [r["losses_hex"] for r in baseline]
+        if any(t != base_traj[0] for t in base_traj):
+            problems.append("baseline workers disagree with each other")
+        for label, results in (("chaos", chaotic), ("replay", replay)):
+            for r in results:
+                if r["losses_hex"] != base_traj[r["rank"]]:
+                    problems.append(
+                        "%s rank %d trajectory is NOT bitwise-identical "
+                        "to baseline (transient faults leaked into the "
+                        "math): %s vs %s"
+                        % (label, r["rank"], r["losses"],
+                           baseline[r["rank"]]["losses"]))
+        faults = sum(len(r["fault_log"]) for r in chaotic)
+        if faults == 0:
+            problems.append("chaos run injected ZERO faults — the spec "
+                            "matched nothing (vacuous pass)")
+        for a, b in zip(chaotic, replay):
+            if a["fault_log"] != b["fault_log"]:
+                problems.append(
+                    "replay rank %d fault sequence differs from chaos "
+                    "run (determinism regression):\n  %s\n  %s"
+                    % (a["rank"], a["fault_log"], b["fault_log"]))
+
+        summary = {
+            "ok": not problems,
+            "iters": args.iters,
+            "workers": args.workers,
+            "servers": args.servers,
+            "chaos": args.chaos,
+            "injected_faults": faults,
+            "final_loss": baseline[0]["losses"][-1],
+            "problems": problems,
+        }
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            print("chaos_smoke: %s — %d injected faults, %d iters, "
+                  "final loss %r"
+                  % ("OK" if not problems else "FAIL", faults,
+                     args.iters, summary["final_loss"]))
+            for p in problems:
+                print("  PROBLEM: %s" % p)
+        return 0 if not problems else 1
+    finally:
+        if args.keep:
+            print("chaos_smoke: scratch kept at %s" % scratch)
+        else:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
