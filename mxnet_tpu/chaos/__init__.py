@@ -22,6 +22,9 @@ applies the returned fault itself, because only the seam knows what
                    (:mod:`mxnet_tpu.checkpoint.manager`)
 ``serving.batch``  each coalesced serving batch execution
                    (:mod:`mxnet_tpu.serving.batcher`)
+``grad.bucket``    the reduced-gradient seam of ``Trainer.step`` (both
+                   the fused and per-slot paths, once per step); the
+                   ``nan`` kind poisons a bucket via :func:`poison_grads`
 =================  ======================================================
 
 Determinism contract: every rule counts its own matching calls, and a
@@ -49,8 +52,8 @@ from .spec import (ChaosSpecError, Fault, Rule, KINDS, SITES,  # noqa: F401
 
 __all__ = ["ChaosError", "ChaosSpecError", "ChaosPlan", "active",
            "configure", "refresh_from_env", "decide", "apply_inline",
-           "chaos_task", "fault_log", "plan", "reset", "describe",
-           "KINDS", "SITES", "parse_spec", "parse_duration"]
+           "chaos_task", "poison_grads", "fault_log", "plan", "reset",
+           "describe", "KINDS", "SITES", "parse_spec", "parse_duration"]
 
 
 class ChaosError(RuntimeError):
@@ -186,6 +189,29 @@ def apply_inline(act):
                       % (act[2], act[3]))
     raise ChaosError("chaos: injected %s at %s #%d"
                      % (kind, act[2], act[3]))
+
+
+def poison_grads(raw_grads, site="grad.bucket"):
+    """The gradient seam: decide once per step at *site*; a ``nan``
+    fault replaces the FIRST bucket with NaNs — deterministic (always
+    the same bucket, decided at step order), so a poisoned run replays
+    exactly from seed + spec.  Other kinds apply inline; no active plan
+    means the input list passes through untouched."""
+    if not _ACTIVE:
+        return raw_grads
+    act = decide(site)
+    if act is None:
+        return raw_grads
+    if act[0] != "nan":
+        apply_inline(act)
+        return raw_grads
+    import numpy as np
+    import jax.numpy as jnp
+    out = list(raw_grads)
+    g0 = out[0]
+    out[0] = jnp.full(getattr(g0, "shape", ()), np.nan,
+                      getattr(g0, "dtype", np.float32))
+    return out
 
 
 def chaos_task(fn, act):

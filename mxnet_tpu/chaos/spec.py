@@ -32,6 +32,8 @@ see :mod:`mxnet_tpu.chaos`):
 ``garbage`` conn.send: replace the frame with garbage bytes
 ``exc``     raise :class:`~mxnet_tpu.chaos.ChaosError` at the site
 ``fail``    raise ``OSError`` (transient-IO flavor, e.g. ``ckpt.io``)
+``nan``     grad.bucket: deterministically replace a gradient bucket
+            with NaNs (drives the training guardian end-to-end)
 ==========  ==========================================================
 """
 from __future__ import annotations
@@ -42,12 +44,12 @@ __all__ = ["ChaosSpecError", "Fault", "Rule", "KINDS", "SITES",
            "parse_spec", "parse_duration"]
 
 KINDS = frozenset({"drop", "delay", "stall", "close", "garbage",
-                   "exc", "fail"})
+                   "exc", "fail", "nan"})
 
 # the seams wired up in this build (documentation + spec validation;
 # prefixes of these are fine, arbitrary others are a typo'd spec)
 SITES = ("conn.send", "conn.recv", "engine.task", "ckpt.io",
-         "serving.batch")
+         "serving.batch", "grad.bucket")
 
 _DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)(us|ms|s)?$")
 _FAULT_RE = re.compile(

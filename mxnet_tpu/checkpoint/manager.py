@@ -147,6 +147,9 @@ class CheckpointManager:
         self.last_error = None
         self._last_enqueued = None
         self._active_tmp = None
+        # the guardian's rollback target: survives restarts via a marker
+        # file so a resumed run keeps its known-good anchor
+        self._pinned_step = self._load_pin()
 
         self._preempt_at = None
         self._final_done = False
@@ -197,6 +200,53 @@ class CheckpointManager:
     @property
     def step(self):
         return self._step
+
+    # -- last-good pinning (the guardian's rollback anchor) ----------------
+
+    _PIN_FILE = "last_good.json"
+
+    def _load_pin(self):
+        try:
+            with open(os.path.join(self._dir, self._PIN_FILE)) as fh:
+                return int(json.load(fh)["step"])
+        except Exception:
+            return None
+
+    @property
+    def last_good_step(self):
+        """The pinned known-good checkpoint step, or None."""
+        return self._pinned_step
+
+    def pin_last_good(self, step=None):
+        """Mark checkpoint *step* (default: the newest committed one) as
+        known-good: retention never evicts it, and the guardian's
+        auto-rollback targets it.  Persisted as an atomic marker file so
+        the pin survives a restart.  Returns the pinned step or None."""
+        if step is None:
+            step = self.last_committed_step
+        if step is None:
+            return None
+        step = int(step)
+        self._pinned_step = step
+        tmp = os.path.join(self._dir, self._PIN_FILE + ".tmp-%d"
+                           % os.getpid())
+        try:
+            with open(tmp, "w") as fh:
+                json.dump({"step": step}, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.rename(tmp, os.path.join(self._dir, self._PIN_FILE))
+        except OSError:
+            # the in-memory pin still protects this process's retention;
+            # only restart persistence degrades.  Remove the torn tmp —
+            # the _retain sweep only handles directories.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        _tel.set_gauge("checkpoint_pinned_step", step)
+        _flight.record("checkpoint", "pin-last-good", step=step)
+        return step
 
     # -- snapshot capture (caller thread: the device→host cut) -------------
 
@@ -388,17 +438,50 @@ class CheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
 
     def _retain(self):
-        """Keep the newest ``keep`` complete checkpoints; sweep the rest
-        plus any abandoned tmp dirs (not the one mid-write)."""
+        """Keep the newest ``keep`` complete checkpoints — plus the
+        ``last_good``-pinned one, whatever its age: evicting the only
+        verified-healthy state would turn the guardian's rollback into a
+        no-op exactly when a sick run needs it.  Sweep the rest and any
+        abandoned tmp dirs (not the one mid-write)."""
         complete = [(s, p) for s, p, m in self._list_checkpoints()
                     if m is not None and m.get("complete")]
-        for _, path in complete[self._keep:]:
+        for step, path in complete[self._keep:]:
+            if step == self._pinned_step:
+                continue
             shutil.rmtree(path, ignore_errors=True)
         for name in os.listdir(self._dir):
             path = os.path.join(self._dir, name)
             if ".tmp-" in name and path != self._active_tmp \
                     and os.path.isdir(path):
                 shutil.rmtree(path, ignore_errors=True)
+
+    def discard_newer_than(self, step):
+        """Evict every checkpoint newer than *step* (the guardian's
+        rollback epilogue): after a rollback those checkpoints are the
+        abandoned, unverified timeline — leaving them on disk means a
+        restart's newest-first ``restore()`` would resume exactly the
+        state the rollback fled.  Returns the discarded steps."""
+        step = int(step)
+        # drain the async writer first: an in-flight snapshot for a
+        # newer (poisoned) step committing AFTER the sweep would
+        # resurrect the abandoned timeline — and then get pinned by the
+        # next clean step's last-good advance
+        self.wait()
+        discarded = []
+        for ckpt_step, path, _manifest in self._list_checkpoints():
+            if ckpt_step > step:
+                shutil.rmtree(path, ignore_errors=True)
+                discarded.append(ckpt_step)
+        if discarded:
+            if self._last_enqueued is not None \
+                    and self._last_enqueued > step:
+                self._last_enqueued = None     # re-saves must re-attempt
+            if self.last_committed_step is not None \
+                    and self.last_committed_step > step:
+                self.last_committed_step = step
+            _flight.record("checkpoint", "discard-newer", than=step,
+                           discarded=discarded)
+        return discarded
 
     # -- restore -----------------------------------------------------------
 
@@ -460,12 +543,31 @@ class CheckpointManager:
                 "saved_shards": int(manifest.get("n_shards", 1)),
                 "params": params, "optim": optim, "state": state}
 
-    def restore(self):
-        """Load the newest complete-and-valid checkpoint into the
-        trainer/module, iterator, and RNG.  Partial or corrupt
-        checkpoints fall back to the previous complete one; returns the
-        restored step, or None when nothing restorable exists."""
-        for step, path, manifest in self._list_checkpoints():
+    def restore(self, step=None):
+        """Load a checkpoint into the trainer/module, iterator, and RNG.
+
+        Default: the newest complete-and-valid one.  With ``step=`` the
+        TARGETED checkpoint is tried first even when newer ones exist
+        (the guardian's rollback: newer checkpoints are exactly the
+        unverified ones).  A corrupt or missing target falls back —
+        non-fatally — to the remaining checkpoints: older ones first
+        (newest-first among them), then the newer group oldest-first as
+        the last resort (closest to the last verified state).  Returns
+        the restored step, or None when nothing restorable exists.
+        """
+        entries = self._list_checkpoints()       # newest first
+        if step is not None:
+            step = int(step)
+            target = [e for e in entries if e[0] == step]
+            older = [e for e in entries if e[0] < step]
+            # the last-resort newer group goes OLDEST-first: when a
+            # corrupt pin forces us into unverified territory, the
+            # checkpoint closest to the last verified state is the
+            # least-bad choice — newest-first would land on the one
+            # furthest into the abandoned timeline
+            newer = [e for e in entries if e[0] > step][::-1]
+            entries = target + older + newer
+        for step, path, manifest in entries:
             try:
                 payload = self._load(path, manifest)
                 self._apply(payload)
@@ -730,6 +832,7 @@ class CheckpointManager:
         return {"directory": self._dir,
                 "step": self._step,
                 "last_committed_step": self.last_committed_step,
+                "last_good_step": self._pinned_step,
                 "every_steps": self._every_steps,
                 "n_shards": self._n_shards,
                 "keep": self._keep,

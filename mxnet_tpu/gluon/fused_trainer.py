@@ -38,11 +38,15 @@ import os
 import weakref
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import profiler as _prof
 from .. import random as _random
 from .. import telemetry as _tel
+from ..guardian import core as _guard
+from ..guardian import health as _health
 from ..optimizer import _state_raw, _state_writeback, static_hypers
 
 __all__ = ["fused_trainer_enabled", "fused_step_fn", "run_fused_step"]
@@ -72,7 +76,7 @@ _STEP_CACHE = {}      # signature -> (weakref to optimizer, jitted step)
 _TRACECHECK_KEEPALIVE = []    # graftcheck specimen optimizers (see below)
 
 
-def _signature(opt, params_raw, states_raw, donate):
+def _signature(opt, params_raw, states_raw, donate, guarded):
     leaves, treedef = jax.tree_util.tree_flatten(states_raw)
     return (type(opt), static_hypers(opt),
             tuple((tuple(w.shape), str(w.dtype)) for w in params_raw),
@@ -82,10 +86,10 @@ def _signature(opt, params_raw, states_raw, donate):
             tuple(str(getattr(w, "sharding", None)) for w in params_raw),
             str(treedef),
             tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
-            bool(donate))
+            bool(donate), bool(guarded))
 
 
-def fused_step_fn(opt, params_raw, states_raw, donate):
+def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False):
     """The jitted whole-model step for this (optimizer, model) signature,
     compiled once per signature process-wide.
 
@@ -94,8 +98,18 @@ def fused_step_fn(opt, params_raw, states_raw, donate):
     same-signature instance produces the same program — and a cached
     entry whose original optimizer died is rebuilt around the caller's
     live one instead of pinning the dead model's parameters forever.
+
+    With ``guarded=True`` (a :class:`~mxnet_tpu.guardian.TrainingGuardian`
+    is installed) the SAME program additionally computes an
+    all-grads-finite scalar — plus the finiteness of ``hyper['loss']``
+    when the loop recorded one — and suppresses the whole update via
+    ``jnp.where`` on a nonfinite verdict: old params/states pass through
+    the donated buffers, the verdict rides out as a third output.  One
+    extra reduction in an existing program; never a second XLA launch,
+    never a host callback (graftcheck-proven on the
+    ``fused_trainer_step_guarded`` specimen).
     """
-    sig = _signature(opt, params_raw, states_raw, donate)
+    sig = _signature(opt, params_raw, states_raw, donate, guarded)
     # prune entries whose owning optimizer died (their compiled programs
     # would otherwise pin memory forever)
     for dead in [k for k, (r, _) in _STEP_CACHE.items() if r() is None]:
@@ -115,11 +129,27 @@ def fused_step_fn(opt, params_raw, states_raw, donate):
         o = opt_ref()
         if o is None:       # only reachable on a retrace after death
             raise RuntimeError("fused step optimizer was collected")
-        return o.fused_update_step(params, grads, states, hyper)
+        if not guarded:
+            return o.fused_update_step(params, grads, states, hyper)
+        finite = _health.all_finite(grads)
+        if "loss" in hyper:            # dict structure: static per trace
+            finite = jnp.logical_and(
+                finite, jnp.all(jnp.isfinite(hyper["loss"])))
+        new_params, new_states = o.fused_update_step(params, grads,
+                                                     states, hyper)
+        # nonfinite ⇒ the donated buffers keep their old values: the
+        # poisoned batch costs one skipped step, not a retrace and not
+        # a host round-trip
+        new_params = [jnp.where(finite, n, p)
+                      for n, p in zip(new_params, params)]
+        new_states = jax.tree_util.tree_map(
+            lambda n, p: jnp.where(finite, n, p), new_states, states)
+        return new_params, new_states, finite
 
     # params + states donated: the update happens in place in HBM
+    name = "fused_trainer_step_guarded" if guarded else "fused_trainer_step"
     fn = _tel.watch_jit(jax.jit(step, donate_argnums=(0, 2) if donate else ()),
-                        "fused_trainer_step")
+                        name)
     _STEP_CACHE[sig] = (opt_ref, fn)
     return fn
 
@@ -143,8 +173,16 @@ def tracecheck_programs():
     hyper = {"lr": np.zeros(2, np.float32), "wd": np.zeros(2, np.float32),
              "t": np.ones(2, np.int32), "rescale": np.float32(1.0)}
     fn = fused_step_fn(opt, params_raw, states_raw, donate=True)
+    # the guardian variant: same donated layout + the folded finite-
+    # health verdict and a recorded loss scalar — graftcheck proves the
+    # guard adds no host callback and no dtype widening
+    guarded_hyper = dict(hyper, loss=np.float32(0.0))
+    guarded = fused_step_fn(opt, params_raw, states_raw, donate=True,
+                            guarded=True)
     return [("fused_trainer_step", fn,
-             (params_raw, params_raw, states_raw, hyper), {})]
+             (params_raw, params_raw, states_raw, hyper), {}),
+            ("fused_trainer_step_guarded", guarded,
+             (params_raw, params_raw, states_raw, guarded_hyper), {})]
 
 
 def run_fused_step(trainer, slots):
@@ -154,8 +192,13 @@ def run_fused_step(trainer, slots):
     counts, lr/wd resolution) identical to the per-slot loop so
     ``save_states``/``load_states`` round-trip unchanged and results are
     bitwise equal.
+
+    Returns True when an installed guardian's verdict suppressed the
+    update (the caller must then NOT notify the step boundary — a
+    skipped step is not a completed optimizer step).
     """
     opt, updater = trainer._optimizer, trainer._updater
+    guard = _guard.current()
     grads = [p.grad() for _, p in slots]
 
     if trainer._kvstore is not None:
@@ -169,8 +212,15 @@ def run_fused_step(trainer, slots):
         raw_grads = [r._data for r in reduced]
     else:
         raw_grads = [g._data for g in grads]
+    if _chaos.active():              # grad seam: `nan` poisons a bucket
+        raw_grads = _chaos.poison_grads(raw_grads)
 
     # state + hyper bookkeeping, per slot, exactly like Updater/update()
+    count_snapshot = None
+    if guard is not None:
+        # the undo token: a skipped step must not advance hyper['t']
+        count_snapshot = opt._snapshot_update_counts(
+            [s for s, _ in slots])
     for slot, p in slots:
         if slot not in updater.states:
             updater.states[slot] = opt.create_state(slot, p.data())
@@ -183,21 +233,51 @@ def run_fused_step(trainer, slots):
              "t": np.asarray([opt._index_update_count[s]
                               for s, _ in slots], np.int32),
              "rescale": np.float32(opt.rescale_grad)}
+    rng_snapshot = None
     if getattr(opt, "needs_rng", False):
+        if guard is not None:
+            # a skipped step must not consume from the key stream, or a
+            # retried batch draws different noise than the clean run
+            rng_snapshot = _random.get_state()
         _prof.bump("xla_program_calls")            # the key split
         hyper["key"] = jax.random.split(_random.next_key(), len(slots))
+    loss_raw = guard.take_loss_raw() if guard is not None else None
+    if loss_raw is not None:
+        hyper["loss"] = loss_raw
 
     params_raw = [p._raw_data() for _, p in slots]
     states_raw = [_state_raw(updater.states[s]) for s, _ in slots]
     donate = slots and slots[0][1].data().context.device_type != "cpu"
-    fn = fused_step_fn(opt, params_raw, states_raw, donate)
+    fn = fused_step_fn(opt, params_raw, states_raw, donate,
+                       guarded=guard is not None)
     trainer._fused_step_jit = fn                   # introspection / tests
 
     _prof.bump("xla_program_calls")
     _prof.bump("trainer_fused_step")
     with _tel.span("fused_optimizer_step", cat="program"):
-        new_params, new_states = fn(params_raw, raw_grads, states_raw, hyper)
+        if guard is not None:
+            new_params, new_states, verdict = fn(params_raw, raw_grads,
+                                                 states_raw, hyper)
+        else:
+            new_params, new_states = fn(params_raw, raw_grads,
+                                        states_raw, hyper)
 
+    # ALWAYS rebind: on a donate backend the inputs were consumed, and on
+    # a skipped step the outputs carry the old values through jnp.where
     for (slot, p), nw, ns in zip(slots, new_params, new_states):
         p._rebind_data(nw)                         # donation-safe rebind
         _state_writeback(updater.states[slot], ns)
+
+    if guard is None:
+        return False
+    # the one cost of guarding: reading the verdict scalar waits for the
+    # step program (the same read dynamic loss scaling needs anyway to
+    # steer the next step's scale).  The VERDICT itself was free — no
+    # callback, no second program — but a guarded step does not overlap
+    # with the next batch's host work the way an unguarded one can.
+    finite = bool(np.asarray(verdict))
+    if not finite:
+        opt._revert_update_counts(count_snapshot)
+        if rng_snapshot is not None:
+            _random.set_state(rng_snapshot)
+    return guard.after_step(finite)

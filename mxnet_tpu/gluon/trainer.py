@@ -13,10 +13,12 @@ from __future__ import annotations
 
 import pickle
 
+from .. import chaos as _chaos
 from .. import kvstore as kvs
 from .. import optimizer as opt
 from .. import telemetry as _tel
 from ..checkpoint import hooks as _ckpt_hooks
+from ..guardian import core as _guard
 from .fused_trainer import fused_trainer_enabled, run_fused_step
 from .parameter import Parameter, ParameterDict
 
@@ -96,10 +98,24 @@ class Trainer(object):
         program (gluon/fused_trainer.py).  ``MXNET_FUSED_TRAINER=0``
         falls back to the per-slot loop, which is also the
         bitwise-equality oracle in tests.
+
+        With a :class:`~mxnet_tpu.guardian.TrainingGuardian` installed
+        the step additionally computes a finite-health verdict inside
+        the update program, suppresses the update on NaN/Inf, and folds
+        the guardian's loss scale into the traced rescale (see
+        docs/GUARDIAN.md); a skipped step does not notify the
+        checkpoint step boundary.
         """
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = float(self._scale) / batch_size
+        guard = _guard.current()
+        if guard is not None:
+            # fold the inverse loss scale into the traced rescale scalar:
+            # scaled gradients un-scale inside the update program, and a
+            # scale change (halve/double) never retraces
+            self._optimizer.rescale_grad = guard.apply_rescale(
+                self._optimizer.rescale_grad)
 
         slots = []
         for slot, param in enumerate(self._params):
@@ -119,6 +135,7 @@ class Trainer(object):
                 continue
             slots.append((slot, param))
 
+        skipped = False
         if slots:
             # step-boundary span: kvstore buckets and the optimizer
             # program nest inside it; memory watermarks, the XLA cost
@@ -130,28 +147,62 @@ class Trainer(object):
                                  "batch_size": batch_size}):
                 if fused_trainer_enabled() \
                         and self._optimizer.supports_fused():
-                    run_fused_step(self, slots)
+                    skipped = run_fused_step(self, slots)
                 else:
-                    self._loop_step(slots)
+                    skipped = self._loop_step(slots)
         for _, param in slots:
             param._fresh_grad = False
         # step boundary: params/optimizer/iterator agree on one step —
         # the active CheckpointManager snapshots here and honors a
-        # pending SIGTERM (one global read when no manager is installed)
-        _ckpt_hooks.note_step_boundary()
+        # pending SIGTERM (one global read when no manager is installed).
+        # A guardian-skipped step is NOT a completed optimizer step:
+        # nothing advanced, so nothing to snapshot.
+        if not skipped:
+            _ckpt_hooks.note_step_boundary()
 
     def _loop_step(self, slots):
         """Per-slot fallback: one kvstore round + one eager Updater
-        dispatch per parameter (O(n_params) program calls)."""
-        for slot, param in slots:
-            grad = param.grad()
-            if self._kvstore is not None:
-                # all-reduce the gradient across workers, update locally
+        dispatch per parameter (O(n_params) program calls).
+
+        With a guardian installed this grows the IDENTICAL guard the
+        fused path folds in: reduce everything first, one finiteness
+        verdict over the reduced gradients (+ recorded loss), then
+        either every per-slot update or none — the bitwise oracle covers
+        the skip machinery too.  Returns True when the step was skipped.
+        """
+        guard = _guard.current()
+        if _chaos.active():          # the same grad seam, once per step
+            raws = _chaos.poison_grads(
+                [param.grad()._data for _, param in slots])
+            for (_, param), raw in zip(slots, raws):
+                if raw is not param.grad()._data:
+                    param.grad()._set_data(raw)
+        if guard is None:
+            for slot, param in slots:
+                grad = param.grad()
+                if self._kvstore is not None:
+                    # all-reduce the gradient across workers, update
+                    # locally
+                    with _tel.span("kvstore_push_pull", cat="kvstore"):
+                        self._kvstore.push(slot, [grad])
+                        self._kvstore.pull(slot, out=[grad])
+                with _tel.span("optimizer_update", cat="program"):
+                    self._updater(slot, grad, param.data())
+            return False
+        if self._kvstore is not None:
+            for slot, param in slots:
+                grad = param.grad()
                 with _tel.span("kvstore_push_pull", cat="kvstore"):
                     self._kvstore.push(slot, [grad])
                     self._kvstore.pull(slot, out=[grad])
-            with _tel.span("optimizer_update", cat="program"):
-                self._updater(slot, grad, param.data())
+        finite = guard.grads_finite(
+            [param.grad()._data for _, param in slots],
+            guard.take_loss_raw())
+        if finite:
+            for slot, param in slots:
+                with _tel.span("optimizer_update", cat="program"):
+                    self._updater(slot, param.grad(), param.data())
+        return guard.after_step(finite)
 
     def save_states(self, fname):
         """Serialise optimizer state (moments etc.) to *fname*.

@@ -1,0 +1,45 @@
+"""mxnet_tpu.guardian: in-program NaN/Inf detection, dynamic loss
+scaling, and auto-rollback to the last-good checkpoint.
+
+The robustness capstone over the checkpoint (PR 7) and chaos (PR 8)
+tiers: PRs 7–8 keep the job *up*; the guardian keeps it *correct*.
+
+    mgr = checkpoint.CheckpointManager(dir, trainer=trainer, data_iter=it,
+                                       every_steps=50)
+    guard = guardian.TrainingGuardian(manager=mgr)   # installs itself
+    for batch in loader:
+        with autograd.record():
+            loss = loss_fn(net(batch.data), batch.label)
+            scaled = guard.scale_loss(loss)          # records + scales
+        scaled.backward()
+        trainer.step(batch_size)                     # verdict in-program
+        if guard.last_step_skipped():
+            ...                                      # optionally retry
+
+See :mod:`.core` for the state machine (detect → skip → rescale →
+roll back), :mod:`.health` for the shared on-device finiteness/norm
+math, and docs/GUARDIAN.md for the recovery matrix.  The live view is
+``GET /guardian`` on the introspection server.
+"""
+from __future__ import annotations
+
+from . import health                                  # noqa: F401
+from .core import (TrainingGuardian, current, install, uninstall,  # noqa: F401
+                   enabled, refresh_from_env)
+from .health import all_finite, global_norm, verdict_program  # noqa: F401
+from .health import tracecheck_programs               # noqa: F401
+
+__all__ = ["TrainingGuardian", "current", "install", "uninstall",
+           "enabled", "refresh_from_env", "all_finite", "global_norm",
+           "verdict_program", "tracecheck_programs", "http_view"]
+
+
+def http_view():
+    """The ``/guardian`` introspection payload: the installed guardian's
+    description, or an inactive stub."""
+    guard = current()
+    if guard is None:
+        return {"active": False, "env_enabled": enabled()}
+    view = guard.describe()
+    view["active"] = True
+    return view

@@ -1,0 +1,444 @@
+"""The training guardian: detect → skip → rescale → roll back.
+
+PRs 7–8 keep the *job* alive through preemption and dead peers; this
+module keeps the job *correct* when the numbers go bad.  One
+:class:`TrainingGuardian` instance per run watches every
+``Trainer.step``:
+
+1. **Detect.**  The fused trainer step computes an all-grads-finite
+   scalar (plus the finiteness of the loss the loop recorded via
+   :meth:`TrainingGuardian.scale_loss` / :meth:`observe_loss`) inside
+   its own donated program — no extra XLA launch, no host callback.
+2. **Skip.**  On a nonfinite verdict the update is suppressed
+   *in-program* (``jnp.where`` keeps the donated buffers at their old
+   values), the per-slot update counts are rolled back host-side, and
+   the step boundary is NOT notified — a poisoned batch costs one
+   skipped step, never a poisoned checkpoint.
+3. **Rescale.**  ``MXNET_GUARDIAN_LOSS_SCALE=dynamic`` maintains a
+   power-of-two loss scale: halve on overflow, double after
+   ``growth_interval`` clean steps.  ``Trainer.step`` folds the inverse
+   into ``rescale_grad`` (a traced scalar — scale changes never
+   retrace), so bf16/f16 training self-heals.
+4. **Roll back.**  An EWMA loss-spike detector flags divergence, and a
+   consecutive-skip budget (``MXNET_GUARDIAN_MAX_SKIPS``), when
+   exhausted, restores the ``last_good``-pinned checkpoint
+   (:meth:`CheckpointManager.pin_last_good` — retention never evicts
+   it), then advances the data iterator past the quarantined batch
+   window so the run does not replay its own failure.
+
+Off path: ``current()`` is one module-global read; with no guardian
+installed nothing else runs.  ``MXNET_GUARDIAN=1`` auto-installs a
+default instance at import (subprocess tests / zero-code adoption);
+programs construct :class:`TrainingGuardian` directly to wire in a
+checkpoint manager and data iterator.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+
+import numpy as np
+
+from .. import telemetry as _tel
+from ..telemetry import flight as _flight
+
+__all__ = ["TrainingGuardian", "current", "install", "uninstall",
+           "enabled", "refresh_from_env"]
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+# EWMA spike detector internals (deliberately not env knobs: the factor
+# is the contract, the smoothing is an implementation detail)
+_EWMA_BETA = 0.9
+_EWMA_WARMUP = 10
+
+_DEFAULT_DYNAMIC_SCALE = float(2 ** 16)
+_MIN_SCALE = 1.0
+_MAX_SCALE = float(2 ** 24)
+
+
+def _env_truthy(name, default="0"):
+    return os.environ.get(name, default).strip().lower() in _TRUTHY
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+def _env_loss_scale():
+    """MXNET_GUARDIAN_LOSS_SCALE: 'dynamic' | <float> | '0'/unset = off."""
+    raw = os.environ.get("MXNET_GUARDIAN_LOSS_SCALE", "0").strip().lower()
+    if raw == "dynamic":
+        return "dynamic"
+    try:
+        val = float(raw)
+    except ValueError:
+        return None
+    return val if val > 0 else None
+
+
+class TrainingGuardian:
+    """One guardian per run; constructing it installs it process-wide
+    (latest wins, like ``checkpoint.hooks``).  Call :meth:`close` when
+    the run is over so later Trainers stop consulting it.
+
+    *manager* (optional ``CheckpointManager``) enables auto-rollback and
+    last-good pinning; *data_iter* (optional, defaults to the manager's)
+    is the stream quarantined after a rollback.
+    """
+
+    def __init__(self, manager=None, data_iter=None, loss_scale=None,
+                 growth_interval=None, max_skips=None, spike_factor=None):
+        self._manager = manager
+        self._data_iter = data_iter
+        spec = loss_scale if loss_scale is not None else _env_loss_scale()
+        if spec == "dynamic":
+            self._dynamic = True
+            self._scale = _DEFAULT_DYNAMIC_SCALE
+        elif spec:
+            self._dynamic = False
+            self._scale = float(spec)
+        else:
+            self._dynamic = False
+            self._scale = 1.0
+        self._scaling = bool(spec)
+        self._growth_interval = max(1, int(
+            growth_interval if growth_interval is not None
+            else _env_int("MXNET_GUARDIAN_GROWTH_INTERVAL", 2000)))
+        self._max_skips = max(1, int(
+            max_skips if max_skips is not None
+            else _env_int("MXNET_GUARDIAN_MAX_SKIPS", 3)))
+        self._spike_factor = float(
+            spike_factor if spike_factor is not None
+            else _env_float("MXNET_GUARDIAN_SPIKE_FACTOR", 10.0))
+
+        self._lock = threading.Lock()
+        self._pending_loss = None      # raw scalar for the NEXT verdict
+        self._last_loss = None         # host float for EWMA/description
+        self._consec_skips = 0
+        self._clean_streak = 0
+        self._ewma = None
+        self._warm = 0
+        self._last_action = None       # "applied" | "skipped" | "rollback"
+        self._last_rollback = None     # (from_step, to_step, quarantined)
+        install(self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        """Detach from the process hot path (Trainer.step stops seeing
+        this guardian); the instance stays inspectable."""
+        uninstall(self)
+
+    # -- loss scaling ------------------------------------------------------
+
+    @property
+    def loss_scale(self):
+        """The current loss scale (1.0 when scaling is off)."""
+        return self._scale if self._scaling else 1.0
+
+    def scale_loss(self, loss):
+        """Record *loss* for this step's verdict/EWMA and return it
+        multiplied by the current loss scale (the tensor to call
+        ``backward()`` on).  With scaling off the loss passes through
+        unchanged but is still recorded."""
+        self.observe_loss(loss)
+        if not self._scaling or self._scale == 1.0:
+            return loss
+        return loss * self._scale
+
+    def observe_loss(self, loss):
+        """Record *loss* (NDArray or raw array) for the next step's
+        in-program finiteness check and the EWMA spike detector.  The
+        raw array is handed to the step program as-is — its reduction
+        happens INSIDE the existing program (no extra XLA launch); keep
+        the loss shape stable across steps (a fixed batch size) or the
+        changed input shape retraces the step once."""
+        self._pending_loss = getattr(loss, "_data", loss)
+        return loss
+
+    def apply_rescale(self, rescale):
+        """Fold the inverse loss scale into the optimizer's
+        ``rescale_grad`` (a traced scalar: no retrace).  Power-of-two
+        scales make scaled training bitwise-identical to unscaled."""
+        if not self._scaling:
+            return rescale
+        return rescale / self._scale
+
+    # -- the step verdict (called by the trainer paths) --------------------
+
+    def take_loss_raw(self):
+        """The recorded loss scalar for this step (raw jax array), or
+        None; clears the pending slot so a stale loss never leaks into a
+        later step's verdict."""
+        raw, self._pending_loss = self._pending_loss, None
+        if raw is not None:
+            # keep a handle for the EWMA read in after_step (the float
+            # conversion happens there, after the step program is in
+            # flight, so it adds no extra sync point)
+            self._last_loss = raw
+        return raw
+
+    def grads_finite(self, raw_grads, loss_raw=None):
+        """The MXNET_FUSED_TRAINER=0 oracle's verdict: one small watched
+        program over the gradient leaves (+ the loss scalar), identical
+        in truth value to the fused program's folded check."""
+        from . import health
+        leaves = list(raw_grads)
+        if loss_raw is not None:
+            leaves.append(loss_raw)
+        _tel.bump("xla_program_calls")     # the oracle's one extra program
+        return bool(np.asarray(health.verdict_program()(leaves)))
+
+    def after_step(self, finite):
+        """Book one step's verdict: counters, scale update, spike
+        detection, last-good pinning, and — on an exhausted skip
+        budget — the automatic rollback.  Returns True iff the step was
+        skipped (the caller must then NOT notify the step boundary)."""
+        with self._lock:
+            return self._after_step_locked(bool(finite))
+
+    def _after_step_locked(self, finite):
+        _tel.bump("guardian_checks")
+        loss_val = self._take_last_loss_float()
+        if not finite:
+            self._last_action = "skipped"
+            self._consec_skips += 1
+            _tel.bump("guardian_skipped_steps")
+            if self._dynamic:
+                new = max(self._scale / 2.0, _MIN_SCALE)
+                if new != self._scale:
+                    self._scale = new
+                    _tel.bump("guardian_scale_cuts")
+                self._clean_streak = 0
+            _flight.record("guardian", "skip",
+                           consecutive=self._consec_skips,
+                           loss_scale=self.loss_scale)
+            if self._consec_skips >= self._max_skips:
+                if self._rollback():
+                    self._last_action = "rollback"
+                    self._consec_skips = 0
+            self._set_gauges()
+            return True
+
+        self._last_action = "applied"
+        self._consec_skips = 0
+        spiked = self._note_loss(loss_val)
+        if self._dynamic:
+            self._clean_streak += 1
+            if self._clean_streak >= self._growth_interval:
+                new = min(self._scale * 2.0, _MAX_SCALE)
+                if new != self._scale:
+                    self._scale = new
+                    _tel.bump("guardian_scale_growths")
+                self._clean_streak = 0
+        if not spiked:
+            self._pin_last_good()
+        self._set_gauges()
+        return False
+
+    def _take_last_loss_float(self):
+        raw, self._last_loss = self._last_loss, None
+        if raw is None:
+            # a direct after_step() without a trainer path in between
+            # (tests, custom loops): consume the recorded loss here
+            raw, self._pending_loss = self._pending_loss, None
+        if raw is None:
+            return None
+        try:
+            # host-side numpy sum over the (tiny) per-sample loss vector:
+            # a transfer, not an XLA program
+            return float(np.asarray(raw).sum())
+        except Exception:
+            return None
+
+    def _note_loss(self, loss_val):
+        """EWMA spike detection on an APPLIED step's loss.  A spike
+        books a counter + flight event and blocks last-good pinning for
+        this step; it never suppresses the already-applied update."""
+        if loss_val is None or not math.isfinite(loss_val):
+            return False
+        if self._spike_factor <= 0:
+            self._fold_ewma(loss_val)
+            return False
+        baseline = self._ewma
+        if baseline is not None and self._warm >= _EWMA_WARMUP \
+                and abs(loss_val) > self._spike_factor \
+                * max(abs(baseline), 1e-12):
+            _tel.bump("guardian_loss_spikes")
+            _flight.record("guardian", "loss-spike", loss=loss_val,
+                           ewma=baseline, factor=self._spike_factor)
+            return True        # a spike does not feed the baseline
+        self._fold_ewma(loss_val)
+        return False
+
+    def _fold_ewma(self, loss_val):
+        self._ewma = loss_val if self._ewma is None \
+            else _EWMA_BETA * self._ewma + (1.0 - _EWMA_BETA) * loss_val
+        self._warm += 1
+
+    def _pin_last_good(self):
+        mgr = self._manager
+        if mgr is None:
+            return
+        last = mgr.last_committed_step
+        if last is not None and last != mgr.last_good_step:
+            mgr.pin_last_good(last)
+
+    # -- rollback ----------------------------------------------------------
+
+    def _rollback(self):
+        """Restore the last-good checkpoint and quarantine the batch
+        window.  Called with the skip budget exhausted, mid-step (the
+        boundary for the failing step will never fire, so the manager's
+        step counter lands exactly on the restored step)."""
+        mgr = self._manager
+        if mgr is None:
+            _flight.record("guardian", "budget-exhausted-no-manager",
+                           skips=self._consec_skips)
+            return False
+        target = mgr.last_good_step
+        if target is None:
+            # nothing was ever verified healthy: restoring the NEWEST
+            # checkpoint would load exactly the unverified state this
+            # rollback is fleeing — keep skipping instead
+            _flight.record("guardian", "rollback-no-last-good",
+                           skips=self._consec_skips)
+            return False
+        fail_step = mgr.step + 1          # the step being skipped now
+        restored = mgr.restore(step=target)
+        if restored is None:
+            _flight.record("guardian", "rollback-failed",
+                           pinned=mgr.last_good_step)
+            return False
+        # quarantine: every batch consumed since the restored step plus
+        # the failing window itself.  Over-skipping by up to the budget
+        # (when the loop retried one batch in place) only drops data;
+        # UNDER-skipping would replay the failure.
+        # evict the abandoned timeline: checkpoints newer than the
+        # restored step are unverified (possibly poisoned) state — left
+        # on disk, a preemption right after this rollback would resume
+        # from them newest-first and replay the failure
+        mgr.discard_newer_than(restored)
+        if mgr.last_good_step != restored:
+            # a corrupt pin fell back to an older checkpoint: re-anchor
+            # the pin on the state we actually (verifiably) loaded
+            mgr.pin_last_good(restored)
+        quarantined = max(0, fail_step - restored) + self._consec_skips
+        it = self._data_iter if self._data_iter is not None \
+            else getattr(mgr, "_data_iter", None)
+        skipped = 0
+        if it is not None and quarantined:
+            skip = getattr(it, "skip_batches", None)
+            if skip is not None:
+                skipped = skip(quarantined)
+        _tel.bump("guardian_rollbacks")
+        _flight.record("guardian", "rollback", from_step=fail_step,
+                       to_step=restored, quarantined=skipped)
+        self._last_rollback = (fail_step, restored, skipped)
+        self._ewma, self._warm = None, 0   # restored weights: re-warm
+        self._clean_streak = 0
+        return True
+
+    # -- introspection -----------------------------------------------------
+
+    def last_action(self):
+        """'applied' | 'skipped' | 'rollback' | None (before any step)."""
+        return self._last_action
+
+    def last_step_skipped(self):
+        """True when the most recent step's update was suppressed (the
+        retrying-loop contract: redo the same batch, don't fetch)."""
+        return self._last_action in ("skipped", "rollback")
+
+    def _set_gauges(self):
+        _tel.set_gauge("guardian_loss_scale", self.loss_scale)
+        _tel.set_gauge("guardian_consecutive_skips", self._consec_skips)
+        if self._ewma is not None:
+            _tel.set_gauge("guardian_loss_ewma", self._ewma)
+
+    def describe(self):
+        """JSON-shaped view for the ``/guardian`` endpoint."""
+        mgr = self._manager
+        return {
+            "loss_scale": self.loss_scale,
+            "dynamic": self._dynamic and self._scaling,
+            "scaling": self._scaling,
+            "growth_interval": self._growth_interval,
+            "max_skips": self._max_skips,
+            "spike_factor": self._spike_factor,
+            "consecutive_skips": self._consec_skips,
+            "clean_streak": self._clean_streak,
+            "loss_ewma": self._ewma,
+            "last_action": self._last_action,
+            "last_rollback": self._last_rollback,
+            "last_good_step": None if mgr is None else mgr.last_good_step,
+            "has_manager": mgr is not None,
+            "counters": {name: _tel.counter(name) for name in
+                         ("guardian_checks", "guardian_skipped_steps",
+                          "guardian_loss_spikes", "guardian_rollbacks",
+                          "guardian_scale_cuts",
+                          "guardian_scale_growths")},
+        }
+
+
+# ---------------------------------------------------------------------------
+# process-wide installation (the hot-path gate is one global read)
+# ---------------------------------------------------------------------------
+
+_CURRENT = None
+_ENV_INSTALLED = None    # the instance refresh_from_env auto-installed
+
+
+def current():
+    """The installed guardian, or None — the Trainer hot paths' one and
+    only check."""
+    return _CURRENT
+
+
+def install(guardian):
+    """Make *guardian* the process guardian (latest wins)."""
+    global _CURRENT
+    _CURRENT = guardian
+    return guardian
+
+
+def uninstall(guardian):
+    """Remove *guardian* if it is still the installed one."""
+    global _CURRENT
+    if _CURRENT is guardian:
+        _CURRENT = None
+
+
+def enabled():
+    """Whether MXNET_GUARDIAN asked for an auto-installed guardian."""
+    return _env_truthy("MXNET_GUARDIAN")
+
+
+def refresh_from_env():
+    """Re-read MXNET_GUARDIAN* (import-time default; tests/late config):
+    installs a default guardian when enabled and none is installed,
+    removes an auto-installed default when disabled (a programmatically
+    constructed guardian is never touched)."""
+    global _ENV_INSTALLED
+    if enabled():
+        if _CURRENT is None:
+            _ENV_INSTALLED = TrainingGuardian()   # constructor installs
+    elif _ENV_INSTALLED is not None:
+        uninstall(_ENV_INSTALLED)
+        _ENV_INSTALLED = None
+    return _CURRENT
+
+
+refresh_from_env()

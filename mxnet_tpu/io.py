@@ -145,6 +145,26 @@ class DataIter:
     def set_checkpoint_state(self, state):
         pass
 
+    def skip_batches(self, n):
+        """Advance the stream *n* batches (wrapping epochs like a
+        training loop would) WITHOUT returning them — the guardian's
+        quarantine primitive: after a rollback rewinds the cursor, the
+        batch window that poisoned the run is skipped instead of
+        replayed.  Returns the number of batches actually skipped (an
+        exhausted, non-resetting stream stops early)."""
+        skipped = 0
+        for _ in range(int(n)):
+            try:
+                self.next()
+            except StopIteration:
+                self.reset()
+                try:
+                    self.next()
+                except StopIteration:
+                    break
+            skipped += 1
+        return skipped
+
     def next(self):
         return _timed_batch(self._produce_next)
 

@@ -534,6 +534,8 @@ ENTRY_POINTS = (
     ("gluon_cached_op", "mxnet_tpu.gluon.block"),
     ("predict", "mxnet_tpu.predict"),
     ("serving", "mxnet_tpu.serving.program"),
+    ("guardian", "mxnet_tpu.guardian"),
+    ("gluon_utils", "mxnet_tpu.gluon.utils"),
 )
 
 

@@ -15,6 +15,7 @@ import numpy as _np
 
 from .base import Registry
 from . import ndarray as nd
+from . import telemetry as _tel
 from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
@@ -35,6 +36,18 @@ def check_label_shapes(labels, preds, shape=False):
 
 def _numpy(x):
     return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+
+
+def _finite_contribution(value):
+    """Gate one accumulator contribution: a NaN/Inf value would poison
+    the running sum FOREVER (every later ``get()`` reports NaN, long
+    after the sick batch scrolled off the log).  Nonfinite updates are
+    excluded and booked as ``metric_nonfinite_updates`` so the exclusion
+    is visible instead of silent."""
+    if math.isfinite(value):
+        return True
+    _tel.bump("metric_nonfinite_updates")
+    return False
 
 
 def _column(arr):
@@ -102,6 +115,8 @@ class _PairAccumulator(EvalMetric):
             check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
             value, count = self.measure(_numpy(label), _numpy(pred))
+            if not _finite_contribution(float(value)):
+                continue
             self.sum_metric += value
             self.num_inst += count
 
@@ -266,8 +281,10 @@ class Perplexity(EvalMetric):
                 masked = lab == self.ignore_label
                 count -= int(masked.sum())
                 target_p = _np.where(masked, 1.0, target_p)
-            self.sum_metric -= float(
-                _np.log(_np.maximum(target_p, 1e-10)).sum())
+            value = -float(_np.log(_np.maximum(target_p, 1e-10)).sum())
+            if not _finite_contribution(value):
+                continue
+            self.sum_metric += value
             self.num_inst += count
 
     def get(self):
@@ -347,7 +364,10 @@ class Loss(EvalMetric):
 
     def update(self, _, preds):
         for pred in preds:
-            self.sum_metric += float(_numpy(pred).sum())
+            value = float(_numpy(pred).sum())
+            if not _finite_contribution(value):
+                continue
+            self.sum_metric += value
             self.num_inst += pred.size
 
 

@@ -165,6 +165,27 @@ class Optimizer:
         self._index_update_count[index] = count + 1
         self.num_update = max(count + 1, self.num_update)
 
+    def _snapshot_update_counts(self, indices):
+        """Pre-step snapshot of the per-slot update counts for *indices*
+        plus ``num_update`` — the undo token the guardian needs when a
+        step's update is suppressed in-program (a skipped step must not
+        advance ``hyper['t']`` or Adam bias correction drifts from the
+        clean trajectory)."""
+        return ({i: self._index_update_count.get(i) for i in indices},
+                self.num_update)
+
+    def _revert_update_counts(self, snapshot):
+        """Restore a :meth:`_snapshot_update_counts` token after a
+        skipped step (slots first seen on the skipped step are removed
+        entirely, exactly undoing ``_update_count``'s setdefault)."""
+        counts, num_update = snapshot
+        for index, prev in counts.items():
+            if prev is None:
+                self._index_update_count.pop(index, None)
+            else:
+                self._index_update_count[index] = prev
+        self.num_update = num_update
+
     def _resolve_mult(self, index, table):
         if index in self.param_dict:
             p = self.param_dict[index]

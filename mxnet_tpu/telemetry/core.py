@@ -370,6 +370,20 @@ COUNTERS = {
     "ps_reconnects": "dist server connections re-established after a "
                      "failure or refresh_servers recovery",
     "ps_heartbeats": "heartbeat frames sent to the dist scheduler",
+    "guardian_checks": "trainer steps whose finite-health verdict the "
+                       "guardian evaluated",
+    "guardian_skipped_steps": "optimizer updates suppressed in-program "
+                              "by a nonfinite gradient/loss verdict",
+    "guardian_loss_spikes": "applied steps whose loss exceeded the EWMA "
+                            "spike factor (blocks last-good pinning)",
+    "guardian_rollbacks": "automatic restores to the last-good pinned "
+                          "checkpoint after an exhausted skip budget",
+    "guardian_scale_cuts": "dynamic loss-scale halvings on overflow",
+    "guardian_scale_growths": "dynamic loss-scale doublings after a "
+                              "clean growth interval",
+    "metric_nonfinite_updates": "EvalMetric updates excluded from "
+                                "running sums because their "
+                                "contribution was NaN/Inf",
 }
 
 GAUGES = {
@@ -406,6 +420,16 @@ GAUGES = {
     "ps_dead_peers": "peers the dist scheduler currently considers dead "
                      "(live on the scheduler; a worker's cached view "
                      "elsewhere)",
+    "guardian_loss_scale": "current guardian loss scale (1.0 when "
+                           "scaling is off)",
+    "guardian_consecutive_skips": "steps skipped in a row by the "
+                                  "guardian (rollback fires at "
+                                  "MXNET_GUARDIAN_MAX_SKIPS)",
+    "guardian_loss_ewma": "the guardian's EWMA loss baseline for spike "
+                          "detection",
+    "checkpoint_pinned_step": "the last-good checkpoint step pinned "
+                              "against retention (guardian rollback "
+                              "target)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)

@@ -110,7 +110,7 @@ def health():
 
 _INDEX = ("mxnet_tpu introspection\n"
           "endpoints: /metrics /healthz /snapshot /trace /flight /stacks "
-          "/checkpoints /peers\n"
+          "/checkpoints /peers /guardian\n"
           "serving:   /v1/models  /v1/models/<name>[/predict|/load|"
           "/unload|/reload]\n")
 
@@ -185,6 +185,19 @@ class _Handler(BaseHTTPRequestHandler):
                                   "(construct a CheckpointManager)"}, 404)
                 else:
                     self._reply_json(ckpt.http_view())
+            elif path == "/guardian":
+                # observe-only sys.modules lookup, like /checkpoints:
+                # `import mxnet_tpu` pulls gluon (hence guardian) in, so
+                # in practice this answers the inactive stub until a
+                # TrainingGuardian is installed; the 404 arm only covers
+                # a standalone-telemetry embedding.
+                guard = sys.modules.get("mxnet_tpu.guardian")
+                if guard is None:
+                    self._reply_json(
+                        {"error": "guardian subsystem not initialized "
+                                  "(construct a TrainingGuardian)"}, 404)
+                else:
+                    self._reply_json(guard.http_view())
             elif path == "/peers":
                 # observe-only sys.modules lookup, like /checkpoints: a
                 # process that never touched the dist transport answers

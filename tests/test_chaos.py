@@ -508,3 +508,39 @@ def test_chaos_smoke_tier1():
     summary = json.loads(out.stdout.strip().splitlines()[-1])
     assert summary["ok"], summary
     assert summary["injected_faults"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the grad-seam `nan` fault kind (ISSUE 10: drives the guardian)
+# ---------------------------------------------------------------------------
+
+def test_nan_fault_grammar_and_grad_seam():
+    seed, rules = chaos.parse_spec("seed=3;grad.bucket:nan@2-4")
+    assert rules[0].site == "grad.bucket"
+    assert rules[0].faults[0].kind == "nan"
+    # deterministic poison: occurrence 2 replaces the FIRST bucket with
+    # NaNs, occurrence 1 passes everything through untouched
+    import jax.numpy as jnp
+    chaos.configure("grad.bucket:nan@2")
+    g0 = jnp.ones((4,), jnp.float32)
+    g1 = jnp.ones((2, 2), jnp.float32)
+    out = chaos.poison_grads([g0, g1])
+    assert out[0] is g0 and out[1] is g1           # occurrence 1: clean
+    out = chaos.poison_grads([g0, g1])
+    assert np.isnan(np.asarray(out[0])).all()      # occurrence 2: poisoned
+    assert out[0].shape == g0.shape and out[1] is g1
+    assert [e[2] for e in chaos.fault_log()] == ["nan"]
+
+
+def test_nan_fault_log_is_deterministic():
+    spec = "seed=5;grad.bucket:nan~0.5"
+    import jax.numpy as jnp
+    g = [jnp.ones((2,), jnp.float32)]
+
+    def run():
+        chaos.configure(spec)
+        for _ in range(16):
+            chaos.poison_grads(g)
+        return chaos.fault_log()
+
+    assert run() == run()

@@ -656,3 +656,134 @@ def test_sigterm_grace_window_never_hangs(tmp_path):
     assert dumps
     dump = json.loads(open(dumps[0]).read())
     assert dump["reason"] == "preempt:grace-expired"
+
+
+# ---------------------------------------------------------------------------
+# last-good pinning + targeted restore (ISSUE 10, guardian rollback)
+# ---------------------------------------------------------------------------
+
+def test_targeted_restore_past_newer_checkpoints(tmp_path):
+    """restore(step=) loads the TARGET even when newer checkpoints
+    exist, and the continuation is bitwise-identical to the original
+    run from that step."""
+    d = str(tmp_path)
+    net, tr, it = _build()
+    _run_steps(net, tr, it, 2)
+    mgr = checkpoint.CheckpointManager(d, trainer=tr, data_iter=it,
+                                       num_shards=2, keep=5)
+    assert mgr.save(2, sync=True)
+    later = _run_steps(net, tr, it, 2)       # steps 3-4 of the original
+    assert mgr.save(4, sync=True)
+    mgr.close()
+
+    net2, tr2, it2 = _build()
+    mgr2 = checkpoint.CheckpointManager(d, trainer=tr2, data_iter=it2,
+                                        num_shards=2)
+    assert mgr2.restore(step=2) == 2
+    assert mgr2.step == 2
+    rest = _run_steps(net2, tr2, it2, 2)
+    mgr2.close()
+    assert rest == later
+
+
+def test_pin_survives_retention_and_restart(tmp_path):
+    """The last_good pin protects its checkpoint from the MXNET_CKPT_KEEP
+    sweep and survives a process restart via the marker file."""
+    net, tr, it = _build()
+    mgr = checkpoint.CheckpointManager(str(tmp_path), trainer=tr,
+                                       data_iter=it, num_shards=1, keep=2)
+    for step in (1, 2, 3, 4, 5):
+        _run_steps(net, tr, it, 1)
+        assert mgr.save(step, sync=True)
+        if step == 1:
+            assert mgr.pin_last_good() == 1      # defaults to newest
+    assert mgr.last_good_step == 1
+    assert mgr.describe()["last_good_step"] == 1
+    mgr.close()
+    steps = sorted(int(os.path.basename(p).split("-")[1])
+                   for p in glob.glob(str(tmp_path / "ckpt-*")))
+    assert steps == [1, 4, 5]                    # pinned + newest keep=2
+
+    net2, tr2, it2 = _build()
+    mgr2 = checkpoint.CheckpointManager(str(tmp_path), trainer=tr2,
+                                        data_iter=it2, num_shards=1)
+    assert mgr2.last_good_step == 1              # marker file reloaded
+    mgr2.close()
+
+
+def test_corrupt_pinned_falls_back_nonfatally(tmp_path):
+    """A corrupt pinned checkpoint must not crash the rollback: the
+    targeted restore falls back to the remaining checkpoints."""
+    d = str(tmp_path)
+    net, tr, it = _build()
+    _run_steps(net, tr, it, 2)
+    mgr = checkpoint.CheckpointManager(d, trainer=tr, data_iter=it,
+                                       num_shards=1, keep=5)
+    assert mgr.save(2, sync=True)
+    mgr.pin_last_good(2)
+    _run_steps(net, tr, it, 2)
+    assert mgr.save(4, sync=True)
+    mgr.close()
+
+    (params,) = glob.glob(os.path.join(d, "ckpt-*2", "params.pkl"))
+    blob = bytearray(open(params, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(params, "wb").write(bytes(blob))
+
+    before = telemetry.counter("checkpoint_restore_fallbacks")
+    net2, tr2, it2 = _build()
+    mgr2 = checkpoint.CheckpointManager(d, trainer=tr2, data_iter=it2,
+                                        num_shards=1)
+    assert mgr2.restore(step=2) == 4             # fell back, non-fatal
+    mgr2.close()
+    assert telemetry.counter("checkpoint_restore_fallbacks") > before
+
+
+def test_restore_step_prefers_older_fallback_over_newer(tmp_path):
+    """With the target corrupt, the fallback order is older-first (the
+    newer checkpoints are exactly the unverified ones a rollback is
+    fleeing) — newer only as the last resort."""
+    d = str(tmp_path)
+    net, tr, it = _build()
+    _run_steps(net, tr, it, 1)
+    mgr = checkpoint.CheckpointManager(d, trainer=tr, data_iter=it,
+                                       num_shards=1, keep=5)
+    assert mgr.save(1, sync=True)
+    _run_steps(net, tr, it, 1)
+    assert mgr.save(2, sync=True)
+    _run_steps(net, tr, it, 1)
+    assert mgr.save(3, sync=True)
+    mgr.close()
+    (params,) = glob.glob(os.path.join(d, "ckpt-*2", "params.pkl"))
+    os.remove(params)
+
+    net2, tr2, it2 = _build()
+    mgr2 = checkpoint.CheckpointManager(d, trainer=tr2, data_iter=it2,
+                                        num_shards=1)
+    assert mgr2.restore(step=2) == 1             # older beats newer
+    mgr2.close()
+
+
+def test_restore_step_newer_last_resort_is_oldest_first(tmp_path):
+    """No older checkpoint survives and the target is corrupt: the
+    newer-group fallback takes the OLDEST newer checkpoint (closest to
+    the last verified state), not the newest."""
+    d = str(tmp_path)
+    net, tr, it = _build()
+    _run_steps(net, tr, it, 1)
+    mgr = checkpoint.CheckpointManager(d, trainer=tr, data_iter=it,
+                                       num_shards=1, keep=5)
+    assert mgr.save(1, sync=True)
+    _run_steps(net, tr, it, 1)
+    assert mgr.save(2, sync=True)
+    _run_steps(net, tr, it, 1)
+    assert mgr.save(3, sync=True)
+    mgr.close()
+    (params,) = glob.glob(os.path.join(d, "ckpt-*1", "params.pkl"))
+    os.remove(params)
+
+    net2, tr2, it2 = _build()
+    mgr2 = checkpoint.CheckpointManager(d, trainer=tr2, data_iter=it2,
+                                        num_shards=1)
+    assert mgr2.restore(step=1) == 2             # oldest of {2, 3}
+    mgr2.close()

@@ -1,0 +1,519 @@
+"""Training guardian (ISSUE 10): in-program NaN/Inf detection, dynamic
+loss scaling, auto-rollback to the last-good checkpoint.
+
+Acceptance contract: a chaos-injected NaN gradient at step k causes
+exactly one ``guardian_skipped_steps`` bump and (with the retrying-loop
+pattern) a final loss trajectory bitwise-identical to the clean run; a
+persistent-NaN run exhausts the skip budget, rolls back to the pinned
+last-good checkpoint, quarantines the batch window, and converges —
+while ``xla_program_calls`` per step and graftcheck findings (zero,
+tests/test_tracecheck_clean.py) are unchanged.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, chaos, checkpoint, gluon, guardian, \
+    profiler, telemetry
+from mxnet_tpu.gluon import fused_trainer, nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaks():
+    """Every test leaves the process guardian- and chaos-free."""
+    yield
+    g = guardian.current()
+    if g is not None:
+        guardian.uninstall(g)
+    chaos.configure(None)
+    from mxnet_tpu.checkpoint import hooks
+    m = hooks.active()
+    if m is not None:
+        hooks.unregister(m)
+
+
+def _set_fused(value):
+    if value is None:
+        os.environ.pop("MXNET_FUSED_TRAINER", None)
+    else:
+        os.environ["MXNET_FUSED_TRAINER"] = value
+    fused_trainer.refresh_from_env()
+
+
+def _build(seed=0, optimizer="adam"):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), optimizer,
+                            {"learning_rate": 0.05})
+    return net, trainer
+
+
+_RS = np.random.RandomState(1)
+_X = _RS.randn(8, 8, 6).astype(np.float32)
+_Y = _RS.randn(8, 8, 4).astype(np.float32)
+
+
+def _run(steps=6, guard=None, poison=None, retry=False, fused=True,
+         seed=0, optimizer_name="adam"):
+    """Seeded mini-run; returns (losses, params, actions)."""
+    prev = os.environ.get("MXNET_FUSED_TRAINER")
+    _set_fused("1" if fused else "0")
+    try:
+        chaos.configure(poison)
+        net, trainer = _build(seed, optimizer_name)
+        loss_fn = gluon.loss.L2Loss()
+        losses, actions = [], []
+        for i in range(steps):
+            while True:
+                with autograd.record():
+                    loss = loss_fn(net(mx.nd.array(_X[i])),
+                                   mx.nd.array(_Y[i]))
+                    scaled = guard.scale_loss(loss) if guard else loss
+                scaled.backward()
+                trainer.step(8)
+                if guard is not None:
+                    actions.append(guard.last_action())
+                    if retry and guard.last_action() == "skipped":
+                        continue
+                break
+            losses.append(float(np.float64(loss.asnumpy().sum())))
+        params = {i: p.data().asnumpy()
+                  for i, p in enumerate(net.collect_params().values())}
+        return losses, params, actions
+    finally:
+        chaos.configure(None)
+        _set_fused(prev)
+
+
+def _assert_bitwise(a, b, what):
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k],
+                                      err_msg="%s[%s]" % (what, k))
+
+
+# ---------------------------------------------------------------------------
+# detection + in-program skip
+# ---------------------------------------------------------------------------
+
+def test_guarded_clean_run_is_bitwise_transparent():
+    """Guardian on (even with dynamic scaling: power-of-two scales are
+    exact) must not perturb a healthy run by one ulp."""
+    ref_l, ref_p, _ = _run()
+    g = guardian.TrainingGuardian(loss_scale="dynamic")
+    try:
+        got_l, got_p, actions = _run(guard=g)
+    finally:
+        g.close()
+    assert got_l == ref_l
+    _assert_bitwise(got_p, ref_p, "param")
+    assert actions == ["applied"] * 6
+
+
+def test_nan_gradient_skips_exactly_one_step():
+    before = telemetry.counter("guardian_skipped_steps")
+    g = guardian.TrainingGuardian()
+    try:
+        ref_l, ref_p, _ = _run()
+        got_l, got_p, actions = _run(guard=g,
+                                     poison="grad.bucket:nan@3")
+    finally:
+        g.close()
+    assert telemetry.counter("guardian_skipped_steps") == before + 1
+    assert actions.count("skipped") == 1 and "rollback" not in actions
+    # the skipped step left params at their pre-step values: losses
+    # before and AT the poisoned step match the clean run, later ones
+    # diverge by exactly one missing update (no NaN anywhere)
+    assert got_l[:3] == ref_l[:3]
+    assert got_l[3:] != ref_l[3:]
+    assert all(np.isfinite(v).all() for v in got_p.values())
+
+
+def test_retrying_loop_recovers_bitwise():
+    """The acceptance identity: skip the poisoned step, retry the same
+    batch (the next chaos occurrence is clean), finish bitwise-identical
+    to the unpoisoned run — on the fused path AND the
+    MXNET_FUSED_TRAINER=0 oracle."""
+    ref_l, ref_p, _ = _run()
+    for fused in (True, False):
+        g = guardian.TrainingGuardian()
+        try:
+            got_l, got_p, actions = _run(guard=g, retry=True, fused=fused,
+                                         poison="grad.bucket:nan@3")
+        finally:
+            g.close()
+        assert actions.count("skipped") == 1, (fused, actions)
+        assert got_l == ref_l, "fused=%s diverged" % fused
+        _assert_bitwise(got_p, ref_p, "param[fused=%s]" % fused)
+
+
+def test_skip_does_not_advance_update_counts():
+    """hyper['t'] (Adam bias correction) must not tick on a skipped
+    step, or the retried update diverges from the clean trajectory."""
+    g = guardian.TrainingGuardian()
+    try:
+        chaos.configure("grad.bucket:nan@2")
+        net, trainer = _build()
+        loss_fn = gluon.loss.L2Loss()
+        for i in range(2):
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(_X[i])),
+                               mx.nd.array(_Y[i]))
+            g.observe_loss(loss)
+            loss.backward()
+            trainer.step(8)
+        assert g.last_step_skipped()
+        counts = set(trainer._optimizer._index_update_count.values())
+        assert counts == {1}, counts       # one applied step only
+        assert trainer._optimizer.num_update == 1
+    finally:
+        g.close()
+
+
+def test_nonfinite_loss_triggers_skip():
+    """The verdict folds the RECORDED loss in: a NaN loss with finite
+    gradients still suppresses the update."""
+    g = guardian.TrainingGuardian()
+    try:
+        net, trainer = _build()
+        loss_fn = gluon.loss.L2Loss()
+        with autograd.record():
+            loss = loss_fn(net(mx.nd.array(_X[0])), mx.nd.array(_Y[0]))
+        g.observe_loss(loss * float("nan"))
+        loss.backward()
+        before = {i: p.data().asnumpy().copy()
+                  for i, p in enumerate(net.collect_params().values())}
+        trainer.step(8)
+        assert g.last_step_skipped()
+        for i, p in enumerate(net.collect_params().values()):
+            np.testing.assert_array_equal(p.data().asnumpy(), before[i])
+    finally:
+        g.close()
+
+
+def test_verdict_costs_no_extra_program_on_fused_path():
+    """The guard rides INSIDE the existing donated program: steady-state
+    xla_program_calls per step are identical with and without it."""
+    def steady_calls(guard):
+        net, trainer = _build()
+        loss_fn = gluon.loss.L2Loss()
+        for i in range(3):
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(_X[i])),
+                               mx.nd.array(_Y[i]))
+            if guard:
+                guard.observe_loss(loss)
+            loss.backward()
+            before = profiler.counter("xla_program_calls")
+            trainer.step(8)
+            delta = profiler.counter("xla_program_calls") - before
+        return delta
+    plain = steady_calls(None)
+    g = guardian.TrainingGuardian()
+    try:
+        guarded = steady_calls(g)
+    finally:
+        g.close()
+    assert guarded == plain == 1
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling
+# ---------------------------------------------------------------------------
+
+def test_dynamic_scale_halves_on_overflow_and_grows_when_clean():
+    g = guardian.TrainingGuardian(loss_scale="dynamic", growth_interval=2)
+    try:
+        assert g.loss_scale == 2.0 ** 16
+        scales = []
+        _run(steps=2, guard=g, poison="grad.bucket:nan@1")
+        scales.append(g.loss_scale)        # halved once on the overflow
+        _run(steps=4, guard=g)
+        scales.append(g.loss_scale)        # grew back on clean streaks
+        assert scales[0] == 2.0 ** 15
+        assert scales[1] > scales[0]
+    finally:
+        g.close()
+
+
+def test_static_scale_is_bitwise_transparent():
+    ref_l, ref_p, _ = _run()
+    g = guardian.TrainingGuardian(loss_scale=8.0)
+    try:
+        got_l, got_p, _ = _run(guard=g)
+    finally:
+        g.close()
+    assert got_l == ref_l
+    _assert_bitwise(got_p, ref_p, "param")
+
+
+def test_env_loss_scale_spec(monkeypatch):
+    monkeypatch.setenv("MXNET_GUARDIAN_LOSS_SCALE", "dynamic")
+    g = guardian.TrainingGuardian()
+    assert g._dynamic and g.loss_scale == 2.0 ** 16
+    g.close()
+    monkeypatch.setenv("MXNET_GUARDIAN_LOSS_SCALE", "128")
+    g = guardian.TrainingGuardian()
+    assert not g._dynamic and g.loss_scale == 128.0
+    g.close()
+    monkeypatch.setenv("MXNET_GUARDIAN_LOSS_SCALE", "0")
+    g = guardian.TrainingGuardian()
+    assert g.loss_scale == 1.0
+    g.close()
+
+
+# ---------------------------------------------------------------------------
+# EWMA spike detector
+# ---------------------------------------------------------------------------
+
+def test_loss_spike_books_counter_and_blocks_pinning():
+    g = guardian.TrainingGuardian(spike_factor=5.0)
+    try:
+        for _ in range(12):                   # warm the EWMA past warmup
+            g.observe_loss(mx.nd.array(np.float32([1.0])))
+            g.after_step(True)
+        before = telemetry.counter("guardian_loss_spikes")
+        g.observe_loss(mx.nd.array(np.float32([100.0])))
+        assert g.after_step(True) is False    # applied, not skipped
+        assert telemetry.counter("guardian_loss_spikes") == before + 1
+        # the spike did not poison the baseline
+        assert g._ewma == pytest.approx(1.0)
+    finally:
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# rollback to last-good
+# ---------------------------------------------------------------------------
+
+def _iter_build(seed=7):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    rs = np.random.RandomState(3)
+    data = mx.nd.array(rs.randn(64, 6).astype(np.float32))
+    label = mx.nd.array(rs.randn(64, 4).astype(np.float32))
+    it = mx.io.NDArrayIter(data, label, batch_size=8, shuffle=True,
+                           last_batch_handle="discard")
+    return net, trainer, it
+
+
+def test_exhausted_skip_budget_rolls_back_and_recovers(tmp_path):
+    chaos.configure("grad.bucket:nan@5-6")
+    net, trainer, it = _iter_build()
+    mgr = checkpoint.CheckpointManager(str(tmp_path), trainer=trainer,
+                                       data_iter=it, every_steps=2,
+                                       num_shards=2)
+    g = guardian.TrainingGuardian(manager=mgr, max_skips=2)
+    loss_fn = gluon.loss.L2Loss()
+    actions, losses = [], []
+    try:
+        for _ in range(10):
+            try:
+                batch = it.next()
+            except StopIteration:
+                it.reset()
+                batch = it.next()
+            with autograd.record():
+                loss = loss_fn(net(batch.data[0]), batch.label[0])
+                scaled = g.scale_loss(loss)
+            scaled.backward()
+            trainer.step(8)
+            actions.append(g.last_action())
+            mgr.wait()                     # commits land promptly
+            losses.append(float(np.float64(loss.asnumpy().sum())))
+    finally:
+        g.close()
+        mgr.close()
+    assert actions[4] == "skipped" and actions[5] == "rollback", actions
+    assert actions[6:] == ["applied"] * 4, actions
+    # the abandoned timeline was evicted: a restart's newest-first
+    # restore() can never resume the rolled-away (unverified) state —
+    # everything on disk is now <= the run's re-advanced frontier, and
+    # the rollback target itself survived the eviction
+    import glob as _glob
+    steps_on_disk = sorted(
+        int(os.path.basename(p).split("-")[1])
+        for p in _glob.glob(str(tmp_path / "ckpt-*")))
+    assert g._last_rollback[1] in steps_on_disk
+    assert max(steps_on_disk) <= mgr.step
+    # rolled back TO the pinned checkpoint, quarantined the window
+    assert g._last_rollback is not None
+    _, to_step, quarantined = g._last_rollback
+    assert to_step == 2              # the pin at rollback time
+    assert mgr.last_good_step >= to_step   # pin re-advanced post-recovery
+    assert quarantined > 0
+    assert all(np.isfinite(v) for v in losses)
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all()
+
+
+def test_budget_without_manager_keeps_skipping_nonfatally():
+    g = guardian.TrainingGuardian(max_skips=1)
+    try:
+        _, _, actions = _run(steps=4, guard=g,
+                             poison="grad.bucket:nan@2-3")
+        # no manager: rollback degrades to continued skips, run survives
+        assert actions.count("skipped") == 2
+        assert "rollback" not in actions
+        assert actions[-1] == "applied"
+    finally:
+        g.close()
+
+
+def test_rng_optimizer_retry_stays_bitwise():
+    """A skipped step must not consume from the PRNG key stream: SGLD's
+    retried batch has to draw the same noise the clean run drew."""
+    ref_l, ref_p, _ = _run(optimizer_name="sgld")
+    g = guardian.TrainingGuardian()
+    try:
+        got_l, got_p, actions = _run(guard=g, retry=True,
+                                     optimizer_name="sgld",
+                                     poison="grad.bucket:nan@3")
+    finally:
+        g.close()
+    assert actions.count("skipped") == 1
+    assert got_l == ref_l
+    _assert_bitwise(got_p, ref_p, "param")
+
+
+# ---------------------------------------------------------------------------
+# clip_global_norm (the rebuilt satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_global_norm_f16_does_not_saturate():
+    """The norm reduction accumulates in f32: an f16 vdot saturates at
+    65504 and would report inf for finite half-precision gradients —
+    which the clipper would then 'fix' by zeroing them."""
+    import jax.numpy as jnp
+    from mxnet_tpu.guardian import health
+    leaf = jnp.full((70000,), 1.0, jnp.float16)     # true norm ~264.6
+    norm = float(np.asarray(health.global_norm([leaf])))
+    assert np.isfinite(norm)
+    assert norm == pytest.approx(np.sqrt(70000.0), rel=1e-3)
+
+def test_clip_global_norm_single_program_and_nan_safe():
+    from mxnet_tpu.gluon.utils import clip_global_norm
+    arrs = [mx.nd.ones((2, 2)) * 10 for _ in range(2)]
+    before = profiler.counter("xla_program_calls")
+    norm = clip_global_norm(arrs, 1.0)
+    assert profiler.counter("xla_program_calls") - before == 1
+    assert norm == pytest.approx(np.sqrt(800.0), rel=1e-5)
+    total = sum((a.asnumpy() ** 2).sum() for a in arrs)
+    np.testing.assert_allclose(np.sqrt(total), 1.0, rtol=1e-4)
+    # nonfinite gradients: arrays untouched, norm reports the sickness
+    bad = [mx.nd.array(np.float32([np.nan, 1.0])), mx.nd.ones((2,))]
+    norm = clip_global_norm(bad, 1.0)
+    assert not np.isfinite(norm)
+    assert np.isnan(bad[0].asnumpy()[0])
+    np.testing.assert_array_equal(bad[1].asnumpy(), np.ones(2))
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+def test_guardian_endpoint_and_http_view():
+    import urllib.request
+    from mxnet_tpu.telemetry import server as tserver
+    view = guardian.http_view()
+    assert view["active"] is False
+    g = guardian.TrainingGuardian(loss_scale="dynamic")
+    srv = tserver.IntrospectionServer(0).start()
+    try:
+        url = "http://127.0.0.1:%d/guardian" % srv.port
+        payload = json.loads(urllib.request.urlopen(url).read())
+        assert payload["active"] is True
+        assert payload["loss_scale"] == 2.0 ** 16
+        assert payload["max_skips"] >= 1
+        assert "guardian_skipped_steps" in payload["counters"]
+    finally:
+        srv.stop()
+        g.close()
+
+
+def test_env_auto_install(monkeypatch):
+    monkeypatch.setenv("MXNET_GUARDIAN", "1")
+    assert guardian.refresh_from_env() is not None
+    g = guardian.current()
+    assert g is not None
+    # disabling the env removes the auto-installed default...
+    monkeypatch.setenv("MXNET_GUARDIAN", "0")
+    guardian.refresh_from_env()
+    assert guardian.current() is None
+    # ...but never a programmatically constructed guardian
+    mine = guardian.TrainingGuardian()
+    guardian.refresh_from_env()
+    assert guardian.current() is mine
+    mine.close()
+    assert guardian.current() is None
+
+
+def test_rollback_without_pin_keeps_skipping(tmp_path):
+    """No checkpoint was ever verified healthy: the rollback must NOT
+    grab the newest (unverified) checkpoint — the run keeps skipping
+    non-fatally."""
+    chaos.configure("grad.bucket:nan@2-4")
+    net, trainer, it = _iter_build()
+    mgr = checkpoint.CheckpointManager(str(tmp_path), trainer=trainer,
+                                       data_iter=it, num_shards=1)
+    g = guardian.TrainingGuardian(manager=mgr, max_skips=1,
+                                  spike_factor=0.0)
+    loss_fn = gluon.loss.L2Loss()
+    before = telemetry.counter("guardian_rollbacks")
+    try:
+        for i in range(5):              # step 1 clean, steps 2-4 poisoned
+            batch = it.next()
+            with autograd.record():
+                loss = loss_fn(net(batch.data[0]), batch.label[0])
+            loss.backward()
+            trainer.step(8)
+            if i == 0:
+                # a committed but NEVER-pinned checkpoint (params are
+                # materialized now); spike_factor=0 means pinning is off
+                # too, so last_good stays None
+                mgr.save(1, sync=True)
+                mgr._pinned_step = None   # guard against pin leakage
+    finally:
+        g.close()
+        mgr.close()
+    assert telemetry.counter("guardian_rollbacks") == before
+    assert g._last_rollback is None
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 smoke (fast variant of tools/guardian_smoke.py)
+# ---------------------------------------------------------------------------
+
+def test_guardian_smoke_tier1():
+    """Subprocess acceptance: transient NaN absorbed bitwise with exactly
+    one skip; persistent NaN rolls back to last-good and recovers within
+    the budget; per-step program calls unchanged."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "guardian_smoke.py"),
+         "--steps", "8", "--window", "5-6", "--timeout", "150", "--json"],
+        capture_output=True, text=True, timeout=500)
+    assert out.returncode == 0, \
+        "guardian_smoke failed:\n%s\n%s" % (out.stdout, out.stderr)
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["ok"], summary
+    assert summary["skipped"] == 1
+    assert summary["rollbacks"] >= 1
+    assert summary["calls_last_step"] == 1

@@ -102,3 +102,39 @@ def test_pearson():
     m.update([label], [pred])
     _, v = m.get()
     assert v > 0.99
+
+
+def test_nonfinite_updates_are_excluded_and_counted():
+    """A NaN contribution must not poison the running sum forever
+    (ISSUE 10 satellite): it is excluded and booked as
+    ``metric_nonfinite_updates``."""
+    from mxnet_tpu import telemetry
+    before = telemetry.counter("metric_nonfinite_updates")
+    m = mx.metric.MAE()
+    good = nd.array(np.array([[1.0], [2.0]]))
+    m.update([good], [good])                       # contributes 0.0
+    bad = nd.array(np.array([[np.nan], [2.0]]))
+    m.update([good], [bad])                        # NaN: excluded
+    m.update([good], [good])
+    name, value = m.get()
+    assert value == 0.0 and m.num_inst == 2        # only the finite pair
+    assert telemetry.counter("metric_nonfinite_updates") == before + 1
+
+    # Loss-style raw accumulators are gated too
+    loss = mx.metric.Loss()
+    loss.update(None, [nd.array(np.array([1.0, 2.0]))])
+    loss.update(None, [nd.array(np.array([np.inf, 2.0]))])
+    _, v = loss.get()
+    assert np.isfinite(v) and v == 1.5
+    assert telemetry.counter("metric_nonfinite_updates") == before + 2
+
+    # Perplexity: a NaN probability row is excluded, not folded
+    p = mx.metric.Perplexity(ignore_label=None)
+    pred = nd.array(np.array([[0.5, 0.5], [0.4, 0.6]]))
+    label = nd.array(np.array([0, 1]))
+    p.update([label], [pred])
+    nan_pred = nd.array(np.array([[np.nan, 0.5], [0.4, 0.6]]))
+    p.update([label], [nan_pred])
+    _, ppl = p.get()
+    assert np.isfinite(ppl)
+    assert telemetry.counter("metric_nonfinite_updates") == before + 3

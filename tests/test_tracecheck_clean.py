@@ -20,7 +20,10 @@ OWNED_PROGRAMS = {
     "executor_fwd_bwd_ones",
     "executor_fwd_bwd",
     "fused_trainer_step",
+    "fused_trainer_step_guarded",
     "gluon_cached_op",
+    "guardian_verdict",
+    "clip_global_norm",
     "kvstore_stack_sum",
     "kvstore_bucket_reduce",
     "module_cached_step",

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Seeded guardian smoke: NaN-skip bitwise identity + rollback recovery.
+
+Runs one small seeded training loop (``GUARDIAN_SMOKE_ROLE=run`` child
+processes, so counters/chaos/guardian state never leak between runs)
+four times:
+
+1. **plain**     — no guardian, no chaos: the reference trajectory and
+   the per-step ``xla_program_calls`` budget;
+2. **clean**     — guardian on (dynamic loss scale), no chaos: must be
+   BITWISE identical to plain (power-of-two scaling is transparent) and
+   issue the identical number of program calls per steady-state step
+   (the folded verdict is not a second program);
+3. **transient** — guardian on + ``MXNET_CHAOS=grad.bucket:nan@K``: the
+   poisoned step must be skipped exactly once (one
+   ``guardian_skipped_steps`` bump), the loop retries the batch, and the
+   final trajectory is again bitwise identical to plain;
+4. **rollback**  — guardian + CheckpointManager + a persistent NaN
+   window wider than the skip budget: the run must roll back to the
+   ``last_good``-pinned checkpoint, quarantine the batch window, and
+   recover — every unhealthy burst is bounded by
+   ``MXNET_GUARDIAN_MAX_SKIPS`` (+1 step to the first clean update) and
+   the run ends applying finite updates.
+
+Exit is nonzero on ANY violated property.  Usage::
+
+    python tools/guardian_smoke.py [--steps 12] [--poison-at 4]
+        [--window 5-10] [--max-skips 2] [--timeout 240] [--json]
+"""
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# child: one seeded training run
+# ---------------------------------------------------------------------------
+
+def child_main():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, checkpoint, gluon, guardian, profiler
+    from mxnet_tpu.gluon import nn
+
+    steps = int(os.environ["GUARDIAN_SMOKE_STEPS"])
+    use_guardian = os.environ.get("GUARDIAN_SMOKE_GUARDIAN") == "1"
+    use_manager = os.environ.get("GUARDIAN_SMOKE_MANAGER") == "1"
+    retries = int(os.environ.get("GUARDIAN_SMOKE_RETRIES", "0"))
+    out_path = os.environ["GUARDIAN_SMOKE_OUT"]
+
+    # mx.random.seed governs host_rng(): initializer draws AND the
+    # NDArrayIter shuffle are covered; the data itself uses an explicit
+    # RandomState (no hidden global numpy state, JG005-clean)
+    mx.random.seed(7)
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    rs = np.random.RandomState(3)
+    data = mx.nd.array(rs.randn(64, 6).astype(np.float32))
+    label = mx.nd.array(rs.randn(64, 4).astype(np.float32))
+    it = mx.io.NDArrayIter(data, label, batch_size=8, shuffle=True,
+                           last_batch_handle="discard")
+    loss_fn = gluon.loss.L2Loss()
+
+    mgr = guard = None
+    if use_manager:
+        mgr = checkpoint.CheckpointManager(
+            os.environ["GUARDIAN_SMOKE_CKPT"], trainer=trainer,
+            data_iter=it, every_steps=2, num_shards=2)
+    if use_guardian:
+        guard = guardian.TrainingGuardian(manager=mgr)
+
+    def fetch():
+        try:
+            return it.next()
+        except StopIteration:
+            it.reset()
+            return it.next()
+
+    losses, actions, calls_last = [], [], 0
+    for _ in range(steps):
+        batch = fetch()
+        attempt = 0
+        while True:
+            with autograd.record():
+                loss = loss_fn(net(batch.data[0]), batch.label[0])
+                scaled = guard.scale_loss(loss) if guard else loss
+            scaled.backward()
+            before = profiler.counter("xla_program_calls")
+            trainer.step(8)
+            calls_last = profiler.counter("xla_program_calls") - before
+            if guard is not None:
+                actions.append(guard.last_action())
+                # the retrying-loop contract: a skipped update redoes the
+                # SAME batch; a rollback moves on (its batch window is
+                # quarantined)
+                if guard.last_action() == "skipped" and attempt < retries:
+                    attempt += 1
+                    continue
+            break
+        losses.append(float(np.float64(loss.asnumpy().sum())))
+
+    if mgr is not None:
+        mgr.wait()
+    params = np.concatenate(
+        [p.data().asnumpy().ravel()
+         for p in net.collect_params().values()])
+    from mxnet_tpu import chaos, telemetry
+    result = {
+        "losses": losses,
+        "losses_hex": [float.hex(x) for x in losses],
+        "actions": actions,
+        "calls_last_step": calls_last,
+        "params_sha": hashlib.sha256(params.tobytes()).hexdigest(),
+        "params_finite": bool(np.isfinite(params).all()),
+        "fault_log": chaos.fault_log(),
+        "counters": {k: telemetry.counter(k) for k in
+                     ("guardian_checks", "guardian_skipped_steps",
+                      "guardian_rollbacks", "guardian_scale_cuts")},
+        "last_good_step": None if mgr is None else mgr.last_good_step,
+    }
+    if guard is not None:
+        guard.close()
+    if mgr is not None:
+        mgr.close()
+    with open(out_path, "w") as fh:
+        json.dump(result, fh)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestrate + assert
+# ---------------------------------------------------------------------------
+
+def run_child(label, scratch, args, guardian=False, manager=False,
+              chaos="", retries=0, extra_env=None):
+    out = os.path.join(scratch, "result-%s.json" % label)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "GUARDIAN_SMOKE_ROLE": "run",
+        "GUARDIAN_SMOKE_STEPS": str(args.steps),
+        "GUARDIAN_SMOKE_GUARDIAN": "1" if guardian else "",
+        "GUARDIAN_SMOKE_MANAGER": "1" if manager else "",
+        "GUARDIAN_SMOKE_RETRIES": str(retries),
+        "GUARDIAN_SMOKE_OUT": out,
+        "GUARDIAN_SMOKE_CKPT": os.path.join(scratch, "ckpt-%s" % label),
+        "MXNET_CHAOS": chaos,
+        "MXNET_GUARDIAN_LOSS_SCALE": "dynamic" if guardian else "0",
+        "MXNET_GUARDIAN_MAX_SKIPS": str(args.max_skips),
+    })
+    env.pop("MXNET_GUARDIAN", None)       # instances, not env auto-install
+    env.update(extra_env or {})
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=args.timeout,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        raise SystemExit("guardian_smoke: HANG — run %r exceeded the %ds "
+                         "wall-clock cap" % (label, args.timeout))
+    if proc.returncode != 0:
+        raise SystemExit("guardian_smoke: run %r failed rc=%d\n%s\n%s"
+                         % (label, proc.returncode, proc.stdout,
+                            proc.stderr))
+    with open(out) as fh:
+        return json.load(fh)
+
+
+def burst_lengths(actions):
+    """Lengths of the unhealthy episodes: consecutive skips up to and
+    including the terminating rollback (the recovery action ends an
+    episode — a still-poisoned window may open the next one)."""
+    bursts, cur = [], 0
+    for act in actions:
+        if act == "applied":
+            if cur:
+                bursts.append(cur)
+            cur = 0
+        else:
+            cur += 1
+            if act == "rollback":     # episode resolved
+                bursts.append(cur)
+                cur = 0
+    if cur:
+        bursts.append(cur)
+    return bursts
+
+
+def main(argv=None):
+    if os.environ.get("GUARDIAN_SMOKE_ROLE") == "run":
+        return child_main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--poison-at", type=int, default=4)
+    ap.add_argument("--window", default="5-10",
+                    help="persistent-NaN occurrence window (rollback run)")
+    ap.add_argument("--max-skips", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=240.0)
+    ap.add_argument("--keep", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    scratch = tempfile.mkdtemp(prefix="mxnet-guardian-smoke-")
+    try:
+        plain = run_child("plain", scratch, args)
+        clean = run_child("clean", scratch, args, guardian=True)
+        transient = run_child(
+            "transient", scratch, args, guardian=True,
+            chaos="grad.bucket:nan@%d" % args.poison_at,
+            retries=args.max_skips + 1)
+        rollback = run_child(
+            "rollback", scratch, args, guardian=True, manager=True,
+            chaos="grad.bucket:nan@%s" % args.window)
+
+        problems = []
+        if clean["losses_hex"] != plain["losses_hex"] \
+                or clean["params_sha"] != plain["params_sha"]:
+            problems.append("guardian-on clean run is NOT bitwise-"
+                            "identical to the unguarded run: %s vs %s"
+                            % (clean["losses"], plain["losses"]))
+        if clean["calls_last_step"] != plain["calls_last_step"]:
+            problems.append(
+                "the folded verdict changed the per-step program budget "
+                "(%d vs %d calls) — it must ride in the existing program"
+                % (clean["calls_last_step"], plain["calls_last_step"]))
+        if transient["counters"]["guardian_skipped_steps"] != 1:
+            problems.append("transient NaN run skipped %d steps, want "
+                            "exactly 1" %
+                            transient["counters"]["guardian_skipped_steps"])
+        if transient["counters"]["guardian_rollbacks"] != 0:
+            problems.append("transient NaN run rolled back — one skip "
+                            "must absorb one poisoned batch")
+        if transient["losses_hex"] != plain["losses_hex"] \
+                or transient["params_sha"] != plain["params_sha"]:
+            problems.append(
+                "transient NaN run is NOT bitwise-identical to the "
+                "clean run after the retry: %s vs %s"
+                % (transient["losses"], plain["losses"]))
+        if not transient["fault_log"]:
+            problems.append("transient run injected ZERO faults "
+                            "(vacuous pass)")
+        if rollback["counters"]["guardian_rollbacks"] < 1:
+            problems.append("persistent NaN run never rolled back "
+                            "(budget %d)" % args.max_skips)
+        if rollback["last_good_step"] is None:
+            problems.append("rollback run never pinned a last-good "
+                            "checkpoint")
+        bursts = burst_lengths(rollback["actions"])
+        if any(b > args.max_skips for b in bursts):
+            problems.append(
+                "an unhealthy burst ran %d steps, over the %d-skip "
+                "budget — recovery exceeded MXNET_GUARDIAN_MAX_SKIPS+1 "
+                "(actions: %s)"
+                % (max(bursts), args.max_skips, rollback["actions"]))
+        if not rollback["actions"] \
+                or rollback["actions"][-1] != "applied":
+            problems.append("rollback run did not end on applied steps "
+                            "(no recovery): %s" % rollback["actions"])
+        if not rollback["params_finite"]:
+            problems.append("rollback run ended with nonfinite params")
+
+        summary = {
+            "ok": not problems,
+            "steps": args.steps,
+            "max_skips": args.max_skips,
+            "skipped": transient["counters"]["guardian_skipped_steps"],
+            "rollbacks": rollback["counters"]["guardian_rollbacks"],
+            "last_good_step": rollback["last_good_step"],
+            "calls_last_step": plain["calls_last_step"],
+            "final_loss": plain["losses"][-1],
+            "problems": problems,
+        }
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            print("guardian_smoke: %s — 1 skip absorbed, %d rollback(s), "
+                  "%d calls/step, final loss %r"
+                  % ("OK" if not problems else "FAIL",
+                     summary["rollbacks"], summary["calls_last_step"],
+                     summary["final_loss"]))
+            for p in problems:
+                print("  PROBLEM: %s" % p)
+        return 0 if not problems else 1
+    finally:
+        if args.keep:
+            print("guardian_smoke: scratch kept at %s" % scratch)
+        else:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
