@@ -362,7 +362,42 @@ def _enum_devices_once(timeout):
                (proc.stderr or "")[-300:].strip().replace("\n", " | "))}
 
 
-def _enum_devices(timeout=45, attempts=2, backoff=5.0):
+# Cached-success fast path (r03–r05 carry-over): the expensive failure
+# mode is re-probing a wedged tunnel over and over.  The first GOOD
+# enumeration of the run is cached here (and in the environment, so
+# child re-invocations of this script inherit it) and reused by every
+# later caller — forensics, retry decisions, the enum smoke — instead
+# of spending another hard timeout on a fresh child.
+_ENUM_CACHE_ENV = "BENCH_ENUM_CACHE"
+_ENUM_CACHE = None
+
+
+def _enum_cached():
+    """The last good enumeration of this run, or None."""
+    global _ENUM_CACHE
+    if _ENUM_CACHE is not None:
+        return _ENUM_CACHE
+    raw = os.environ.get(_ENUM_CACHE_ENV, "")
+    if raw:
+        try:
+            parsed = json.loads(raw)
+            if isinstance(parsed, dict) and "error" not in parsed:
+                _ENUM_CACHE = parsed
+        except ValueError:
+            pass
+    return _ENUM_CACHE
+
+
+def _enum_remember(result):
+    """Bank a successful enumeration for the rest of the run."""
+    global _ENUM_CACHE
+    if isinstance(result, dict) and "error" not in result:
+        _ENUM_CACHE = dict(result)
+        os.environ[_ENUM_CACHE_ENV] = json.dumps(_ENUM_CACHE)
+    return result
+
+
+def _enum_devices(timeout=45, attempts=2, backoff=5.0, use_cache=True):
     """Ask a FRESH child process what jax can actually see, with a hard
     per-attempt timeout — the r03-r05 failure mode IS backend init
     hanging, so the enumeration itself must be expendable.
@@ -371,13 +406,20 @@ def _enum_devices(timeout=45, attempts=2, backoff=5.0):
     probe retries with exponential backoff (*attempts* total) before the
     caller falls back to CPU; EVERY attempt's outcome is recorded in the
     returned dict so the probe_forensics block shows the retry history,
-    not just the last word.
+    not just the last word.  A good result from earlier in the run is
+    returned straight from the cache (``use_cache=False`` forces a
+    fresh probe).
     """
+    if use_cache:
+        cached = _enum_cached()
+        if cached is not None:
+            return dict(cached, cached=True)
     history = []
     for i in range(max(1, attempts)):
         result = _enum_devices_once(timeout)
         history.append(dict(result, attempt=i + 1))
         if "error" not in result:
+            _enum_remember(result)
             break
         if i + 1 < attempts:
             delay = backoff * (2 ** i)
@@ -390,6 +432,26 @@ def _enum_devices(timeout=45, attempts=2, backoff=5.0):
     final.pop("attempt", None)
     final["attempts"] = history
     return final
+
+
+def _smoke_enum():
+    """``BENCH_SMOKE=enum``: enum-only smoke — one bounded fresh-child
+    enumeration (cache-aware), ONE JSON line, never the measurement
+    path.  Lets a driver record whether a TPU is visible at all in
+    seconds instead of burning the full probe budget against a wedged
+    tunnel."""
+    result = _enum_devices()
+    platform = result.get("platform")
+    on_tpu = "error" not in result and platform not in (None, "cpu")
+    print(json.dumps({
+        "metric": "bench_enum_smoke",
+        "value": int(result.get("device_count", 0)) if on_tpu else 0,
+        "unit": "tpu_devices",
+        "platform": platform,
+        "device_kinds": result.get("device_kinds"),
+        "cached": bool(result.get("cached")),
+        "error": result.get("error"),
+    }))
 
 
 def _enum_role():
@@ -441,6 +503,9 @@ def main():
     if role == "enum":
         _enum_role()
         return
+    if os.environ.get("BENCH_SMOKE", "") == "enum":
+        _smoke_enum()
+        return
     if role == "chip":
         _measure(require_chip=True)
         return
@@ -454,7 +519,20 @@ def main():
     total_budget = float(os.environ.get("BENCH_PROBE_BUDGET", "900"))
     deadline = time.time() + total_budget
     attempt, last_err = 0, "no attempts made"
-    while time.time() < deadline:
+    # Pre-flight (r03-r05 carry-over): ONE bounded enumeration decides
+    # whether chip attempts are worth their timeouts at all.  A wedged
+    # tunnel now costs ~45s instead of the whole probe budget, and a
+    # good answer is cached for every later probe of this run.
+    preflight = _enum_devices()
+    tpu_visible = "error" not in preflight \
+        and preflight.get("platform") not in (None, "cpu")
+    if not tpu_visible:
+        last_err = "preflight enumeration found no accelerator: %s" \
+            % json.dumps({k: preflight.get(k)
+                          for k in ("platform", "device_count", "error")})
+        print("bench: %s; skipping chip attempts" % last_err,
+              file=sys.stderr)
+    while tpu_visible and time.time() < deadline:
         attempt += 1
         # The chip child compiles (~40s) + measures (~60s); give it most of
         # the remaining budget but keep one retry's worth in reserve.
@@ -470,11 +548,13 @@ def main():
     # Structured forensics BEFORE the CPU fallback runs: the probe's
     # timeout cause, what a fresh child can enumerate, and the host
     # socket/log evidence — so a "10 img/s" artifact explains itself.
+    # The enumeration here is deliberately CACHE-BYPASSING: a tunnel
+    # that wedged after a good preflight must show up as wedged.
     forensics = {
         "cause": last_err,
         "attempts": attempt,
         "probe_budget_s": total_budget,
-        "device_enum": _enum_devices(),
+        "device_enum": _enum_devices(use_cache=False),
         "env": {k: os.environ[k] for k in
                 ("JAX_PLATFORMS", "BENCH_PROBE_BUDGET") if k in os.environ},
         "host": _forensics(),

@@ -132,10 +132,14 @@ class CheckpointManager:
                                 else _env_int("MXNET_CKPT_KEEP", 3)))
         if num_shards is None:
             num_shards = _env_int("MXNET_CKPT_SHARDS", 0)
+        # an explicit shard count (argument or env) is pinned; otherwise
+        # the count tracks the trainer's live ZeRO-1 layout (below),
+        # reverting to this auto default when ZeRO deactivates
+        self._n_shards_explicit = bool(num_shards)
         if not num_shards:
             import jax
             num_shards = max(1, jax.local_device_count())
-        self._n_shards = max(1, int(num_shards))
+        self._n_shards = self._auto_shards = max(1, int(num_shards))
         self._retries = max(1, int(retries if retries is not None
                                    else _env_int("MXNET_CKPT_RETRIES", 3)))
         self._grace_secs = _env_float("MXNET_CKPT_GRACE_SECS", 30.0)
@@ -253,6 +257,15 @@ class CheckpointManager:
     def _capture(self, step, reason):
         if self._trainer is not None:
             params, optim, state = self._capture_trainer()
+            # MXNET_ZERO: one checkpoint shard per update replica, so
+            # each shard file is written from state that already lives
+            # on that replica (the reshard.py round-robin layout on
+            # device AND on disk — no gather-to-save).  An explicit
+            # shard count stays pinned.
+            plan = getattr(self._trainer, "_zero_plan", None)
+            if not self._n_shards_explicit:
+                self._n_shards = max(1, int(plan.n)) if plan is not None \
+                    else self._auto_shards
         else:
             params, optim, state = self._capture_module()
         state["reason"] = reason

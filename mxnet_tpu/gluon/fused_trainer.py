@@ -31,6 +31,15 @@ reads them on the next backward.
 
 Opt out with ``MXNET_FUSED_TRAINER=0`` (the per-slot loop stays the
 bitwise-equality oracle in tests/test_fused_trainer.py).
+
+ZeRO-1 sharded mode (``MXNET_ZERO=1``, docs/ZERO.md): the SAME one
+donated program additionally carries cross-replica weight-update
+sharding (arXiv 2004.13336 via ``parallel/zero.py``): optimizer state
+persists sharded 1/N per local device, gradients are reduce-scattered
+in (``kvstore.reduce_scatter_all`` or a direct sharded placement),
+each replica updates only its rows, and the updated weights all-gather
+back out — bitwise-identical to the replicated path, still exactly one
+XLA program per step, guardian verdict folded in unchanged.
 """
 from __future__ import annotations
 
@@ -47,9 +56,11 @@ from .. import random as _random
 from .. import telemetry as _tel
 from ..guardian import core as _guard
 from ..guardian import health as _health
+from ..ndarray import NDArray
 from ..optimizer import _state_raw, _state_writeback, static_hypers
 
-__all__ = ["fused_trainer_enabled", "fused_step_fn", "run_fused_step"]
+__all__ = ["fused_trainer_enabled", "fused_step_fn", "run_fused_step",
+           "zero_enabled", "zero_num_shards"]
 
 
 def _env_enabled():
@@ -57,26 +68,213 @@ def _env_enabled():
         not in ("0", "false", "off", "no")
 
 
+def _env_zero():
+    return os.environ.get("MXNET_ZERO", "0").strip().lower() \
+        in ("1", "true", "on", "yes")
+
+
+def _env_zero_shards():
+    try:
+        return max(0, int(os.environ.get("MXNET_ZERO_SHARDS", "0")))
+    except ValueError:
+        return 0
+
+
 # cached at import (the JG006 cached-value pattern): Trainer.step consults
-# this once per step and must not re-parse the environment each time
+# these once per step and must not re-parse the environment each time
 _ENABLED = _env_enabled()
+_ZERO = _env_zero()
+_ZERO_SHARDS = _env_zero_shards()
 
 
 def refresh_from_env():
-    """Re-read MXNET_FUSED_TRAINER (tests / late configuration)."""
-    global _ENABLED
+    """Re-read MXNET_FUSED_TRAINER / MXNET_ZERO / MXNET_ZERO_SHARDS
+    (tests / late configuration)."""
+    global _ENABLED, _ZERO, _ZERO_SHARDS
     _ENABLED = _env_enabled()
+    _ZERO = _env_zero()
+    _ZERO_SHARDS = _env_zero_shards()
 
 
 def fused_trainer_enabled():
     return _ENABLED
 
 
+def zero_enabled():
+    """Whether MXNET_ZERO asked for the sharded weight update."""
+    return _ZERO
+
+
+def zero_num_shards():
+    """Replica count for the sharded update: MXNET_ZERO_SHARDS, clamped
+    to the local device count; 0/unset means every local device."""
+    n_local = jax.local_device_count()
+    return min(_ZERO_SHARDS, n_local) if _ZERO_SHARDS else n_local
+
+
 _STEP_CACHE = {}      # signature -> (weakref to optimizer, jitted step)
 _TRACECHECK_KEEPALIVE = []    # graftcheck specimen optimizers (see below)
 
 
-def _signature(opt, params_raw, states_raw, donate, guarded):
+class _ZeroPlan:
+    """The ZeRO-1 layout for one Trainer: a 1-D ``zero`` mesh of N local
+    devices plus per-shape update shardings (``parallel/zero.py``).
+
+    The plan owns the persistent placement of the optimizer state — each
+    weight-shaped state leaf whose leading dim divides N lives sharded
+    P('zero') across the mesh; scalar/odd leaves stay replicated — and
+    the per-step placement of params/grads entering the one program.
+    Slot→checkpoint-shard assignment is untouched: the round-robin
+    ``checkpoint/reshard.py`` layout the CheckpointManager already
+    writes, so snapshotting sharded state never gathers on device.
+    """
+
+    axis = "zero"
+
+    def __init__(self, n_shards):
+        from ..parallel import zero as z
+        self._z = z
+        self.mesh = z.zero1_axis_mesh(n_shards, self.axis)
+        self.n = int(self.mesh.shape[self.axis])
+        from jax.sharding import NamedSharding, PartitionSpec
+        self.replicated = NamedSharding(self.mesh, PartitionSpec())
+        self._upd_cache = {}           # weight shape -> sharding or None
+        self._bytes = None             # (per_device, replicated) cache
+
+    def update_sharding(self, shape):
+        shape = tuple(shape)
+        if shape not in self._upd_cache:
+            self._upd_cache[shape] = self._z.update_sharding(
+                self.mesh, shape, self.axis)
+        return self._upd_cache[shape]
+
+    def grad_shardings(self, shapes):
+        """Per-slot placement for incoming gradients: the update
+        sharding (the reduce-scatter target) or replicated."""
+        return [self.update_sharding(s) or self.replicated for s in shapes]
+
+    def place_replicated(self, arrs):
+        """Broadcast host/device arrays onto the mesh (the weights'
+        entry leg; pure data movement, no XLA program)."""
+        if not arrs:
+            return list(arrs)
+        return list(jax.device_put(list(arrs), self.replicated))
+
+    def scatter_grads(self, raw_grads, shapes):
+        """Direct reduce-scatter placement for the no-kvstore path: each
+        device receives only its rows of each divisible gradient."""
+        if not raw_grads:
+            return list(raw_grads)
+        return list(jax.device_put(list(raw_grads),
+                                   self.grad_shardings(shapes)))
+
+    @staticmethod
+    def _state_nds(state):
+        """Flatten one slot's NDArray state tree to its NDArray leaves."""
+        if state is None:
+            return []
+        if isinstance(state, NDArray):
+            return [state]
+        out = []
+        for s in state:
+            out.extend(_ZeroPlan._state_nds(s))
+        return out
+
+    def place_states(self, slots, updater):
+        """Ensure every state leaf sits at its planned sharding; leaves
+        arriving from a checkpoint restore / load_states (plain host or
+        single-device arrays) are re-placed, which is also what makes a
+        restore elastic across a changed shard count."""
+        moved = False
+        for slot, p in slots:
+            wshape = tuple(p.data().shape)
+            upd = self.update_sharding(wshape)
+            for leaf in self._state_nds(updater.states.get(slot)):
+                want = self._z.shard_state_tree_spec(
+                    leaf.shape, wshape, upd, self.replicated)
+                if getattr(leaf._data, "sharding", None) != want:
+                    leaf._set_data(jax.device_put(leaf._data, want))
+                    moved = True
+        if moved:
+            self._bytes = None
+        return moved
+
+    def unplace_states(self, slots, updater):
+        """Pull sharded state back to each weight's own device (the exit
+        path when MXNET_ZERO is flipped off mid-run)."""
+        from jax.sharding import SingleDeviceSharding
+        for slot, p in slots:
+            dev = p.data().context.jax_device
+            home = SingleDeviceSharding(dev)
+            for leaf in self._state_nds(updater.states.get(slot)):
+                if getattr(leaf._data, "sharding", None) != home:
+                    leaf._set_data(jax.device_put(leaf._data, dev))
+        self._bytes = None
+
+    def local_view(self, arr, jax_device):
+        """The single-device view of a replicated program output on
+        *jax_device* — no copy when the shard buffer already lives
+        there; a weight whose home device is outside the zero mesh gets
+        an explicit transfer back so it never silently migrates."""
+        for s in arr.addressable_shards:
+            if s.device == jax_device:
+                return s.data
+        return jax.device_put(arr.addressable_shards[0].data, jax_device)
+
+    def state_byte_gauges(self, slots, updater):
+        """(per_device, replicated) optimizer-state bytes under this
+        layout — the ``zero_optimizer_bytes_*`` gauges' arithmetic."""
+        if self._bytes is None:
+            leaves = []
+            for slot, p in slots:
+                wshape = tuple(p.data().shape)
+                upd = self.update_sharding(wshape)
+                for leaf in self._state_nds(updater.states.get(slot)):
+                    sharded = upd is not None \
+                        and tuple(leaf.shape) == wshape
+                    leaves.append((leaf.shape, leaf.dtype, sharded))
+            self._bytes = self._z.state_bytes(leaves, self.n)
+        return self._bytes
+
+
+def _deactivate_zero(trainer, slots):
+    """De-shard a trainer that previously ran the ZeRO path: pull the
+    state home, drop the plan, and zero the gauges (their declared
+    contract is '0/absent when replicated')."""
+    plan = getattr(trainer, "_zero_plan", None)
+    if plan is None:
+        return
+    plan.unplace_states(slots, trainer._updater)
+    trainer._zero_plan = None
+    _tel.set_gauge("zero_shards", 0)
+    _tel.set_gauge("zero_optimizer_bytes_per_device", 0)
+    _tel.set_gauge("zero_optimizer_bytes_replicated", 0)
+
+
+def ensure_unsharded(trainer, slots):
+    """Entry hook for the NON-fused paths (the ``MXNET_FUSED_TRAINER=0``
+    oracle loop, non-fusable optimizers): a trainer whose state was left
+    mesh-sharded by an earlier ZeRO step must be de-sharded before any
+    eager per-slot update touches it — the eager dispatch would
+    otherwise mix single-device grads with mesh-committed state."""
+    _deactivate_zero(trainer, slots)
+
+
+def _zero_plan(trainer, slots):
+    """The trainer's active ZeRO plan, or None.  Builds/rebuilds on an
+    env change (shard count or enable flip) and migrates the optimizer
+    state's placement accordingly."""
+    if not zero_enabled():
+        _deactivate_zero(trainer, slots)   # flipped off mid-run
+        return None
+    plan = getattr(trainer, "_zero_plan", None)
+    n = zero_num_shards()
+    if plan is None or plan.n != n:
+        plan = trainer._zero_plan = _ZeroPlan(n)
+    return plan
+
+
+def _signature(opt, params_raw, states_raw, donate, guarded, zero=None):
     leaves, treedef = jax.tree_util.tree_flatten(states_raw)
     return (type(opt), static_hypers(opt),
             tuple((tuple(w.shape), str(w.dtype)) for w in params_raw),
@@ -86,10 +284,12 @@ def _signature(opt, params_raw, states_raw, donate, guarded):
             tuple(str(getattr(w, "sharding", None)) for w in params_raw),
             str(treedef),
             tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
-            bool(donate), bool(guarded))
+            bool(donate), bool(guarded),
+            None if zero is None else ("zero", zero.n))
 
 
-def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False):
+def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False,
+                  zero=None):
     """The jitted whole-model step for this (optimizer, model) signature,
     compiled once per signature process-wide.
 
@@ -108,8 +308,17 @@ def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False):
     extra reduction in an existing program; never a second XLA launch,
     never a host callback (graftcheck-proven on the
     ``fused_trainer_step_guarded`` specimen).
+
+    With ``zero`` (a :class:`_ZeroPlan`) the SAME program carries the
+    ZeRO-1 placement: per-slot sharding constraints make the XLA
+    partitioner reduce-scatter each divisible gradient, run the
+    identical update math on 1/N of the rows per replica against the
+    persistently sharded state, and all-gather the updated weights back
+    to replicated outputs.  Guarding composes unchanged — the verdict
+    reduces over the sharded gradients (same truth value) and the
+    ``jnp.where`` pass-through keeps each replica's state rows.
     """
-    sig = _signature(opt, params_raw, states_raw, donate, guarded)
+    sig = _signature(opt, params_raw, states_raw, donate, guarded, zero)
     # prune entries whose owning optimizer died (their compiled programs
     # would otherwise pin memory forever)
     for dead in [k for k, (r, _) in _STEP_CACHE.items() if r() is None]:
@@ -124,30 +333,81 @@ def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False):
             return entry[1]
 
     opt_ref = weakref.ref(opt)
+    if zero is not None:
+        zero_upd = [zero.update_sharding(tuple(w.shape))
+                    for w in params_raw]
+        zero_rep = zero.replicated
+        wshapes = [tuple(w.shape) for w in params_raw]
 
     def step(params, grads, states, hyper):
         o = opt_ref()
         if o is None:       # only reachable on a retrace after death
             raise RuntimeError("fused step optimizer was collected")
+        wsc = jax.lax.with_sharding_constraint
+        states_in = states
+        if zero is not None:
+            # reduce-scatter point: each replica keeps only its rows of
+            # each divisible gradient/weight before the update runs
+            grads = [g if s is None else wsc(g, s)
+                     for g, s in zip(grads, zero_upd)]
+            p_in = [p if s is None else wsc(p, s)
+                    for p, s in zip(params, zero_upd)]
+            # isolate each slot's update into its own fusion island:
+            # XLA's cross-slot loop fusion emits different vector code
+            # for shard-shaped buffers than for the full arrays, which
+            # costs 1-ulp drift vs the replicated program.  A per-slot
+            # barrier (identity — no arithmetic) makes each update
+            # compile exactly like its standalone per-slot program, the
+            # same bits the MXNET_FUSED_TRAINER=0 oracle produces.
+            iso_p, iso_g, iso_s = [], [], []
+            for p_i, g_i, s_i in zip(p_in, grads, states):
+                p_i, g_i, s_i = jax.lax.optimization_barrier(
+                    (p_i, g_i, s_i))
+                iso_p.append(p_i)
+                iso_g.append(g_i)
+                iso_s.append(s_i)
+            p_in, grads, states_in = iso_p, iso_g, iso_s
+        else:
+            p_in = params
+        finite = None
+        if guarded:
+            finite = _health.all_finite(grads)
+            if "loss" in hyper:        # dict structure: static per trace
+                finite = jnp.logical_and(
+                    finite, jnp.all(jnp.isfinite(hyper["loss"])))
+        new_params, new_states = o.fused_update_step(p_in, grads,
+                                                     states_in, hyper)
+        if zero is not None:
+            # seal the islands: downstream select/constraint ops are
+            # arithmetic-free, but without this barrier they could fuse
+            # back INTO the update clusters and re-open codegen drift
+            new_params = list(jax.lax.optimization_barrier(
+                tuple(new_params)))
+        if guarded:
+            # nonfinite ⇒ the donated buffers keep their old values: the
+            # poisoned batch costs one skipped step, not a retrace and
+            # not a host round-trip
+            new_params = [jnp.where(finite, n, p)
+                          for n, p in zip(new_params, params)]
+            new_states = jax.tree_util.tree_map(
+                lambda n, p: jnp.where(finite, n, p), new_states, states)
+        if zero is not None:
+            # all-gather leg, pinned LAST so the partitioner cannot
+            # re-shard the final outputs past it: updated weights come
+            # back replicated; state rows stay on their replica
+            new_params = [wsc(nw, zero_rep) for nw in new_params]
+            new_states = [
+                ns if s is None else jax.tree_util.tree_map(
+                    lambda x, s=s, w=w: wsc(x, s)
+                    if tuple(x.shape) == w else x, ns)
+                for ns, s, w in zip(new_states, zero_upd, wshapes)]
         if not guarded:
-            return o.fused_update_step(params, grads, states, hyper)
-        finite = _health.all_finite(grads)
-        if "loss" in hyper:            # dict structure: static per trace
-            finite = jnp.logical_and(
-                finite, jnp.all(jnp.isfinite(hyper["loss"])))
-        new_params, new_states = o.fused_update_step(params, grads,
-                                                     states, hyper)
-        # nonfinite ⇒ the donated buffers keep their old values: the
-        # poisoned batch costs one skipped step, not a retrace and not
-        # a host round-trip
-        new_params = [jnp.where(finite, n, p)
-                      for n, p in zip(new_params, params)]
-        new_states = jax.tree_util.tree_map(
-            lambda n, p: jnp.where(finite, n, p), new_states, states)
+            return new_params, new_states
         return new_params, new_states, finite
 
     # params + states donated: the update happens in place in HBM
-    name = "fused_trainer_step_guarded" if guarded else "fused_trainer_step"
+    name = "fused_trainer_step" + ("_zero1" if zero is not None else "") \
+        + ("_guarded" if guarded else "")
     fn = _tel.watch_jit(jax.jit(step, donate_argnums=(0, 2) if donate else ()),
                         name)
     _STEP_CACHE[sig] = (opt_ref, fn)
@@ -179,10 +439,29 @@ def tracecheck_programs():
     guarded_hyper = dict(hyper, loss=np.float32(0.0))
     guarded = fused_step_fn(opt, params_raw, states_raw, donate=True,
                             guarded=True)
+    # the ZeRO-1 variants: same donated layout with the sharded-update
+    # placement over a zero mesh (2 shards where the host offers >1
+    # device, degenerate 1 otherwise) — graftcheck proves the collective
+    # sandwich adds no host callback, no dtype widening, and keeps the
+    # donation clean
+    zero = _ZeroPlan(min(2, jax.local_device_count()))
+    zparams = zero.place_replicated(params_raw)
+    zgrads = zero.scatter_grads(params_raw,
+                                [w.shape for w in params_raw])
+    zstates = [None if s is None else jax.device_put(
+        s, zero.update_sharding(tuple(w.shape)) or zero.replicated)
+        for s, w in zip(states_raw, params_raw)]
+    zfn = fused_step_fn(opt, zparams, zstates, donate=True, zero=zero)
+    zguarded = fused_step_fn(opt, zparams, zstates, donate=True,
+                             guarded=True, zero=zero)
     return [("fused_trainer_step", fn,
              (params_raw, params_raw, states_raw, hyper), {}),
             ("fused_trainer_step_guarded", guarded,
-             (params_raw, params_raw, states_raw, guarded_hyper), {})]
+             (params_raw, params_raw, states_raw, guarded_hyper), {}),
+            ("fused_trainer_step_zero1", zfn,
+             (zparams, zgrads, zstates, hyper), {}),
+            ("fused_trainer_step_zero1_guarded", zguarded,
+             (zparams, zgrads, zstates, guarded_hyper), {})]
 
 
 def run_fused_step(trainer, slots):
@@ -200,18 +479,34 @@ def run_fused_step(trainer, slots):
     opt, updater = trainer._optimizer, trainer._updater
     guard = _guard.current()
     grads = [p.grad() for _, p in slots]
+    plan = _zero_plan(trainer, slots)
+    wshapes = [tuple(p.data().shape) for _, p in slots]
 
     if trainer._kvstore is not None:
-        with _tel.span("kvstore_push_pull", cat="kvstore"):
-            reduced = trainer._kvstore.push_pull_all(
-                [s for s, _ in slots], [[g] for g in grads])
-        # per-slot grad buffers observe the reduced value, like pull(out=g)
-        for g, r in zip(grads, reduced):
-            if r is not g:
-                g._set_data(r._data)
-        raw_grads = [r._data for r in reduced]
+        if plan is not None:
+            # the reduce-scatter leg: the bucketed reduction lands each
+            # divisible gradient already sharded over the zero mesh (the
+            # per-slot grad buffers are NOT rewritten — the sharded
+            # arrays are consumed by the one step program)
+            with _tel.span("kvstore_push_pull", cat="kvstore"):
+                reduced = trainer._kvstore.reduce_scatter_all(
+                    [s for s, _ in slots], [[g] for g in grads],
+                    plan.grad_shardings(wshapes))
+            raw_grads = [r._data for r in reduced]
+        else:
+            with _tel.span("kvstore_push_pull", cat="kvstore"):
+                reduced = trainer._kvstore.push_pull_all(
+                    [s for s, _ in slots], [[g] for g in grads])
+            # per-slot grad buffers observe the reduced value, like
+            # pull(out=g)
+            for g, r in zip(grads, reduced):
+                if r is not g:
+                    g._set_data(r._data)
+            raw_grads = [r._data for r in reduced]
     else:
         raw_grads = [g._data for g in grads]
+        if plan is not None:
+            raw_grads = plan.scatter_grads(raw_grads, wshapes)
     if _chaos.active():              # grad seam: `nan` poisons a bucket
         raw_grads = _chaos.poison_grads(raw_grads)
 
@@ -246,14 +541,31 @@ def run_fused_step(trainer, slots):
         hyper["loss"] = loss_raw
 
     params_raw = [p._raw_data() for _, p in slots]
+    if plan is not None:
+        # every program input must live on the zero mesh: weights (and
+        # the loss/keys) enter replicated — data movement only, the
+        # devices already share the reduced gradient rows and the
+        # persistently sharded state
+        plan.place_states(slots, updater)
+        params_raw = plan.place_replicated(params_raw)
+        if loss_raw is not None:
+            hyper["loss"] = jax.device_put(hyper["loss"], plan.replicated)
+        if "key" in hyper:
+            hyper["key"] = jax.device_put(hyper["key"], plan.replicated)
+        per_dev, rep_bytes = plan.state_byte_gauges(slots, updater)
+        _tel.set_gauge("zero_shards", plan.n)
+        _tel.set_gauge("zero_optimizer_bytes_per_device", per_dev)
+        _tel.set_gauge("zero_optimizer_bytes_replicated", rep_bytes)
     states_raw = [_state_raw(updater.states[s]) for s, _ in slots]
     donate = slots and slots[0][1].data().context.device_type != "cpu"
     fn = fused_step_fn(opt, params_raw, states_raw, donate,
-                       guarded=guard is not None)
+                       guarded=guard is not None, zero=plan)
     trainer._fused_step_jit = fn                   # introspection / tests
 
     _prof.bump("xla_program_calls")
     _prof.bump("trainer_fused_step")
+    if plan is not None:
+        _prof.bump("trainer_zero_step")
     with _tel.span("fused_optimizer_step", cat="program"):
         if guard is not None:
             new_params, new_states, verdict = fn(params_raw, raw_grads,
@@ -265,6 +577,11 @@ def run_fused_step(trainer, slots):
     # ALWAYS rebind: on a donate backend the inputs were consumed, and on
     # a skipped step the outputs carry the old values through jnp.where
     for (slot, p), nw, ns in zip(slots, new_params, new_states):
+        if plan is not None:
+            # the all-gathered weight is replicated over the mesh: keep
+            # the shard already on this weight's OWN device (a view, not
+            # a copy) so the eager forward/backward path is untouched
+            nw = plan.local_view(nw, p.data().context.jax_device)
         p._rebind_data(nw)                         # donation-safe rebind
         _state_writeback(updater.states[slot], ns)
 

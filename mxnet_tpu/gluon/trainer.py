@@ -19,7 +19,8 @@ from .. import optimizer as opt
 from .. import telemetry as _tel
 from ..checkpoint import hooks as _ckpt_hooks
 from ..guardian import core as _guard
-from .fused_trainer import fused_trainer_enabled, run_fused_step
+from .fused_trainer import (ensure_unsharded, fused_trainer_enabled,
+                            run_fused_step)
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -99,6 +100,12 @@ class Trainer(object):
         falls back to the per-slot loop, which is also the
         bitwise-equality oracle in tests.
 
+        ``MXNET_ZERO=1`` additionally shards the weight update ZeRO-1
+        style across ``MXNET_ZERO_SHARDS`` local devices (docs/ZERO.md):
+        optimizer state persists 1/N per device, the kvstore leg becomes
+        a bucketed reduce-scatter, and the one step program all-gathers
+        updated weights — bitwise-identical to the replicated paths.
+
         With a :class:`~mxnet_tpu.guardian.TrainingGuardian` installed
         the step additionally computes a finite-health verdict inside
         the update program, suppresses the update on NaN/Inf, and folds
@@ -171,6 +178,9 @@ class Trainer(object):
         the skip machinery too.  Returns True when the step was skipped.
         """
         guard = _guard.current()
+        # state left mesh-sharded by an earlier ZeRO step must come home
+        # before eager per-slot dispatch mixes devices
+        ensure_unsharded(self, slots)
         if _chaos.active():          # the same grad seam, once per step
             raws = _chaos.poison_grads(
                 [param.grad()._data for _, param in slots])

@@ -345,6 +345,55 @@ class KVStore:
                         r.copyto(dst)
         return results
 
+    def reduce_scatter_all(self, keys, values, shardings, priority=0):
+        """Bucketed reduce-scatter: the ZeRO-1 gradient leg
+        (arXiv 2004.13336), beside :meth:`push_pull_all`.
+
+        Cross-copy reduction runs through the same (dtype, n_copies)
+        flat buckets as ``push_pull_all`` (one program per bucket), then
+        each reduced value is *scattered* onto ``shardings[i]`` — a
+        ``jax.Sharding`` placing the rows its owning replicas update, or
+        None to leave the reduction where it landed.  The scatter is
+        pure data movement (on a mesh backend each device keeps only its
+        rows); no extra XLA program launches.  Like ``push_pull_all``
+        this owns the whole round: per-key server slots and the callers'
+        gradient buffers are NOT rewritten — the sharded results feed
+        the fused sharded update directly.
+        """
+        skeys, vlists = self._normalize_all(keys, values)
+        assert len(shardings) == len(skeys)
+        reduced = self._reduce_all(vlists)
+        _prof.bump("kvstore_reduce_scatter")
+        return self._scatter(reduced, vlists, shardings)
+
+    @staticmethod
+    def _scatter(reduced, vlists, shardings):
+        """Place reduced values onto their target shardings (one batched
+        transfer; None entries pass through)."""
+        placed = list(reduced)
+        idxs = [i for i, s in enumerate(shardings) if s is not None]
+        if idxs:
+            outs = jax.device_put([reduced[i]._data for i in idxs],
+                                  [shardings[i] for i in idxs])
+            for i, o in zip(idxs, outs):
+                placed[i] = NDArray(o, ctx=vlists[i][0].context)
+        return placed
+
+    def all_gather_all(self, keys, values, priority=0):
+        """The inverse leg: materialize each (possibly update-sharded)
+        value fully on its own context device — what a consumer outside
+        the sharded step program (evaluation, host export) needs.  Pure
+        data movement; returns new NDArrays."""
+        skeys, vlists = self._normalize_all(keys, values)
+        outs = []
+        for k, vl in zip(skeys, vlists):
+            v = vl[0]
+            _prof.bump("kvstore_pull")
+            outs.append(NDArray(jax.device_put(v._data,
+                                               v.context.jax_device),
+                                ctx=v.context))
+        return outs
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference kvstore.py:227)."""
         assert out is not None and row_ids is not None
@@ -693,6 +742,17 @@ class KVStoreDist(KVStore):
                         _prof.bump("xla_program_calls")  # broadcast copy
                         dst._set_data(r.as_in_context(dst.context)._data)
         return results
+
+    def reduce_scatter_all(self, keys, values, shardings, priority=0):
+        """Dist reduce-scatter: the flat-bucket push+pull round of
+        :meth:`push_pull_all` followed by the local scatter — each
+        worker re-places the globally reduced value so only its owned
+        rows stay resident for the sharded update."""
+        results = self.push_pull_all(keys, values, priority=priority)
+        _prof.bump("kvstore_reduce_scatter")
+        vlists = [v if isinstance(v, (list, tuple)) else [v]
+                  for v in values]
+        return self._scatter(results, vlists, shardings)
 
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the servers (reference kvstore.py:353:

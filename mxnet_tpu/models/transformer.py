@@ -227,40 +227,34 @@ def make_train_step_zero1(cfg, mesh, params, lr=0.1, momentum=0.9,
     ``(step, momenta)`` where ``step(params, momenta, tokens, labels) ->
     (new_params, new_momenta, loss)``.
     """
-    from ..parallel.sharded import zero1_update_spec
-    ndata = mesh.shape.get("data", 1)
+    from ..parallel.zero import sharded_update, update_sharding
 
-    def update_sharding(p):
-        spec = zero1_update_spec(p.shape, getattr(p.sharding, "spec", P()),
-                                 ndata)
-        if spec is not None:
-            return NamedSharding(mesh, spec)
-        return p.sharding
-
-    upd_shardings = jax.tree_util.tree_map(update_sharding, params)
-    param_shardings = jax.tree_util.tree_map(lambda p: p.sharding, params)
-    momenta = jax.tree_util.tree_map(
-        lambda p, s: jax.device_put(jnp.zeros_like(p), s),
-        params, upd_shardings)
+    upd_shardings = {
+        n: update_sharding(mesh, p.shape, "data",
+                           getattr(p.sharding, "spec", P()))
+        for n, p in params.items()}
+    param_shardings = {n: p.sharding for n, p in params.items()}
+    momenta = {
+        n: jax.device_put(jnp.zeros_like(p),
+                          upd_shardings[n] or p.sharding)
+        for n, p in params.items()}
 
     loss_of = _lm_loss_fn(cfg, mesh, seq_axis)
-    wsc = jax.lax.with_sharding_constraint
+
+    def momentum_sgd(p, g, m, hyper):
+        new_m = momentum * m + g.astype(m.dtype)
+        return p - lr * new_m.astype(p.dtype), new_m
 
     def step(ps, ms, tokens, labels):
         loss, grads = jax.value_and_grad(loss_of)(ps, tokens, labels)
-
-        def upd(p, g, m, us, pssh):
-            g = wsc(g.astype(m.dtype), us)      # reduce-scatter point
-            new_m = momentum * m + g
-            new_p = wsc(p - lr * new_m.astype(p.dtype), pssh)  # all-gather
-            return new_p, wsc(new_m, us)
-
-        pairs = jax.tree_util.tree_map(upd, ps, grads, ms,
-                                       upd_shardings, param_shardings)
-        new_p = jax.tree_util.tree_map(lambda pr: pr[0], pairs,
-                                       is_leaf=lambda x: isinstance(x, tuple))
-        new_m = jax.tree_util.tree_map(lambda pr: pr[1], pairs,
-                                       is_leaf=lambda x: isinstance(x, tuple))
+        # the shared ZeRO-1 placement core (parallel/zero.py): the same
+        # wsc sandwich the fused Trainer's MXNET_ZERO path and
+        # ShardedTrainer compile
+        new_p, new_m = {}, {}
+        for n in ps:
+            new_p[n], new_m[n] = sharded_update(
+                momentum_sgd, ps[n], grads[n], ms[n], {},
+                upd_shardings[n], param_shardings[n])
         return new_p, new_m, loss
 
     return _tel.watch_jit(jax.jit(step, donate_argnums=(0, 1)),

@@ -29,20 +29,10 @@ from .. import random as _random
 from .. import telemetry as _tel
 from ..ndarray.ndarray import NDArray, _wrap
 from .mesh import auto_mesh
+from .zero import sharded_update, zero1_update_spec
 
 __all__ = ["ShardedTrainer", "block_pure_fn", "sharded_data",
            "zero1_update_spec"]
-
-
-def zero1_update_spec(shape, current_spec, ndata, batch_axis="data"):
-    """The ZeRO-1 (arXiv:2004.13336) update PartitionSpec for a weight,
-    or None when it must fall back to the replicated update: the param
-    must currently be replicated (no TP sharding), the data axis must
-    have >1 replica, and the leading dim must divide evenly."""
-    replicated = all(s is None for s in tuple(current_spec or ()))
-    if replicated and shape and ndata > 1 and shape[0] % ndata == 0:
-        return P(*((batch_axis,) + (None,) * (len(shape) - 1)))
-    return None
 
 
 def _deactivate_hybrid(block, saved=None):
@@ -238,32 +228,23 @@ class ShardedTrainer:
 
         upd_shardings = self._update_shardings
         param_shardings = {n: self.params[n].sharding for n in grad_names}
-        wsc = jax.lax.with_sharding_constraint
 
         def apply_updates(params, grads, states, lrs, wds, ts):
             # Pure functional core: the same update_step the eager Updater
             # runs, traced here with lr/wd/t entering as scalars so one
             # cached program serves every step of the schedule.  Under
-            # weight-update sharding the constraints below make the XLA
-            # partitioner reduce-scatter the gradient, run the update on
-            # 1/N of the rows per replica, and all-gather the result
-            # (arXiv:2004.13336).
+            # weight-update sharding ``parallel.zero.sharded_update``
+            # constrains grad/weight/state so the XLA partitioner
+            # reduce-scatters the gradient, runs the update on 1/N of
+            # the rows per replica, and all-gathers the result
+            # (arXiv:2004.13336) — the same shared core the fused
+            # Trainer's MXNET_ZERO path compiles.
             new_p, new_s = {}, {}
             for n in grad_names:
                 hyper = {"lr": lrs[n], "wd": wds[n], "t": ts[n]}
-                g, p = grads[n], params[n]
-                if n in upd_shardings:
-                    g = wsc(g, upd_shardings[n])
-                    p = wsc(p, upd_shardings[n])
-                np_, ns_ = opt.update_step(p, g, states[n], hyper)
-                if n in upd_shardings:
-                    wshape = tuple(p.shape)
-                    ns_ = jax.tree_util.tree_map(
-                        lambda x, s=upd_shardings[n]:
-                            wsc(x, s) if tuple(x.shape) == wshape else x,
-                        ns_)
-                    np_ = wsc(np_, param_shardings[n])  # all-gather back
-                new_p[n], new_s[n] = np_, ns_
+                new_p[n], new_s[n] = sharded_update(
+                    opt.update_step, params[n], grads[n], states[n], hyper,
+                    upd_shardings.get(n), param_shardings[n])
             return new_p, new_s
 
         def step(params, states, aux, data, label, key, lrs, wds, ts):

@@ -1,0 +1,122 @@
+"""ZeRO-1 cross-replica weight-update sharding: the shared core.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (PAPERS.md arxiv 2004.13336) observes that in data-parallel
+training every replica redundantly computes the identical weight update
+and redundantly holds the identical optimizer state.  The fix is pure
+placement: shard the update computation and its state across replicas,
+reduce-scatter the gradient in, all-gather the updated weight out — the
+numbers are bit-identical, only *where* they are computed changes.
+
+This module is the one implementation of that placement, consumed by
+three sites that each used to carry a bespoke copy:
+
+* ``gluon.fused_trainer`` — the production path: ``MXNET_ZERO=1`` runs
+  the whole-model fused optimizer program with per-replica state shards
+  (see docs/ZERO.md).
+* ``parallel.sharded.ShardedTrainer(shard_weight_update=True)`` — the
+  SPMD trainer's in-step update.
+* ``models.transformer.make_train_step_zero1`` — the MULTICHIP dryrun
+  flagship.
+
+The unit of sharding is the leading axis of each weight-shaped array
+(the XLA-friendly choice from the paper: the SPMD partitioner turns the
+constraints into reduce-scatter / 1-of-N update / all-gather with no
+manual collectives).  Slot→checkpoint-shard assignment stays the
+round-robin ``checkpoint/reshard.py`` layout — a sharded state leaf is
+written from its per-device rows without ever being gathered on device.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["zero1_update_spec", "zero1_axis_mesh", "update_sharding",
+           "sharded_update", "shard_state_tree_spec", "state_bytes"]
+
+
+def zero1_update_spec(shape, current_spec, ndata, batch_axis="data"):
+    """The ZeRO-1 (arXiv:2004.13336) update PartitionSpec for a weight,
+    or None when it must fall back to the replicated update: the param
+    must currently be replicated (no TP sharding), the data axis must
+    have >1 replica, and the leading dim must divide evenly."""
+    replicated = all(s is None for s in tuple(current_spec or ()))
+    if replicated and shape and ndata > 1 and shape[0] % ndata == 0:
+        return P(*((batch_axis,) + (None,) * (len(shape) - 1)))
+    return None
+
+
+def zero1_axis_mesh(n_shards, axis="zero", devices=None):
+    """A 1-D mesh of the first *n_shards* local devices — the replica
+    axis the fused Trainer's sharded update lives on."""
+    if devices is None:
+        devices = jax.local_devices()
+    n = max(1, min(int(n_shards), len(devices)))
+    return Mesh(np.asarray(devices[:n]), (axis,))
+
+
+def update_sharding(mesh, shape, axis, current_spec=None):
+    """NamedSharding for one weight's sharded update on *mesh*, or None
+    for the replicated fallback (TP-sharded weight, indivisible leading
+    dim, a scalar, or a mesh without the replica axis at all)."""
+    spec = zero1_update_spec(shape, current_spec,
+                             mesh.shape.get(axis, 1), axis)
+    if spec is None:
+        return None
+    return NamedSharding(mesh, spec)
+
+
+def shard_state_tree_spec(state_leaf_shape, weight_shape, upd_sharding,
+                          replicated):
+    """Placement for one optimizer-state leaf: weight-shaped leaves ride
+    the weight's update sharding; scalar/odd-shaped schedule state (e.g.
+    Nadam's mu product) stays replicated."""
+    if upd_sharding is not None \
+            and tuple(state_leaf_shape) == tuple(weight_shape):
+        return upd_sharding
+    return replicated
+
+
+def sharded_update(update_fn, p, g, state, hyper, upd_sharding,
+                   param_sharding):
+    """One weight's update with ZeRO-1 placement constraints.
+
+    ``update_fn(p, g, state, hyper) -> (new_p, new_state)`` is the pure
+    optimizer core (``Optimizer.update_step`` or an inline formula).
+    With ``upd_sharding`` set, the gradient and weight are constrained to
+    the update sharding (the reduce-scatter point — each replica keeps
+    only its 1/N of the rows), the update runs on the shard, weight-
+    shaped state leaves are pinned to the shard, and the new weight is
+    constrained back to ``param_sharding`` (the all-gather).  With
+    ``upd_sharding=None`` the update is untouched (replicated fallback).
+    Numerically exact either way: elementwise update math on a row slice
+    produces the same bits as on the full array.
+    """
+    if upd_sharding is None:
+        return update_fn(p, g, state, hyper)
+    wsc = jax.lax.with_sharding_constraint
+    wshape = tuple(p.shape)
+    g = wsc(g, upd_sharding)                       # reduce-scatter point
+    p_sh = wsc(p, upd_sharding)
+    new_p, new_state = update_fn(p_sh, g, state, hyper)
+    new_state = jax.tree_util.tree_map(
+        lambda x: wsc(x, upd_sharding)
+        if tuple(x.shape) == wshape else x, new_state)
+    if param_sharding is not None:
+        new_p = wsc(new_p, param_sharding)         # all-gather back
+    return new_p, new_state
+
+
+def state_bytes(leaves, n_shards):
+    """(per_device_bytes, replicated_bytes) for a list of (leaf_shape,
+    leaf_dtype, is_sharded) descriptors — the ``zero_optimizer_bytes_*``
+    gauge arithmetic, shared by the trainer and ``tools/zero_bench.py``.
+    """
+    per_dev = total = 0
+    n = max(1, int(n_shards))
+    for shape, dtype, sharded in leaves:
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        total += nbytes
+        per_dev += nbytes // n if sharded else nbytes
+    return per_dev, total

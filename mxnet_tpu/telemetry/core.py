@@ -314,6 +314,11 @@ COUNTERS = {
     "kvstore_push": "kvstore push operations (per key)",
     "kvstore_pull": "kvstore pull broadcast copies (per destination)",
     "kvstore_bucket_reduce": "bucketed gradient-reduce programs",
+    "kvstore_reduce_scatter": "bucketed reduce-scatter rounds (ZeRO-1 "
+                              "gradient leg: reduce + per-replica "
+                              "row placement)",
+    "trainer_zero_step": "fused Trainer steps run with the MXNET_ZERO "
+                         "sharded weight update",
     "kvstore_push_bytes": "bytes entering kvstore reduction",
     "kvstore_pull_bytes": "bytes broadcast out of the kvstore",
     "kvstore_reduce_bytes": "payload bytes moved through bucket reduces",
@@ -430,6 +435,15 @@ GAUGES = {
     "checkpoint_pinned_step": "the last-good checkpoint step pinned "
                               "against retention (guardian rollback "
                               "target)",
+    "zero_shards": "replica count of the active MXNET_ZERO sharded "
+                   "weight update (0/absent when replicated)",
+    "zero_optimizer_bytes_per_device": "optimizer-state bytes resident "
+                                       "per device under the active "
+                                       "ZeRO-1 layout",
+    "zero_optimizer_bytes_replicated": "optimizer-state bytes a fully "
+                                       "replicated layout would hold "
+                                       "per device (the ZeRO-1 "
+                                       "denominator)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)

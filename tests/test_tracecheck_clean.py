@@ -21,6 +21,8 @@ OWNED_PROGRAMS = {
     "executor_fwd_bwd",
     "fused_trainer_step",
     "fused_trainer_step_guarded",
+    "fused_trainer_step_zero1",
+    "fused_trainer_step_zero1_guarded",
     "gluon_cached_op",
     "guardian_verdict",
     "clip_global_norm",

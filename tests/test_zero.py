@@ -1,0 +1,506 @@
+"""ZeRO-1 sharded weight update in the fused Trainer (ISSUE 11).
+
+Acceptance contract: under ``MXNET_ZERO=1`` the fused step is
+bitwise-identical to the replicated fused path AND the
+``MXNET_FUSED_TRAINER=0`` per-slot oracle on a 20+-parameter model over
+1/2/4 faked replicas, still launches exactly ONE XLA program per step,
+keeps the guardian's skip/retry semantics, persists optimizer state
+physically sharded 1/N per device (the ``zero_optimizer_bytes_*``
+gauges), and checkpoints the sharded state natively — shard files in
+the ``reshard.py`` round-robin layout with no device gather, elastic
+across a changed shard count.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, chaos, gluon, guardian, profiler, telemetry
+from mxnet_tpu.checkpoint import CheckpointManager, reshard
+from mxnet_tpu.gluon import fused_trainer, nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    """Every test leaves the zero/fused env and the guardian pristine."""
+    yield
+    for key in ("MXNET_ZERO", "MXNET_ZERO_SHARDS", "MXNET_FUSED_TRAINER"):
+        os.environ.pop(key, None)
+    fused_trainer.refresh_from_env()
+    g = guardian.current()
+    if g is not None:
+        guardian.uninstall(g)
+    chaos.configure(None)
+    from mxnet_tpu.checkpoint import hooks
+    m = hooks.active()
+    if m is not None:
+        hooks.unregister(m)
+
+
+def _set_mode(fused=True, zero=None):
+    os.environ["MXNET_FUSED_TRAINER"] = "1" if fused else "0"
+    if zero is None:
+        os.environ.pop("MXNET_ZERO", None)
+        os.environ.pop("MXNET_ZERO_SHARDS", None)
+    else:
+        os.environ["MXNET_ZERO"] = "1"
+        os.environ["MXNET_ZERO_SHARDS"] = str(zero)
+    fused_trainer.refresh_from_env()
+
+
+def _net(n_layers=12, width=8):
+    net = nn.Sequential()
+    for _ in range(n_layers - 1):
+        net.add(nn.Dense(width, activation="relu"))
+    net.add(nn.Dense(4))
+    return net
+
+
+def _state_arrays(trainer):
+    out = {}
+    for idx, st in trainer._updater.states.items():
+        leaves = []
+
+        def _collect(s):
+            if s is None:
+                leaves.append(None)
+            elif isinstance(s, (tuple, list)):
+                for x in s:
+                    _collect(x)
+            else:
+                leaves.append(s.asnumpy())
+
+        _collect(st)
+        out[idx] = leaves
+    return out
+
+
+def _train(optimizer, fused=True, zero=None, steps=3, n_layers=12,
+           width=8, seed=0, kvstore="device"):
+    """Seeded mini-run; returns (params, states, trainer, calls/step)."""
+    _set_mode(fused=fused, zero=zero)
+    try:
+        np.random.seed(seed)
+        mx.random.seed(seed)
+        rng = np.random.RandomState(seed + 1)
+        net = _net(n_layers, width)
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), optimizer,
+                                {"learning_rate": 0.05}, kvstore=kvstore)
+        loss_fn = gluon.loss.L2Loss()
+        X = rng.randn(steps, 8, 6).astype(np.float32)
+        Y = rng.randn(steps, 8, 4).astype(np.float32)
+        calls = 0
+        for step in range(steps):
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(X[step])),
+                               mx.nd.array(Y[step]))
+            loss.backward()
+            before = profiler.counter("xla_program_calls")
+            trainer.step(8)
+            calls = profiler.counter("xla_program_calls") - before
+        params = {i: p.data().asnumpy()
+                  for i, p in enumerate(net.collect_params().values())}
+        return params, _state_arrays(trainer), trainer, calls
+    finally:
+        _set_mode(fused=True, zero=None)
+
+
+def _assert_bitwise(a, b, what):
+    assert a.keys() == b.keys()
+    for k in a:
+        fa, fb = a[k], b[k]
+        if isinstance(fa, list):
+            for i, (x, y) in enumerate(zip(fa, fb)):
+                if x is None:
+                    assert y is None
+                    continue
+                np.testing.assert_array_equal(
+                    x, y, err_msg="%s[%s][%d]" % (what, k, i))
+        else:
+            np.testing.assert_array_equal(fa, fb,
+                                          err_msg="%s[%s]" % (what, k))
+
+
+# ---------------------------------------------------------------------------
+# the bitwise gate: sharded == replicated fused == per-slot loop
+# ---------------------------------------------------------------------------
+
+_REF = {}       # optimizer -> (params, states) of the replicated runs
+
+
+def _refs(optimizer):
+    if optimizer not in _REF:
+        fp, fs, _, _ = _train(optimizer, fused=True)
+        lp, ls, _, _ = _train(optimizer, fused=False)
+        _assert_bitwise(fp, lp, "fused-vs-loop param")
+        _assert_bitwise(fs, ls, "fused-vs-loop state")
+        _REF[optimizer] = (fp, fs)
+    return _REF[optimizer]
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4])
+def test_sharded_matches_replicated_bitwise(shards):
+    """20+-param adam model: MXNET_ZERO=1 over 1/2/4 faked replicas is
+    bitwise-identical (params AND optimizer state) to the replicated
+    fused path, which itself matches the MXNET_FUSED_TRAINER=0 oracle."""
+    ref_p, ref_s = _refs("adam")
+    zp, zs, trainer, _ = _train("adam", zero=shards)
+    assert len(ref_p) >= 20
+    _assert_bitwise(zp, ref_p, "param[shards=%d]" % shards)
+    _assert_bitwise(zs, ref_s, "state[shards=%d]" % shards)
+    assert trainer._zero_plan is not None \
+        and trainer._zero_plan.n == shards
+
+
+def test_sharded_momentum_sgd_bitwise_no_kvstore():
+    """The no-kvstore direct-scatter leg, with single-slot-state sgd."""
+    fp, fs, _, _ = _train("sgd", fused=True, kvstore=None)
+    zp, zs, _, _ = _train("sgd", zero=4, kvstore=None)
+    _assert_bitwise(zp, fp, "param")
+    _assert_bitwise(zs, fs, "state")
+
+
+def test_one_program_call_per_step_and_physical_sharding():
+    """Steady state under MXNET_ZERO: exactly ONE XLA program per step;
+    every dividing state leaf physically holds 1/N per device; the
+    memory gauges report the 1/N shrink."""
+    import jax
+    from jax.sharding import NamedSharding
+    zp, zs, trainer, calls = _train("adam", zero=4)
+    assert calls == 1, "zero step issued %d program calls" % calls
+    assert profiler.counter("trainer_zero_step") > 0
+    plan = trainer._zero_plan
+    n_sharded = 0
+    for st in trainer._updater.states.values():
+        for leaf in plan._state_nds(st):
+            sh = leaf._data.sharding
+            assert isinstance(sh, NamedSharding)
+            if any(a is not None for a in sh.spec):
+                n_sharded += 1
+                shard0 = leaf._data.addressable_shards[0].data
+                assert shard0.nbytes * plan.n == leaf._data.nbytes
+    assert n_sharded >= 20
+    per_dev = telemetry.gauge("zero_optimizer_bytes_per_device")
+    total = telemetry.gauge("zero_optimizer_bytes_replicated")
+    assert total > 0 and per_dev <= total / 4 * 1.01
+
+
+def test_guardian_transient_nan_recovers_bitwise_under_zero():
+    """The PR-9 contract under MXNET_ZERO=1: a chaos NaN at step 2 skips
+    exactly once in-program, the retry recovers, and the final params
+    are bitwise-identical to the clean replicated run."""
+    rs = np.random.RandomState(1)
+    X = rs.randn(8, 8, 6).astype(np.float32)
+    Y = rs.randn(8, 8, 4).astype(np.float32)
+
+    def run(zero, guard=None, poison=None, retry=False, steps=5):
+        _set_mode(fused=True, zero=zero)
+        chaos.configure(poison)
+        try:
+            mx.random.seed(0)
+            np.random.seed(0)
+            net = _net(3, 8)
+            net.initialize()
+            tr = gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.05})
+            loss_fn = gluon.loss.L2Loss()
+            losses, actions = [], []
+            for i in range(steps):
+                while True:
+                    with autograd.record():
+                        loss = loss_fn(net(mx.nd.array(X[i])),
+                                       mx.nd.array(Y[i]))
+                        scaled = guard.scale_loss(loss) if guard else loss
+                    scaled.backward()
+                    tr.step(8)
+                    if guard is not None:
+                        actions.append(guard.last_action())
+                        if retry and guard.last_action() == "skipped":
+                            continue
+                    break
+                losses.append(float(np.float64(loss.asnumpy().sum())))
+            params = {i: p.data().asnumpy()
+                      for i, p in enumerate(
+                          net.collect_params().values())}
+            return losses, params, actions
+        finally:
+            chaos.configure(None)
+            _set_mode(fused=True, zero=None)
+
+    ref_l, ref_p, _ = run(zero=None)
+    g = guardian.TrainingGuardian()
+    try:
+        zl, zp, za = run(zero=4, guard=g,
+                         poison="seed=3;grad.bucket:nan@2", retry=True)
+    finally:
+        g.close()
+    assert za.count("skipped") == 1
+    assert zl == ref_l
+    _assert_bitwise(zp, ref_p, "param")
+
+
+# ---------------------------------------------------------------------------
+# checkpointing: native sharded save, elastic restore
+# ---------------------------------------------------------------------------
+
+def _ckpt_run(tmp_path, shards, total_steps, restore_at=None,
+              restore_shards=None, subdir="ck"):
+    """Adam run under MXNET_ZERO=*shards*; optionally rebuild the world
+    at *restore_at* (fresh net/trainer/manager on *restore_shards*
+    replicas) and restore from the newest checkpoint."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(total_steps, 8, 6).astype(np.float32)
+    Y = rng.randn(total_steps, 8, 4).astype(np.float32)
+    ckdir = str(tmp_path / subdir)
+
+    def fresh(n):
+        _set_mode(fused=True, zero=n)
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = _net(3, 8)
+        net.initialize(init=mx.initializer.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.05})
+        mgr = CheckpointManager(ckdir, trainer=tr)
+        return net, tr, mgr
+
+    net, tr, mgr = fresh(shards)
+    loss_fn = gluon.loss.L2Loss()
+    try:
+        for step in range(total_steps):
+            if restore_at is not None and step == restore_at:
+                mgr.close()
+                net, tr, mgr = fresh(restore_shards)
+                restored = mgr.restore()
+                assert restored == restore_at
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(X[step])),
+                               mx.nd.array(Y[step]))
+            loss.backward()
+            tr.step(8)
+            save_at = (restore_at - 1) if restore_at is not None \
+                else total_steps // 2
+            if step == save_at:
+                assert mgr.save(sync=True)
+        params = {i: p.data().asnumpy()
+                  for i, p in enumerate(net.collect_params().values())}
+        return params, _state_arrays(tr), mgr
+    finally:
+        mgr.close()
+        _set_mode(fused=True, zero=None)
+
+
+def test_checkpoint_sharded_native_no_gather(tmp_path):
+    """Saving under MXNET_ZERO launches no XLA program (each replica's
+    slots stream host-side), the manifest shard count tracks the zero
+    plan, and every shard file holds exactly its round-robin slots."""
+    _set_mode(fused=True, zero=4)
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = _net(3, 8)
+    net.initialize(init=mx.initializer.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05})
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=tr)
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(7)
+    try:
+        for _ in range(2):
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(
+                    rng.randn(8, 6).astype(np.float32))),
+                    mx.nd.array(rng.randn(8, 4).astype(np.float32)))
+            loss.backward()
+            tr.step(8)
+        before = profiler.counter("xla_program_calls")
+        assert mgr.save(sync=True)
+        assert profiler.counter("xla_program_calls") == before, \
+            "sharded checkpoint save launched an XLA program (gather?)"
+        ckpts = [d for d in os.listdir(str(tmp_path / "ck"))
+                 if d.startswith("ckpt-")]
+        assert len(ckpts) == 1
+        ckdir = str(tmp_path / "ck" / ckpts[0])
+        with open(os.path.join(ckdir, "manifest.json")) as fh:
+            manifest = json.load(fh)
+        assert manifest["n_shards"] == 4
+        slot_ids = sorted(tr._updater.states)
+        expect = reshard.assign_slots(slot_ids, 4)
+        for k in range(4):
+            with open(os.path.join(
+                    ckdir, "optim-%05d-of-%05d.pkl" % (k, 4)), "rb") as fh:
+                payload = pickle.load(fh)
+            assert sorted(payload) == expect[k], \
+                "shard %d holds %s, round-robin expects %s" \
+                % (k, sorted(payload), expect[k])
+    finally:
+        mgr.close()
+        _set_mode(fused=True, zero=None)
+
+
+def test_checkpoint_restore_across_changed_shard_count(tmp_path):
+    """Save on 4 replicas, restore onto 2: the restore re-buckets and
+    the continued trajectory is bitwise-identical to the uninterrupted
+    4-replica run (which is itself bitwise == replicated)."""
+    ref_p, ref_s, _ = _ckpt_run(tmp_path, shards=4, total_steps=5,
+                                subdir="ref")
+    got_p, got_s, _ = _ckpt_run(tmp_path, shards=4, total_steps=5,
+                                restore_at=3, restore_shards=2,
+                                subdir="elastic")
+    _assert_bitwise(got_p, ref_p, "param")
+    _assert_bitwise(got_s, ref_s, "state")
+
+
+def test_save_load_states_roundtrip_under_zero(tmp_path):
+    """Trainer.save_states serializes the (sharded) state via the host;
+    a fresh trainer load_states + re-placement continues bitwise."""
+    _set_mode(fused=True, zero=2)
+    try:
+        np.random.seed(0)
+        mx.random.seed(0)
+        rng = np.random.RandomState(3)
+        X = rng.randn(4, 8, 6).astype(np.float32)
+        Y = rng.randn(4, 8, 4).astype(np.float32)
+
+        def fresh():
+            net = _net(3, 8)
+            net.initialize(init=mx.initializer.Xavier())
+            tr = gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.05})
+            return net, tr
+
+        def run(reload_at=None):
+            mx.random.seed(0)
+            np.random.seed(0)
+            net, tr = fresh()
+            loss_fn = gluon.loss.L2Loss()
+            for step in range(4):
+                if reload_at is not None and step == reload_at:
+                    f = str(tmp_path / "tr.states")
+                    tr.save_states(f)
+                    weights = [p.data().asnumpy()
+                               for p in net.collect_params().values()]
+                    net, tr = fresh()
+                    for p, w in zip(net.collect_params().values(),
+                                    weights):
+                        p.set_data(mx.nd.array(w))
+                    tr.load_states(f)
+                with autograd.record():
+                    loss = loss_fn(net(mx.nd.array(X[step])),
+                                   mx.nd.array(Y[step]))
+                loss.backward()
+                tr.step(8)
+            return {i: p.data().asnumpy() for i, p in
+                    enumerate(net.collect_params().values())}
+
+        ref = run()
+        got = run(reload_at=2)
+        _assert_bitwise(got, ref, "param")
+    finally:
+        _set_mode(fused=True, zero=None)
+
+
+# ---------------------------------------------------------------------------
+# kvstore collectives + mode plumbing
+# ---------------------------------------------------------------------------
+
+def test_kvstore_reduce_scatter_and_all_gather():
+    """reduce_scatter_all reduces bitwise like push_pull_all and places
+    each divisible value sharded; all_gather_all materializes it back
+    on the context device."""
+    import jax
+    from jax.sharding import NamedSharding
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.gluon.fused_trainer import _ZeroPlan
+    plan = _ZeroPlan(4)
+    rng = np.random.RandomState(0)
+    vals = [rng.randn(8, 4).astype(np.float32) for _ in range(3)]
+
+    kv = kvs.create("device")
+    kv2 = kvs.create("device")
+    keys = list(range(3))
+    for k, v in zip(keys, vals):
+        kv.init(k, mx.nd.array(v))
+        kv2.init(k, mx.nd.array(v))
+    copies = [[mx.nd.array(v), mx.nd.array(v * 0.5)] for v in vals]
+    copies2 = [[mx.nd.array(v), mx.nd.array(v * 0.5)] for v in vals]
+    expect = kv2.push_pull_all(keys, copies2)
+    shardings = plan.grad_shardings([v.shape for v in vals])
+    before = profiler.counter("kvstore_reduce_scatter")
+    got = kv.reduce_scatter_all(keys, copies, shardings)
+    assert profiler.counter("kvstore_reduce_scatter") == before + 1
+    for e, g, s in zip(expect, got, shardings):
+        np.testing.assert_array_equal(e.asnumpy(), g.asnumpy())
+        assert isinstance(g._data.sharding, NamedSharding)
+        assert g._data.sharding == s
+    gathered = kv.all_gather_all(keys, [[g] for g in got])
+    for e, g in zip(expect, gathered):
+        np.testing.assert_array_equal(e.asnumpy(), g.asnumpy())
+        assert len(g._data.sharding.device_set) == 1
+
+
+@pytest.mark.parametrize("flip_to_loop", [False, True])
+def test_zero_off_is_default_and_flip_off_unplaces(flip_to_loop):
+    """MXNET_ZERO unset: no plan is built.  Flipping it off mid-run —
+    onto the fused replicated path OR the ``MXNET_FUSED_TRAINER=0``
+    eager loop — pulls the state back to the weight's own device and
+    zeroes the ``zero_*`` gauges (their '0/absent when replicated'
+    contract)."""
+    _, _, tr, _ = _train("adam", fused=True)
+    assert getattr(tr, "_zero_plan", None) is None
+
+    _set_mode(fused=True, zero=2)
+    try:
+        np.random.seed(0)
+        mx.random.seed(0)
+        rng = np.random.RandomState(5)
+        net = _net(3, 8)
+        net.initialize(init=mx.initializer.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.05})
+        loss_fn = gluon.loss.L2Loss()
+        for step in range(2):
+            if step == 1:
+                # flip off mid-run (optionally onto the eager loop,
+                # which must de-shard before any per-slot dispatch)
+                _set_mode(fused=not flip_to_loop, zero=None)
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(
+                    rng.randn(8, 6).astype(np.float32))),
+                    mx.nd.array(rng.randn(8, 4).astype(np.float32)))
+            loss.backward()
+            tr.step(8)
+        assert tr._zero_plan is None
+        for st in tr._updater.states.values():
+            if st is None:
+                continue
+            leaves = st if isinstance(st, tuple) else (st,)
+            for leaf in leaves:
+                assert len(leaf._data.sharding.device_set) == 1
+        assert telemetry.gauge("zero_shards") == 0
+        assert telemetry.gauge("zero_optimizer_bytes_per_device") == 0
+    finally:
+        _set_mode(fused=True, zero=None)
+
+
+def test_zero_bench_fast_subprocess():
+    """tools/zero_bench.py --fast: the tier-1 acceptance gate — per-
+    device optimizer bytes shrink ~1/N, one program per step, exit 0."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "zero_bench.py"),
+         "--fast", "--shards", "4", "--steps", "2", "--warmup", "1"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["ok"] is True
+    assert payload["bytes_ratio"] <= 0.3
+    assert payload["sharded"]["program_calls"] == 1
+    assert payload["replicated"]["program_calls"] == 1

@@ -196,6 +196,24 @@ def mfu_stats(snapshot):
     return out
 
 
+def zero_stats(snapshot):
+    """The ZeRO-1 sharded-update view: the ``zero_*`` gauges the fused
+    Trainer sets under MXNET_ZERO (absent/None on replicated runs or
+    snapshots from older builds)."""
+    if not isinstance(snapshot, dict):
+        return None
+    gauges = snapshot.get("gauges") or {}
+    per_dev = gauges.get("zero_optimizer_bytes_per_device")
+    if not per_dev:        # absent, or zeroed when ZeRO deactivated
+        return None
+    replicated = gauges.get("zero_optimizer_bytes_replicated") or 0
+    out = {"shards": gauges.get("zero_shards"),
+           "optimizer_bytes_per_device": per_dev,
+           "optimizer_bytes_replicated": replicated,
+           "bytes_ratio": (per_dev / replicated) if replicated else None}
+    return out
+
+
 def _fmt_bytes(n):
     for unit in ("B", "KiB", "MiB", "GiB"):
         if n < 1024 or unit == "GiB":
@@ -224,6 +242,7 @@ def build_report(events, snapshot, top):
               "buckets": bucket_stats(events),
               "retraces": retrace_stats(events, snapshot),
               "mfu": mfu_stats(snapshot),
+              "zero": zero_stats(snapshot),
               "data_pipeline": None}
     gauges = (snapshot or {}).get("gauges") or {}
     wait = gauges.get("io_batch_wait_us")
@@ -317,6 +336,19 @@ def render(report, top):
     else:
         lines.append("(no cost accounting in snapshot — run with "
                      "MXNET_TELEMETRY=1 on a build with telemetry.costs)")
+
+    z = report.get("zero")
+    if z:
+        lines.append("")
+        lines.append("== zero-1 sharded update ==")
+        parts = ["shards %s" % int(z["shards"] or 0),
+                 "optimizer state/device %s"
+                 % _fmt_bytes(z["optimizer_bytes_per_device"]),
+                 "replicated %s"
+                 % _fmt_bytes(z["optimizer_bytes_replicated"])]
+        if z["bytes_ratio"] is not None:
+            parts.append("ratio %.3f" % z["bytes_ratio"])
+        lines.append("  ".join(parts))
 
     dp = report["data_pipeline"]
     if dp:

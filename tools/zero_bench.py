@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Replicated vs ZeRO-1-sharded fused Trainer step micro-bench.
+
+Measures the same model's fused optimizer step in both placements:
+
+- replicated (``MXNET_ZERO=0``): every device would hold the full
+  optimizer state; ONE donated whole-model update program.
+- sharded    (``MXNET_ZERO=1``): optimizer state persists 1/N per
+  device (arXiv 2004.13336 via ``parallel/zero.py``); gradients
+  reduce-scatter in, updated weights all-gather out — still ONE
+  program.
+
+and prints one JSON line::
+
+    {"metric": "zero_trainer_step", "shards": N,
+     "replicated": {"step_s": ..., "program_calls": ...,
+                    "optimizer_bytes_per_device": ...},
+     "sharded":    {..., "optimizer_bytes_per_device": ...},
+     "bytes_ratio": ..., "ok": true}
+
+``bytes_ratio`` is sharded-per-device over replicated-per-device; the
+process exits nonzero when it exceeds ``--max-ratio`` (default
+1.25 / shards) or when either mode needs more than one update program
+per step — the ISSUE 11 acceptance gate, runnable anywhere:
+``python tools/zero_bench.py --fast``.
+
+The sharded bytes are read back from the ``zero_optimizer_bytes_*``
+telemetry gauges (not recomputed) so the bench also proves the
+observability plumbing the sampler and ``tools/trace_report.py``
+surface.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+# faked replicas: must be pinned before jax initializes (same doctrine
+# as tests/conftest.py); harmless when the host already has devices
+_DEVICES = None
+for _i, _a in enumerate(sys.argv):
+    if _a == "--devices" and _i + 1 < len(sys.argv):
+        _DEVICES = sys.argv[_i + 1]
+    elif _a.startswith("--devices="):
+        _DEVICES = _a.split("=", 1)[1]
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = ("%s --xla_force_host_platform_device_count=%s"
+                               % (os.environ.get("XLA_FLAGS", ""),
+                                  _DEVICES or "4")).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, profiler, telemetry  # noqa: E402
+from mxnet_tpu.gluon import fused_trainer, nn  # noqa: E402
+
+
+def build_net(n_layers=12, width=16):
+    """Dense stack: >= 20 trainable slots, every leading dim a multiple
+    of 4 so the whole state shards cleanly on up to 4 replicas."""
+    net = nn.Sequential()
+    for _ in range(n_layers - 1):
+        net.add(nn.Dense(width, activation="relu"))
+    net.add(nn.Dense(8))
+    return net
+
+
+def _state_leaf_bytes(updater):
+    """Total optimizer-state bytes (= the replicated per-device cost)."""
+    total = 0
+
+    def _walk(st):
+        nonlocal total
+        if st is None:
+            return
+        if isinstance(st, (tuple, list)):
+            for s in st:
+                _walk(s)
+            return
+        total += st.size * st.dtype.itemsize
+
+    for st in updater.states.values():
+        _walk(st)
+    return total
+
+
+def run_mode(zero, shards, steps, warmup, batch_size, optimizer,
+             n_layers, width):
+    prev_zero = os.environ.get("MXNET_ZERO")
+    prev_shards = os.environ.get("MXNET_ZERO_SHARDS")
+    os.environ["MXNET_ZERO"] = "1" if zero else "0"
+    os.environ["MXNET_ZERO_SHARDS"] = str(shards)
+    fused_trainer.refresh_from_env()
+    try:
+        mx.random.seed(0)
+        rng = mx.random.host_rng()
+        net = build_net(n_layers, width)
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), optimizer,
+                                {"learning_rate": 0.05})
+        loss_fn = gluon.loss.L2Loss()
+        x = mx.nd.array(rng.standard_normal((batch_size, 8))
+                        .astype(np.float32))
+        y = mx.nd.array(rng.standard_normal((batch_size, 8))
+                        .astype(np.float32))
+
+        def one_step():
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            before = profiler.counter("xla_program_calls")
+            t0 = time.perf_counter()
+            trainer.step(batch_size)
+            for p in net.collect_params().values():
+                p.data().wait_to_read()
+            return time.perf_counter() - t0, \
+                profiler.counter("xla_program_calls") - before
+
+        for _ in range(warmup):
+            one_step()
+        times, calls = [], 0
+        for _ in range(steps):
+            dt, calls = one_step()
+            times.append(dt)
+        replicated_bytes = _state_leaf_bytes(trainer._updater)
+        if zero:
+            per_dev = telemetry.gauge("zero_optimizer_bytes_per_device")
+            gauge_rep = telemetry.gauge("zero_optimizer_bytes_replicated")
+        else:
+            per_dev, gauge_rep = replicated_bytes, replicated_bytes
+        return {
+            "step_s": round(float(np.median(times)), 6),
+            "program_calls": calls,
+            "optimizer_bytes_per_device": int(per_dev or 0),
+            "optimizer_bytes_replicated": int(gauge_rep or 0),
+            "n_params": len([p for p in net.collect_params().values()
+                             if p.grad_req != "null"]),
+        }
+    finally:
+        if prev_zero is None:
+            os.environ.pop("MXNET_ZERO", None)
+        else:
+            os.environ["MXNET_ZERO"] = prev_zero
+        if prev_shards is None:
+            os.environ.pop("MXNET_ZERO_SHARDS", None)
+        else:
+            os.environ["MXNET_ZERO_SHARDS"] = prev_shards
+        fused_trainer.refresh_from_env()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=None)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--optimizer", default="adam")
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--devices", type=int, default=None,
+                    help="faked host device count (pinned pre-jax)")
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--width", type=int, default=16)
+    ap.add_argument("--max-ratio", type=float, default=None,
+                    help="fail when sharded/replicated per-device bytes "
+                         "exceed this (default 1.25/shards)")
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 variant: 4 steps, 1 warmup")
+    args = ap.parse_args()
+    if args.steps is None:
+        args.steps = 4 if args.fast else 20
+    if args.warmup is None:
+        args.warmup = 1 if args.fast else 3
+
+    import jax
+    shards = max(1, min(args.shards, jax.local_device_count()))
+    rep = run_mode(False, shards, args.steps, args.warmup,
+                   args.batch_size, args.optimizer, args.layers,
+                   args.width)
+    shd = run_mode(True, shards, args.steps, args.warmup,
+                   args.batch_size, args.optimizer, args.layers,
+                   args.width)
+    ratio = (shd["optimizer_bytes_per_device"]
+             / max(1, rep["optimizer_bytes_per_device"]))
+    max_ratio = args.max_ratio if args.max_ratio is not None \
+        else 1.25 / shards
+    ok = (ratio <= max_ratio
+          and rep["program_calls"] <= 1
+          and shd["program_calls"] <= 1)
+    print(json.dumps({
+        "metric": "zero_trainer_step",
+        "shards": shards,
+        "devices": jax.local_device_count(),
+        "optimizer": args.optimizer,
+        "replicated": rep,
+        "sharded": shd,
+        "bytes_ratio": round(ratio, 4),
+        "max_ratio": round(max_ratio, 4),
+        "speedup": round(rep["step_s"] / shd["step_s"], 3)
+        if shd["step_s"] else None,
+        "ok": ok,
+        "backend": mx.context.current_context().device_type,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
