@@ -56,7 +56,8 @@ __all__ = ["role", "num_workers", "num_servers", "root_addr",
            "Conn", "ProtocolError", "PeerLost", "RPCTimeout",
            "Scheduler", "Server", "WorkerTransport",
            "run_scheduler", "run_server", "shard_ranges", "server_of_key",
-           "BIGARRAY_BOUND", "peer_view", "refresh_gauges",
+           "BIGARRAY_BOUND", "peer_view", "fleet_view",
+           "clock_offset_us", "dump_trace_artifacts", "refresh_gauges",
            "refresh_from_env"]
 
 # Wire frame: magic + protocol version + payload length. The magic word
@@ -134,16 +135,47 @@ def _read_env():
                                  15.0 * heartbeat if heartbeat else 30.0),
         "barrier_timeout":
             _env_float("MXNET_PS_BARRIER_TIMEOUT_S", 600.0) or None,
+        # distributed tracing: MXNET_TRACE_CONTEXT=0 keeps trace ids off
+        # the wire even with telemetry on; MXNET_TRACE_DUMP_DIR makes
+        # every role dump its Chrome trace (+ rank/clock metadata) there
+        # at exit, the per-rank artifacts trace_report --fleet merges
+        "trace_context":
+            os.environ.get("MXNET_TRACE_CONTEXT", "1").strip().lower()
+            not in ("0", "false", "off", "no"),
+        "trace_dump_dir":
+            os.environ.get("MXNET_TRACE_DUMP_DIR", "").strip() or None,
     }
 
 
 _ENV = _read_env()
 
 
+def _parse_rank_hint():
+    """Launcher-provided rank hint, or None when no launcher set one
+    (registration sends None so the scheduler assigns any free rank —
+    0 would wrongly claim rank 0)."""
+    hint = (os.environ.get("DMLC_WORKER_RANK")
+            or os.environ.get("OMPI_COMM_WORLD_RANK")
+            or os.environ.get("PMI_RANK"))
+    try:
+        return int(hint) if hint is not None else None
+    except ValueError:
+        return None
+
+
+# role/rank identity for per-frame trace context: cached at import (the
+# JG006 cached-value pattern — identity cannot change mid-process, and
+# _wrap_traced sits on the send hot path)
+_ROLE = os.environ.get("DMLC_ROLE", "worker")
+_RANK_HINT = _parse_rank_hint()
+
+
 def refresh_from_env():
     """Re-read every MXNET_PS_* knob (tests / late configuration)."""
-    global _ENV
+    global _ENV, _ROLE, _RANK_HINT
     _ENV = _read_env()
+    _ROLE = os.environ.get("DMLC_ROLE", "worker")
+    _RANK_HINT = _parse_rank_hint()
 
 
 # retry jitter: intentionally unseeded — it desynchronizes thundering
@@ -213,6 +245,62 @@ def _send_site(msg):
     return "conn.send"
 
 
+def _msg_op(msg):
+    if isinstance(msg, tuple) and msg and isinstance(msg[0], str):
+        return msg[0]
+    return "?"
+
+
+# ---------------------------------------------------------------------------
+# wire trace context
+# ---------------------------------------------------------------------------
+#
+# When the sender's telemetry is tracing (and MXNET_TRACE_CONTEXT is not
+# 0), every frame is wrapped  ("__tc__", (trace_id, span_id, send_clock,
+# role, rank), payload)  and the send/recv pair lands in both ranks'
+# Chrome traces as ``ps_send:<op>`` / ``ps_recv:<op>`` events sharing
+# the span id — the joints trace_report --fleet draws flow arrows on.
+# A receiver adopts the trace id into its context, so work a server does
+# on behalf of a worker's step carries the step's trace id.  Receivers
+# unwrap unconditionally (the SENDER decides whether to trace), so
+# mixed-configuration jobs interoperate.
+
+_TC_TAG = "__tc__"
+
+
+def _wrap_traced(msg):
+    if not (_ENV["trace_context"] and _tel.trace_active()):
+        return msg
+    trace_id = _tel.trace_context() or _tel.new_trace_id()
+    span_id = _tel.new_span_id()
+    ctx = (trace_id, span_id, _tel.now_us(), _ROLE, _my_rank())
+    t0 = _tel.now_us()
+    _tel.add_event("ps_send:%s" % _msg_op(msg), "rpc", t0, 1.0,
+                   args={"trace_id": trace_id, "span_id": span_id})
+    return (_TC_TAG, ctx, msg)
+
+
+def _unwrap_traced(msg):
+    if not (isinstance(msg, tuple) and len(msg) == 3
+            and msg[0] == _TC_TAG):
+        return msg
+    ctx, payload = msg[1], msg[2]
+    try:
+        trace_id, span_id, send_clock, from_role, from_rank = ctx
+    except (TypeError, ValueError):
+        return payload
+    _tel.set_trace_context(trace_id)
+    if _tel.trace_active():
+        _tel.add_event("ps_recv:%s" % _msg_op(payload), "rpc",
+                       _tel.now_us(), 1.0,
+                       args={"trace_id": trace_id,
+                             "parent_span": span_id,
+                             "send_clock_us": send_clock,
+                             "from_role": from_role,
+                             "from_rank": from_rank})
+    return payload
+
+
 class Conn:
     """Message channel: (magic, version, length) header + allowlist-
     restricted pickle payload.
@@ -262,7 +350,8 @@ class Conn:
             % (addr[0], addr[1], max(1, retries), last)) from last
 
     def send(self, msg):
-        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        blob = pickle.dumps(_wrap_traced(msg),
+                            protocol=pickle.HIGHEST_PROTOCOL)
         if self._broken:
             raise ConnectionError(
                 "connection poisoned (%s); reconnect before reuse"
@@ -342,7 +431,7 @@ class Conn:
                    " (mid-frame; connection poisoned)" if mid else "")
             ) from exc
         try:
-            return _restricted_loads(blob)
+            return _unwrap_traced(_restricted_loads(blob))
         except pickle.UnpicklingError as exc:
             raise ProtocolError(str(exc))
         except Exception as exc:   # truncated/garbage pickle bytes
@@ -410,16 +499,65 @@ _NODES = {}               # (role, rank) -> zero-arg dict provider
 _NODES_LOCK = threading.Lock()
 _SCHEDULER_REF = None     # weakref to the in-process Scheduler, if any
 _PEER_SNAPSHOT = None     # (unix_time, table) last fetched by a worker
+_FLEET_SNAPSHOT = None    # (unix_time, table) last fetched by a worker
+_MY_RANK = None           # rank of this process's primary (env) role
+_CLOCK = [None, None]     # [offset_us, rtt_us] vs the scheduler's clock
 
 
 def _register_node(role_name, rank, provider):
+    global _MY_RANK
     with _NODES_LOCK:
         _NODES[(role_name, rank)] = provider
+        if role_name == role():
+            _MY_RANK = rank
+
+
+def _my_rank():
+    if _MY_RANK is not None:
+        return _MY_RANK
+    return _RANK_HINT if _RANK_HINT is not None else 0
 
 
 def _set_peer_snapshot(table):
     global _PEER_SNAPSHOT
     _PEER_SNAPSHOT = (time.time(), table)
+
+
+def _set_fleet_snapshot(table):
+    global _FLEET_SNAPSHOT
+    _FLEET_SNAPSHOT = (time.time(), table)
+
+
+def _set_clock(offset_us, rtt_us):
+    _CLOCK[0] = offset_us
+    _CLOCK[1] = rtt_us
+    _tel.set_gauge("ps_clock_offset_us", offset_us)
+    _tel.set_gauge("ps_clock_rtt_us", rtt_us)
+
+
+def clock_offset_us():
+    """This rank's estimated trace-clock offset to the scheduler (None
+    before the first heartbeat clock exchange; 0 on the scheduler)."""
+    if _SCHEDULER_REF is not None and _SCHEDULER_REF() is not None:
+        return 0.0
+    return _CLOCK[0]
+
+
+def _local_digest():
+    """The compact telemetry digest a rank ships on fleet_sync: enough
+    for the scheduler's /fleet view, small enough for a heartbeat."""
+    gauge_names = ("step_device_us", "step_collective_us", "step_host_us",
+                   "step_data_wait_us", "overlap_ratio", "step_rate_per_s",
+                   "device_bytes_in_use", "engine_pending_tasks",
+                   "serving_queue_depth")
+    return {"pid": os.getpid(),
+            "unix_time": time.time(),
+            "steps": _flight.step_count(),
+            "telemetry": _tel.enabled(),
+            "counters": _tel.counters(),
+            "gauges": {name: _tel.gauge(name) for name in gauge_names},
+            "clock_offset_us": _CLOCK[0],
+            "clock_rtt_us": _CLOCK[1]}
 
 
 def peer_view():
@@ -458,6 +596,62 @@ def peer_view():
     return out
 
 
+def fleet_view():
+    """Fleet-wide telemetry for the introspection server's ``/fleet``.
+
+    Observe-only by contract (the /peers doctrine): the live digest
+    table when this process IS the scheduler, otherwise the snapshot the
+    heartbeat thread last cached — never a network round trip from the
+    HTTP handler.
+    """
+    out = {"role": role(),
+           "rank": _my_rank(),
+           "clock_offset_us": clock_offset_us(),
+           "clock_rtt_us": _CLOCK[1]}
+    sched = _SCHEDULER_REF() if _SCHEDULER_REF is not None else None
+    if sched is not None:
+        out["fleet"] = sched.fleet_table()
+        out["live"] = True
+        return out
+    snap = _FLEET_SNAPSHOT
+    if snap is not None:
+        out["fleet"] = dict(snap[1],
+                            snapshot_age_s=round(time.time() - snap[0], 3))
+    out["live"] = False
+    return out
+
+
+def dump_trace_artifacts(directory=None):
+    """Write this rank's Chrome trace (+ rank/clock metadata) as
+    ``trace_<role>_<rank>.json`` — the per-rank artifact
+    ``trace_report --fleet`` merges into one clock-aligned timeline.
+
+    *directory* defaults to ``MXNET_TRACE_DUMP_DIR``; returns the path,
+    or None when no directory is configured.  Called automatically at
+    role exit (scheduler/server mains, worker finalize) when the env
+    knob is set; safe to call explicitly at any point.
+    """
+    directory = directory or _ENV["trace_dump_dir"]
+    if not directory:
+        return None
+    payload = _tel.chrome_trace_payload()
+    payload["rank_meta"] = {
+        "role": role(), "rank": _my_rank(), "pid": os.getpid(),
+        "clock_offset_us": clock_offset_us(),
+        "clock_rtt_us": _CLOCK[1],
+        "steps": _flight.step_count(),
+        "unix_time": time.time()}
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory,
+                        "trace_%s_%s.json" % (role(), _my_rank()))
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    import json as _json
+    with open(tmp, "w") as fh:
+        _json.dump(payload, fh, default=repr)
+    os.replace(tmp, path)
+    return path
+
+
 def refresh_gauges():
     """Feed the ``ps_dead_peers`` gauge (called by the introspection
     sampler through ``sys.modules`` — observe-only)."""
@@ -477,9 +671,13 @@ def refresh_gauges():
 
 def _start_heartbeat(role_name, rank):
     """Daemon thread: a dedicated scheduler connection carrying periodic
-    one-way ``heartbeat`` frames (and, every few ticks, a ``peers``
-    request whose reply feeds the cached /peers snapshot).  Returns a
-    stop Event, or None when heartbeats are disabled."""
+    one-way ``heartbeat`` frames and, every few ticks, a ``fleet_sync``
+    exchange — this rank's telemetry digest out; the peer table, the
+    fleet digest table, and the scheduler's trace clock back.  The
+    round-trip also estimates this rank's clock offset to the scheduler
+    (RTT-midpoint: the scheduler stamped its clock mid-flight, so local
+    time ``t0 + rtt/2`` corresponds to that stamp; error ≤ rtt/2).
+    Returns a stop Event, or None when heartbeats are disabled."""
     env = _ENV
     if env["heartbeat"] <= 0:
         return None
@@ -499,10 +697,15 @@ def _start_heartbeat(role_name, rank):
                 conn.send(("heartbeat",))
                 _tel.bump("ps_heartbeats")
                 if tick % 5 == 0:
-                    conn.send(("peers",))
+                    t0 = _tel.now_us()
+                    conn.send(("fleet_sync", _local_digest()))
                     reply = conn.recv(timeout=max(env["dead_after"], 5.0))
-                    if reply and reply[0] == "peers":
+                    if reply and reply[0] == "fleet_sync":
+                        rtt = _tel.now_us() - t0
                         _set_peer_snapshot(reply[1])
+                        _set_fleet_snapshot(reply[2])
+                        _set_clock(reply[3] - (t0 + rtt / 2.0), rtt)
+                        _tel.bump("ps_fleet_syncs")
             except (OSError, ConnectionError):
                 return                 # scheduler gone; RPCs will notice
         conn.close()
@@ -551,6 +754,7 @@ class Scheduler:
         self.dead_workers = set()
         self.dead_servers = set()
         self._hb = {}             # (role, rank) -> last monotonic
+        self._fleet = {}          # (role, rank) -> (monotonic, digest)
         self._done = threading.Event()
         _SCHEDULER_REF = weakref.ref(self)
         _register_node("scheduler", 0, self._node_info)
@@ -586,6 +790,21 @@ class Scheduler:
             return {"nworkers": self.nworkers, "nservers": self.nservers,
                     "workers": workers, "servers": servers,
                     "barrier_waiters": len(self._barrier_waiters)}
+
+    def fleet_table(self):
+        """Aggregated per-rank telemetry digests (the /fleet payload's
+        core): whatever each rank last shipped on its heartbeat link,
+        plus this scheduler's own clock so readers can re-anchor."""
+        now = time.monotonic()
+        with self._lock:
+            ranks = {"%s-%s" % key: dict(digest,
+                                         digest_age_s=round(now - at, 3))
+                     for key, (at, digest) in sorted(self._fleet.items())}
+        return {"nworkers": self.nworkers, "nservers": self.nservers,
+                "ranks": ranks,
+                "scheduler": {"pid": os.getpid(),
+                              "now_us": round(_tel.now_us(), 1),
+                              "steps": _flight.step_count()}}
 
     def run(self):
         # Accept until shutdown rather than counting to N connections: a
@@ -682,6 +901,19 @@ class Scheduler:
                     conn.send(("peers", self.peer_table()))
                 except (OSError, ConnectionError):
                     return
+            elif msg and msg[0] == "fleet_sync":
+                if len(msg) > 1 and isinstance(msg[1], dict):
+                    with self._lock:
+                        self._fleet[key] = (time.monotonic(), msg[1])
+                try:
+                    # the clock stamp goes LAST in the handler so the
+                    # peer's rtt/2 midpoint brackets it as tightly as
+                    # the transport allows
+                    conn.send(("fleet_sync", self.peer_table(),
+                               self.fleet_table(),
+                               round(_tel.now_us(), 1)))
+                except (OSError, ConnectionError):
+                    return
 
     # -- registration + control --------------------------------------------
 
@@ -770,6 +1002,9 @@ class Scheduler:
                 continue
             if msg[0] == "peers":
                 conn.send(("peers", self.peer_table()))
+                continue
+            if msg[0] == "fleet":
+                conn.send(("fleet", self.fleet_table()))
                 continue
             if msg[0] == "barrier":
                 fail = None
@@ -1063,6 +1298,10 @@ def _int_key(k):
 
 def run_scheduler():
     Scheduler(num_workers(), num_servers()).run()
+    try:
+        dump_trace_artifacts()
+    except Exception:
+        pass
 
 
 def run_server():
@@ -1098,6 +1337,10 @@ def run_server():
         hb_stop.set()
     stop.set()
     lsock.close()
+    try:
+        dump_trace_artifacts()
+    except Exception:
+        pass
 
 
 def _check(reply):
@@ -1124,11 +1367,9 @@ class WorkerTransport:
 
     def __init__(self):
         self.sched = Conn.connect(root_addr())
-        rank_hint = (os.environ.get("DMLC_WORKER_RANK")
-                     or os.environ.get("OMPI_COMM_WORLD_RANK")
-                     or os.environ.get("PMI_RANK"))
-        self.sched.send(("reg_worker",
-                         int(rank_hint) if rank_hint is not None else None))
+        # read fresh (not the import-time _RANK_HINT cache): transports
+        # are constructed once, and tests set the env late
+        self.sched.send(("reg_worker", _parse_rank_hint()))
         # rendezvous waits for the full roster: deliberately unbounded
         msg = self.sched.recv(timeout=None)
         assert msg[0] == "ranked"
@@ -1277,6 +1518,15 @@ class WorkerTransport:
         _set_peer_snapshot(msg[1])
         return msg[1]
 
+    def fleet_health(self):
+        """The scheduler's live fleet digest table (also cached for the
+        /fleet endpoint — the deterministic, heartbeat-free way for a
+        worker to refresh its fleet view)."""
+        msg = self._sched_rpc(("fleet",))
+        assert msg[0] == "fleet"
+        _set_fleet_snapshot(msg[1])
+        return msg[1]
+
     def refresh_servers(self, timeout=60.0):
         """Re-resolve the server address list and redial every server.
 
@@ -1347,6 +1597,10 @@ class WorkerTransport:
         for c in self.server_conns:
             c.close()
         self.sched.close()
+        try:      # MXNET_TRACE_DUMP_DIR: leave the --fleet artifact
+            dump_trace_artifacts()
+        except Exception:
+            pass
 
     # -- kv ops -------------------------------------------------------------
 

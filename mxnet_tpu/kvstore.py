@@ -42,6 +42,12 @@ def _stack_sum(arrs):
 
 _stack_sum = _tel.watch_jit(jax.jit(_stack_sum), "kvstore_stack_sum")
 
+# every kvstore-owned program is collective communication for the
+# device-time step decomposition: blocked time under these names lands
+# in the step timeline's collective segment (and overlap_ratio's
+# denominator), not device-compute
+_tel.device.register_collective("kvstore")
+
 
 def _nd_nbytes(arr):
     return arr.size * arr.dtype.itemsize

@@ -196,10 +196,16 @@ class CircuitBreaker:
 class _Request:
     """One in-flight predict request: host inputs + a completion event.
     *deadline* (perf_counter seconds, None = none) bounds its QUEUE
-    time: the scheduler drops it un-run once passed."""
+    time: the scheduler drops it un-run once passed.
+
+    Every request carries a *trace_id* (surfaced in the HTTP response)
+    and, once dispatched, its span decomposition in *segments*:
+    ``queue_wait_us`` (submit → batch dispatch, per request) plus the
+    shared batch segments ``pad_us`` / ``execute_us`` / ``slice_us`` —
+    what lets serve_bench attribute a p99 to queueing vs execution."""
 
     __slots__ = ("inputs", "n", "t_submit", "t_done", "outputs", "error",
-                 "deadline", "_done")
+                 "deadline", "trace_id", "segments", "_done")
 
     def __init__(self, inputs, n, timeout_s=None):
         self.inputs = inputs
@@ -210,6 +216,8 @@ class _Request:
         self.error = None
         self.deadline = None if not timeout_s \
             else self.t_submit + timeout_s
+        self.trace_id = _telemetry.new_trace_id()
+        self.segments = {}
         self._done = threading.Event()
 
     def wait(self, timeout=None):
@@ -452,23 +460,40 @@ class ContinuousBatcher:
     def _run_batch(self, program, batch, total):
         """Execute one coalesced batch and split results per request.
         Never raises: failures land in the request futures."""
+        # per-request queue-wait resolves at dispatch, before any work:
+        # the decomposition must hold even when the batch then fails
+        t_dispatch = time.perf_counter()
+        for req in batch:
+            wait_us = (t_dispatch - req.t_submit) * 1e6
+            req.segments["queue_wait_us"] = wait_us
+            _telemetry.observe("serving_queue_wait_us", wait_us)
+            if self._metrics is not None:
+                self._metrics.queue_wait(wait_us)
+        timings = {}
+        trace_ids = [req.trace_id for req in batch]
         try:
-            if _chaos.active():
-                act = _chaos.decide("serving.batch")
-                if act is not None:
-                    _chaos.apply_inline(act)
-            if len(batch) == 1:
-                inputs = batch[0].inputs
-            else:
-                import numpy as np
-                names = list(batch[0].inputs)
-                inputs = {name: np.concatenate(
-                    [req.inputs[name] for req in batch], axis=0)
-                    for name in names}
-            if total > program.max_batch:
-                outs, bucket, cost = program.run_straight(inputs, total)
-            else:
-                outs, bucket, cost = program.run(inputs, total)
+            with _telemetry.span("serving_run_batch", cat="serving",
+                                 args={"rows": total,
+                                       "requests": len(batch),
+                                       "trace_ids": trace_ids}):
+                if _chaos.active():
+                    act = _chaos.decide("serving.batch")
+                    if act is not None:
+                        _chaos.apply_inline(act)
+                if len(batch) == 1:
+                    inputs = batch[0].inputs
+                else:
+                    import numpy as np
+                    names = list(batch[0].inputs)
+                    inputs = {name: np.concatenate(
+                        [req.inputs[name] for req in batch], axis=0)
+                        for name in names}
+                if total > program.max_batch:
+                    outs, bucket, cost = program.run_straight(
+                        inputs, total)
+                else:
+                    outs, bucket, cost = program.run(inputs, total,
+                                                     timings=timings)
         except BaseException as exc:  # noqa: BLE001 — futures carry it
             self._breaker.record(ok=False)
             if self._metrics is not None:
@@ -480,6 +505,7 @@ class ContinuousBatcher:
                 req._finish(error=err)
             return
         self._breaker.record(ok=True)
+        self._book_segments(batch, timings, trace_ids)
         # book ALL accounting BEFORE waking any waiter: a client reading
         # counters/stats the instant predict() returns must see this
         # batch (the futures' latency stamp is taken here, so the booked
@@ -502,3 +528,32 @@ class ContinuousBatcher:
                                 cost=cost, n_requests=len(batch))
         for req, outputs in zip(batch, slices):
             req._finish(outputs=outputs)
+
+    def _book_segments(self, batch, timings, trace_ids):
+        """Attach the batch's pad/execute/slice segments to every rider
+        and land them as child trace events under serving_run_batch."""
+        if not timings:
+            return                    # straight-through path: no pads
+        execute_us = timings.get("execute_us", 0.0)
+        _telemetry.observe("serving_execute_us", execute_us)
+        for req in batch:
+            req.segments.update(timings)
+        if self._metrics is not None:
+            self._metrics.execute(execute_us)
+        if not _telemetry.trace_active():
+            return
+        # reconstruct the child spans from the measured segment walls:
+        # they tile the tail of the batch span ending now
+        end = _telemetry.now_us()
+        args = {"trace_ids": trace_ids}
+        t_slice = end - timings.get("slice_us", 0.0)
+        t_exec = t_slice - execute_us
+        t_pad = t_exec - timings.get("pad_us", 0.0)
+        _telemetry.add_event("serving_pad", "serving", t_pad,
+                             timings.get("pad_us", 0.0), args=args)
+        _telemetry.add_event("serving_execute", "serving", t_exec,
+                             execute_us,
+                             args=dict(args, device_blocked=timings.get(
+                                 "device_blocked", False)))
+        _telemetry.add_event("serving_slice", "serving", t_slice,
+                             timings.get("slice_us", 0.0), args=args)

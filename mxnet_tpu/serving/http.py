@@ -140,6 +140,14 @@ def _predict(registry, name, body):
         "model": name,
         "batch": request.n,
         "latency_us": round(request.latency_us, 1),
+        # request tracing: the id joins this request to its spans in the
+        # process trace (/trace) and any fleet-merged timeline; the
+        # segments say where the latency went (queue vs pad/execute/
+        # slice — the batch-shared segments ride on every coalesced
+        # member)
+        "trace_id": request.trace_id,
+        "spans": {key: round(val, 1) if isinstance(val, float) else val
+                  for key, val in sorted(request.segments.items())},
         "outputs": {out_name: out.tolist() for out_name, out
                     in zip(slot.program.output_names, outs)},
     })

@@ -263,11 +263,18 @@ class PredictProgram:
             raise MXNetError("batch has %d rows, expected %d" % (rows, n))
         return {name: arrs[name] for name in self._input_names}
 
-    def run(self, inputs, n):
+    def run(self, inputs, n, timings=None):
         """Pad a batch of *n* rows to its bucket and execute the AOT
         executable.  Returns ``(outputs, bucket, cost)`` with outputs a
         list of per-output numpy arrays sliced back to *n* rows.  No
-        tracing happens here, ever."""
+        tracing happens here, ever.
+
+        *timings* (optional dict) is filled with the request-span
+        decomposition: ``pad_us`` (host pad + device_put),
+        ``execute_us`` (the executable call — dispatch wall normally;
+        true device time when the MXNET_DEVICE_TIME sampler blocks this
+        batch, flagged ``device_blocked``), ``slice_us`` (result
+        host-transfer + per-request slicing)."""
         import jax
         b = self.bucket_for(n)
         if b is None:
@@ -282,13 +289,33 @@ class PredictProgram:
                 self._variants.setdefault(b, variant)
             _telemetry.bump("serving_warmup_compiles")
         compiled, fixed, cost = variant
+        t0 = _telemetry.now_us()
         vals = self._gather_inputs(inputs, n)
         arg_vals = list(fixed)
         for name in self._input_names:
             arg_vals[self._arg_pos[name]] = jax.device_put(
                 _pad_rows(vals[name], b), self._dev)
+        t1 = _telemetry.now_us()
         outs, _new_aux = compiled(arg_vals, self._aux_vals, self._key)
-        return [np.asarray(o)[:n] for o in outs], b, cost
+        blocked = _telemetry.device.take_serving_sample()
+        if blocked:
+            # sampled batch: wait for the device so execute_us is true
+            # execution time (and book it in the device-time table, the
+            # serving twin of the watched-jit sampler)
+            jax.block_until_ready(outs)
+        t2 = _telemetry.now_us()
+        sliced = [np.asarray(o)[:n] for o in outs]
+        t3 = _telemetry.now_us()
+        if blocked:
+            _telemetry.device.record_program(
+                "serving:%s:b%d" % (self.name, b), t2 - t1,
+                collective=False)
+        if timings is not None:
+            timings["pad_us"] = t1 - t0
+            timings["execute_us"] = t2 - t1
+            timings["slice_us"] = t3 - t2
+            timings["device_blocked"] = blocked
+        return sliced, b, cost
 
     def run_straight(self, inputs, n):
         """Oversize escape hatch: run *n* rows unpadded through the

@@ -37,6 +37,9 @@ class SlotMetrics:
                         "padded_rows": 0, "overloads": 0, "errors": 0,
                         "deadline_drops": 0, "breaker_shed": 0}
         self._latency = _telemetry.Histogram("latency_us")
+        # the request-span decomposition: where the latency above went
+        self._queue_wait = _telemetry.Histogram("queue_wait_us")
+        self._execute = _telemetry.Histogram("execute_us")
         self._occupancy_sum = 0.0
         self._flops = 0.0
         self.t_loaded = time.perf_counter()
@@ -47,6 +50,12 @@ class SlotMetrics:
 
     def latency(self, us):
         self._latency.observe(us)
+
+    def queue_wait(self, us):
+        self._queue_wait.observe(us)
+
+    def execute(self, us):
+        self._execute.observe(us)
 
     def batch(self, rows, bucket, padded, cost=None, n_requests=1):
         with self._lock:
@@ -75,14 +84,19 @@ class SlotMetrics:
                     mfu = flops / (elapsed * peak)
             except Exception:
                 pass
+        def _pcts(hist):
+            return {"p50": hist.percentile(50),
+                    "p90": hist.percentile(90),
+                    "p99": hist.percentile(99),
+                    "mean": (hist.total / hist.count)
+                    if hist.count else 0.0,
+                    "count": hist.count}
+
         lat = self._latency
         return dict(counts, **{
-            "latency_us": {"p50": lat.percentile(50),
-                           "p90": lat.percentile(90),
-                           "p99": lat.percentile(99),
-                           "mean": (lat.total / lat.count)
-                           if lat.count else 0.0,
-                           "count": lat.count},
+            "latency_us": _pcts(lat),
+            "queue_wait_us": _pcts(self._queue_wait),
+            "execute_us": _pcts(self._execute),
             "batch_occupancy_mean": (occ_sum / batches) if batches else None,
             "model_flops_total": flops,
             "mfu_since_load": mfu,

@@ -25,7 +25,7 @@ iff ``MXNET_HANG_DUMP_SECS`` is set, and the HTTP server starts iff
 """
 from __future__ import annotations
 
-from . import core, costs, flight, server          # noqa: F401
+from . import core, costs, device, flight, server  # noqa: F401
 from .core import *                                # noqa: F401,F403
 from .core import (_set_profiler_running,          # noqa: F401  (profiler)
                    current_span, refresh_from_env, retrace_limit)
@@ -37,7 +37,7 @@ from .server import (health, start_server,         # noqa: F401
 
 __all__ = list(core.__all__) + [
     "current_span", "refresh_from_env", "retrace_limit",
-    "core", "costs", "flight", "server",
+    "core", "costs", "device", "flight", "server",
     "dump_flight", "install_crash_hooks", "start_hang_watchdog",
     "thread_stacks", "health", "start_server", "stop_server",
 ]

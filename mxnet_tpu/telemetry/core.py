@@ -71,7 +71,9 @@ __all__ = ["enabled", "set_enabled", "configure", "trace_active",
            "dump_chrome_trace", "chrome_trace_payload", "prometheus_text",
            "snapshot", "dump_snapshot", "reset", "sample_memory",
            "program_cost", "program_costs",
-           "COUNTERS", "GAUGES", "HISTOGRAMS", "METRIC_NAMES"]
+           "trace_context", "set_trace_context", "reset_trace_context",
+           "new_trace_id", "new_span_id",
+           "COUNTERS", "GAUGES", "HISTOGRAMS", "SPANS", "METRIC_NAMES"]
 
 _LOG = logging.getLogger("mxnet_tpu.telemetry")
 
@@ -110,6 +112,14 @@ _ENABLED = _env_enabled()
 _RETRACE_LIMIT = _env_retrace_limit()
 _TRACECHECK = _env_tracecheck()
 _PROF_RUNNING = False          # mirrored by profiler.set_state
+# mirrored by telemetry.device (MXNET_DEVICE_TIME): the watched-jit hot
+# path gates the sampled device-timing hook on this one module global
+_DEVICE_TIME = False
+
+
+def _set_device_time(flag):
+    global _DEVICE_TIME
+    _DEVICE_TIME = bool(flag)
 
 
 def enabled():
@@ -137,13 +147,16 @@ def configure(enabled=None, retrace_limit=None, max_events=None):
 
 def refresh_from_env():
     """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_RETRACE_LIMIT /
-    MXNET_TRACECHECK (and, when the cost module is loaded, its
-    MXNET_PEAK_* overrides)."""
+    MXNET_TRACECHECK / MXNET_DEVICE_TIME (and, when the cost module is
+    loaded, its MXNET_PEAK_* overrides)."""
     global _ENABLED, _RETRACE_LIMIT, _TRACECHECK
     _ENABLED = _env_enabled()
     _RETRACE_LIMIT = _env_retrace_limit()
     _TRACECHECK = _env_tracecheck()
     _costs().refresh_from_env()
+    dev = sys.modules.get("mxnet_tpu.telemetry.device")
+    if dev is not None:
+        dev.refresh_from_env()
 
 
 def retrace_limit():
@@ -180,9 +193,10 @@ _t0 = time.perf_counter()
 # dump time from the highest-priority category the tid hosted.
 _CAT_TRACK = {"operator": "eager-dispatch", "program": "executor",
               "step": "train-step", "kvstore": "kvstore", "io": "data-io",
-              "compile": "jit-compile", "user": "user"}
-_CAT_PRIORITY = ("step", "program", "kvstore", "io", "operator",
-                 "compile", "user")
+              "compile": "jit-compile", "serving": "serving",
+              "rpc": "dist-rpc", "user": "user"}
+_CAT_PRIORITY = ("step", "serving", "program", "kvstore", "io",
+                 "operator", "rpc", "compile", "user")
 
 
 def now_us():
@@ -235,6 +249,47 @@ def current_span():
     return stack[-1] if stack else None
 
 
+# --------------------------------------------------------------------------
+# trace context (distributed tracing)
+# --------------------------------------------------------------------------
+#
+# One trace id names one logical unit of work across processes: a
+# training step (minted by its step span), a serving request (minted at
+# submit), an RPC (minted per frame when nothing is active).  dist_ps
+# propagates it on the wire; trace_report --fleet joins the per-rank
+# traces back together on it.
+
+_TRACE_CTX = contextvars.ContextVar("mxnet_tpu_trace_id", default=None)
+
+
+def trace_context():
+    """The active trace id on this context (None outside any trace)."""
+    return _TRACE_CTX.get()
+
+
+def set_trace_context(trace_id):
+    """Adopt *trace_id* (e.g. one received over the wire); returns the
+    reset token."""
+    return _TRACE_CTX.set(trace_id)
+
+
+def reset_trace_context(token):
+    try:
+        _TRACE_CTX.reset(token)
+    except ValueError:        # token from another context: best effort
+        pass
+
+
+def new_trace_id():
+    """16-hex-char process-unique trace id."""
+    return os.urandom(8).hex()
+
+
+def new_span_id():
+    """8-hex-char span id (send/recv flow pairing)."""
+    return os.urandom(4).hex()
+
+
 class span:
     """Hierarchical timed span: ``with telemetry.span("trainer_step"): ...``
 
@@ -251,7 +306,7 @@ class span:
     """
 
     __slots__ = ("_name", "_cat", "_hist", "_memory", "_args",
-                 "_on", "_t0", "_tok", "_parent")
+                 "_on", "_t0", "_tok", "_parent", "_trace_tok")
 
     def __init__(self, name, cat="user", hist=None, memory=False, args=None):
         self._name = name
@@ -263,12 +318,27 @@ class span:
     def __enter__(self):
         if not trace_active():
             self._on = False
+            self._t0 = None
+            if _DEVICE_TIME and self._cat == "step":
+                # device-time attribution works with the trace buffer
+                # off: the window still opens so sampled programs are
+                # decomposed (the span itself records nothing)
+                _open_step_window()
+                self._t0 = now_us()
             return self
         self._on = True
         stack = _SPAN_STACK.get()
         self._parent = stack[-1] if stack else None
         self._tok = _SPAN_STACK.set(stack + (self._name,))
+        self._trace_tok = None
         if self._cat == "step":
+            # one trace id per step: RPCs issued inside (kvstore push/
+            # pull over dist_ps) inherit it, so --fleet can join the
+            # step's spans across ranks.  Steps are trace ROOTS — mint
+            # unconditionally: an ambient id adopted from an earlier
+            # RPC reply (recv sets the contextvar) must not glue every
+            # step of the run into one giant trace
+            self._trace_tok = _TRACE_CTX.set(new_trace_id())
             _open_step_window()
         self._t0 = now_us()
         return self
@@ -279,6 +349,11 @@ class span:
             # ticks for coarse spans (step/program exits are what the
             # hang watchdog and /healthz reason about) — one string
             # compare, no timing, no lock
+            # gate on the OPENED window (_t0), not the live flag:
+            # disabling device timing mid-span must not leak the step
+            # depth the matching open incremented
+            if self._t0 is not None:
+                _close_step_window(now_us() - self._t0)
             if self._cat in ("step", "program"):
                 _flight.note_span(self._name, self._cat)
             return False
@@ -286,12 +361,17 @@ class span:
         _SPAN_STACK.reset(self._tok)
         args = {"parent": self._parent,
                 "depth": len(_SPAN_STACK.get())}
+        trace_id = _TRACE_CTX.get()
+        if trace_id is not None:
+            args["trace_id"] = trace_id
         if self._args:
             args.update(self._args)
         add_event(self._name, self._cat, self._t0, dur, args=args)
         _flight.note_span(self._name, self._cat, dur)
         if self._cat == "step":
             _close_step_window(dur)
+            if self._trace_tok is not None:
+                reset_trace_context(self._trace_tok)
         if self._hist is not None and _ENABLED:
             observe(self._hist, dur)
         if self._memory and _ENABLED:
@@ -389,6 +469,11 @@ COUNTERS = {
     "metric_nonfinite_updates": "EvalMetric updates excluded from "
                                 "running sums because their "
                                 "contribution was NaN/Inf",
+    "device_time_samples": "watched-jit calls block_until_ready-timed "
+                           "by the MXNET_DEVICE_TIME sampler",
+    "ps_fleet_syncs": "fleet_sync exchanges completed on the heartbeat "
+                      "link (digest out, peer/fleet tables + scheduler "
+                      "clock back)",
 }
 
 GAUGES = {
@@ -444,6 +529,21 @@ GAUGES = {
                                        "replicated layout would hold "
                                        "per device (the ZeRO-1 "
                                        "denominator)",
+    "step_data_wait_us": "data-wait segment of the last sampled step "
+                         "timeline (io_batch_wait at window open)",
+    "step_host_us": "host-gap segment of the last sampled step timeline "
+                    "(wall minus device minus collective)",
+    "step_device_us": "device-compute segment of the last sampled step "
+                      "timeline (blocked compute-program time)",
+    "step_collective_us": "collective-comm segment of the last sampled "
+                          "step timeline (blocked kvstore-program time)",
+    "overlap_ratio": "fraction of the last sampled step's collective "
+                     "time hidden under compute (0-1; the ROADMAP "
+                     "item-2 win condition)",
+    "ps_clock_offset_us": "this rank's estimated trace-clock offset to "
+                          "the dist scheduler (RTT-midpoint method)",
+    "ps_clock_rtt_us": "round-trip time of the last scheduler clock "
+                       "exchange (offset error is bounded by RTT/2)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)
@@ -464,10 +564,42 @@ HISTOGRAMS = {
     "serving_batch_occupancy": ("dispatched rows as a percent of bucket "
                                 "capacity per serving batch",
                                 _PCT_BUCKETS),
+    "device_time_us": ("sampled per-program device execution time "
+                       "(block-until-ready delta)", _US_BUCKETS),
+    "serving_queue_wait_us": ("request queue wait, submit to batch "
+                              "dispatch", _US_BUCKETS),
+    "serving_execute_us": ("serving batch execute segment (dispatch "
+                           "wall; true device time on sampled batches "
+                           "under MXNET_DEVICE_TIME)", _US_BUCKETS),
+}
+
+# Span names the framework itself emits (``span("...")`` literals).
+# Declared for the same reason the metrics are: a typo'd span name
+# silently splits trace_report's self-time series, so the static gate
+# in tests/test_telemetry.py checks every literal against this table.
+# (Dynamic span names — the executor's per-program labels — are booked
+# through watch_jit names instead and are out of the literal gate's
+# reach by construction.)
+SPANS = {
+    "trainer_step": "one Trainer.step (the step-timeline anchor)",
+    "data_batch": "one data-iterator batch production (io tier)",
+    "module_train_step": "one Module cached train step",
+    "module_step_program": "the module step's fused program call",
+    "kvstore_push_pull": "gradient reduce round inside a step",
+    "kvstore_bucket_reduce": "one bucketed reduce program (also a "
+                             "counter)",
+    "optimizer_update": "eager per-slot optimizer update",
+    "fused_optimizer_step": "the fused whole-model update program",
+    "serving_run_batch": "one coalesced serving batch, dispatch to "
+                         "futures resolved",
+    "serving_pad": "pad + device_put segment of a serving batch",
+    "serving_execute": "executable-call segment of a serving batch",
+    "serving_slice": "result slice/host-transfer segment of a serving "
+                     "batch",
 }
 
 METRIC_NAMES = frozenset(COUNTERS) | frozenset(GAUGES) \
-    | frozenset(HISTOGRAMS)
+    | frozenset(HISTOGRAMS) | frozenset(SPANS)
 
 
 class Counter:
@@ -638,10 +770,10 @@ class _WatchedJit:
         self._max_seen = 0
 
     def __call__(self, *args, **kwargs):
-        # MXNET_TRACECHECK rides the same compile-event detection even
-        # with telemetry off (its findings are counter-booked, and
-        # counters are always on)
-        if not (_ENABLED or _TRACECHECK):
+        # MXNET_TRACECHECK and MXNET_DEVICE_TIME ride the same wrapper
+        # even with telemetry off (findings/samples are counter-booked,
+        # and counters are always on)
+        if not (_ENABLED or _TRACECHECK or _DEVICE_TIME):
             return self._fn(*args, **kwargs)
         size_fn = getattr(self._fn, "_cache_size", None)
         if size_fn is None:
@@ -650,6 +782,13 @@ class _WatchedJit:
         t0 = now_us()
         out = self._fn(*args, **kwargs)
         after = size_fn()
+        if _DEVICE_TIME and after == before:
+            # sampled device timing: block on the outputs so the wall
+            # delta ≈ dispatch + device execution.  Fresh-compile calls
+            # are excluded (trace+compile wall would pollute the
+            # device-time series), and no extra XLA program ever runs —
+            # block_until_ready only waits.
+            _device().maybe_time(self._name, t0, out)
         if after > before:
             # dedupe concurrent observers of one compile: only the call
             # that advances the high-water cache size books it
@@ -732,6 +871,17 @@ def _costs():
     return _costs_mod
 
 
+_device_mod = None
+
+
+def _device():
+    global _device_mod
+    if _device_mod is None:
+        from . import device as _device_mod_  # noqa: PLC0415
+        _device_mod = _device_mod_
+    return _device_mod
+
+
 def _capture_cost(fn, name, args, kwargs):
     """Ask XLA what the freshly compiled program costs; never raises."""
     try:
@@ -760,6 +910,8 @@ def _open_step_window():
     _STEP_DEPTH += 1
     if _STEP_DEPTH == 1:
         _STEP_WINDOW = [0.0, 0.0]
+        if _DEVICE_TIME:
+            _device().open_step_window()
 
 
 def _close_step_window(dur_us):
@@ -775,6 +927,8 @@ def _close_step_window(dur_us):
             _costs().finalize_step(win[0], win[1], dur_us)
         except Exception:
             pass
+    if _DEVICE_TIME:
+        _device().close_step_window(dur_us)
     _sample_engine_pending()
 
 
@@ -1025,13 +1179,19 @@ def snapshot(lock_timeout=None):
             _mlock.release()
     costs_ = {"programs": program_costs(),
               "peaks": _costs().peaks_if_resolved()}
-    return {"enabled": _ENABLED,
+    snap = {"enabled": _ENABLED,
             "retrace_limit": _RETRACE_LIMIT,
             "counters": counters_,
             "gauges": gauges_,
             "histograms": hists_,
             "retraces": retrace_report(lock_timeout),
             "costs": costs_}
+    if _DEVICE_TIME:
+        try:
+            snap["device"] = _device().device_report()
+        except Exception:     # a post-mortem snapshot must never fail
+            pass
+    return snap
 
 
 def dump_snapshot(filename):
@@ -1055,4 +1215,7 @@ def reset():
     _PROGRAM_COSTS.clear()
     _STEP_WINDOW = None
     _STEP_DEPTH = 0
+    dev = sys.modules.get("mxnet_tpu.telemetry.device")
+    if dev is not None:
+        dev.reset()
     _flight.reset()

@@ -67,7 +67,19 @@ def _env_hang_secs():
         return 0.0
 
 
+DEFAULT_KEEP = 8
+
+
+def _env_keep():
+    try:
+        return max(0, int(os.environ.get("MXNET_FLIGHT_KEEP",
+                                         DEFAULT_KEEP)))
+    except ValueError:
+        return DEFAULT_KEEP
+
+
 _CAPACITY = _env_capacity()
+_KEEP = _env_keep()
 _ring = deque(maxlen=_CAPACITY or 1)
 _DUMP_DIR = os.environ.get("MXNET_FLIGHT_DIR", "") or None
 
@@ -91,13 +103,15 @@ def capacity():
     return _CAPACITY
 
 
-def configure(max_events=None):
-    """Resize (or 0-disable) the ring; tests and notebooks."""
-    global _CAPACITY, _ring
+def configure(max_events=None, keep=None):
+    """Resize (or 0-disable) the ring / retention; tests and notebooks."""
+    global _CAPACITY, _KEEP, _ring
     if max_events is not None:
         _CAPACITY = max(0, int(max_events))
         _ring = deque(list(_ring)[-(_CAPACITY or 1):],
                       maxlen=_CAPACITY or 1)
+    if keep is not None:
+        _KEEP = max(0, int(keep))
 
 
 # --------------------------------------------------------------------------
@@ -206,17 +220,54 @@ def payload(reason):
             "stacks": thread_stacks()}
 
 
+def _sweep_old_dumps(directory, keep_path):
+    """Retention: keep the newest ``MXNET_FLIGHT_KEEP`` flight dumps in
+    *directory*, deleting oldest-first (by mtime).  A long-lived host
+    that restarts workers for months accumulates one ``flight_<pid>``
+    per incarnation; eight post-mortems back is plenty.  Only files
+    matching the exact ``flight_<digits>.json`` pattern are candidates,
+    the file just written never is, and every error is swallowed — the
+    sweep must not turn a crash dump into a second crash."""
+    if not _KEEP:
+        return
+    try:
+        candidates = []
+        for name in os.listdir(directory):
+            if not (name.startswith("flight_") and name.endswith(".json")
+                    and name[7:-5].isdigit()):
+                continue
+            path = os.path.join(directory, name)
+            if path == keep_path:
+                continue
+            try:
+                candidates.append((os.path.getmtime(path), path))
+            except OSError:
+                continue
+        # keep_path occupies one retention slot
+        excess = len(candidates) - (_KEEP - 1)
+        for _, path in sorted(candidates)[:max(0, excess)]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+    except Exception:
+        pass
+
+
 def dump(reason="manual", directory=None):
     """Write ``flight_<pid>.json`` (MXNET_FLIGHT_DIR or cwd); returns the
     path.  One file per pid — a later dump (e.g. the excepthook after a
     hang dump) overwrites with the more recent state, atomically via a
-    same-directory rename so a reader never sees a torn file."""
+    same-directory rename so a reader never sees a torn file.  After the
+    write, dumps beyond ``MXNET_FLIGHT_KEEP`` (default 8) are swept
+    oldest-first."""
     directory = directory or _DUMP_DIR or os.getcwd()
     path = os.path.join(directory, "flight_%d.json" % os.getpid())
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(payload(reason), f, indent=1, default=repr)
     os.replace(tmp, path)
+    _sweep_old_dumps(directory, path)
     try:
         # best-effort counter bump: same signal-context rule as above —
         # never block on a lock the interrupted main thread may hold

@@ -80,16 +80,53 @@ _STALL_SECS = _env_stall_secs()
 # health verdict
 # --------------------------------------------------------------------------
 
+def _guardian_health():
+    """Guardian contribution to the 503 criteria — observe-only
+    (``sys.modules`` lookup; a process without an installed guardian
+    contributes nothing).  Unhealthy when the consecutive-skip budget is
+    exhausted (rollback imminent or, with no manager, the job is
+    spinning on poisoned batches) or a rollback is in progress (the last
+    step's verdict forced a restore and no applied step has landed
+    since)."""
+    gmod = sys.modules.get("mxnet_tpu.guardian")
+    if gmod is None:
+        return None
+    try:
+        guard = gmod.current()
+    except Exception:
+        return None
+    if guard is None:
+        return None
+    try:
+        desc = guard.describe()
+    except Exception:
+        return None
+    skips = int(desc.get("consecutive_skips") or 0)
+    budget = int(desc.get("max_skips") or 0)
+    exhausted = budget > 0 and skips >= budget
+    rolling_back = desc.get("last_action") == "rollback"
+    return {"ok": not (exhausted or rolling_back),
+            "consecutive_skips": skips,
+            "max_skips": budget,
+            "skip_budget_exhausted": exhausted,
+            "rollback_in_progress": rolling_back,
+            "last_action": desc.get("last_action"),
+            "rollbacks": core.counter("guardian_rollbacks")}
+
+
 def health():
     """(ok, detail-dict).  Healthy means: if training has started, a step
     landed within MXNET_HEALTH_STALL_SECS; no retrace storm; no sanitizer
-    violations.  A process that never steps (pure inference, a notebook)
-    is healthy by the step criterion."""
+    violations; and no installed guardian reporting an exhausted skip
+    budget or an in-progress rollback.  A process that never steps (pure
+    inference, a notebook) is healthy by the step criterion."""
     age = flight.last_step_age()
     stalled = age is not None and age > _STALL_SECS
     storms = core.counter("retrace_storms")
     violations = core.counter("sanitizer_violations")
-    ok = not stalled and storms == 0 and violations == 0
+    guardian = _guardian_health()
+    ok = not stalled and storms == 0 and violations == 0 \
+        and (guardian is None or guardian["ok"])
     return ok, {
         "ok": ok,
         "steps": {"count": flight.step_count(),
@@ -99,6 +136,7 @@ def health():
                   "stall_limit_s": _STALL_SECS},
         "retrace_storms": storms,
         "sanitizer_violations": violations,
+        "guardian": guardian,
         "engine_pending_tasks": core.gauge("engine_pending_tasks"),
         "flight_dumps": core.counter("flight_dumps"),
     }
@@ -110,7 +148,7 @@ def health():
 
 _INDEX = ("mxnet_tpu introspection\n"
           "endpoints: /metrics /healthz /snapshot /trace /flight /stacks "
-          "/checkpoints /peers /guardian\n"
+          "/checkpoints /peers /fleet /guardian\n"
           "serving:   /v1/models  /v1/models/<name>[/predict|/load|"
           "/unload|/reload]\n")
 
@@ -198,6 +236,19 @@ class _Handler(BaseHTTPRequestHandler):
                                   "(construct a TrainingGuardian)"}, 404)
                 else:
                     self._reply_json(guard.http_view())
+            elif path == "/fleet":
+                # observe-only sys.modules lookup, like /peers: reports
+                # the scheduler's live digest table in the scheduler
+                # process, the heartbeat thread's cached snapshot in a
+                # worker/server — never network IO from this handler.
+                dist = sys.modules.get("mxnet_tpu.dist_ps")
+                if dist is None:
+                    self._reply_json(
+                        {"error": "dist transport not initialized "
+                                  "(no mxnet_tpu.dist_ps in this "
+                                  "process)"}, 404)
+                else:
+                    self._reply_json(dist.fleet_view())
             elif path == "/peers":
                 # observe-only sys.modules lookup, like /checkpoints: a
                 # process that never touched the dist transport answers

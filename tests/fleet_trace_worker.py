@@ -1,0 +1,82 @@
+"""Deterministic dist worker for the fleet-trace fixture.
+
+Launched by tests/test_fleet_trace.py as scheduler + servers + workers
+(tools/launch.py runs this same script in every role; ``kv.create``
+dispatches).  Each worker runs a few push/pull rounds inside
+``trainer_step`` spans with telemetry ON, so every role's trace buffer
+fills with step spans and ``ps_send``/``ps_recv`` RPC events carrying
+propagated trace ids — and every role dumps its
+``trace_<role>_<rank>.json`` artifact into ``MXNET_TRACE_DUMP_DIR`` at
+exit (scheduler/server mains, worker finalize).  The artifacts are what
+``tools/trace_report.py --fleet`` merges; the worker additionally writes
+``result-<rank>.json`` with the trace ids it used per step so the test
+can assert the same id crossed the wire.
+
+Env contract (set by the test):
+  FLEET_STATE_DIR        shared scratch dir (results; required)
+  MXNET_TRACE_DUMP_DIR   where the per-rank artifacts land (required)
+  FLEET_ITERS            push/pull rounds (default 3)
+  MXNET_TELEMETRY=1      tracing on in every role
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_TELEMETRY", "1")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import json      # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx          # noqa: E402
+from mxnet_tpu import telemetry  # noqa: E402
+
+ITERS = int(os.environ.get("FLEET_ITERS", "3"))
+STATE = os.environ["FLEET_STATE_DIR"]
+
+KEYS = ["w0", "w1"]
+SHAPES = {"w0": (8,), "w1": (4, 4)}
+
+
+def main():
+    telemetry.set_enabled(True)
+    kv = mx.kv.create("dist_sync")        # scheduler/server roles exit in
+    rank = kv.rank                        # create(); only workers return
+    for i, k in enumerate(KEYS):
+        kv.init(k, mx.nd.ones(SHAPES[k]) * (i + 1))
+
+    step_trace_ids = []
+    for _ in range(ITERS):
+        with telemetry.span("trainer_step", cat="step",
+                            hist="step_time_us"):
+            step_trace_ids.append(telemetry.trace_context())
+            for k in KEYS:
+                kv.push(k, mx.nd.array(
+                    np.full(SHAPES[k], 0.5, np.float32)))
+            for k in KEYS:
+                out = mx.nd.zeros(SHAPES[k])
+                kv.pull(k, out=out)
+
+    fleet = None
+    try:
+        # deterministic fleet fetch (heartbeat cadence is too slow for a
+        # short fixture): also caches the snapshot for /fleet
+        fleet = kv._trans.fleet_health()
+    except Exception:
+        pass
+
+    result = {"rank": rank,
+              "step_trace_ids": step_trace_ids,
+              "fleet_ranks": sorted((fleet or {}).get("ranks", {}))}
+    path = os.path.join(STATE, "result-%d.json" % rank)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as fh:
+        json.dump(result, fh, indent=1)
+    os.replace(tmp, path)
+    print("fleet worker %d: %d steps" % (rank, ITERS), flush=True)
+
+
+if __name__ == "__main__":
+    main()

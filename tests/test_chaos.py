@@ -200,13 +200,14 @@ class _FakeProgram:
         self.fail = False
         self.runs = 0
 
-    def run(self, inputs, total):
+    def run(self, inputs, total, timings=None):
         self.runs += 1
         if self.fail:
             raise RuntimeError("injected executor failure")
         return [np.asarray(inputs["x"])], self.max_batch, None
 
-    run_straight = run
+    def run_straight(self, inputs, total):
+        return self.run(inputs, total)
 
 
 def _submit_and_wait(batcher, n=1, timeout=5.0):

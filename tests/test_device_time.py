@@ -1,0 +1,260 @@
+"""Device-time attribution (ISSUE 12) + the rider satellites.
+
+Covers the MXNET_DEVICE_TIME sampler: per-program blocked timing through
+the watched-jit wrapper, the step-timeline decomposition (data-wait /
+host / device / collective + overlap_ratio) resolved at step-span exits,
+sampling-rate periods, the zero-extra-compiles contract — plus the
+flight-dump retention sweep and the guardian-aware /healthz verdict.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import device, flight, server
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    device.configure(1)
+    yield telemetry
+    device.configure(0)
+    telemetry.set_enabled(False)
+    telemetry.reset()
+
+
+def _watched(name, fn=None):
+    return telemetry.watch_jit(jax.jit(fn or (lambda x: x * 2)), name)
+
+
+def _steps(fns, n, x):
+    for _ in range(n):
+        with telemetry.span("trainer_step", cat="step",
+                            hist="step_time_us"):
+            for f in fns:
+                f(x)
+
+
+# ---- sampler ------------------------------------------------------------
+
+def test_device_time_off_by_default(tel):
+    device.configure(0)
+    assert not device.enabled()
+    f = _watched("dt_off_prog")
+    _steps([f], 3, jnp.ones((8, 8)))
+    assert telemetry.counter("device_time_samples") == 0
+    assert telemetry.histogram("device_time_us").count == 0
+    assert "device" not in telemetry.snapshot()
+
+
+def test_sampled_step_decomposition(tel):
+    f = _watched("dt_compute_prog")
+    g = _watched("kvstore_dt_reduce", lambda x: x + 1)   # collective name
+    x = jnp.ones((32, 32))
+    telemetry.set_gauge("io_batch_wait_us", 123.0)
+    _steps([f, g], 4, x)
+    # first step carries the compiles (excluded from device timing);
+    # later steps sample both programs
+    assert telemetry.counter("device_time_samples") >= 6
+    assert telemetry.histogram("device_time_us").count >= 6
+    report = device.device_report()
+    assert report["programs"]["dt_compute_prog"]["samples"] >= 3
+    assert report["programs"]["kvstore_dt_reduce"]["collective"] is True
+    assert report["programs"]["dt_compute_prog"]["collective"] is False
+    last = report["last_step"]
+    assert last["device_us"] > 0 and last["collective_us"] > 0
+    assert last["data_wait_us"] == pytest.approx(123.0)
+    # the decomposition tiles the step wall (entries are rounded to
+    # 0.1us, so three roundings may disagree with the wall by 0.15)
+    assert last["host_us"] + last["device_us"] + last["collective_us"] \
+        == pytest.approx(last["wall_us"], rel=1e-6, abs=0.31)
+    for gauge in ("step_device_us", "step_collective_us", "step_host_us",
+                  "step_data_wait_us", "overlap_ratio"):
+        assert gauge in telemetry.snapshot()["gauges"]
+    snap = telemetry.snapshot()
+    assert snap["device"]["sample_period"] == 1
+    assert snap["device"]["timelines"]
+
+
+def test_sample_rate_period(tel):
+    device.configure(0.5)                       # every 2nd step
+    assert device.sample_period() == 2
+    f = _watched("dt_rate_prog")
+    x = jnp.ones((8, 8))
+    f(x)                                        # compile outside any step
+    _steps([f], 6, x)
+    report = device.device_report()
+    assert len(report["timelines"]) == 3        # steps 1, 3, 5 sampled
+    # the un-sampled steps fed the free-running-wall baseline
+    assert report["free_wall_ewma_us"] is not None
+    assert report["programs"]["dt_rate_prog"]["samples"] == 3
+
+
+def test_device_timing_adds_zero_compiles(tel):
+    """The acceptance contract: turning the sampler on compiles nothing
+    — block_until_ready only waits on programs that already ran."""
+    device.configure(0)
+    f = _watched("dt_nocompile_prog")
+    x = jnp.ones((16, 16))
+    _steps([f], 2, x)                           # warm
+    compiles = telemetry.counter("jit_compiles")
+    calls = telemetry.counter("xla_program_calls")
+    device.configure(1)
+    _steps([f], 3, x)
+    assert telemetry.counter("jit_compiles") == compiles
+    assert telemetry.counter("xla_program_calls") == calls
+    assert telemetry.counter("device_time_samples") >= 3
+
+
+def test_device_time_works_with_telemetry_off(tel):
+    """MXNET_DEVICE_TIME is its own knob: spans off, sampler on — the
+    decomposition still lands in the (always-on) gauges."""
+    telemetry.set_enabled(False)
+    assert not telemetry.trace_active()
+    f = _watched("dt_teloff_prog")
+    x = jnp.ones((8, 8))
+    _steps([f], 3, x)
+    assert telemetry.counter("device_time_samples") >= 2
+    assert telemetry.gauge("step_device_us") > 0
+
+
+def test_step_span_mints_trace_id(tel):
+    assert telemetry.trace_context() is None
+    with telemetry.span("trainer_step", cat="step"):
+        tid = telemetry.trace_context()
+        assert tid and len(tid) == 16
+    assert telemetry.trace_context() is None
+    events = [e for e in telemetry.core._events if e.get("cat") == "step"]
+    assert events and events[-1]["args"]["trace_id"] == tid
+    # steps are trace ROOTS: an ambient id adopted from a wire recv
+    # must be shadowed by a fresh per-step id, then restored — else
+    # every step of a fleet run glues into one trace
+    tok = telemetry.set_trace_context("ffffffffffffffff")
+    try:
+        seen = []
+        for _ in range(2):
+            with telemetry.span("trainer_step", cat="step"):
+                seen.append(telemetry.trace_context())
+        assert "ffffffffffffffff" not in seen
+        assert len(set(seen)) == 2
+        assert telemetry.trace_context() == "ffffffffffffffff"
+    finally:
+        telemetry.reset_trace_context(tok)
+
+
+def test_trace_report_prints_step_timeline(tel, tmp_path):
+    import subprocess
+    import sys
+    f = _watched("dt_report_prog")
+    _steps([f], 3, jnp.ones((8, 8)))
+    trace = tmp_path / "trace.json"
+    snap = tmp_path / "snap.json"
+    telemetry.dump_chrome_trace(str(trace))
+    telemetry.dump_snapshot(str(snap))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_report.py"),
+         str(trace), "--snapshot", str(snap)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "== step timeline" in proc.stdout
+    for label in ("data-wait", "device", "collective", "overlap"):
+        assert label in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_report.py"),
+         str(trace), "--snapshot", str(snap), "--json"],
+        capture_output=True, text=True, timeout=60)
+    report = json.loads(proc.stdout)
+    assert report["timeline"]["last_step"]["device_us"] > 0
+
+
+# ---- satellite: flight-dump retention -----------------------------------
+
+def test_flight_keep_sweeps_oldest(tmp_path):
+    for i in range(6):
+        path = tmp_path / ("flight_%d.json" % (1000 + i))
+        path.write_text("{}")
+        t = time.time() - 600 + i
+        os.utime(path, (t, t))
+    stale = tmp_path / "flight_notes.json"      # non-matching: untouched
+    stale.write_text("{}")
+    flight.configure(keep=3)
+    try:
+        flight.dump(directory=str(tmp_path))
+    finally:
+        flight.configure(keep=flight.DEFAULT_KEEP)
+    names = sorted(p.name for p in tmp_path.glob("flight_*.json"))
+    assert "flight_%d.json" % os.getpid() in names
+    assert "flight_notes.json" in names
+    kept = [n for n in names if n[7:-5].isdigit()]
+    assert len(kept) == 3                       # newest 2 fakes + ours
+    assert "flight_1004.json" in kept and "flight_1005.json" in kept
+
+
+def test_flight_keep_zero_disables_sweep(tmp_path):
+    for i in range(4):
+        (tmp_path / ("flight_%d.json" % (2000 + i))).write_text("{}")
+    flight.configure(keep=0)
+    try:
+        flight.dump(directory=str(tmp_path))
+    finally:
+        flight.configure(keep=flight.DEFAULT_KEEP)
+    kept = [p for p in tmp_path.glob("flight_*.json")]
+    assert len(kept) == 5
+
+
+def test_no_flight_dumps_left_at_repo_root():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stale = [n for n in os.listdir(repo)
+             if n.startswith("flight_") and n.endswith(".json")
+             and n[7:-5].isdigit()]
+    assert not stale, "stale flight dumps at repo root: %s" % stale
+
+
+# ---- satellite: guardian folds into /healthz ----------------------------
+
+def test_healthz_unhealthy_on_exhausted_skip_budget(tel):
+    from mxnet_tpu import guardian
+    g = guardian.TrainingGuardian(max_skips=1)
+    guardian.install(g)
+    try:
+        ok, detail = server.health()
+        assert ok and detail["guardian"]["ok"]
+        g.after_step(False)             # budget 1 exhausted, no manager
+        ok, detail = server.health()
+        assert not ok
+        assert detail["guardian"]["skip_budget_exhausted"]
+        g.after_step(True)              # an applied step recovers
+        ok, detail = server.health()
+        assert ok
+    finally:
+        guardian.uninstall(g)
+    ok, detail = server.health()
+    assert ok and detail["guardian"] is None
+
+
+# ---- satellite: serve_bench span budget gate ----------------------------
+
+def test_serve_bench_decomposition_and_budget_gate():
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--clients", "2", "--requests", "5", "--qps", "50",
+         "--duration", "0.5", "--max-queue-ms", "0.000001"],
+        capture_output=True, text=True, timeout=600, env=env)
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["spans"]["queue_wait"]["count"] > 0
+    assert report["spans"]["execute"]["count"] > 0
+    assert report["queue_wait_over_budget"] is True
+    assert proc.returncode == 1     # the (absurd) budget gate fired

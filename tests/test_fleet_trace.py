@@ -1,0 +1,181 @@
+"""Fleet trace merging: the ISSUE-12 multi-process fixture.
+
+One real scheduler + 2 servers + 2 workers run
+tests/fleet_trace_worker.py with telemetry on and
+``MXNET_TRACE_DUMP_DIR`` set, leaving one ``trace_<role>_<rank>.json``
+artifact per role.  The assertions then go through the *tool* (the
+artifact consumers a human would use):
+
+* ``trace_report.py --fleet`` merges all five artifacts into one
+  clock-aligned Chrome trace whose per-rank event streams stay
+  monotonic under the clock shift;
+* one trace id minted by a worker's step span crosses a push RPC's
+  wire frame: the sender's ``ps_send:push`` and a server's
+  ``ps_recv:push`` share it (joined by a flow-arrow pair in the merge);
+* deleting a rank's artifact degrades the merge to a warning + partial
+  timeline, never a traceback.
+"""
+import json
+import os
+import subprocess
+import sys
+from collections import defaultdict
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fleet_trace_worker.py")
+TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _run_fleet(tmp_path, iters=3):
+    from launch import launch
+    state = tmp_path / "state"
+    traces = tmp_path / "traces"
+    state.mkdir()
+    traces.mkdir()
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "FLEET_STATE_DIR": str(state),
+        "FLEET_ITERS": str(iters),
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TRACE_DUMP_DIR": str(traces),
+        "MXNET_PS_RPC_TIMEOUT_S": "30",
+        "MXNET_PS_HEARTBEAT_S": "0.2",
+        "MXNET_FLIGHT_DIR": str(state),
+    }
+    rcs = launch(2, 2, [sys.executable, WORKER], env_extra=env,
+                 timeout=180)
+    assert rcs == [0, 0], "fleet workers failed: %r" % (rcs,)
+    results = []
+    for r in range(2):
+        with open(state / ("result-%d.json" % r)) as fh:
+            results.append(json.load(fh))
+    return traces, results
+
+
+def _merge(traces, extra=()):
+    proc = subprocess.run(
+        [sys.executable, TRACE_REPORT, "--fleet", str(traces), "--json",
+         *extra],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    return json.loads(proc.stdout)
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("fleet")
+    traces, results = _run_fleet(tmp_path)
+    return tmp_path, traces, results
+
+
+def test_artifacts_written_per_role(fleet):
+    _, traces, _ = fleet
+    names = sorted(os.listdir(traces))
+    assert "trace_scheduler_0.json" in names
+    assert "trace_worker_0.json" in names and "trace_worker_1.json" in names
+    assert sum(n.startswith("trace_server_") for n in names) == 2
+    with open(traces / "trace_worker_0.json") as fh:
+        payload = json.load(fh)
+    meta = payload["rank_meta"]
+    assert meta["role"] == "worker" and meta["rank"] == 0
+    assert "clock_offset_us" in meta
+    assert meta["steps"] >= 3          # the step spans ticked the clock
+
+
+def test_fleet_merge_clock_monotonic_per_rank(fleet):
+    _, traces, _ = fleet
+    summary = _merge(traces)
+    assert not summary["problems"], summary["problems"]
+    assert len(summary["ranks"]) == 5
+    with open(summary["merged"]) as fh:
+        merged = json.load(fh)["traceEvents"]
+    # clock-monotonic per rank: the merge applies ONE constant shift per
+    # rank (its heartbeat-estimated offset), so each rank's aligned
+    # event stream is elementwise src_ts + offset — same order, same
+    # deltas, no skew or reordering inside a rank
+    by_pid = defaultdict(list)
+    for e in merged:
+        if e.get("ph") == "X" and isinstance(e.get("ts"), (int, float)):
+            by_pid[e["pid"]].append(e["ts"])
+    assert len(by_pid) == 5
+    for rank_row in summary["ranks"]:
+        pid, offset = rank_row["pid"], rank_row["clock_offset_us"]
+        label = rank_row["label"]
+        role = label.split("-")[0]
+        src_path = traces / ("trace_%s_%s.json"
+                             % (role, label.split("-")[1]))
+        with open(src_path) as fh:
+            src_ts = [e["ts"] for e in json.load(fh)["traceEvents"]
+                      if e.get("ph") == "X"
+                      and isinstance(e.get("ts"), (int, float))]
+        aligned = by_pid[pid]
+        assert len(aligned) == len(src_ts)
+        assert all(abs(a - (s + offset)) < 1e-6
+                   for a, s in zip(aligned, src_ts)), (
+            "rank %s not shifted by one constant offset" % label)
+    # and every rank contributed a labelled track
+    labels = {e["args"]["name"] for e in merged
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert {"scheduler-0", "worker-0", "worker-1"} <= labels
+
+
+def test_trace_id_crosses_push_rpc(fleet):
+    _, traces, results = fleet
+    step_ids = {tid for r in results for tid in r["step_trace_ids"] if tid}
+    assert step_ids, "worker step spans minted no trace ids"
+    with open(traces / "trace_worker_0.json") as fh:
+        worker_events = json.load(fh)["traceEvents"]
+    sends = [e for e in worker_events
+             if e.get("name", "").startswith("ps_send:push")]
+    assert sends, "no traced push sends in the worker artifact"
+    send_ids = {e["args"]["trace_id"] for e in sends}
+    assert send_ids & step_ids, (
+        "push RPCs did not inherit the step span's trace id")
+    # the same id arrived at a server
+    recv_ids = set()
+    for name in os.listdir(traces):
+        if not name.startswith("trace_server_"):
+            continue
+        with open(traces / name) as fh:
+            for e in json.load(fh)["traceEvents"]:
+                if e.get("name", "").startswith("ps_recv:push"):
+                    recv_ids.add(e["args"]["trace_id"])
+    assert recv_ids & send_ids & step_ids, (
+        "no push trace id observed on both the worker (send) and a "
+        "server (recv)")
+    # and the merge joined send/recv pairs with flow arrows
+    summary = _merge(traces)
+    assert summary["flows"] > 0
+
+
+def test_fleet_degrades_on_missing_rank_artifact(fleet, tmp_path):
+    _, traces, _ = fleet
+    partial = tmp_path / "partial"
+    partial.mkdir()
+    import shutil
+    for name in os.listdir(traces):
+        if name.startswith("trace_") and name != "trace_server_1.json":
+            shutil.copy(traces / name, partial / name)
+    # a corrupt artifact rides along: must warn, not raise
+    with open(partial / "trace_server_1.json", "w") as fh:
+        fh.write("{torn")
+    summary = _merge(partial, extra=("--out", str(partial / "m.json")))
+    assert len(summary["ranks"]) == 4
+    assert any("trace_server_1.json" in p for p in summary["problems"])
+    assert summary["merged"] and os.path.exists(summary["merged"])
+
+
+def test_fleet_mode_empty_dir_fails_loudly(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc = subprocess.run(
+        [sys.executable, TRACE_REPORT, "--fleet", str(empty), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    summary = json.loads(proc.stdout)
+    assert summary["problems"]

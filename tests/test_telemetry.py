@@ -323,13 +323,20 @@ def test_off_path_records_nothing():
 _METRIC_USE = re.compile(
     r'(?:\bbump|\bcounter|\bobserve|\bset_gauge|\bgauge|\bhistogram)'
     r'\(\s*["\']([A-Za-z0-9_]+)["\']'
-    r'|hist=["\']([A-Za-z0-9_]+)["\']')
+    r'|hist=["\']([A-Za-z0-9_]+)["\']'
+    r'|\bspan\(\s*["\']([A-Za-z0-9_]+)["\']'
+    r'|\badd_event\(\s*["\']([A-Za-z0-9_]+)["\']')
 
 
 def test_all_metric_names_declared():
-    """Static check: a typo'd counter name silently splits a time series —
-    every name used inside mxnet_tpu/ must be declared in
-    telemetry.METRIC_NAMES (tools/tests may use ad-hoc names)."""
+    """Static check: a typo'd counter OR span name silently splits a
+    time series / trace_report table — every literal used inside
+    mxnet_tpu/ (bump/observe/set_gauge/histogram, ``span("...")``,
+    ``add_event("...")``) must be declared in telemetry.METRIC_NAMES
+    (which folds in core.SPANS; tools/tests may use ad-hoc names).
+    Dynamic names — e.g. the executor's per-program span labels and the
+    ``ps_send:<op>`` rpc events — go through watch_jit names or carry a
+    declared prefix and are outside the literal scan by construction."""
     used = {}
     pkg = os.path.join(REPO, "mxnet_tpu")
     for dirpath, _, files in os.walk(pkg):
@@ -340,15 +347,18 @@ def test_all_metric_names_declared():
             with open(path) as f:
                 src = f.read()
             for m in _METRIC_USE.finditer(src):
-                name = m.group(1) or m.group(2)
+                name = next(g for g in m.groups() if g)
                 used.setdefault(name, []).append(
                     os.path.relpath(path, REPO))
     assert used, "scan found no metric uses — regex rotted?"
     undeclared = {n: ps for n, ps in used.items()
                   if n not in telemetry.METRIC_NAMES}
     assert not undeclared, (
-        "metric names used but not declared in telemetry.py: %r"
+        "span/metric names used but not declared in telemetry.core: %r"
         % undeclared)
+    # the new-code gate is live: the serving/device names are declared
+    for name in ("serving_run_batch", "device_time_us", "overlap_ratio"):
+        assert name in telemetry.METRIC_NAMES
 
 
 # ---- counters contract stays intact with telemetry ON --------------------

@@ -42,6 +42,16 @@ WORKER = os.path.join(REPO, "tests", "chaos_dist_worker.py")
 
 DEFAULT_CHAOS = "seed=11;conn.send.pull:drop@3;conn.recv:delay~0.05=2ms"
 
+# fleet-tracing passthrough knobs, read once at import (JG006
+# cached-value pattern): MXNET_TELEMETRY=1 MXNET_TRACE_DUMP_DIR=d
+# chaos_smoke ... leaves per-rank trace artifacts that
+# `trace_report.py --fleet d` merges into one clock-aligned timeline
+# (trace ids never touch the math, so the bitwise gates are unaffected)
+_TRACE_PASSTHROUGH = tuple(
+    (knob, os.environ.get(knob, ""))
+    for knob in ("MXNET_TELEMETRY", "MXNET_TRACE_DUMP_DIR",
+                 "MXNET_DEVICE_TIME"))
+
 
 def run_once(label, state_dir, args, chaos_spec):
     """One launch under the hard cap; returns per-rank result dicts."""
@@ -57,6 +67,9 @@ def run_once(label, state_dir, args, chaos_spec):
         "MXNET_PS_HEARTBEAT_S": "0",
         "MXNET_FLIGHT_DIR": state_dir,
     }
+    for knob, val in _TRACE_PASSTHROUGH:
+        if val:
+            env[knob] = val
     try:
         rcs = launch(args.workers, args.servers,
                      [sys.executable, WORKER],

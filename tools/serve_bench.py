@@ -183,6 +183,10 @@ def main(argv=None):
     parser.add_argument("--http", action="store_true",
                         help="drive the closed loop through the live "
                              "/v1 HTTP surface")
+    parser.add_argument("--max-queue-ms", type=float, default=None,
+                        help="fail (exit 1) when queue-wait p99 exceeds "
+                             "this budget — the SLO gate on the "
+                             "request-span decomposition")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -226,6 +230,21 @@ def main(argv=None):
                     + telemetry.counter("serving_warmup_compiles")
                     - compiles_after_warmup)
         stats = slot.stats()
+
+        def _span_ms(key):
+            """p50/p99/mean (ms) of one request-span segment from the
+            slot's decomposition histograms."""
+            seg = stats.get(key) or {}
+            return {"p50_ms": round((seg.get("p50") or 0.0) / 1e3, 3),
+                    "p99_ms": round((seg.get("p99") or 0.0) / 1e3, 3),
+                    "mean_ms": round((seg.get("mean") or 0.0) / 1e3, 3),
+                    "count": seg.get("count", 0)}
+
+        spans = {"queue_wait": _span_ms("queue_wait_us"),
+                 "execute": _span_ms("execute_us")}
+        queue_p99_ms = spans["queue_wait"]["p99_ms"]
+        queue_over_budget = (args.max_queue_ms is not None
+                             and queue_p99_ms > args.max_queue_ms)
         report = {
             "metric": "serve_bench",
             "model": MODEL,
@@ -255,6 +274,12 @@ def main(argv=None):
             "rows": stats["rows"],
             "mfu_since_load": stats["mfu_since_load"],
             "retraces_after_warmup": retraces,
+            # the request-span decomposition: where a p99 actually went
+            # (a fat queue_wait means capacity/coalescing, a fat execute
+            # means the model itself)
+            "spans": spans,
+            "max_queue_ms": args.max_queue_ms,
+            "queue_wait_over_budget": queue_over_budget,
         }
         device = None
         try:
@@ -265,7 +290,8 @@ def main(argv=None):
         report["device"] = device
         serving.unload(MODEL)
         print(json.dumps(report))
-        return 0 if retraces == 0 and not closed_err else 1
+        ok = retraces == 0 and not closed_err and not queue_over_budget
+        return 0 if ok else 1
 
 
 if __name__ == "__main__":

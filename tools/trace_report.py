@@ -19,6 +19,20 @@ did the step go" — and "what could the hardware have done":
     FLOPs, MFU and HBM-bandwidth utilization against the device peaks,
     plus per-program arithmetic intensity vs. the machine balance point
     (is each program compute- or memory-bound?)
+  * step timeline          — the MXNET_DEVICE_TIME decomposition from
+    the snapshot's ``device`` section: data-wait / host-gap / device-
+    compute / collective-comm per sampled step plus ``overlap_ratio``
+    (the fraction of collective time hidden under compute — ROADMAP
+    item 2's win condition) and the per-program device-time table
+
+``--fleet DIR`` switches to fleet mode: every ``trace_<role>_<rank>.json``
+artifact in DIR (written by ``dist_ps.dump_trace_artifacts`` /
+``MXNET_TRACE_DUMP_DIR``) is merged into ONE clock-aligned Chrome trace —
+each rank's events shifted onto the scheduler's clock by the heartbeat-
+estimated offset in its ``rank_meta``, re-pid'd per rank, and the
+``ps_send``/``ps_recv`` RPC pairs joined with Chrome flow arrows on their
+shared span id.  A missing or corrupt rank artifact degrades to a warning
+and a partial merge, never a traceback.
 
 Degrades gracefully: an empty or missing ``traceEvents`` array, or a
 snapshot from an older build lacking the newer keys, prints "(no ...)"
@@ -32,11 +46,14 @@ be copied, with no jax / framework import.
 Usage:
     python tools/trace_report.py trace.json [--snapshot snap.json]
                                  [--top 10] [--json]
+    python tools/trace_report.py --fleet DIR [--out merged.json] [--json]
 """
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from collections import defaultdict
 
@@ -214,6 +231,179 @@ def zero_stats(snapshot):
     return out
 
 
+def timeline_stats(snapshot):
+    """The MXNET_DEVICE_TIME step-timeline view from the snapshot's
+    ``device`` section (None on snapshots from runs without it)."""
+    if not isinstance(snapshot, dict):
+        return None
+    device = snapshot.get("device")
+    if not isinstance(device, dict):
+        return None
+    last = device.get("last_step")
+    if not last and not device.get("programs"):
+        return None
+    timelines = [t for t in (device.get("timelines") or [])
+                 if isinstance(t, dict)]
+    mean = None
+    if timelines:
+        keys = ("wall_us", "data_wait_us", "host_us", "device_us",
+                "collective_us", "overlap_ratio")
+        mean = {k: sum(t.get(k) or 0 for t in timelines) / len(timelines)
+                for k in keys}
+        mean["samples"] = len(timelines)
+    return {"sample_period": device.get("sample_period"),
+            "last_step": last,
+            "mean": mean,
+            "free_wall_ewma_us": device.get("free_wall_ewma_us"),
+            "programs": device.get("programs") or {}}
+
+
+# --------------------------------------------------------------------------
+# fleet mode: merge per-rank artifacts into one clock-aligned trace
+# --------------------------------------------------------------------------
+
+def load_fleet_artifacts(directory):
+    """(ranks, problems): per-rank dicts from every readable
+    ``trace_*.json`` in *directory*, sorted scheduler→servers→workers.
+    Unreadable artifacts land in *problems* instead of raising."""
+    ranks, problems = [], []
+    paths = sorted(glob.glob(os.path.join(directory, "trace_*.json")))
+    for path in paths:
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError) as exc:
+            problems.append("%s: unreadable (%s)"
+                            % (os.path.basename(path), exc))
+            continue
+        if not isinstance(payload, dict):
+            problems.append("%s: not a trace object"
+                            % os.path.basename(path))
+            continue
+        meta = payload.get("rank_meta") or {}
+        events = [e for e in payload.get("traceEvents", [])
+                  if isinstance(e, dict)]
+        ranks.append({"path": path,
+                      "label": "%s-%s" % (meta.get("role", "?"),
+                                          meta.get("rank", "?")),
+                      "meta": meta,
+                      "offset_us": float(meta.get("clock_offset_us")
+                                         or 0.0),
+                      "events": events})
+    order = {"scheduler": 0, "server": 1, "worker": 2}
+    ranks.sort(key=lambda r: (order.get(r["meta"].get("role"), 3),
+                              r["meta"].get("rank", 0) or 0))
+    return ranks, problems
+
+
+def merge_fleet(ranks):
+    """One Chrome trace: every rank's 'X' events shifted onto the
+    scheduler clock (``ts + clock_offset_us``), pid = rank index with a
+    process_name metadata row, plus flow events ('s'/'f', bound to the
+    enclosing ps_send/ps_recv events) joining each traced RPC's
+    send/recv pair across ranks on their shared span id."""
+    merged = []
+    sends = {}              # span_id -> (pid, tid, ts, name, trace_id)
+    recvs = []              # (parent_span, pid, tid, ts, name)
+    for pid, rank in enumerate(ranks):
+        merged.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": rank["label"]}})
+        offset = rank["offset_us"]
+        for e in rank["events"]:
+            if e.get("ph") == "M":
+                ev = dict(e, pid=pid)
+                merged.append(ev)
+                continue
+            if not isinstance(e.get("ts"), (int, float)):
+                continue
+            ev = dict(e, pid=pid, ts=e["ts"] + offset)
+            merged.append(ev)
+            if e.get("cat") != "rpc":
+                continue
+            args = e.get("args") or {}
+            name = e.get("name", "")
+            if name.startswith("ps_send:") and args.get("span_id"):
+                sends[args["span_id"]] = (pid, ev.get("tid", 0),
+                                          ev["ts"], name,
+                                          args.get("trace_id"))
+            elif name.startswith("ps_recv:") and args.get("parent_span"):
+                recvs.append((args["parent_span"], pid,
+                              ev.get("tid", 0), ev["ts"], name))
+    flows = 0
+    for parent_span, rpid, rtid, rts, rname in recvs:
+        src = sends.get(parent_span)
+        if src is None:
+            continue                    # sender artifact missing: skip
+        spid, stid, sts, sname, trace_id = src
+        op = sname.split(":", 1)[-1]
+        flow = {"cat": "rpc", "name": "rpc:%s" % op, "id": parent_span,
+                "args": {"trace_id": trace_id}}
+        merged.append(dict(flow, ph="s", pid=spid, tid=stid, ts=sts))
+        merged.append(dict(flow, ph="f", bp="e", pid=rpid, tid=rtid,
+                           ts=max(rts, sts)))
+        flows += 1
+    return merged, flows
+
+
+def fleet_report(directory, out_path=None):
+    """Build + write the merged fleet trace; returns the summary dict."""
+    ranks, problems = load_fleet_artifacts(directory)
+    summary = {"directory": directory, "ranks": [], "problems": problems,
+               "merged": None, "flows": 0}
+    if not ranks:
+        problems.append("no trace_*.json artifacts in %s" % directory)
+        return summary
+    merged, flows = merge_fleet(ranks)
+    for pid, rank in enumerate(ranks):
+        xs = [e["ts"] for e in rank["events"]
+              if e.get("ph") == "X"
+              and isinstance(e.get("ts"), (int, float))]
+        summary["ranks"].append({
+            "pid": pid, "label": rank["label"],
+            "clock_offset_us": rank["offset_us"],
+            "clock_rtt_us": rank["meta"].get("clock_rtt_us"),
+            "steps": rank["meta"].get("steps"),
+            "events": len(xs),
+            "first_ts_us": round(min(xs) + rank["offset_us"], 1)
+            if xs else None,
+            "last_ts_us": round(max(xs) + rank["offset_us"], 1)
+            if xs else None})
+    summary["flows"] = flows
+    if out_path is None:
+        out_path = os.path.join(directory, "fleet_merged.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump({"traceEvents": merged, "displayTimeUnit": "ms"},
+                      fh)
+        summary["merged"] = out_path
+    except OSError as exc:
+        problems.append("cannot write %s (%s)" % (out_path, exc))
+    return summary
+
+
+def render_fleet(summary):
+    lines = ["== fleet trace merge =="]
+    for problem in summary["problems"]:
+        lines.append("WARNING: %s" % problem)
+    if summary["ranks"]:
+        lines.append("%-16s %8s %14s %12s %7s" %
+                     ("rank", "events", "clock_off_us", "rtt_us",
+                      "steps"))
+        for r in summary["ranks"]:
+            lines.append("%-16s %8d %14.1f %12s %7s"
+                         % (r["label"], r["events"],
+                            r["clock_offset_us"],
+                            "-" if r["clock_rtt_us"] is None
+                            else "%.1f" % r["clock_rtt_us"],
+                            "-" if r["steps"] is None else r["steps"]))
+        lines.append("flow arrows (rpc send->recv pairs): %d"
+                     % summary["flows"])
+    if summary["merged"]:
+        lines.append("merged trace: %s  (load in Perfetto / "
+                     "chrome://tracing)" % summary["merged"])
+    return "\n".join(lines)
+
+
 def _fmt_bytes(n):
     for unit in ("B", "KiB", "MiB", "GiB"):
         if n < 1024 or unit == "GiB":
@@ -243,6 +433,7 @@ def build_report(events, snapshot, top):
               "retraces": retrace_stats(events, snapshot),
               "mfu": mfu_stats(snapshot),
               "zero": zero_stats(snapshot),
+              "timeline": timeline_stats(snapshot),
               "data_pipeline": None}
     gauges = (snapshot or {}).get("gauges") or {}
     wait = gauges.get("io_batch_wait_us")
@@ -337,6 +528,51 @@ def render(report, top):
         lines.append("(no cost accounting in snapshot — run with "
                      "MXNET_TELEMETRY=1 on a build with telemetry.costs)")
 
+    tl = report.get("timeline")
+    if tl:
+        lines.append("")
+        lines.append("== step timeline (MXNET_DEVICE_TIME, 1/%s steps "
+                     "sampled) ==" % (tl.get("sample_period") or "?"))
+        lines.append("%-12s %14s %14s" % ("segment", "last_step_us",
+                                          "mean_us"))
+        last = tl.get("last_step") or {}
+        mean = tl.get("mean") or {}
+        for key, label in (("data_wait_us", "data-wait"),
+                           ("host_us", "host"),
+                           ("device_us", "device"),
+                           ("collective_us", "collective"),
+                           ("wall_us", "step wall")):
+            lines.append("%-12s %14s %14s"
+                         % (label,
+                            "-" if last.get(key) is None
+                            else "%.1f" % last[key],
+                            "-" if mean.get(key) is None
+                            else "%.1f" % mean[key]))
+        over_last = last.get("overlap_ratio")
+        over_mean = mean.get("overlap_ratio")
+        lines.append("%-12s %14s %14s"
+                     % ("overlap",
+                        "-" if over_last is None
+                        else "%.2f" % over_last,
+                        "-" if over_mean is None
+                        else "%.2f" % over_mean))
+        if tl.get("free_wall_ewma_us") is not None:
+            lines.append("free-running wall EWMA %.1fus (the overlap "
+                         "baseline)" % tl["free_wall_ewma_us"])
+        programs = tl.get("programs") or {}
+        if programs:
+            lines.append("%-32s %8s %10s %10s %5s"
+                         % ("program (device time)", "samples",
+                            "mean_us", "max_us", "coll"))
+            ordered = sorted(programs.items(),
+                             key=lambda kv: -(kv[1].get("total_us") or 0))
+            for name, rec in ordered:
+                lines.append("%-32s %8d %10.1f %10.1f %5s"
+                             % (name[:32], rec.get("samples", 0),
+                                rec.get("mean_us", 0.0),
+                                rec.get("max_us", 0.0),
+                                "yes" if rec.get("collective") else "-"))
+
     z = report.get("zero")
     if z:
         lines.append("")
@@ -365,15 +601,33 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Summarise an mxnet_tpu Chrome trace "
                     "(+ optional telemetry snapshot).")
-    ap.add_argument("trace", help="Chrome trace JSON from dump_profile()")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="Chrome trace JSON from dump_profile()")
     ap.add_argument("--snapshot", default=None,
                     help="JSON from telemetry.dump_snapshot()")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the self-time table (default 10)")
+    ap.add_argument("--fleet", default=None, metavar="DIR",
+                    help="merge the per-rank trace_*.json artifacts in "
+                         "DIR (MXNET_TRACE_DUMP_DIR) into one "
+                         "clock-aligned trace")
+    ap.add_argument("--out", default=None,
+                    help="--fleet: merged trace path (default "
+                         "DIR/fleet_merged.json)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout (CI)")
     args = ap.parse_args(argv)
 
+    if args.fleet is not None:
+        summary = fleet_report(args.fleet, out_path=args.out)
+        if args.as_json:
+            print(json.dumps(summary, indent=1, sort_keys=True))
+        else:
+            print(render_fleet(summary))
+        return 0 if summary["ranks"] else 2
+
+    if args.trace is None:
+        ap.error("a trace file is required (or use --fleet DIR)")
     events = load_events(args.trace)
     snapshot = load_snapshot(args.snapshot) if args.snapshot else None
     report = build_report(events, snapshot, args.top)
