@@ -25,6 +25,11 @@ applies the returned fault itself, because only the seam knows what
 ``grad.bucket``    the reduced-gradient seam of ``Trainer.step`` (both
                    the fused and per-slot paths, once per step); the
                    ``nan`` kind poisons a bucket via :func:`poison_grads`
+``fleet.route``    each predict request the serving fleet router
+                   accepts, decided in routing order BEFORE a replica
+                   is picked (:mod:`mxnet_tpu.serving.fleet`)
+``replica.predict`` each predict RPC a replica process serves
+                   (:mod:`mxnet_tpu.serving.replica`)
 =================  ======================================================
 
 Determinism contract: every rule counts its own matching calls, and a

@@ -49,7 +49,8 @@ KINDS = frozenset({"drop", "delay", "stall", "close", "garbage",
 # the seams wired up in this build (documentation + spec validation;
 # prefixes of these are fine, arbitrary others are a typo'd spec)
 SITES = ("conn.send", "conn.recv", "engine.task", "ckpt.io",
-         "serving.batch", "grad.bucket")
+         "serving.batch", "grad.bucket", "fleet.route",
+         "replica.predict")
 
 _DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)(us|ms|s)?$")
 _FAULT_RE = re.compile(

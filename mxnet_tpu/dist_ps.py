@@ -53,7 +53,8 @@ from .telemetry import core as _tel
 from .telemetry import flight as _flight
 
 __all__ = ["role", "num_workers", "num_servers", "root_addr",
-           "Conn", "ProtocolError", "PeerLost", "RPCTimeout",
+           "Conn", "RpcListener", "ProtocolError", "PeerLost",
+           "RPCTimeout",
            "Scheduler", "Server", "WorkerTransport",
            "run_scheduler", "run_server", "shard_ranges", "server_of_key",
            "BIGARRAY_BOUND", "peer_view", "fleet_view",
@@ -340,6 +341,20 @@ class Conn:
         for _ in range(max(1, retries)):
             try:
                 s = socket.create_connection(addr, timeout=60)
+                if s.getsockname() == s.getpeername():
+                    # TCP self-connect: dialing a port with no listener
+                    # can "succeed" when the kernel picks the target
+                    # port itself as our source port (likely on
+                    # localhost right after that port's owner died —
+                    # freed ports are preferentially reused).  Both
+                    # ends are THIS socket, so any protocol exchange
+                    # would read back its own frames; a dial-verify
+                    # against a killed server's address would wrongly
+                    # pass.  Never a real peer: fail the attempt.
+                    s.close()
+                    raise ConnectionError(
+                        "self-connected to %s:%s (no listener on the "
+                        "port)" % (addr[0], addr[1]))
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return cls(s, timeout=timeout)
             except OSError as exc:
@@ -455,6 +470,83 @@ class Conn:
             self.sock.close()
         except OSError:
             pass
+
+
+def _accept_loop(lsock, stop, handler, make_conn=Conn):
+    """The one accept/poll/stop discipline every wire role shares
+    (:class:`RpcListener`, :meth:`Scheduler.run`,
+    :meth:`Server.serve_forever`): poll ``accept`` on a bounded 0.25s
+    timeout so ``stop`` never waits on a silent socket, end the loop on
+    a socket error (the listener was closed under us), and hand each
+    accepted connection to *handler* on a daemon thread that owns the
+    conn's lifetime.  The caller keeps ownership of *lsock* — closing
+    it (and any post-loop shutdown protocol) stays the caller's job."""
+    lsock.settimeout(0.25)
+    while not stop.is_set():
+        try:
+            sock, _ = lsock.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        threading.Thread(target=handler, args=(make_conn(sock),),
+                         daemon=True).start()
+
+
+class RpcListener:
+    """Bounded accept loop + per-connection handler threads — the
+    :func:`_accept_loop` discipline plus socket setup/teardown, so new
+    wire roles (the serving fleet router and its replicas) don't
+    re-derive it.
+
+    *handler(conn)* runs on a daemon thread per accepted connection and
+    owns the conn's lifetime; the accept loop itself polls on a bounded
+    timeout so :meth:`stop` never waits on a silent socket.
+    """
+
+    def __init__(self, handler, port=0, host="127.0.0.1", name="rpc",
+                 conn_timeout=_UNSET):
+        self._handler = handler
+        self._conn_timeout = _ENV["rpc_timeout"] \
+            if conn_timeout is _UNSET else conn_timeout
+        self._stop = threading.Event()
+        self.lsock = socket.socket()
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind((host, port))
+        self.lsock.listen(128)
+        self.addr = (host, self.lsock.getsockname()[1])
+        self._thread = threading.Thread(
+            target=self._loop, name="mxps-listen-%s" % name, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        _accept_loop(
+            self.lsock, self._stop, self._serve,
+            make_conn=lambda s: Conn(s, timeout=self._conn_timeout))
+        try:
+            self.lsock.close()
+        except OSError:
+            pass
+
+    def _serve(self, conn):
+        try:
+            self._handler(conn)
+        except (OSError, ConnectionError):
+            pass                       # peer went away: its problem
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.lsock.close()         # unblock a pending accept
+        except OSError:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(5.0)
 
 
 # ---------------------------------------------------------------------------
@@ -810,16 +902,7 @@ class Scheduler:
         # Accept until shutdown rather than counting to N connections: a
         # malformed/rogue connection must not consume a registration slot
         # and hang the whole job (it is dropped in _serve instead).
-        self.lsock.settimeout(0.25)
-        while not self._done.is_set():
-            try:
-                sock, _ = self.lsock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                break
-            threading.Thread(target=self._serve, args=(Conn(sock),),
-                             daemon=True).start()
+        _accept_loop(self.lsock, self._done, self._serve)
         for c in self.server_conns:
             try:
                 c.send(("shutdown",))
@@ -1109,6 +1192,12 @@ class Server:
     def handle(self, msg):
         """Process one request; return the reply (or None)."""
         op = msg[0]
+        if op == "ping":
+            # liveness probe (refresh_servers dial-verify): a reply
+            # proves a live server PROCESS is behind the socket — a
+            # bare TCP connect cannot (the kernel completes handshakes
+            # into a killed process's not-yet-torn-down accept queue)
+            return ("pong",)
         if op == "init":
             _, key, flat, shape, rng = msg
             with self._lock:
@@ -1249,17 +1338,7 @@ class Server:
         self.store[key] = w.asnumpy().astype(self.store[key].dtype).ravel()
 
     def serve_forever(self, lsock, stop):
-        while not stop.is_set():
-            try:
-                lsock.settimeout(0.25)
-                sock, _ = lsock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                break
-            conn = Conn(sock)
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+        _accept_loop(lsock, stop, self._serve_conn)
 
     def _serve_conn(self, conn):
         while True:
@@ -1317,11 +1396,35 @@ def run_server():
                          daemon=True)
     t.start()
 
-    sched = Conn.connect(root_addr())
-    sched.send(("reg_server", my_addr))
-    # rendezvous waits for the full roster — deliberately unbounded (a
-    # straggler worker is not a failure; scheduler death is an EOF here)
-    msg = sched.recv(timeout=None)  # ("ranked", rank, addrs)
+    # Registration retries: a RESTARTED server can beat the scheduler's
+    # dead-peer detection — every rank still looks alive, the scheduler
+    # refuses the registration as over-registration (closes the conn),
+    # and without a retry the replacement would crash here and leave the
+    # job permanently short one server (the exact recovery the fleet
+    # replicas also depend on).  Re-register on a fresh conn until the
+    # scheduler hands out a rank or the bounded window closes.
+    last = None
+    for _ in range(max(1, _env_int("MXNET_PS_REREGISTER_RETRIES", 40))):
+        sched = None
+        try:
+            sched = Conn.connect(root_addr())
+            sched.send(("reg_server", my_addr))
+            # rendezvous waits for the full roster — deliberately
+            # unbounded (a straggler worker is not a failure; scheduler
+            # death / an over-registration refusal is an EOF here)
+            msg = sched.recv(timeout=None)  # ("ranked", rank, addrs)
+            break
+        except (OSError, ConnectionError) as exc:
+            last = exc
+            if sched is not None:
+                sched.close()
+            time.sleep(0.25)
+    else:
+        raise PeerLost(
+            "scheduler refused server registration (no free or dead "
+            "rank) and never freed one: %r" % (last,),
+            role="scheduler", addr=root_addr(),
+            reason="over-registration")
     rank = int(msg[1])
     _register_node("server", rank, lambda: {"keys": len(server.store),
                                             "addr": my_addr})
@@ -1549,12 +1652,25 @@ class WorkerTransport:
                 # scheduler may not have noticed the death yet, so a
                 # clean-looking list can still carry the dead server's
                 # stale address — trusting it would leak a bare
-                # ConnectionError out of the recovery path
+                # ConnectionError out of the recovery path.  A bare
+                # connect is NOT proof of life (the kernel completes
+                # handshakes into a freshly-killed process's accept
+                # queue for a brief teardown window, and self-connects
+                # are rejected separately in Conn.connect), so each
+                # verified conn must answer a ping round trip.
                 conns, ok = [], True
                 for a in addrs:
                     try:
-                        conns.append(Conn.connect(a, retries=3,
-                                                  delay=0.05))
+                        conn = Conn.connect(a, retries=3, delay=0.05)
+                        conn.send(("ping",))
+                        reply = conn.recv(timeout=min(
+                            _ENV["rpc_timeout"] or 5.0, 5.0))
+                        if not (isinstance(reply, tuple) and reply
+                                and reply[0] == "pong"):
+                            raise ConnectionError(
+                                "ping to %s:%s answered %r"
+                                % (a[0], a[1], reply))
+                        conns.append(conn)
                     except (OSError, ConnectionError) as exc:
                         last = exc
                         ok = False
@@ -1587,13 +1703,18 @@ class WorkerTransport:
             self._ts.clear()
 
     def finalize(self):
-        if self._hb_stop is not None:
-            self._hb_stop.set()
+        # finalize FIRST, stop heartbeats AFTER the scheduler confirmed:
+        # the scheduler treats a heartbeat-link drop from an unfinalized
+        # rank as a death, so closing the hb conn before the finalize
+        # frame is processed would race a clean exit into a spurious
+        # dead-worker count (get_num_dead_node() != 0 on live peers)
         try:
             self.sched.send(("finalize",))
             self.sched.recv(timeout=_ENV["rpc_timeout"])
         except (OSError, ConnectionError):
             pass
+        if self._hb_stop is not None:
+            self._hb_stop.set()
         for c in self.server_conns:
             c.close()
         self.sched.close()

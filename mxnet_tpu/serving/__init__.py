@@ -23,6 +23,12 @@ the dedicated ``c_predict_api`` deployment ABI, PAPER layer 9):
 * :mod:`.http` — the ``/v1`` **ops surface**, served by the PR-4
   introspection server (``MXNET_TELEMETRY_HTTP``): model listing +
   stats, predict, and management actions.
+* :mod:`.fleet` + :mod:`.replica` — the **multi-replica serving
+  fleet** (ISSUE 13): a router spreading predict over N replica
+  processes with least-outstanding balancing, hedged retries, breaker-
+  and health-gated failover, and zero-downtime rolling rollout; see
+  docs/SERVING.md §fleet.  Imported lazily — single-process serving
+  pays nothing for them.
 
 Quick start::
 
@@ -47,7 +53,8 @@ __all__ = ["PredictProgram", "ContinuousBatcher", "Overloaded",
            "ModelRegistry", "ModelSlot", "bucket_sizes",
            "get_registry", "reset_registry",
            "load", "unload", "reload_model", "predict", "submit",
-           "stats", "handle_http", "refresh_gauges", "refresh_from_env"]
+           "stats", "handle_http", "readiness", "refresh_gauges",
+           "refresh_from_env"]
 
 
 def load(name, **kwargs):
@@ -83,15 +90,57 @@ def handle_http(method, path, body=None):
     return http.handle(method, path, body)
 
 
+def readiness():
+    """(ok, detail) for the ``/readyz`` endpoint: readiness — distinct
+    from ``/healthz`` liveness — is "safe to route NEW traffic here".
+    Not ready while any slot is compiling/reloading/draining, while this
+    process's replica is warming/reloading/draining, or when this
+    process is a fleet router with zero routable replicas.  Observe-only
+    (``sys.modules`` lookups; constructs nothing)."""
+    import sys
+    ok, detail = True, {}
+    registry = slots._registry
+    if registry is not None:
+        slots_ok, slots_detail = registry.readiness()
+        detail["slots"] = slots_detail
+        ok = ok and slots_ok
+    rep_mod = sys.modules.get("mxnet_tpu.serving.replica")
+    if rep_mod is not None:
+        rep = rep_mod.current_replica()
+        if rep is not None:
+            detail["replica"] = {"rank": rep.rank, "state": rep.state}
+            ok = ok and rep.state == "ready"
+    fleet_mod = sys.modules.get("mxnet_tpu.serving.fleet")
+    if fleet_mod is not None:
+        router = fleet_mod.current_router()
+        if router is not None:
+            ready = router.ready_count()
+            detail["fleet"] = {"replicas_ready": ready,
+                               "replicas_total": router.total_count()}
+            ok = ok and ready > 0
+    return ok, detail
+
+
 def refresh_gauges():
     """Refresh the aggregate serving gauges (called by the introspection
     sampler through ``sys.modules`` — observe-only, creates nothing)."""
+    import sys
     registry = slots._registry
     if registry is not None:
         registry.refresh_gauges()
+    fleet_mod = sys.modules.get("mxnet_tpu.serving.fleet")
+    if fleet_mod is not None:
+        router = fleet_mod.current_router()
+        if router is not None:
+            router.refresh_gauges()
 
 
 def refresh_from_env():
-    """Re-read every MXNET_SERVE_* knob (tests / live reconfig)."""
+    """Re-read every MXNET_SERVE_* / MXNET_FLEET_* knob (tests / live
+    reconfig)."""
+    import sys
     program.refresh_from_env()
     batcher.refresh_from_env()
+    fleet_mod = sys.modules.get("mxnet_tpu.serving.fleet")
+    if fleet_mod is not None:
+        fleet_mod.refresh_from_env()

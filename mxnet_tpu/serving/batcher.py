@@ -216,7 +216,12 @@ class _Request:
         self.error = None
         self.deadline = None if not timeout_s \
             else self.t_submit + timeout_s
-        self.trace_id = _telemetry.new_trace_id()
+        # adopt the ambient trace id when one exists (a fleet-routed
+        # predict: the router's id rode the wire and the replica's
+        # handler thread adopted it) so router span, rpc events, and
+        # this request's batch spans merge end-to-end; otherwise mint
+        self.trace_id = _telemetry.trace_context() \
+            or _telemetry.new_trace_id()
         self.segments = {}
         self._done = threading.Event()
 

@@ -1,0 +1,727 @@
+"""The serving fleet router: health-gated, hedging, failover balancing.
+
+ROADMAP item 5's "millions of users" step: one router process spreads
+predict traffic over N :mod:`~.replica` processes (each the PR-6
+slot-table server) on the PR-8 hardened transport, and its headline
+property is that the fleet *keeps serving* through replica death and
+model rollout:
+
+* **Least-outstanding balancing** — each request routes to the ready
+  replica with the fewest attempts in flight (ties break to the least
+  served, so an idle fleet round-robins); readiness is the replica's own
+  reported state, so a compiling/warming/draining replica takes no
+  traffic.
+* **Hedged retries** — predict is idempotent, so after a p99-derived
+  hedge timeout (``MXNET_FLEET_HEDGE_MS=0`` derives ``2 × p99`` from the
+  router's own attempt latencies; an explicit value pins it) a duplicate
+  fires to a *different* replica and the first reply wins.  Tail latency
+  from one slow replica stops being the fleet's tail.
+* **Failover** — a failed attempt (dead connection, RPC timeout,
+  replica-side executor fault, ``busy`` backpressure) immediately
+  re-routes to an untried replica, up to ``MXNET_FLEET_MAX_ATTEMPTS``,
+  all bounded by the request deadline
+  (``MXNET_FLEET_REQUEST_TIMEOUT_MS``): an accepted request completes —
+  hedged or failed over — within its deadline, or fails structurally,
+  never hangs.
+* **Per-replica circuit breakers** (reusing
+  :class:`~.slots.CircuitBreaker`) — a replica that fails repeatedly is
+  shed from routing until its half-open probe succeeds.
+* **Health-gated membership** — replicas heartbeat on dedicated
+  connections (``MXNET_FLEET_HEARTBEAT_S``); a kill -9'd replica is
+  detected by disconnect instantly and by staleness within
+  ``MXNET_FLEET_DEAD_AFTER_S`` (default 2x the interval), then shed
+  while its in-flight requests fail over.  A restarted replica
+  re-registers into its dead rank, warms from the checkpoint tier, and
+  takes traffic only once it reports ``ready``.
+* **Zero-downtime rollout** — :meth:`FleetRouter.rolling_reload` (the
+  router's ``POST /v1/models/<m>/reload``) walks replicas one at a
+  time: hold traffic, drain in-flight, compile-then-swap via the slot
+  ``reload``, resume on ``ready``.  Survivors carry the load, so a full
+  fleet rollout completes with zero failed requests.
+
+The :mod:`mxnet_tpu.chaos` ``fleet.route`` seam fires once per accepted
+request, in routing order, before a replica is picked — so router-side
+faults replay deterministically from a seeded spec.  Trace ids ride the
+wire for free (the :class:`~mxnet_tpu.dist_ps.Conn` trace context), so a
+request's router span, RPC events, and replica-side batch spans share
+one id end-to-end in ``trace_report --fleet`` merges.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .. import chaos as _chaos
+from .. import dist_ps as _ps
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .slots import CircuitBreaker
+from .batcher import Overloaded
+
+__all__ = ["FleetRouter", "current_router", "refresh_from_env",
+           "heartbeat_s", "dead_after_s",
+           "DEFAULT_HEARTBEAT_S", "DEFAULT_REQUEST_TIMEOUT_MS",
+           "DEFAULT_MAX_ATTEMPTS"]
+
+DEFAULT_HEARTBEAT_S = 0.5
+DEFAULT_REQUEST_TIMEOUT_MS = 10000.0
+DEFAULT_MAX_ATTEMPTS = 3
+DEFAULT_RELOAD_TIMEOUT_S = 600.0
+
+_ROUTABLE_STATES = ("ready",)
+_KNOWN_STATES = ("starting", "warming", "ready", "reloading", "draining",
+                 "stopped", "dead")
+
+
+# env parsing shared with the transport the fleet rides on (one
+# implementation to fix when a knob needs smarter parsing)
+_env_float = _ps._env_float
+_env_int = _ps._env_int
+
+
+def _read_env():
+    hb = _env_float("MXNET_FLEET_HEARTBEAT_S", DEFAULT_HEARTBEAT_S,
+                    minimum=0.05)
+    return {
+        "heartbeat": hb,
+        # the acceptance contract: a silent replica is shed within 2x
+        # the heartbeat interval (disconnects are instant regardless)
+        "dead_after": _env_float("MXNET_FLEET_DEAD_AFTER_S", 2.0 * hb,
+                                 minimum=0.1),
+        "hedge_ms": _env_float("MXNET_FLEET_HEDGE_MS", 0.0),
+        "request_timeout_ms": _env_float("MXNET_FLEET_REQUEST_TIMEOUT_MS",
+                                         DEFAULT_REQUEST_TIMEOUT_MS,
+                                         minimum=1.0),
+        "max_attempts": _env_int("MXNET_FLEET_MAX_ATTEMPTS",
+                                 DEFAULT_MAX_ATTEMPTS),
+        "reload_timeout": _env_float("MXNET_FLEET_RELOAD_TIMEOUT_S",
+                                     DEFAULT_RELOAD_TIMEOUT_S,
+                                     minimum=1.0),
+    }
+
+
+# cached at import (JG006 cached-value pattern; predict is the hot path)
+_ENV = _read_env()
+
+
+def refresh_from_env():
+    """Re-read every MXNET_FLEET_* knob (tests / live reconfig)."""
+    global _ENV
+    _ENV = _read_env()
+
+
+def heartbeat_s():
+    return _ENV["heartbeat"]
+
+
+def dead_after_s():
+    return _ENV["dead_after"]
+
+
+class _ReplicaHandle:
+    """Router-side view of one replica: address, reported state, the
+    balancing/breaker accounting, and a small idle-connection pool."""
+
+    _POOL_CAP = 4
+
+    def __init__(self, rank, addr, models):
+        self.rank = rank
+        self.addr = tuple(addr)
+        self.models = list(models or ())
+        self.state = "warming"
+        self.admin_hold = False        # router-held (rolling reload)
+        self.generation = 0            # bumped per (re-)registration
+        self.last_hb = time.monotonic()
+        self.breaker = CircuitBreaker()
+        self.outstanding = 0
+        self.served = 0
+        self.reported_outstanding = 0
+        self._lock = threading.Lock()
+        self._pool = []
+
+    # -- connection pool ---------------------------------------------------
+
+    def get_conn(self):
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return _ps.Conn.connect(self.addr, retries=2, delay=0.05)
+
+    def put_conn(self, conn):
+        with self._lock:
+            if len(self._pool) < self._POOL_CAP:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def close_conns(self):
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            conn.close()
+
+    # -- accounting --------------------------------------------------------
+
+    def inc_outstanding(self, delta):
+        with self._lock:
+            self.outstanding += delta
+
+    def routable(self, model=None):
+        return (self.state in _ROUTABLE_STATES
+                and not self.admin_hold
+                and (model is None or model in self.models))
+
+    def view(self):
+        with self._lock:
+            outstanding, served = self.outstanding, self.served
+        return {"addr": "%s:%s" % self.addr,
+                "state": "held" if self.admin_hold and
+                self.state == "ready" else self.state,
+                "models": list(self.models),
+                "outstanding": outstanding,
+                "served": served,
+                "reported_outstanding": self.reported_outstanding,
+                "breaker": self.breaker.state(),
+                "last_hb_age_s": round(time.monotonic() - self.last_hb,
+                                       3)}
+
+
+class _PredictBox:
+    """Shared completion state between a request's attempt threads."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.outs = None           # (names, arrays, replica_rank, kind)
+        self.app_error = None
+        self.fails = []            # [(kind, exception)]
+        self.finished = 0
+
+
+class FleetRouter:
+    """The replica registry + request router (one per router process)."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self._replicas = {}            # rank -> _ReplicaHandle
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._reload_lock = threading.Lock()
+        # p99 source for the derived hedge timeout: an unregistered
+        # Histogram (per-router series, not the flat global registry)
+        self._attempt_latency = _telemetry.Histogram("attempt_us")
+        self._listener = _ps.RpcListener(self._serve_conn, port=port,
+                                         host=host, name="fleet-router")
+        self.addr = self._listener.addr
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="mxnet-fleet-monitor",
+            daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        global _CURRENT
+        self._listener.start()
+        self._monitor.start()
+        _CURRENT = self
+        _telemetry.flight.record("fleet_router_start",
+                                 "%s:%s" % self.addr)
+        return self
+
+    def stop(self):
+        global _CURRENT
+        self._stop.set()
+        self._listener.stop()
+        with self._lock:
+            handles = list(self._replicas.values())
+        for handle in handles:
+            handle.close_conns()
+        if _CURRENT is self:
+            _CURRENT = None
+
+    def shutdown_replicas(self):
+        """Ask every live replica to exit (tests / orchestration)."""
+        with self._lock:
+            handles = list(self._replicas.values())
+        for handle in handles:
+            if handle.state == "dead":
+                continue
+            try:
+                conn = handle.get_conn()
+                conn.send(("shutdown",))
+                conn.recv(timeout=5.0)
+                conn.close()
+            except (OSError, ConnectionError):
+                pass
+
+    # -- registration + heartbeats (the listener side) ---------------------
+
+    def _serve_conn(self, conn):
+        try:
+            msg = conn.recv(timeout=max(dead_after_s() * 5, 15.0))
+        except (OSError, ConnectionError):
+            return
+        if not (isinstance(msg, tuple) and msg):
+            return
+        if msg[0] == "reg_replica":
+            _, addr, rank_hint, models = msg
+            rank = self._register(tuple(addr), rank_hint, models)
+            conn.send(("ranked", rank))
+            return
+        if msg[0] == "hb_replica":
+            self._serve_heartbeats(conn, int(msg[1]))
+
+    def _register(self, addr, rank_hint, models):
+        """Assign a rank: the same address re-registers in place (a
+        replica whose heartbeat link blipped must not appear twice),
+        else the hint wins when its slot is free or dead (a restarted
+        replica takes over its old rank), else the lowest dead rank,
+        else a fresh one."""
+        with self._lock:
+            rank = None
+            for r, h in self._replicas.items():
+                if h.addr == tuple(addr):
+                    rank = r
+                    break
+            if rank is None and isinstance(rank_hint, int) \
+                    and rank_hint >= 0:
+                cur = self._replicas.get(rank_hint)
+                if cur is None or cur.state == "dead":
+                    rank = rank_hint
+            if rank is None:
+                dead = sorted(r for r, h in self._replicas.items()
+                              if h.state == "dead")
+                rank = dead[0] if dead \
+                    else (max(self._replicas) + 1 if self._replicas else 0)
+            old = self._replicas.get(rank)
+            handle = _ReplicaHandle(rank, addr, models)
+            handle.generation = (old.generation + 1) if old else 0
+            self._replicas[rank] = handle
+        if old is not None:
+            old.close_conns()
+        _telemetry.bump("fleet_registrations")
+        _telemetry.flight.record(
+            "fleet_register", str(rank), addr="%s:%s" % tuple(addr),
+            rejoin=old is not None)
+        self.refresh_gauges()
+        return rank
+
+    def _handle_for(self, rank):
+        with self._lock:
+            return self._replicas.get(rank)
+
+    def _serve_heartbeats(self, conn, rank):
+        """Per-replica heartbeat loop: stamp arrivals, adopt the
+        replica's reported state, declare death on disconnect (instant)
+        or staleness.  *generation* guards the kill-then-restart race:
+        a dead connection from a superseded registration must not bury
+        the replica that just took the rank over."""
+        handle = self._handle_for(rank)
+        if handle is None:
+            return
+        generation = handle.generation
+        while not self._stop.is_set():
+            try:
+                msg = conn.recv(timeout=max(dead_after_s(), 0.05))
+            except _ps.RPCTimeout:
+                handle = self._handle_for(rank)
+                if handle is not None \
+                        and handle.generation == generation:
+                    self._mark_dead(handle, "heartbeat-stale")
+                continue
+            except (OSError, ConnectionError):
+                handle = self._handle_for(rank)
+                if handle is not None \
+                        and handle.generation == generation:
+                    self._mark_dead(handle, "heartbeat-disconnect")
+                return
+            handle = self._handle_for(rank)
+            if handle is None or handle.generation != generation:
+                return                 # superseded registration
+            handle.last_hb = time.monotonic()
+            if isinstance(msg, tuple) and msg and msg[0] == "hb":
+                state = str(msg[1])
+                if state in _KNOWN_STATES:
+                    if handle.state == "dead" and state != "dead":
+                        _telemetry.flight.record("fleet_revive",
+                                                 str(rank), state=state)
+                    handle.state = state
+                if len(msg) > 2:
+                    handle.reported_outstanding = int(msg[2])
+                if len(msg) > 3 and msg[3]:
+                    handle.models = list(msg[3])
+
+    def _mark_dead(self, handle, reason):
+        if handle.state == "dead":
+            return
+        handle.state = "dead"
+        handle.close_conns()
+        _telemetry.bump("fleet_replica_deaths")
+        _telemetry.flight.record("fleet_replica_dead", str(handle.rank),
+                                 reason=reason)
+        self.refresh_gauges()
+
+    def _monitor_loop(self):
+        """Staleness tripwire: disconnects shed a dead replica
+        instantly; this sweep catches the truly-silent-on-a-live-socket
+        case within the 2x-heartbeat contract."""
+        while not self._stop.wait(max(heartbeat_s() / 2.0, 0.025)):
+            now = time.monotonic()
+            with self._lock:
+                handles = list(self._replicas.values())
+            for handle in handles:
+                if handle.state != "dead" \
+                        and now - handle.last_hb > dead_after_s():
+                    self._mark_dead(handle, "heartbeat-stale")
+            self.refresh_gauges()
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick(self, model, tried):
+        """Least-outstanding ready replica not yet tried (ties: least
+        served, then rank — an idle fleet round-robins).  The breaker is
+        consulted in preference order and only until one admits:
+        ``allow()`` on a half-open breaker CLAIMS its single probe
+        lease, so asking every candidate up front would burn the leases
+        of replicas this request never dispatches to and wedge fleet
+        recovery."""
+        with self._lock:
+            candidates = [h for h in self._replicas.values()
+                          if h.rank not in tried and h.routable(model)]
+            candidates.sort(
+                key=lambda h: (h.outstanding, h.served, h.rank))
+            for handle in candidates:
+                if handle.breaker.allow():
+                    return handle
+            return None
+
+    def _launch(self, handle, model, inputs, deadline, box, kind):
+        handle.inc_outstanding(1)
+        threading.Thread(
+            target=self._attempt,
+            args=(handle, model, inputs, deadline, box, kind),
+            name="mxnet-fleet-attempt-%d" % handle.rank,
+            daemon=True).start()
+
+    def _attempt(self, handle, model, inputs, deadline, box, kind):
+        """One replica RPC; posts its outcome into the request's box.
+        Every wait is bounded by the request deadline."""
+        t0 = time.perf_counter()
+        reply = err = None
+        conn = None
+        try:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                raise _ps.RPCTimeout("request deadline passed before "
+                                     "the attempt dispatched")
+            conn = handle.get_conn()
+            conn.send(("predict", model, inputs,
+                       round(remaining * 1e3, 1)))
+            reply = conn.recv(
+                timeout=max(0.01, deadline - time.perf_counter()))
+        except Exception as exc:  # noqa: BLE001 — the box MUST resolve:
+            # PeerLost/RPCTimeout/ProtocolError or any unexpected bug;
+            # a dead attempt thread would otherwise leave the request
+            # waiting out its full deadline.  The conn is suspect —
+            # never recycle it.
+            handle.breaker.record(ok=False)
+            err = exc
+            if conn is not None:
+                conn.close()
+                conn = None
+        else:
+            handle.put_conn(conn)
+        handle.inc_outstanding(-1)
+        with box.cond:
+            box.finished += 1
+            if err is not None:
+                box.fails.append((kind, err))
+            elif (isinstance(reply, tuple) and reply
+                  and reply[0] == "outs"):
+                handle.breaker.record(ok=True)
+                with handle._lock:
+                    handle.served += 1
+                self._attempt_latency.observe(
+                    (time.perf_counter() - t0) * 1e6)
+                if box.outs is None:
+                    box.outs = (list(reply[1]), list(reply[2]),
+                                reply[3], kind)
+            elif (isinstance(reply, tuple) and reply
+                  and reply[0] in ("busy", "not_ready")):
+                # backpressure, not a fault: route around, no breaker hit
+                box.fails.append((kind, Overloaded(
+                    "replica %d is %s: %s"
+                    % (handle.rank, reply[0], reply[1]))))
+            elif (isinstance(reply, tuple) and reply
+                  and reply[0] == "fail"):
+                handle.breaker.record(ok=False)
+                box.fails.append((kind, MXNetError(str(reply[1]))))
+            elif (isinstance(reply, tuple) and reply
+                  and reply[0] == "err"):
+                # the request's own fault: any replica would answer the
+                # same, so propagate instead of burning failovers
+                handle.breaker.record(ok=True)
+                if box.app_error is None:
+                    box.app_error = MXNetError(str(reply[1]))
+            else:
+                handle.breaker.record(ok=False)
+                box.fails.append((kind, MXNetError(
+                    "replica %d sent malformed reply %r"
+                    % (handle.rank, reply))))
+            box.cond.notify_all()
+
+    def _hedge_timeout_s(self):
+        """The p99-derived hedge delay: 2x the router's own attempt p99,
+        clamped to [25ms, 1s]; ``MXNET_FLEET_HEDGE_MS`` pins it; before
+        enough samples exist the conservative 250ms floor applies."""
+        if _ENV["hedge_ms"] > 0:
+            return _ENV["hedge_ms"] / 1e3
+        hist = self._attempt_latency
+        if hist.count >= 20:
+            return min(max(2.0 * hist.percentile(99) / 1e6, 0.025), 1.0)
+        return 0.25
+
+    def predict(self, model, inputs, timeout_s=None):
+        """Route one predict; returns the output arrays (first winning
+        reply).  See :meth:`predict_detail` for the attempt metadata."""
+        return self.predict_detail(model, inputs, timeout_s=timeout_s)[0]
+
+    def predict_detail(self, model, inputs, timeout_s=None):
+        """Route one predict with hedging + failover; returns
+        ``(outputs, meta)`` where meta carries the serving replica rank,
+        output names, attempt count, and whether the hedge won."""
+        if _chaos.active():
+            act = _chaos.decide("fleet.route")
+            if act is not None:
+                _chaos.apply_inline(act)
+        _telemetry.bump("fleet_requests")
+        t0 = time.perf_counter()
+        deadline = t0 + (timeout_s if timeout_s
+                         else _ENV["request_timeout_ms"] / 1e3)
+        max_attempts = _ENV["max_attempts"]
+        box = _PredictBox()
+        tried = set()
+        with _telemetry.span("fleet_route", cat="serving",
+                             args={"model": model}):
+            first = self._pick(model, tried)
+            if first is None:
+                self._refuse(model)            # raises 404 or shed/503
+            tried.add(first.rank)
+            self._launch(first, model, inputs, deadline, box, "primary")
+            launched, consumed, hedged = 1, 0, False
+            hedge_at = t0 + self._hedge_timeout_s()
+            last_err = None
+            while True:
+                with box.cond:
+                    if (box.outs is None and box.app_error is None
+                            and len(box.fails) == consumed):
+                        horizon = deadline if hedged else \
+                            min(deadline, hedge_at)
+                        wait_s = horizon - time.perf_counter()
+                        box.cond.wait(min(max(wait_s, 0.0), 0.05)
+                                      + 0.001)
+                    outs = box.outs
+                    app_error = box.app_error
+                    new_fails = box.fails[consumed:]
+                    finished = box.finished
+                if outs is not None:
+                    return self._finish(model, outs, t0,
+                                        attempts=launched)
+                if app_error is not None:
+                    _telemetry.bump("fleet_errors")
+                    raise app_error
+                now = time.perf_counter()
+                for kind, exc in new_fails:
+                    consumed += 1
+                    last_err = exc
+                    if now < deadline and launched < max_attempts:
+                        nxt = self._pick(model, tried)
+                        if nxt is not None:
+                            tried.add(nxt.rank)
+                            self._launch(nxt, model, inputs, deadline,
+                                         box, "failover")
+                            launched += 1
+                            _telemetry.bump("fleet_failovers")
+                if not hedged and now >= hedge_at:
+                    if (now < deadline and launched < max_attempts
+                            and finished < launched):
+                        nxt = self._pick(model, tried)
+                        if nxt is not None:
+                            tried.add(nxt.rank)
+                            self._launch(nxt, model, inputs, deadline,
+                                         box, "hedge")
+                            launched += 1
+                            _telemetry.bump("fleet_hedges")
+                    # the hedge window resolves exactly once — placed,
+                    # or given up (attempts exhausted / no untried
+                    # replica).  Leaving it open would re-poll _pick
+                    # under the router lock at ~1 kHz until the
+                    # deadline because the wait horizon stays in the
+                    # past.
+                    hedged = True
+                with box.cond:
+                    finished = box.finished
+                    settled = (box.outs is not None
+                               or box.app_error is not None
+                               or len(box.fails) > consumed)
+                if settled:
+                    continue           # resolve it on the next pass
+                if finished >= launched:
+                    _telemetry.bump("fleet_errors")
+                    raise Overloaded(
+                        "fleet predict for %r failed on every routable "
+                        "replica (%d attempt(s)); last error: %r"
+                        % (model, launched, last_err))
+                if now >= deadline:
+                    _telemetry.bump("fleet_errors")
+                    raise MXNetError(
+                        "fleet predict for %r timed out after %.1fs "
+                        "(%d attempt(s) in flight)"
+                        % (model, time.perf_counter() - t0, launched))
+
+    def _finish(self, model, outs, t0, attempts):
+        names, arrays, rank, kind = outs
+        latency_us = (time.perf_counter() - t0) * 1e6
+        _telemetry.observe("fleet_request_us", latency_us)
+        meta = {"replica": rank, "output_names": names,
+                "attempts": attempts, "hedged_win": kind == "hedge",
+                "latency_us": latency_us}
+        return arrays, meta
+
+    def _refuse(self, model):
+        """No routable replica: 404 when the model is unknown fleetwide,
+        503 (shed) when replicas exist but none can take traffic."""
+        with self._lock:
+            handles = list(self._replicas.values())
+        known_anywhere = any(model in h.models for h in handles)
+        routable_any = any(h.routable() for h in handles)
+        if routable_any and not known_anywhere:
+            raise MXNetError(
+                "model %r is not loaded on any replica (fleet of %d)"
+                % (model, len(handles)))
+        _telemetry.bump("fleet_shed")
+        raise Overloaded(
+            "no routable replica for %r (%d registered: dead, warming, "
+            "breaker-open, or held); retry later"
+            % (model, len(handles)))
+
+    # -- rollout -----------------------------------------------------------
+
+    def rolling_reload(self, model, prefix=None, epoch=None,
+                       drain_timeout_s=10.0):
+        """Zero-downtime rollout: walk ready replicas one at a time —
+        hold new traffic, drain in-flight, compile-then-swap via the
+        replica's slot ``reload``, resume.  Stops at the first failure
+        (survivors keep the old weights — a canary abort, not a
+        half-broken fleet).  Returns {rank: "ok" | "error: ..."}."""
+        if not self._reload_lock.acquire(blocking=False):
+            raise MXNetError("a rolling reload is already in progress")
+        try:
+            with self._lock:
+                targets = sorted(
+                    (h for h in self._replicas.values()
+                     if h.routable(model)), key=lambda h: h.rank)
+            if not targets:
+                raise MXNetError(
+                    "model %r is not loaded on any ready replica"
+                    % model)
+            spec = {"prefix": prefix, "epoch": epoch}
+            results = {}
+            for handle in targets:
+                handle.admin_hold = True
+                try:
+                    t_end = time.monotonic() + drain_timeout_s
+                    while handle.outstanding > 0 \
+                            and time.monotonic() < t_end:
+                        time.sleep(0.01)
+                    conn = handle.get_conn()
+                    try:
+                        conn.send(("reload", model, spec))
+                        reply = conn.recv(timeout=_ENV["reload_timeout"])
+                    except (OSError, ConnectionError) as exc:
+                        conn.close()
+                        results[handle.rank] = "error: %r" % (exc,)
+                        break
+                    handle.put_conn(conn)
+                    if isinstance(reply, tuple) and reply \
+                            and reply[0] == "ok":
+                        results[handle.rank] = "ok"
+                        _telemetry.bump("fleet_reloads")
+                    else:
+                        results[handle.rank] = "error: %s" % (
+                            reply[1] if isinstance(reply, tuple)
+                            and len(reply) > 1 else reply,)
+                        break
+                finally:
+                    handle.admin_hold = False
+            _telemetry.flight.record(
+                "fleet_rolling_reload", model,
+                ok=all(v == "ok" for v in results.values()),
+                replicas=len(results))
+            return results
+        finally:
+            self._reload_lock.release()
+
+    # -- views -------------------------------------------------------------
+
+    def ready_count(self):
+        with self._lock:
+            return sum(1 for h in self._replicas.values()
+                       if h.routable())
+
+    def total_count(self):
+        with self._lock:
+            return len(self._replicas)
+
+    def models(self):
+        """Every model some routable replica advertises."""
+        with self._lock:
+            names = set()
+            for h in self._replicas.values():
+                if h.routable():
+                    names.update(h.models)
+        return sorted(names)
+
+    def wait_ready(self, n, timeout=60.0):
+        """Poll until *n* replicas are routable; False on timeout."""
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            if self.ready_count() >= n:
+                return True
+            time.sleep(0.02)
+        return self.ready_count() >= n
+
+    def http_view(self):
+        """The /fleet serving view: replica table + routing counters."""
+        with self._lock:
+            replicas = {str(r): h.view()
+                        for r, h in sorted(self._replicas.items())}
+        return {"addr": "%s:%s" % self.addr,
+                "replicas": replicas,
+                "replicas_ready": self.ready_count(),
+                "replicas_total": len(replicas),
+                "models": self.models(),
+                "hedge_timeout_ms": round(
+                    self._hedge_timeout_s() * 1e3, 1),
+                "counters": {name: _telemetry.counter(name) for name in
+                             ("fleet_requests", "fleet_hedges",
+                              "fleet_failovers", "fleet_errors",
+                              "fleet_shed", "fleet_replica_deaths",
+                              "fleet_registrations", "fleet_reloads")}}
+
+    def refresh_gauges(self):
+        with self._lock:
+            handles = list(self._replicas.values())
+        _telemetry.set_gauge("fleet_replicas_ready",
+                             sum(1 for h in handles if h.routable()))
+        _telemetry.set_gauge("fleet_replicas_total", len(handles))
+        _telemetry.set_gauge("fleet_outstanding",
+                             sum(h.outstanding for h in handles))
+
+
+_CURRENT = None
+
+
+def current_router():
+    """The process's started FleetRouter, or None (the /v1 + /fleet
+    delegation hook — observe-only callers never construct one)."""
+    return _CURRENT

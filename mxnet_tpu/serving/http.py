@@ -20,6 +20,13 @@ Routes (all JSON):
     POST /v1/models/<name>/unload          {}
     POST /v1/models/<name>/reload          {"prefix"?, "epoch"?}
 
+When this process runs a started :class:`~.fleet.FleetRouter`, the same
+surface fronts the whole fleet instead of local slots: predict routes
+through the balancer (hedged/failed-over; the response names the serving
+replica), reload runs the zero-downtime rolling rollout across every
+ready replica, and GET returns the fleet table.  load/unload stay
+per-replica operations (400 on the router).
+
 Status codes are the contract the load generator and any real LB probe
 rely on: 200 ok, 400 malformed, 404 unknown model/route, 503 overloaded
 (bounded queue full — retry later), 500 internal.
@@ -27,6 +34,7 @@ rely on: 200 ok, 400 malformed, 404 unknown model/route, 503 overloaded
 from __future__ import annotations
 
 import json
+import sys
 
 import numpy as np
 
@@ -35,6 +43,13 @@ from .batcher import Overloaded
 from .slots import get_registry
 
 __all__ = ["handle"]
+
+
+def _current_router():
+    """The process's FleetRouter, or None — a ``sys.modules`` lookup so
+    a process that never imported the fleet tier pays nothing."""
+    fleet_mod = sys.modules.get("mxnet_tpu.serving.fleet")
+    return fleet_mod.current_router() if fleet_mod is not None else None
 
 
 def _json(code, obj):
@@ -68,15 +83,25 @@ def _route(method, path, body):
     parts = [p for p in path.split("/") if p]      # ["v1", "models", ...]
     if len(parts) < 2 or parts[0] != "v1" or parts[1] != "models":
         return _error(404, "unknown route %r" % path)
+    router = _current_router()
     registry = get_registry()
     if len(parts) == 2:
         if method != "GET":
             return _error(400, "use GET on /v1/models")
+        if router is not None:
+            return _json(200, {"models": registry.stats(),
+                               "fleet": router.http_view()})
         return _json(200, {"models": registry.stats()})
     name = parts[2]
     if len(parts) == 3:
         if method != "GET":
             return _error(400, "use GET on /v1/models/<name>")
+        if router is not None:
+            view = router.http_view()
+            if name not in view["models"]:
+                return _error(404, "model %r is not loaded on any "
+                                   "routable replica" % name)
+            return _json(200, {name: {"fleet": view}})
         return _json(200, {name: registry.get(name).stats()})
     action = parts[3]
     if len(parts) > 4:
@@ -84,20 +109,72 @@ def _route(method, path, body):
     if action == "predict":
         if method != "POST":
             return _error(400, "predict is POST-only")
+        if router is not None:
+            return _fleet_predict(router, name, body)
         return _predict(registry, name, body)
     if method != "POST":
         return _error(400, "%s is POST-only" % action)
+    if action == "reload":
+        spec = _parse_body(body)
+        if router is not None:
+            results = router.rolling_reload(name,
+                                            prefix=spec.get("prefix"),
+                                            epoch=spec.get("epoch"))
+            ok = all(v == "ok" for v in results.values())
+            return _json(200 if ok else 500,
+                         {"reloaded": name,
+                          "replicas": {str(r): v
+                                       for r, v in results.items()},
+                          "ok": ok})
+        registry.reload(name, prefix=spec.get("prefix"),
+                        epoch=spec.get("epoch"))
+        return _json(200, {"reloaded": name})
+    if router is not None:
+        return _error(400, "%s is a per-replica operation; the fleet "
+                           "router only routes predict and rolling "
+                           "reload" % action)
     if action == "load":
         return _load(registry, name, body)
     if action == "unload":
         registry.unload(name)
         return _json(200, {"unloaded": name})
-    if action == "reload":
-        spec = _parse_body(body)
-        registry.reload(name, prefix=spec.get("prefix"),
-                        epoch=spec.get("epoch"))
-        return _json(200, {"reloaded": name})
     return _error(404, "unknown action %r" % action)
+
+
+def _fleet_predict(router, name, body):
+    """Router-mode predict: parse like the local path, route through the
+    fleet balancer, answer with the serving replica's identity."""
+    obj = _parse_body(body)
+    raw = obj.get("inputs", obj)
+    if not isinstance(raw, dict) or not raw:
+        raise MXNetError(
+            'predict body must be {"inputs": {name: [[...]], ...}}')
+    timeout = _number(obj, "timeout_s")
+    inputs = {}
+    for key, val in raw.items():
+        if key in ("inputs", "timeout_s", "deadline_ms"):
+            continue
+        try:
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:     # replicas re-cast anyway;
+                arr = arr.astype(np.float32)  # don't ship double bytes
+            inputs[key] = arr
+        except (TypeError, ValueError) as exc:
+            raise MXNetError("input %r is not a numeric array: %s"
+                             % (key, exc))
+    outs, meta = router.predict_detail(name, inputs, timeout_s=timeout)
+    rows = int(next(iter(inputs.values())).shape[0])
+    return _json(200, {
+        "model": name,
+        "batch": rows,
+        "latency_us": round(meta["latency_us"], 1),
+        "replica": meta["replica"],
+        "attempts": meta["attempts"],
+        "hedged": meta["hedged_win"],
+        "outputs": {out_name: np.asarray(out).tolist()
+                    for out_name, out in zip(meta["output_names"],
+                                             outs)},
+    })
 
 
 def _parse_body(body):

@@ -1,0 +1,379 @@
+"""One serving replica: a slot-table process on the dist_ps transport.
+
+A replica is the fleet's unit of capacity and of failure: an ordinary
+:class:`~.slots.ModelRegistry` (AOT bucket tables + continuous batchers,
+exactly the PR-6 single-process serving tier) wrapped in a wire server
+speaking the hardened :class:`mxnet_tpu.dist_ps.Conn` protocol
+(length-prefixed, magic/version-checked, restricted-unpickler payloads)
+so the :class:`~.fleet.FleetRouter` can spread predict traffic over N of
+them and kill -9 any one without losing accepted requests.
+
+Lifecycle / readiness state machine (what ``/readyz`` and the router's
+routing decision key off)::
+
+    starting ──register──▶ warming ──slots compiled──▶ ready
+        ready ──reload RPC──▶ reloading ──swap done──▶ ready
+        ready ──drain RPC───▶ draining (in-flight finishes, no new work)
+
+A replica registers with its router *before* warming (so the fleet view
+shows it coming up), but advertises ``ready`` only after every slot's
+bucket table is compiled — warm loads come from the checkpoint tier (the
+same ``save_checkpoint`` artifacts ``ModelRegistry.load`` already
+consumes), so a restarted replica re-registers into its dead rank,
+recompiles, and only then takes traffic.  Heartbeats ride a dedicated
+router connection (``MXNET_FLEET_HEARTBEAT_S``) carrying the current
+state, so the router's view converges within one interval and a dead
+process is detected by disconnect instantly.
+
+Wire ops (request → reply):
+
+=====================================  ===============================
+``("predict", model, inputs, dl_ms)``  ``("outs", names, arrays, rank)``
+                                       / ``("busy", msg)`` backpressure
+                                       / ``("fail", msg)`` replica fault
+                                       / ``("err", msg)`` bad request
+                                       / ``("not_ready", state)``
+``("reload", model, spec)``            ``("ok",)`` / ``("err", msg)``
+``("load", model, spec)``              ``("ok",)`` / ``("err", msg)``
+``("status",)``                        ``("status", dict)``
+``("drain",)`` / ``("shutdown",)``     ``("ok",)``
+=====================================  ===============================
+
+The :mod:`mxnet_tpu.chaos` ``replica.predict`` seam fires once per
+predict RPC served, so replica-side faults (delays, failures) are
+deterministically injectable under a seeded spec.
+
+Run one from the command line (the shape ``tools/fleet_smoke.py`` and
+``serve_bench --fleet`` spawn)::
+
+    python -m mxnet_tpu.serving.replica --router 127.0.0.1:9200 \\
+        --name mlp --prefix ckpt/mlp --epoch 0 \\
+        --input-shapes '{"data": [1, 784]}'
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import chaos as _chaos
+from .. import dist_ps as _ps
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .batcher import Overloaded
+from .slots import ModelRegistry
+from . import fleet as _fleet
+
+__all__ = ["ReplicaServer", "current_replica", "main"]
+
+
+_CURRENT = None            # the process's ReplicaServer (readiness view)
+
+
+def current_replica():
+    """This process's replica server, or None (the /readyz hook)."""
+    return _CURRENT
+
+
+class ReplicaServer:
+    """The wire wrapper around one process's model slots.
+
+    *router* is the ``(host, port)`` of the fleet router to register
+    with (None = standalone, for tests driving the wire ops directly);
+    *registry* defaults to a private :class:`ModelRegistry` so several
+    in-process replicas (tests) stay independent — the CLI main uses
+    the process singleton so ``/v1`` and ``/readyz`` work locally too.
+    """
+
+    def __init__(self, router=None, port=0, rank_hint=None,
+                 registry=None):
+        global _CURRENT
+        self.router = tuple(router) if router is not None else None
+        self.rank = None
+        self.rank_hint = rank_hint
+        self.state = "starting"
+        self.registry = registry if registry is not None \
+            else ModelRegistry()
+        self._outstanding = 0
+        self._served = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._hb_conn = None
+        self._hb_thread = None
+        self._listener = _ps.RpcListener(self._serve_conn, port=port,
+                                         name="replica")
+        self.addr = self._listener.addr
+        _CURRENT = self
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._listener.start()
+        if self.router is not None:
+            self._register()                 # raises if the router is gone
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name="mxnet-replica-hb", daemon=True)
+            self._hb_thread.start()
+        return self
+
+    def load(self, name, **kwargs):
+        """Warm one slot from the checkpoint tier (compiles the whole
+        bucket table before returning — the warm-load cost that buys a
+        retrace-free request path)."""
+        self.state = "warming"
+        slot = self.registry.load(name, **kwargs)
+        _telemetry.flight.record("replica_warm", name,
+                                 rank=self.rank,
+                                 buckets=len(slot.program.buckets))
+        return slot
+
+    def advertise_ready(self):
+        """Flip to ``ready`` — call after every slot is loaded.  The
+        next heartbeat carries the state; the router routes from then."""
+        self.state = "ready"
+        self._send_heartbeat_now()
+        return self
+
+    def stop(self, drain=True):
+        """Stop serving.  *drain=False* is the test harness's stand-in
+        for a crash: listener and conns die with requests in flight."""
+        global _CURRENT
+        self.state = "draining" if drain else "stopped"
+        self._stop.set()
+        self._listener.stop()
+        conn = self._hb_conn
+        if conn is not None:
+            conn.close()
+        self.registry.shutdown(drain=drain)
+        self.state = "stopped"
+        if _CURRENT is self:       # a stopped replica gates nothing
+            _CURRENT = None
+
+    def wait_shutdown(self, poll_s=1.0):
+        """Block until a ``shutdown`` RPC (the CLI main's park loop)."""
+        while not self._stop.wait(poll_s):
+            pass
+
+    # -- router registration + heartbeats ----------------------------------
+
+    def _register(self, retries=50, delay=0.1):
+        """Dial the router, claim a rank (preferring *rank_hint* — a
+        restarted replica re-registers into its dead rank), then open
+        the dedicated heartbeat connection."""
+        hint = self.rank if self.rank is not None else self.rank_hint
+        conn = _ps.Conn.connect(self.router, retries=retries, delay=delay)
+        try:
+            conn.send(("reg_replica", tuple(self.addr), hint,
+                       self.registry.names()))
+            reply = conn.recv(timeout=max(_fleet.dead_after_s() * 5, 15.0))
+        finally:
+            conn.close()
+        if not (isinstance(reply, tuple) and reply
+                and reply[0] == "ranked"):
+            raise MXNetError("router at %s:%s refused registration: %r"
+                             % (self.router[0], self.router[1], reply))
+        self.rank = int(reply[1])
+        hb = _ps.Conn.connect(self.router, retries=retries, delay=delay)
+        hb.send(("hb_replica", self.rank))
+        self._hb_conn = hb
+        _telemetry.flight.record("replica_registered", str(self.rank),
+                                 addr="%s:%s" % self.addr)
+        return self.rank
+
+    def _send_heartbeat_now(self):
+        conn = self._hb_conn
+        if conn is None:
+            return
+        try:
+            with self._lock:
+                outstanding = self._outstanding
+            conn.send(("hb", self.state, outstanding,
+                       self.registry.names()))
+        except (OSError, ConnectionError):
+            self._hb_conn = None       # the hb loop re-registers
+
+    def _hb_loop(self):
+        """Periodic state heartbeats; a lost router connection triggers
+        re-registration (bounded dial per tick, so a router restart is
+        survived without a thundering reconnect loop)."""
+        while not self._stop.wait(_fleet.heartbeat_s()):
+            if self._hb_conn is None:
+                try:
+                    self._register(retries=1, delay=0)
+                except (OSError, ConnectionError, MXNetError):
+                    continue           # router still gone; next tick
+            self._send_heartbeat_now()
+
+    # -- the wire ops ------------------------------------------------------
+
+    def _serve_conn(self, conn):
+        while not self._stop.is_set():
+            try:
+                # a replica waits on its router between RPCs by design
+                # (liveness is the heartbeat link's job): deliberate
+                # unbounded recv, the JG007 annotation
+                msg = conn.recv(timeout=None)
+            except (OSError, ConnectionError):
+                return
+            try:
+                reply = self._handle(msg)
+            except Exception as exc:   # the ops surface never dies
+                reply = ("err", "replica error: %r" % (exc,))
+            if reply is not None:
+                conn.send(reply)
+
+    def _handle(self, msg):
+        if not (isinstance(msg, tuple) and msg
+                and isinstance(msg[0], str)):
+            raise _ps.ProtocolError("malformed replica request %r"
+                                    % (msg,))
+        op = msg[0]
+        if op == "predict":
+            return self._predict(*msg[1:])
+        if op == "status":
+            return ("status", self.status())
+        if op == "load":
+            _, name, spec = msg
+            # a replica that was serving keeps serving: load() flips to
+            # "warming" for the compile, but an already-ready replica
+            # must come back even when the load FAILS — its existing
+            # models are intact (only the initial CLI warm-up leaves
+            # the ready flip to an explicit advertise_ready)
+            was_ready = self.state == "ready"
+            try:
+                self.load(name, **self._load_kwargs(spec))
+            finally:
+                if was_ready:
+                    self.state = "ready"
+                    self._send_heartbeat_now()
+            return ("ok",)
+        if op == "reload":
+            return self._reload(*msg[1:])
+        if op == "drain":
+            self.state = "draining"
+            self._send_heartbeat_now()
+            return ("ok",)
+        if op == "shutdown":
+            self._stop.set()
+            return ("ok",)
+        raise _ps.ProtocolError("unknown replica op %r" % (op,))
+
+    @staticmethod
+    def _load_kwargs(spec):
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in spec["input_shapes"].items()}
+        return dict(prefix=spec["prefix"],
+                    epoch=int(spec.get("epoch") or 0),
+                    input_shapes=shapes,
+                    buckets=spec.get("buckets"),
+                    max_batch=spec.get("max_batch"))
+
+    def _predict(self, model, inputs, deadline_ms=None):
+        """Serve one routed predict.  Reply tags encode retryability for
+        the router: ``busy``/``fail``/``not_ready`` are safe to route
+        elsewhere (predict is idempotent), ``err`` is the request's own
+        fault and retrying would fail identically."""
+        if self.state != "ready":
+            return ("not_ready", self.state)
+        if _chaos.active():
+            act = _chaos.decide("replica.predict")
+            if act is not None:
+                try:
+                    _chaos.apply_inline(act)
+                except (OSError, _chaos.ChaosError) as exc:
+                    return ("fail", "chaos: %r" % (exc,))
+        timeout_s = max(0.01, float(deadline_ms) / 1e3) \
+            if deadline_ms else 60.0
+        with self._lock:
+            self._outstanding += 1
+        try:
+            slot = self.registry.get(model)
+            request = slot.submit(inputs, timeout_ms=deadline_ms)
+            outs = request.wait(timeout_s)
+        except Overloaded as exc:
+            return ("busy", str(exc))
+        except MXNetError as exc:
+            message = str(exc)
+            # executor failures are the replica's fault (retry elsewhere);
+            # malformed requests would fail identically on any replica
+            if "predict batch failed" in message \
+                    or "timed out" in message:
+                return ("fail", message)
+            return ("err", message)
+        finally:
+            with self._lock:
+                self._outstanding -= 1
+        with self._lock:
+            self._served += 1
+        _telemetry.bump("replica_predicts")
+        return ("outs", slot.program.output_names, outs, self.rank)
+
+    def _reload(self, model, spec=None):
+        """Compile-then-swap reload, readiness-gated: the replica
+        reports ``reloading`` (no new fleet traffic) for the compile,
+        in-flight batches finish on the old program."""
+        spec = spec or {}
+        self.state = "reloading"
+        self._send_heartbeat_now()
+        try:
+            self.registry.reload(model, prefix=spec.get("prefix"),
+                                 epoch=spec.get("epoch"))
+        except MXNetError as exc:
+            return ("err", str(exc))
+        finally:
+            self.state = "ready"
+            self._send_heartbeat_now()
+        return ("ok",)
+
+    def status(self):
+        with self._lock:
+            outstanding, served = self._outstanding, self._served
+        return {"rank": self.rank, "state": self.state,
+                "addr": "%s:%s" % self.addr,
+                "outstanding": outstanding, "served": served,
+                "models": self.registry.names()}
+
+
+def main(argv=None):
+    """CLI entry: warm the slots from the checkpoint tier, register,
+    serve until the router says shutdown (or the process is killed)."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        description="one mxnet_tpu serving replica")
+    parser.add_argument("--router", required=True,
+                        help="fleet router host:port")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--rank-hint", type=int, default=None)
+    parser.add_argument("--name", required=True)
+    parser.add_argument("--prefix", required=True)
+    parser.add_argument("--epoch", type=int, default=0)
+    parser.add_argument("--input-shapes", required=True,
+                        help='JSON, e.g. {"data": [1, 784]}')
+    parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--buckets", default=None,
+                        help="comma-separated bucket ladder")
+    args = parser.parse_args(argv)
+
+    host, _, port = args.router.partition(":")
+    shapes = {k: tuple(int(d) for d in v)
+              for k, v in json.loads(args.input_shapes).items()}
+    buckets = [int(b) for b in args.buckets.split(",")] \
+        if args.buckets else None
+
+    from .slots import get_registry
+    replica = ReplicaServer(router=(host, int(port)), port=args.port,
+                            rank_hint=args.rank_hint,
+                            registry=get_registry()).start()
+    replica.load(args.name, prefix=args.prefix, epoch=args.epoch,
+                 input_shapes=shapes, max_batch=args.max_batch,
+                 buckets=buckets)
+    replica.advertise_ready()
+    replica.wait_shutdown()
+    replica.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

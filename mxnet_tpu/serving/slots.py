@@ -18,11 +18,11 @@ import time
 
 from .. import telemetry as _telemetry
 from ..base import MXNetError
-from .batcher import ContinuousBatcher
+from .batcher import CircuitBreaker, ContinuousBatcher
 from .program import PredictProgram
 
-__all__ = ["ModelSlot", "ModelRegistry", "SlotMetrics", "get_registry",
-           "reset_registry"]
+__all__ = ["ModelSlot", "ModelRegistry", "SlotMetrics", "CircuitBreaker",
+           "get_registry", "reset_registry"]
 
 
 class SlotMetrics:
@@ -169,6 +169,7 @@ class ModelRegistry:
 
     def __init__(self):
         self._slots = {}
+        self._loading = set()      # names mid-compile (the /readyz view)
         self._lock = threading.Lock()
 
     # -- management --------------------------------------------------------
@@ -192,11 +193,16 @@ class ModelRegistry:
                 raise MXNetError(
                     "model %r is already loaded (reload() to swap "
                     "weights, unload() first to change shapes)" % name)
-        slot = ModelSlot(name, predictor,
-                         source={"prefix": prefix, "epoch": epoch},
-                         buckets=buckets, max_batch=max_batch,
-                         queue_cap=queue_cap, timeout_ms=timeout_ms,
-                         use_engine=use_engine).start()
+            self._loading.add(name)      # /readyz: compiling = not ready
+        try:
+            slot = ModelSlot(name, predictor,
+                             source={"prefix": prefix, "epoch": epoch},
+                             buckets=buckets, max_batch=max_batch,
+                             queue_cap=queue_cap, timeout_ms=timeout_ms,
+                             use_engine=use_engine).start()
+        finally:
+            with self._lock:
+                self._loading.discard(name)
         with self._lock:
             if name in self._slots:      # lost a concurrent load race
                 slot.batcher.stop(drain=False)
@@ -233,12 +239,17 @@ class ModelRegistry:
                 "model %r was loaded from an in-memory predictor; "
                 "reload needs an explicit prefix" % name)
         from ..predict import Predictor
-        predictor = Predictor.load(
-            src["prefix"], src.get("epoch") or 0,
-            {n: tuple(s) for n, s in slot.program._input_shapes.items()},
-            ctx=ctx)
-        slot.swap(predictor)
-        slot.source = src
+        slot.status = "reloading"       # /readyz: compiling = not ready
+        try:
+            predictor = Predictor.load(
+                src["prefix"], src.get("epoch") or 0,
+                {n: tuple(s)
+                 for n, s in slot.program._input_shapes.items()},
+                ctx=ctx)
+            slot.swap(predictor)
+            slot.source = src
+        finally:
+            slot.status = "ready"
         _telemetry.flight.record("serving_reload", name)
         return slot
 
@@ -266,6 +277,19 @@ class ModelRegistry:
         with self._lock:
             slots = dict(self._slots)
         return {name: slot.stats() for name, slot in sorted(slots.items())}
+
+    def readiness(self):
+        """(ok, detail) for the ``/readyz`` view: not ready while any
+        slot is compiling (load in flight), reloading, or draining —
+        the state an external LB must not route new traffic into."""
+        with self._lock:
+            loading = sorted(self._loading)
+            slots = {name: slot.status
+                     for name, slot in sorted(self._slots.items())}
+        not_ready = loading + [name for name, status in slots.items()
+                               if status != "ready"]
+        return not not_ready, {"slots": slots, "loading": loading,
+                               "not_ready": sorted(set(not_ready))}
 
     def queue_depth_total(self):
         with self._lock:

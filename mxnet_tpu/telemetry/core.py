@@ -474,6 +474,24 @@ COUNTERS = {
     "ps_fleet_syncs": "fleet_sync exchanges completed on the heartbeat "
                       "link (digest out, peer/fleet tables + scheduler "
                       "clock back)",
+    "fleet_requests": "predict requests accepted by the serving fleet "
+                      "router",
+    "fleet_hedges": "hedged duplicate attempts fired after the "
+                    "p99-derived hedge timeout (first reply wins)",
+    "fleet_failovers": "predict attempts re-routed to another replica "
+                       "after a replica failure or not-ready reply",
+    "fleet_errors": "fleet predict requests that ultimately failed "
+                    "(every failover/hedge exhausted or deadline hit)",
+    "fleet_shed": "fleet predict requests refused with no routable "
+                  "replica (all dead, not-ready, or breaker-open)",
+    "fleet_replica_deaths": "replicas declared dead by the router "
+                            "(heartbeat disconnect or staleness)",
+    "fleet_registrations": "replica registrations accepted by the "
+                           "router (including re-registrations into a "
+                           "dead rank)",
+    "fleet_reloads": "per-replica reload RPCs completed during rolling "
+                     "rollouts",
+    "replica_predicts": "predict RPCs served by this replica process",
 }
 
 GAUGES = {
@@ -544,6 +562,13 @@ GAUGES = {
                           "the dist scheduler (RTT-midpoint method)",
     "ps_clock_rtt_us": "round-trip time of the last scheduler clock "
                        "exchange (offset error is bounded by RTT/2)",
+    "fleet_replicas_ready": "replicas the serving fleet router currently "
+                            "routes traffic to",
+    "fleet_replicas_total": "replicas registered with the serving fleet "
+                            "router (any state, including dead)",
+    "fleet_outstanding": "predict attempts in flight across all "
+                         "replicas (the least-outstanding balancing "
+                         "signal, summed)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)
@@ -571,6 +596,9 @@ HISTOGRAMS = {
     "serving_execute_us": ("serving batch execute segment (dispatch "
                            "wall; true device time on sampled batches "
                            "under MXNET_DEVICE_TIME)", _US_BUCKETS),
+    "fleet_request_us": ("fleet predict latency at the router, accept "
+                         "to first winning reply (hedges and failovers "
+                         "included)", _US_BUCKETS),
 }
 
 # Span names the framework itself emits (``span("...")`` literals).
@@ -596,6 +624,8 @@ SPANS = {
     "serving_execute": "executable-call segment of a serving batch",
     "serving_slice": "result slice/host-transfer segment of a serving "
                      "batch",
+    "fleet_route": "one fleet-routed predict request, router side "
+                   "(accept to winning reply or final failure)",
 }
 
 METRIC_NAMES = frozenset(COUNTERS) | frozenset(GAUGES) \

@@ -147,8 +147,8 @@ def health():
 # --------------------------------------------------------------------------
 
 _INDEX = ("mxnet_tpu introspection\n"
-          "endpoints: /metrics /healthz /snapshot /trace /flight /stacks "
-          "/checkpoints /peers /fleet /guardian\n"
+          "endpoints: /metrics /healthz /readyz /snapshot /trace "
+          "/flight /stacks /checkpoints /peers /fleet /guardian\n"
           "serving:   /v1/models  /v1/models/<name>[/predict|/load|"
           "/unload|/reload]\n")
 
@@ -204,6 +204,21 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/healthz":
                 ok, detail = health()
                 self._reply_json(detail, 200 if ok else 503)
+            elif path == "/readyz":
+                # READINESS, split from /healthz LIVENESS: "safe to
+                # route new traffic here" vs "process is not wedged".
+                # A replica compiling/warming/draining is alive (200 on
+                # /healthz) but not ready (503 here) — the router and
+                # any external LB key off this one.  Observe-only
+                # sys.modules delegation like /v1: a process without a
+                # serving tier is trivially ready.
+                serving = sys.modules.get("mxnet_tpu.serving")
+                if serving is None:
+                    self._reply_json({"ok": True, "serving": False}, 200)
+                else:
+                    ok, detail = serving.readiness()
+                    self._reply_json(dict(detail, ok=ok, serving=True),
+                                     200 if ok else 503)
             elif path == "/snapshot":
                 self._reply_json(core.snapshot())
             elif path == "/trace":
@@ -237,18 +252,27 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._reply_json(guard.http_view())
             elif path == "/fleet":
-                # observe-only sys.modules lookup, like /peers: reports
-                # the scheduler's live digest table in the scheduler
-                # process, the heartbeat thread's cached snapshot in a
-                # worker/server — never network IO from this handler.
+                # observe-only sys.modules lookup, like /peers: the
+                # dist part reports the scheduler's live digest table
+                # (or a worker's cached snapshot); the serving part
+                # reports the in-process FleetRouter's replica table —
+                # never network IO from this handler.
+                out = {}
                 dist = sys.modules.get("mxnet_tpu.dist_ps")
-                if dist is None:
+                if dist is not None:
+                    out = dist.fleet_view()
+                fleet_mod = sys.modules.get("mxnet_tpu.serving.fleet")
+                router = fleet_mod.current_router() \
+                    if fleet_mod is not None else None
+                if router is not None:
+                    out["serving_fleet"] = router.http_view()
+                if not out:
                     self._reply_json(
-                        {"error": "dist transport not initialized "
-                                  "(no mxnet_tpu.dist_ps in this "
-                                  "process)"}, 404)
+                        {"error": "no fleet in this process (neither "
+                                  "mxnet_tpu.dist_ps nor a serving "
+                                  "FleetRouter is initialized)"}, 404)
                 else:
-                    self._reply_json(dist.fleet_view())
+                    self._reply_json(out)
             elif path == "/peers":
                 # observe-only sys.modules lookup, like /checkpoints: a
                 # process that never touched the dist transport answers

@@ -169,3 +169,36 @@ def test_protocol_error_is_not_retried_as_peer_loss():
     assert issubclass(dist_ps.ProtocolError, ConnectionError)
     assert not issubclass(dist_ps.ProtocolError, dist_ps.PeerLost)
     assert not issubclass(dist_ps.PeerLost, dist_ps.ProtocolError)
+
+
+def test_connect_rejects_tcp_self_connect(monkeypatch):
+    """Dialing a port with no listener can "succeed" via TCP
+    self-connect (kernel picks the target port as the source port —
+    preferentially, right after that port's owner died).  Both ends are
+    the same socket, so a dial-verify against a killed server's address
+    would wrongly pass and commit a stale address list.  Conn.connect
+    must refuse the trap."""
+    # build a deterministic self-connected socket (simultaneous open)
+    sock = socket.socket()
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.connect(("127.0.0.1", port))
+    assert sock.getsockname() == sock.getpeername(), \
+        "platform does not self-connect; guard untestable this way"
+    monkeypatch.setattr(dist_ps.socket, "create_connection",
+                        lambda addr, timeout=None: sock)
+    with pytest.raises(ConnectionError, match="self-connected"):
+        dist_ps.Conn.connect(("127.0.0.1", port), retries=1, delay=0)
+    # the trap socket was closed by the guard
+    with pytest.raises(OSError):
+        sock.getpeername()
+
+
+def test_server_answers_liveness_ping():
+    """The refresh_servers dial-verify rides on a ping round trip — a
+    bare TCP connect is not proof of life (the kernel completes
+    handshakes into a killed process's accept queue for a brief
+    teardown window)."""
+    server = dist_ps.Server(nworkers=1)
+    assert server.handle(("ping",)) == ("pong",)

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Serving-fleet smoke: kill -9 a replica mid-load, survive (CI gate).
+
+The ISSUE-13 acceptance artifact.  Under a hard wall-clock cap:
+
+1. start an in-process :class:`~mxnet_tpu.serving.fleet.FleetRouter`
+   and N (default 3) replica subprocesses warmed from a bench
+   checkpoint;
+2. drive seeded closed-loop load through the router and **kill -9 one
+   replica mid-run**;
+3. assert the router sheds the dead replica within **2x the heartbeat
+   interval** (+ a small measurement slack), that **every accepted
+   request completes** (hedged or failed over — zero errors), and that
+   p99 stays bounded;
+4. restart the replica with the dead rank as its hint and assert it
+   **re-registers into that rank, warms from the checkpoint tier, and
+   takes traffic again**.
+
+Exit is nonzero on ANY of: a hang (the wall cap fires → exit 3), a
+replica that never becomes ready, late dead-replica detection, a lost
+accepted request, an unbounded p99, or a restarted replica that serves
+nothing.  ``MXNET_CHAOS`` passes through to the router process (the
+``fleet.route`` seam) for seeded-fault runs.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/fleet_smoke.py [--replicas 3]
+        [--clients 4] [--requests 30] [--heartbeat 0.5]
+        [--p99-cap-ms 5000] [--timeout 300] [--json]
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _hang_exit(timeout):
+    print(json.dumps({"metric": "fleet_smoke", "ok": False,
+                      "problems": ["HANG: wall-clock cap of %ss fired — "
+                                   "an accepted request or a fleet state "
+                                   "change never completed" % timeout]}))
+    sys.stdout.flush()
+    os._exit(3)
+
+
+class _Load:
+    """Closed-loop clients through the router with a live progress
+    counter (so the main thread can kill a replica mid-run)."""
+
+    def __init__(self, router, model, clients, requests, max_rows,
+                 features, seed):
+        self.router = router
+        self.model = model
+        self.latencies = []
+        self.errors = []
+        self.completed = 0
+        self._lock = threading.Lock()
+        self._threads = []
+        self.total = clients * requests
+
+        def client(idx):
+            rng = np.random.RandomState(seed + idx)
+            for _ in range(requests):
+                x = rng.randn(int(rng.randint(1, max_rows + 1)),
+                              features).astype(np.float32)
+                t0 = time.perf_counter()
+                try:
+                    # accepted at submit; the router owes completion
+                    # within the deadline — hedged or failed over
+                    self.router.predict(self.model, {"data": x},
+                                        timeout_s=30.0)
+                except Exception as exc:
+                    with self._lock:
+                        self.errors.append(repr(exc))
+                    continue
+                with self._lock:
+                    self.latencies.append(
+                        (time.perf_counter() - t0) * 1e6)
+                    self.completed += 1
+
+        self._threads = [threading.Thread(target=client, args=(i,),
+                                          daemon=True)
+                         for i in range(clients)]
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def wait_completed(self, n, timeout=60.0):
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            with self._lock:
+                if self.completed >= n:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def join(self, timeout=120.0):
+        for t in self._threads:
+            t.join(timeout)
+        return all(not t.is_alive() for t in self._threads)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=30,
+                    help="closed-loop requests per client per phase")
+    ap.add_argument("--max-rows", type=int, default=4)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--heartbeat", type=float, default=0.5)
+    ap.add_argument("--p99-cap-ms", type=float, default=5000.0,
+                    help="fail when request p99 exceeds this (bounded-"
+                         "tail gate; generous for loaded CI hosts)")
+    ap.add_argument("--detect-slack-s", type=float, default=0.5,
+                    help="measurement slack on the 2x-heartbeat "
+                         "dead-detection gate")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="hard wall-clock cap (hang detector)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    watchdog = threading.Timer(args.timeout, _hang_exit,
+                               args=(args.timeout,))
+    watchdog.daemon = True
+    watchdog.start()
+
+    os.environ["MXNET_FLEET_HEARTBEAT_S"] = str(args.heartbeat)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import mxnet_tpu.serving.fleet as fleet
+    fleet.refresh_from_env()
+    from serve_bench import (FEATURES, MODEL, build_checkpoint,
+                             spawn_replica)
+
+    problems = []
+    summary = {"metric": "fleet_smoke", "replicas": args.replicas,
+               "heartbeat_s": args.heartbeat}
+    router = fleet.FleetRouter(port=0).start()
+    procs = []
+    with tempfile.TemporaryDirectory(prefix="fleet-smoke-") as tmp:
+        try:
+            prefix = build_checkpoint(tmp, args.seed)
+            procs = [spawn_replica(router.addr, prefix, args.max_batch)
+                     for _ in range(args.replicas)]
+            if not router.wait_ready(args.replicas, timeout=180.0):
+                problems.append(
+                    "only %d/%d replicas became ready"
+                    % (router.ready_count(), args.replicas))
+                raise SystemExit
+
+            # --- phase A: load + kill -9 mid-run --------------------------
+            load = _Load(router, MODEL, args.clients, args.requests,
+                         args.max_rows, FEATURES, args.seed).start()
+            if not load.wait_completed(max(load.total // 4, 1)):
+                problems.append("load never progressed to the kill "
+                                "point")
+                raise SystemExit
+            victim = procs[0]
+            t_kill = time.monotonic()
+            os.kill(victim.pid, signal.SIGKILL)
+            # detection: the router must shed the dead replica within
+            # 2x the heartbeat interval (disconnect is instant; the
+            # staleness tripwire is the bound)
+            dead_rank = None
+            detect_s = None
+            while time.monotonic() - t_kill < 4.0 * args.heartbeat \
+                    + args.detect_slack_s:
+                view = router.http_view()["replicas"]
+                dead = [r for r, v in view.items()
+                        if v["state"] == "dead"]
+                if dead:
+                    dead_rank = int(dead[0])
+                    detect_s = time.monotonic() - t_kill
+                    break
+                time.sleep(0.02)
+            summary["dead_detect_s"] = detect_s
+            if detect_s is None:
+                problems.append("kill -9'd replica was never marked "
+                                "dead")
+            elif detect_s > 2.0 * args.heartbeat + args.detect_slack_s:
+                problems.append(
+                    "dead replica shed in %.3fs — over the 2x heartbeat "
+                    "contract (%.3fs + %.2fs slack)"
+                    % (detect_s, 2.0 * args.heartbeat,
+                       args.detect_slack_s))
+            if not load.join():
+                problems.append("phase-A load threads hung")
+                raise SystemExit
+            if load.errors:
+                problems.append(
+                    "%d accepted request(s) LOST through the kill "
+                    "(first: %s)" % (len(load.errors), load.errors[0]))
+            summary["phase_a"] = {"completed": load.completed,
+                                  "total": load.total,
+                                  "errors": len(load.errors)}
+            lat = sorted(load.latencies)
+
+            # --- phase B: restart into the dead rank ----------------------
+            if dead_rank is not None:
+                procs.append(spawn_replica(router.addr, prefix,
+                                           args.max_batch,
+                                           rank_hint=dead_rank))
+                if not router.wait_ready(args.replicas, timeout=180.0):
+                    problems.append("restarted replica never became "
+                                    "ready")
+                else:
+                    load_b = _Load(router, MODEL, args.clients,
+                                   args.requests, args.max_rows,
+                                   FEATURES, args.seed + 100).start()
+                    if not load_b.join():
+                        problems.append("phase-B load threads hung")
+                    if load_b.errors:
+                        problems.append(
+                            "%d request(s) lost AFTER recovery"
+                            % len(load_b.errors))
+                    lat += load_b.latencies
+                    view = router.http_view()["replicas"]
+                    revived = view.get(str(dead_rank), {})
+                    summary["phase_b"] = {
+                        "completed": load_b.completed,
+                        "revived_rank_state": revived.get("state"),
+                        "revived_rank_served": revived.get("served")}
+                    if revived.get("state") != "ready":
+                        problems.append(
+                            "rank %d did not re-register ready (state "
+                            "%r)" % (dead_rank, revived.get("state")))
+                    elif not revived.get("served"):
+                        problems.append(
+                            "restarted rank %d took no traffic"
+                            % dead_rank)
+
+            # --- tail gate ------------------------------------------------
+            if lat:
+                p99_ms = float(np.percentile(np.asarray(lat), 99)) / 1e3
+                summary["p99_ms"] = round(p99_ms, 1)
+                if p99_ms > args.p99_cap_ms:
+                    problems.append(
+                        "p99 %.0fms exceeds the %.0fms cap (unbounded "
+                        "tail through the kill)"
+                        % (p99_ms, args.p99_cap_ms))
+            counters = router.http_view()["counters"]
+            summary["counters"] = counters
+        except SystemExit:
+            pass
+        finally:
+            watchdog.cancel()
+            router.shutdown_replicas()
+            router.stop()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    summary["ok"] = not problems
+    summary["problems"] = problems
+    if args.json:
+        print(json.dumps(summary, default=repr))
+    else:
+        print("fleet_smoke: %s — dead detected in %s, p99 %s ms"
+              % ("OK" if not problems else "FAIL",
+                 summary.get("dead_detect_s"), summary.get("p99_ms")))
+        for p in problems:
+            print("  PROBLEM: %s" % p)
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,21 @@ Two phases, both against the same loaded model slot:
   a capacity plan actually needs (closed-loop QPS self-throttles; open
   loop shows queueing delay growing before the 503 cliff).
 
+The closed-loop client honors shed signals the way a well-behaved
+real client does: a 503 (bounded queue full / breaker open) is retried
+after its Retry-After hint and **counted** (``shed_retried``) instead of
+inflating the error rate — backpressure is the serving contract, not a
+failure.
+
+**Fleet mode** (``--fleet N``): starts an in-process
+:class:`~mxnet_tpu.serving.fleet.FleetRouter`, spawns N replica
+subprocesses warmed from the bench checkpoint, and drives the closed
+loop through the router — reporting aggregate QPS plus the per-replica
+request distribution.  ``--rolling-reload`` additionally performs a
+zero-downtime rollout of every replica *while the load runs* and gates
+on zero failed requests (the ISSUE-13 acceptance artifact; run with
+``--fleet 1`` and ``--fleet 4`` to see the near-linear scaling).
+
 The retrace contract is asserted here the same way tests assert it: the
 ``jit_compiles`` + ``serving_warmup_compiles`` counters must not move
 after warmup — every request lands on an AOT-compiled bucket executable
@@ -26,12 +41,15 @@ Usage::
     JAX_PLATFORMS=cpu python tools/serve_bench.py
     python tools/serve_bench.py --clients 8 --requests 50 --qps 200 \
         --duration 5 --http     # drive through the live /v1 HTTP surface
+    python tools/serve_bench.py --fleet 4 --rolling-reload
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import re
+import subprocess
 import sys
 import tempfile
 import threading
@@ -39,8 +57,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 FEATURES = 16
 CLASSES = 8
@@ -77,31 +95,95 @@ def _percentiles(latencies_us):
             "mean_ms": round(float(arr.mean()), 3)}
 
 
-class _Driver:
-    """Issue predicts either in-process or through the live HTTP server."""
+class _Shed(Exception):
+    """A 503 with its Retry-After hint: backpressure, not failure."""
 
-    def __init__(self, use_http, port=None):
+    def __init__(self, retry_after_s):
+        super().__init__("shed; retry in %.3fs" % retry_after_s)
+        self.retry_after_s = retry_after_s
+
+
+_RETRY_IN_RE = re.compile(r"retry in ([0-9.]+)\s*s")
+
+
+class _Driver:
+    """Issue predicts in-process, through the live HTTP server, or
+    through an in-process fleet router."""
+
+    def __init__(self, use_http, port=None, router=None):
         self.use_http = use_http
         self.port = port
+        self.router = router
 
-    def predict(self, x):
+    def _predict_once(self, x):
+        from mxnet_tpu.serving.batcher import Overloaded
+        if self.router is not None:
+            try:
+                return self.router.predict(MODEL, {"data": x},
+                                           timeout_s=60.0)
+            except Overloaded as exc:
+                raise _Shed(self._hint(exc)) from exc
         if not self.use_http:
             import mxnet_tpu.serving as serving
-            return serving.predict(MODEL, {"data": x}, timeout=60.0)
+            try:
+                return serving.predict(MODEL, {"data": x}, timeout=60.0)
+            except Overloaded as exc:
+                raise _Shed(self._hint(exc)) from exc
+        import urllib.error
         import urllib.request
         body = json.dumps({"inputs": {"data": x.tolist()}}).encode()
         req = urllib.request.Request(
             "http://127.0.0.1:%d/v1/models/%s/predict" % (self.port, MODEL),
             data=body, method="POST",
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            if exc.code == 503:
+                try:
+                    after = float(exc.headers.get("Retry-After", 0.05))
+                except (TypeError, ValueError):
+                    after = 0.05
+                raise _Shed(min(max(after, 0.01), 1.0)) from exc
+            raise
+
+    @staticmethod
+    def _hint(exc):
+        """Retry-After from an Overloaded message ('retry in Xs' — the
+        breaker includes it; a plain full queue gets a short default)."""
+        m = _RETRY_IN_RE.search(str(exc))
+        if m:
+            try:
+                return min(max(float(m.group(1)), 0.01), 1.0)
+            except ValueError:
+                pass
+        return 0.05
+
+    def predict(self, x, deadline_s=60.0):
+        """One predict with shed-retry: a 503 sleeps out its Retry-After
+        and tries again (bounded by *deadline_s*).  Returns the number
+        of sheds absorbed; raises only on real failure."""
+        sheds = 0
+        t_end = time.perf_counter() + deadline_s
+        while True:
+            try:
+                self._predict_once(x)
+                return sheds
+            except _Shed as shed:
+                if time.perf_counter() + shed.retry_after_s >= t_end:
+                    raise
+                sheds += 1
+                time.sleep(shed.retry_after_s)
 
 
 def closed_loop(driver, clients, requests, max_rows, seed):
-    """N clients, zero think time; returns (latencies_us, wall_s, errors)."""
+    """N clients, zero think time; returns (latencies_us, wall_s,
+    errors, shed_retried).  Latency includes any shed-retry backoff —
+    that IS the latency a politely-retrying client observes."""
     latencies = [[] for _ in range(clients)]
     errors = [0] * clients
+    sheds = [0] * clients
     barrier = threading.Barrier(clients + 1)
 
     def client(idx):
@@ -112,7 +194,7 @@ def closed_loop(driver, clients, requests, max_rows, seed):
         for x in xs:
             t0 = time.perf_counter()
             try:
-                driver.predict(x)
+                sheds[idx] += driver.predict(x)
             except Exception:
                 errors[idx] += 1
                 continue
@@ -128,7 +210,7 @@ def closed_loop(driver, clients, requests, max_rows, seed):
         t.join()
     wall = time.perf_counter() - t0
     flat = [v for chunk in latencies for v in chunk]
-    return flat, wall, sum(errors)
+    return flat, wall, sum(errors), sum(sheds)
 
 
 def open_loop(qps, duration, max_rows, seed):
@@ -165,6 +247,119 @@ def open_loop(qps, duration, max_rows, seed):
     return latencies, wall, errors, offered
 
 
+def spawn_replica(router_addr, prefix, max_batch, rank_hint=None,
+                  buckets=None):
+    """One replica subprocess warmed from *prefix* (the checkpoint
+    tier), registered with the router at *router_addr*."""
+    cmd = [sys.executable, "-m", "mxnet_tpu.serving.replica",
+           "--router", "%s:%d" % tuple(router_addr),
+           "--name", MODEL, "--prefix", prefix, "--epoch", "0",
+           "--input-shapes", json.dumps({"data": [1, FEATURES]}),
+           "--max-batch", str(max_batch)]
+    if rank_hint is not None:
+        cmd += ["--rank-hint", str(rank_hint)]
+    if buckets:
+        cmd += ["--buckets", ",".join(str(b) for b in buckets)]
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    return subprocess.Popen(cmd, env=env, cwd=REPO)
+
+
+def fleet_main(args):
+    """--fleet N: router + N replica subprocesses, closed loop through
+    the balancer, per-replica distribution, optional rolling reload
+    under load (zero-failed-requests gate)."""
+    from mxnet_tpu.serving.fleet import FleetRouter
+
+    with tempfile.TemporaryDirectory(prefix="serve-bench-fleet-") as tmp:
+        prefix = build_checkpoint(tmp, args.seed)
+        router = FleetRouter(port=0).start()
+        procs = [spawn_replica(router.addr, prefix, args.max_batch)
+                 for _ in range(args.fleet)]
+        try:
+            if not router.wait_ready(args.fleet, timeout=180.0):
+                print(json.dumps({
+                    "metric": "serve_bench", "fleet": args.fleet,
+                    "error": "only %d/%d replicas became ready"
+                             % (router.ready_count(), args.fleet),
+                    "view": router.http_view()}, default=repr))
+                return 1
+            driver = _Driver(False, router=router)
+            driver.predict(np.zeros((1, FEATURES), np.float32))
+
+            reload_report = None
+            reload_thread = None
+            reload_errors = []
+            if args.rolling_reload:
+                new_prefix = build_checkpoint(tmp, args.seed + 1)
+
+                def _roll():
+                    try:
+                        reload_errors.append(
+                            ("results",
+                             router.rolling_reload(MODEL,
+                                                   prefix=new_prefix,
+                                                   epoch=0)))
+                    except Exception as exc:  # gate below reports it
+                        reload_errors.append(("error", repr(exc)))
+
+                reload_thread = threading.Thread(target=_roll,
+                                                 daemon=True)
+                reload_thread.start()
+
+            lat, wall, errors, sheds = closed_loop(
+                driver, args.clients, args.requests, args.max_rows,
+                args.seed)
+            if reload_thread is not None:
+                reload_thread.join(300.0)
+                results = dict(reload_errors).get("results") or {}
+                reload_report = {
+                    "ok": bool(results)
+                    and all(v == "ok" for v in results.values()),
+                    "replicas": {str(r): v for r, v in results.items()},
+                    "error": dict(reload_errors).get("error"),
+                }
+            view = router.http_view()
+            distribution = {rank: rep["served"]
+                            for rank, rep in view["replicas"].items()}
+            report = {
+                "metric": "serve_bench",
+                "model": MODEL,
+                "transport": "fleet",
+                "fleet": {
+                    "replicas": args.fleet,
+                    "distribution": distribution,
+                    "replicas_ready": view["replicas_ready"],
+                    "hedge_timeout_ms": view["hedge_timeout_ms"],
+                    "counters": view["counters"],
+                    "rolling_reload": reload_report,
+                },
+                "closed_loop": dict(
+                    _percentiles(lat),
+                    clients=args.clients,
+                    requests=len(lat),
+                    errors=errors,
+                    shed_retried=sheds,
+                    qps=round(len(lat) / wall, 1) if wall > 0 else None),
+            }
+            print(json.dumps(report, default=repr))
+            balanced = sum(1 for n in distribution.values() if n > 0) \
+                == args.fleet
+            ok = (errors == 0 and balanced
+                  and (reload_report is None or reload_report["ok"]))
+            return 0 if ok else 1
+        finally:
+            router.shutdown_replicas()
+            router.stop()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--clients", type=int, default=4)
@@ -183,6 +378,15 @@ def main(argv=None):
     parser.add_argument("--http", action="store_true",
                         help="drive the closed loop through the live "
                              "/v1 HTTP surface")
+    parser.add_argument("--fleet", type=int, default=0, metavar="N",
+                        help="fleet mode: route the closed loop through "
+                             "an in-process FleetRouter over N replica "
+                             "subprocesses; reports per-replica request "
+                             "distribution")
+    parser.add_argument("--rolling-reload", action="store_true",
+                        help="fleet mode: roll every replica onto fresh "
+                             "weights WHILE the load runs and gate on "
+                             "zero failed requests")
     parser.add_argument("--max-queue-ms", type=float, default=None,
                         help="fail (exit 1) when queue-wait p99 exceeds "
                              "this budget — the SLO gate on the "
@@ -197,6 +401,9 @@ def main(argv=None):
     import mxnet_tpu.serving as serving
     from mxnet_tpu import telemetry
     telemetry.set_enabled(True)
+
+    if args.fleet > 0:
+        return fleet_main(args)
 
     with tempfile.TemporaryDirectory(prefix="serve-bench-") as tmpdir:
         prefix = build_checkpoint(tmpdir, args.seed)
@@ -221,7 +428,7 @@ def main(argv=None):
                                  + telemetry.counter(
                                      "serving_warmup_compiles"))
 
-        closed_lat, closed_wall, closed_err = closed_loop(
+        closed_lat, closed_wall, closed_err, closed_shed = closed_loop(
             driver, args.clients, args.requests, args.max_rows, args.seed)
         open_lat, open_wall, open_err, offered = open_loop(
             args.qps, args.duration, args.max_rows, args.seed + 1000)
@@ -256,6 +463,7 @@ def main(argv=None):
                 clients=args.clients,
                 requests=len(closed_lat),
                 errors=closed_err,
+                shed_retried=closed_shed,
                 qps=round(len(closed_lat) / closed_wall, 1)
                 if closed_wall > 0 else None),
             "open_loop": dict(
