@@ -22,7 +22,28 @@ from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "backward", "grad", "get_symbol",
-           "set_recording", "set_training"]
+           "set_recording", "set_training", "set_grad_ready_hook"]
+
+
+# -- grad-ready notification (comm/compute overlap) -------------------------
+#
+# The tape sweep finalizes each marked variable's gradient the moment its
+# LAST consumer has been processed (not in one batch at the end), and
+# fires this hook with the finalized variable.  mxnet_tpu.gluon.overlap
+# installs the hook to dispatch a gradient bucket's reduce as an engine
+# task while backward is still computing earlier layers — DDP-style
+# comm/compute overlap.  One module-global read when no hook is set.
+
+_GRAD_READY_HOOK = None
+
+
+def set_grad_ready_hook(hook):
+    """Install (or with None, remove) the grad-ready listener; returns
+    the previous hook.  The hook receives the marked *data* NDArray
+    whose ``_grad`` buffer has just been finalized by backward."""
+    global _GRAD_READY_HOOK
+    prev, _GRAD_READY_HOOK = _GRAD_READY_HOOK, hook
+    return prev
 
 
 class _State(threading.local):
@@ -119,6 +140,27 @@ def _clear_tape():
     _STATE.tape = []
 
 
+def _finalize_marked(v, g):
+    """Write one marked variable's accumulated gradient into its
+    attached buffer (identical semantics to the reference end-of-sweep
+    batch write) and fire the grad-ready hook.  ``g is None`` — the
+    variable received no contribution this backward — writes nothing
+    and stays stale, exactly like before."""
+    if v._grad is None or g is None:
+        return
+    if v._grad_req == "add":
+        v._grad._set_data(v._grad._data + g)
+    elif v._grad_req != "null":
+        v._grad._set_data(jnp.broadcast_to(g, v._grad.shape).astype(
+            v._grad.dtype) if g.shape != tuple(v._grad.shape)
+            else g.astype(v._grad.dtype))
+    if v._grad_req != "null":
+        v._fresh_grad = True  # Trainer.step stale-grad tracking
+        hook = _GRAD_READY_HOOK
+        if hook is not None:
+            hook(v)
+
+
 def append_node(node):
     _STATE.tape.append(node)
 
@@ -153,45 +195,58 @@ def _backward_impl(heads, head_grads=None, retain_graph=False,
         grad_map[id(h)] = grad_map.get(id(h), 0) + g
         keepalive[id(h)] = h
 
-    marked = {}
-    for node in reversed(_STATE.tape):
-        if not any(id(o) in grad_map for o in node.outputs):
-            continue
-        out_grads = tuple(
-            grad_map.get(id(o), jnp.zeros_like(o._data)) for o in node.outputs)
-        if node.custom_bwd is not None:
-            all_in_grads = node.custom_bwd(out_grads, node.in_vals,
-                                           node.out_vals, node.attrs)
-            in_grads = [all_in_grads[i] for i in node.diff_idx]
-        else:
-            in_grads = node.vjp_fn(out_grads)
-        for pos, g in zip(node.diff_idx, in_grads):
+    # Incremental finalization (comm/compute overlap): a marked
+    # variable's accumulated gradient can no longer change once the
+    # node at its SMALLEST consumer index has been processed — the
+    # reverse sweep visits indices in decreasing order, so that node is
+    # its last contributor.  Writing the buffer right there (instead of
+    # one batch at the end) lets the grad-ready hook start a gradient
+    # bucket's reduce while the sweep is still computing earlier
+    # layers' gradients.  Heads are excluded: a marked head's seed
+    # gradient is outside the consumer bookkeeping.
+    tape = _STATE.tape
+    head_ids = {id(h) for h in heads}
+    final_at = {}            # tape index -> [marked vars final there]
+    claimed = set()
+    for idx, node in enumerate(tape):
+        for pos in node.diff_idx:
             inp = node.inputs[pos]
             key = id(inp)
-            keepalive[key] = inp
-            if key in grad_map:
-                grad_map[key] = grad_map[key] + g
+            if key in claimed or key in head_ids \
+                    or not getattr(inp, "_marked", False):
+                continue
+            claimed.add(key)
+            final_at.setdefault(idx, []).append(inp)
+
+    late = {}                # finalized after the sweep (heads, leftovers)
+    for idx in range(len(tape) - 1, -1, -1):
+        node = tape[idx]
+        if any(id(o) in grad_map for o in node.outputs):
+            out_grads = tuple(
+                grad_map.get(id(o), jnp.zeros_like(o._data))
+                for o in node.outputs)
+            if node.custom_bwd is not None:
+                all_in_grads = node.custom_bwd(out_grads, node.in_vals,
+                                               node.out_vals, node.attrs)
+                in_grads = [all_in_grads[i] for i in node.diff_idx]
             else:
-                grad_map[key] = g
-            if getattr(inp, "_marked", False):
-                marked[key] = inp
+                in_grads = node.vjp_fn(out_grads)
+            for pos, g in zip(node.diff_idx, in_grads):
+                inp = node.inputs[pos]
+                key = id(inp)
+                keepalive[key] = inp
+                if key in grad_map:
+                    grad_map[key] = grad_map[key] + g
+                else:
+                    grad_map[key] = g
+        for v in final_at.get(idx, ()):
+            _finalize_marked(v, grad_map.get(id(v)))
 
     for h in heads:
         if getattr(h, "_marked", False):
-            marked[id(h)] = h
-
-    # write accumulated grads into attached buffers
-    for key, v in marked.items():
-        if v._grad is None or key not in grad_map:
-            continue
-        g = grad_map[key]
-        if v._grad_req == "add":
-            v._grad._set_data(v._grad._data + g)
-        elif v._grad_req != "null":
-            v._grad._set_data(jnp.broadcast_to(g, v._grad.shape).astype(
-                v._grad.dtype) if g.shape != tuple(v._grad.shape) else g.astype(v._grad.dtype))
-        if v._grad_req != "null":
-            v._fresh_grad = True  # Trainer.step stale-grad tracking
+            late[id(h)] = h
+    for key, v in late.items():
+        _finalize_marked(v, grad_map.get(key))
 
     result = None
     if variables is not None:

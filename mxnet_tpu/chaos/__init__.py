@@ -37,7 +37,22 @@ fault triggers on the count (``@N`` windows) or on a per-fault
 ``random.Random`` derived from ``(seed, site, kind, position)`` (``~P``
 probabilities).  Given the same spec, seed, and per-site call sequence,
 the injected-fault sequence is identical — :func:`fault_log` exposes it
-for replay assertions.  Every injected fault is also booked as the
+for replay assertions.
+
+Keyed sites (comm/compute overlap): seams whose calls can be
+*reordered* by concurrent dispatch — the per-bucket gradient seam and
+the bucket push frames the overlap tier fires while backward is still
+running — pass ``decide(site, key=<bucket id>)``.  A keyed call counts
+against a per-``(rule, key)`` counter and draws its ``~P`` randomness
+from ``(seed, site, kind, key, occurrence)``, so the decision depends
+only on *which bucket, which occurrence* — never on dispatch order —
+and the same spec+seed yields an identical :func:`fault_log` whether
+overlap is on or off.  ``@N`` windows on keyed sites mean "the N-th
+occurrence of that key" (one occurrence per step for gradient buckets,
+so ``@N`` keeps reading as "step N").  :func:`fault_log` returns the
+log in a canonical ``(site, key, occurrence)`` order for the same
+reason: arrival order is a property of thread interleaving, not of the
+fault plan.  Every injected fault is also booked as the
 ``chaos_faults`` telemetry counter and a ``chaos`` flight-ring event, so
 post-mortems distinguish injected pain from real failures.
 
@@ -77,8 +92,11 @@ class ChaosPlan:
         self.rules = rules
         self._lock = threading.Lock()
         self._counts = [0] * len(rules)
+        self._kcounts = [{} for _ in rules]   # per-rule {key: count}
         self._rngs = {}
-        self.log = []           # [(site, rule_site, kind, match_index)]
+        # unkeyed: (site, rule_site, kind, match_index)
+        # keyed:   (site, rule_site, kind, match_index, key)
+        self.log = []
 
     def _rng(self, ridx, fidx):
         key = (ridx, fidx)
@@ -90,36 +108,58 @@ class ChaosPlan:
             rng = self._rngs[key] = Random(zlib.adler32(token.encode()))
         return rng
 
-    def decide(self, site):
+    def decide(self, site, key=None):
         """The fault to inject for this call at *site*, or None.
 
         Counts every matching rule (so ``@N`` windows are stable no
         matter which other rules exist); the first triggering fault of
-        the first matching rule wins.
+        the first matching rule wins.  With *key* (a bucket id), the
+        count is per ``(rule, key)`` and the ``~P`` draw depends only on
+        ``(seed, site, kind, key, occurrence)`` — dispatch-order
+        independent, see the module docstring.
         """
         hit = None
         with self._lock:
             for ridx, rule in enumerate(self.rules):
                 if not rule.matches(site):
                     continue
-                self._counts[ridx] += 1
-                n = self._counts[ridx]
+                if key is None:
+                    n = self._counts[ridx] = self._counts[ridx] + 1
+                else:
+                    kc = self._kcounts[ridx]
+                    n = kc[key] = kc.get(key, 0) + 1
                 if hit is not None:
                     continue        # keep counting later rules anyway
                 for fidx, fault in enumerate(rule.faults):
                     if fault.lo is not None:
                         fired = fault.lo <= n <= fault.hi
                     elif fault.prob is not None:
-                        fired = self._rng(ridx, fidx).random() < fault.prob
+                        fired = self._draw(ridx, fidx, key, n) < fault.prob
                     else:
                         fired = True
                     if fired:
                         hit = (fault.kind, fault.value, site, n)
-                        self.log.append((site, rule.site, fault.kind, n))
+                        entry = (site, rule.site, fault.kind, n)
+                        self.log.append(entry if key is None
+                                        else entry + (key,))
                         break
         if hit is not None:
             self._book(hit)
         return hit
+
+    def _draw(self, ridx, fidx, key, n):
+        """One ``~P`` uniform draw.  Unkeyed: the rule's sequential RNG
+        (stream position = call order, which IS deterministic for
+        unkeyed sites).  Keyed: a fresh value from ``(seed, site, kind,
+        key, occurrence)`` — no shared stream, so concurrent dispatch
+        order cannot shift anyone's draw."""
+        if key is None:
+            return self._rng(ridx, fidx).random()
+        rule = self.rules[ridx]
+        token = "%d|%s|%s|%d|%s|%d" % (self.seed, rule.site,
+                                       rule.faults[fidx].kind, fidx,
+                                       key, n)
+        return Random(zlib.adler32(token.encode())).random()
 
     def _book(self, hit):
         kind, _value, site, n = hit
@@ -135,6 +175,7 @@ class ChaosPlan:
         """Restart counters/RNGs/log (a fresh deterministic replay)."""
         with self._lock:
             self._counts = [0] * len(self.rules)
+            self._kcounts = [{} for _ in self.rules]
             self._rngs.clear()
             self.log = []
 
@@ -174,11 +215,14 @@ def refresh_from_env():
     return configure(os.environ.get("MXNET_CHAOS", ""))
 
 
-def decide(site):
+def decide(site, key=None):
     """The seam-facing entry point: fault tuple ``(kind, value, site,
-    n)`` or None.  Call only after an :func:`active` check."""
+    n)`` or None.  Call only after an :func:`active` check.  Pass
+    ``key=<bucket id>`` from seams whose dispatch order is not
+    deterministic (overlapped bucket reduces) — see the module
+    docstring's keyed-sites contract."""
     p = _PLAN
-    return None if p is None else p.decide(site)
+    return None if p is None else p.decide(site, key=key)
 
 
 def apply_inline(act):
@@ -196,15 +240,24 @@ def apply_inline(act):
                      % (kind, act[2], act[3]))
 
 
-def poison_grads(raw_grads, site="grad.bucket"):
-    """The gradient seam: decide once per step at *site*; a ``nan``
-    fault replaces the FIRST bucket with NaNs — deterministic (always
-    the same bucket, decided at step order), so a poisoned run replays
-    exactly from seed + spec.  Other kinds apply inline; no active plan
-    means the input list passes through untouched."""
+def poison_grads(raw_grads, site="grad.bucket", key=None):
+    """The gradient seam: decide at *site*; a ``nan`` fault replaces
+    the FIRST array of the list with NaNs — deterministic, so a
+    poisoned run replays exactly from seed + spec.  Other kinds apply
+    inline; no active plan means the input list passes through
+    untouched.
+
+    Unkeyed (the per-slot ``MXNET_FUSED_TRAINER=0`` oracle loop):
+    decided once per step in step order, *raw_grads* is the whole
+    gradient list and "first bucket" means its first array.  Keyed (the
+    whole fused path — kvstore or not, overlap on or off): decided once
+    per step PER BUCKET with ``key=<bucket index>``, *raw_grads* is
+    that bucket's gradient list — the per-key occurrence count equals
+    the step number, so ``nan@K`` still reads "poison at step K" while
+    the decision stays identical under overlapped dispatch."""
     if not _ACTIVE:
         return raw_grads
-    act = decide(site)
+    act = decide(site, key=key)
     if act is None:
         return raw_grads
     if act[0] != "nan":
@@ -232,9 +285,20 @@ def chaos_task(fn, act):
 
 
 def fault_log():
-    """The injected-fault sequence so far (replay/determinism asserts)."""
+    """The injected faults so far, in canonical ``(site, key,
+    rule, occurrence)`` order (replay/determinism asserts).  Arrival
+    order is a property of thread interleaving — overlapped bucket
+    dispatch, heartbeat threads — so the log is sorted into an order
+    every equally-faulted run shares; entries themselves are unchanged
+    (keyed entries carry their key as a 5th element)."""
     p = _PLAN
-    return [] if p is None else list(p.log)
+    if p is None:
+        return []
+    with p._lock:
+        entries = list(p.log)
+    return sorted(entries,
+                  key=lambda e: (e[0], "" if len(e) < 5 else str(e[4]),
+                                 e[1], e[3], e[2]))
 
 
 def reset():

@@ -90,10 +90,20 @@ def _tree_to_np(tree):
 
 
 def _np_to_state(tree, ctx):
-    """Numpy tree -> NDArray state tree on *ctx* (None = default ctx)."""
+    """Numpy tree -> NDArray state tree on *ctx* (None = default ctx).
+
+    Large leaves upload through the chunked device-put
+    (``parallel.collective``, arXiv 2112.01075): an elastic restore
+    streams each leaf onto its device in bounded chunks instead of
+    staging a second full host copy beside the target buffer."""
     if tree is None:
         return None
     if isinstance(tree, np.ndarray):
+        from ..parallel import collective as _coll
+        if tree.nbytes > _coll.chunk_bytes():
+            from ..context import current_context
+            dev = (ctx or current_context()).jax_device
+            return NDArray(_coll.chunked_device_put(tree, dev), ctx=ctx)
         return nd.array(tree, ctx=ctx, dtype=tree.dtype)
     return tuple(_np_to_state(t, ctx) for t in tree)
 

@@ -16,6 +16,14 @@ layout, so no step of a restore ever materializes more than one source
 shard beyond the state being accumulated — never an all-gathered
 ``n``-shard blob followed by an ``m``-way split.
 
+The device-side promotion of this schedule lives in
+``mxnet_tpu/parallel/collective.py``: ``redistribution_schedule`` is the
+same decomposition at element/chunk granularity, and
+``chunked_reduce_scatter`` / ``chunked_all_gather`` / ``redistribute``
+execute it on device — kvstore buckets, the ZeRO-1 weight all-gather,
+and the elastic-restore placement all stream through it.  This module
+stays the *file*-granularity half (which shard file holds which slot).
+
 Slot→shard assignment is round-robin over the *sorted* slot ids.  That
 keeps the layout a pure function of (slots, n_shards) — every writer and
 every reader derives the same plan with no layout metadata beyond

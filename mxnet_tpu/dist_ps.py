@@ -246,6 +246,19 @@ def _send_site(msg):
     return "conn.send"
 
 
+def _send_key(msg):
+    """Chaos key for one outgoing frame: push frames count per kv key
+    (bucket id), because the overlap tier dispatches bucket pushes in
+    whatever order gradients become ready — a dispatch-order counter
+    would make the same spec+seed hit different buckets with overlap on
+    vs off.  Every other op keeps the sequential counter (their order
+    IS the deterministic call order)."""
+    if isinstance(msg, tuple) and len(msg) > 1 and msg[0] == "push" \
+            and isinstance(msg[1], str):
+        return msg[1]
+    return None
+
+
 def _msg_op(msg):
     if isinstance(msg, tuple) and msg and isinstance(msg[0], str):
         return msg[0]
@@ -372,7 +385,7 @@ class Conn:
                 "connection poisoned (%s); reconnect before reuse"
                 % self._broken)
         if _chaos.active():
-            act = _chaos.decide(_send_site(msg))
+            act = _chaos.decide(_send_site(msg), key=_send_key(msg))
             if act is not None:
                 kind = act[0]
                 if kind == "drop":

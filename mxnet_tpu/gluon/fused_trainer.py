@@ -54,6 +54,7 @@ from .. import chaos as _chaos
 from .. import profiler as _prof
 from .. import random as _random
 from .. import telemetry as _tel
+from . import overlap as _overlap
 from ..guardian import core as _guard
 from ..guardian import health as _health
 from ..ndarray import NDArray
@@ -183,8 +184,11 @@ class _ZeroPlan:
     def place_states(self, slots, updater):
         """Ensure every state leaf sits at its planned sharding; leaves
         arriving from a checkpoint restore / load_states (plain host or
-        single-device arrays) are re-placed, which is also what makes a
-        restore elastic across a changed shard count."""
+        single-device arrays) are re-placed — through the chunked
+        redistribution path (``parallel.collective.redistribute``,
+        arXiv 2112.01075) so an elastic restore onto a changed shard
+        count streams instead of staging full per-device copies."""
+        from ..parallel import collective as _coll
         moved = False
         for slot, p in slots:
             wshape = tuple(p.data().shape)
@@ -193,7 +197,7 @@ class _ZeroPlan:
                 want = self._z.shard_state_tree_spec(
                     leaf.shape, wshape, upd, self.replicated)
                 if getattr(leaf._data, "sharding", None) != want:
-                    leaf._set_data(jax.device_put(leaf._data, want))
+                    leaf._set_data(_coll.redistribute(leaf._data, want))
                     moved = True
         if moved:
             self._bytes = None
@@ -201,25 +205,30 @@ class _ZeroPlan:
 
     def unplace_states(self, slots, updater):
         """Pull sharded state back to each weight's own device (the exit
-        path when MXNET_ZERO is flipped off mid-run)."""
+        path when MXNET_ZERO is flipped off mid-run) — the chunked
+        all-gather: each leaf streams home shard by shard instead of
+        materializing beside a full gathered staging copy."""
         from jax.sharding import SingleDeviceSharding
+        from ..parallel import collective as _coll
         for slot, p in slots:
             dev = p.data().context.jax_device
             home = SingleDeviceSharding(dev)
             for leaf in self._state_nds(updater.states.get(slot)):
                 if getattr(leaf._data, "sharding", None) != home:
-                    leaf._set_data(jax.device_put(leaf._data, dev))
+                    leaf._set_data(_coll.gather_home(leaf._data, dev))
         self._bytes = None
 
     def local_view(self, arr, jax_device):
         """The single-device view of a replicated program output on
         *jax_device* — no copy when the shard buffer already lives
         there; a weight whose home device is outside the zero mesh gets
-        an explicit transfer back so it never silently migrates."""
+        a chunked transfer back (``collective.gather_home``) so it
+        never silently migrates and never stages a second full copy."""
+        from ..parallel import collective as _coll
         for s in arr.addressable_shards:
             if s.device == jax_device:
                 return s.data
-        return jax.device_put(arr.addressable_shards[0].data, jax_device)
+        return _coll.gather_home(arr, jax_device)
 
     def state_byte_gauges(self, slots, updater):
         """(per_device, replicated) optimizer-state bytes under this
@@ -481,9 +490,22 @@ def run_fused_step(trainer, slots):
     grads = [p.grad() for _, p in slots]
     plan = _zero_plan(trainer, slots)
     wshapes = [tuple(p.data().shape) for _, p in slots]
+    session = _overlap.take_session(trainer)
 
     if trainer._kvstore is not None:
-        if plan is not None:
+        raw_grads = None
+        if session is not None:
+            # overlap drain: the per-bucket rounds were dispatched
+            # under backward as each bucket's gradients landed — this
+            # waits out whatever is still in flight (the EXPOSED part
+            # of the collective; the rest was hidden) and surfaces any
+            # in-flight failure (PeerLost) before anything touches
+            # params
+            with _tel.span("kvstore_push_pull", cat="kvstore",
+                           args={"overlap_drain": True}):
+                raw_grads = session.drain(trainer._kvstore,
+                                          [s for s, _ in slots], plan)
+        if raw_grads is None and plan is not None:
             # the reduce-scatter leg: the bucketed reduction lands each
             # divisible gradient already sharded over the zero mesh (the
             # per-slot grad buffers are NOT rewritten — the sharded
@@ -493,7 +515,7 @@ def run_fused_step(trainer, slots):
                     [s for s, _ in slots], [[g] for g in grads],
                     plan.grad_shardings(wshapes))
             raw_grads = [r._data for r in reduced]
-        else:
+        elif raw_grads is None:
             with _tel.span("kvstore_push_pull", cat="kvstore"):
                 reduced = trainer._kvstore.push_pull_all(
                     [s for s, _ in slots], [[g] for g in grads])
@@ -504,11 +526,17 @@ def run_fused_step(trainer, slots):
                     g._set_data(r._data)
             raw_grads = [r._data for r in reduced]
     else:
+        if session is not None:      # nothing to overlap without a store
+            session.discard()
         raw_grads = [g._data for g in grads]
         if plan is not None:
             raw_grads = plan.scatter_grads(raw_grads, wshapes)
-    if _chaos.active():              # grad seam: `nan` poisons a bucket
-        raw_grads = _chaos.poison_grads(raw_grads)
+    if _chaos.active():
+        # grad seam, once per BUCKET per step, keyed by bucket id: the
+        # same decisions in the same canonical order whether the
+        # buckets were reduced under backward or synchronously
+        raw_grads = _overlap.poison_by_bucket(
+            raw_grads, _overlap.bucket_plan(grads))
 
     # state + hyper bookkeeping, per slot, exactly like Updater/update()
     count_snapshot = None

@@ -1,0 +1,447 @@
+"""Comm/compute overlap: bucket-ready gradient reduction under backward.
+
+The fused ``Trainer.step`` used to run every kvstore collective as one
+post-hoc phase after backward returned, so communication time was pure
+added wall clock (ROADMAP item 2).  This module makes gradient
+reduction start the moment a bucket's gradients exist, DDP-style:
+
+1. At the end of each ``Trainer.step`` the trainer **arms** an
+   :class:`OverlapSession` for the next iteration: the trainable slots
+   are grouped into the same dtype-homogeneous ≤4 MiB buckets the
+   kvstore itself plans (``kvstore._plan_buckets`` on identical metas,
+   so bucket membership — and the dist wire's ``__bucket__<digest>``
+   keys — match the non-overlapped round exactly).
+2. ``autograd._backward_impl`` finalizes each parameter's gradient the
+   moment its last consumer is processed and fires the grad-ready hook
+   (the ``grad.bucket`` seam PR 9 carved).  When a bucket's last
+   gradient lands, the session dispatches that bucket's kvstore round —
+   ``push_pull_all`` (or ``reduce_scatter_all`` under ``MXNET_ZERO``) —
+   as an **engine task** while the tape sweep is still computing
+   earlier layers.
+3. ``run_fused_step`` **drains** the session instead of launching the
+   round itself: it waits out the in-flight buckets, measures how much
+   collective wall time was hidden under backward vs exposed in the
+   step, and feeds the reduced gradients straight into the one fused
+   update program.
+
+Ordering: backward produces gradients in roughly reverse slot order
+(output layers first), so buckets are *launched* in descending bucket
+index — a bucket becomes launchable only once every higher-indexed
+bucket has been dispatched.  The launch order is therefore a pure
+function of the bucket plan, identical on every rank: concurrent
+same-key pushes can never interleave across ranks into the dist_sync
+deadlock, and chaos decisions stay deterministic (bucket-keyed
+counters, see :mod:`mxnet_tpu.chaos`).  Bucket tasks serialize on one
+engine lane variable, so the transport sees at most one in-flight
+bucket round per trainer — each protected by the PR-8 per-RPC
+deadlines: a dead peer mid-overlap surfaces as a structured
+``PeerLost`` at drain (engine errors re-raise at the wait point), never
+a hang, and the params are untouched because the fused update program
+only runs after a clean drain.
+
+Bitwise: bucket membership, per-key payloads, and per-element summation
+order are identical to the non-overlapped round — only *when* each
+bucket's round runs changes.  ``MXNET_OVERLAP=0`` disables arming
+entirely and is the equality oracle in tests (the same trick as
+``MXNET_FUSED_TRAINER=0``).
+
+Anything the armed plan cannot honor — a changed slot set, a gradient
+re-written after its bucket dispatched (double backward), a flipped
+ZeRO plan, stale slots — discards the session and falls back to the
+synchronous round: ``overlap_fallbacks`` counts those, correctness
+never depends on the fast path.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .. import chaos as _chaos
+from .. import engine as _engine
+from .. import profiler as _prof
+from .. import telemetry as _tel
+from ..telemetry import flight as _flight
+
+__all__ = ["overlap_enabled", "refresh_from_env", "OverlapSession",
+           "maybe_arm", "take_session", "abandon_session",
+           "bucket_plan", "poison_by_bucket", "last_step_stats"]
+
+
+def _env_enabled():
+    return os.environ.get("MXNET_OVERLAP", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+# cached at import (the JG006 pattern): consulted once per Trainer.step
+_ENABLED = _env_enabled()
+
+
+def refresh_from_env():
+    """Re-read MXNET_OVERLAP (tests / late configuration)."""
+    global _ENABLED
+    _ENABLED = _env_enabled()
+
+
+def overlap_enabled():
+    return _ENABLED
+
+
+def _now_us():
+    from ..telemetry import core as _core
+    return _core.now_us()
+
+
+# ---------------------------------------------------------------------------
+# the canonical bucket plan (shared with the non-overlapped chaos seam)
+# ---------------------------------------------------------------------------
+
+def bucket_plan(grads):
+    """Group slot positions into the canonical gradient buckets:
+    ``kvstore._plan_buckets`` over the same (dtype, nbytes) metas the
+    kvstore and the dist ``_bucket_layout`` derive — one shared plan, so
+    overlapped per-bucket rounds reduce exactly the buckets the
+    monolithic round would, and chaos bucket ids mean the same thing on
+    every path.  Returns ``[[slot positions of bucket 0], ...]``."""
+    from .. import kvstore as kvs
+    metas = [(str(g.dtype), g.size * g.dtype.itemsize) for g in grads]
+    return kvs._plan_buckets(metas)
+
+
+def poison_by_bucket(raw_grads, plan):
+    """The per-bucket ``grad.bucket`` chaos seam, bucket-id keyed: one
+    decision per bucket per step, in ascending bucket order at a
+    deterministic point (post-reduce, pre-update) — identical calls
+    whether the buckets were reduced under backward or synchronously.
+    A ``nan`` fault poisons the FIRST gradient of its bucket."""
+    out = list(raw_grads)
+    for bidx, positions in enumerate(plan):
+        sub = [out[p] for p in positions]
+        res = _chaos.poison_grads(sub, key=bidx)
+        if res is not sub:
+            for p, r in zip(positions, res):
+                out[p] = r
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the session
+# ---------------------------------------------------------------------------
+
+# id(param data NDArray) -> (weakref to session, position); the autograd
+# hook does ONE dict lookup per finalized gradient.  The session is held
+# WEAKLY: a trainer dropped with its final session still armed (every
+# step ends with maybe_arm) must not pin model-sized params/grads in a
+# module global forever — when the trainer dies, the session dies, and
+# its entries are swept lazily here and at the next arm.
+_WATCH = {}
+_WATCH_LOCK = threading.Lock()
+_PREV_HOOK = None
+_HOOK_ON = False
+
+_LAST_STATS = None          # the most recent drained step's overlap stats
+
+
+def _grad_ready_hook(data_nd):
+    entry = _WATCH.get(id(data_nd))
+    if entry is not None:
+        session = entry[0]()
+        if session is None:          # owner died armed: sweep the entry
+            with _WATCH_LOCK:
+                if _WATCH.get(id(data_nd)) is entry:
+                    del _WATCH[id(data_nd)]
+                _hook_sync()
+            return
+        session._on_ready(entry[1], data_nd)
+
+
+def _sweep_dead_watch():
+    """Drop entries whose session was garbage-collected (called under
+    _WATCH_LOCK)."""
+    dead = [k for k, e in _WATCH.items() if e[0]() is None]
+    for k in dead:
+        del _WATCH[k]
+
+
+def _hook_sync():
+    """Install/remove the autograd hook to track watch-map emptiness."""
+    global _PREV_HOOK, _HOOK_ON
+    from .. import autograd as _ag
+    if _WATCH and not _HOOK_ON:
+        _PREV_HOOK = _ag.set_grad_ready_hook(_grad_ready_hook)
+        _HOOK_ON = True
+    elif not _WATCH and _HOOK_ON:
+        _ag.set_grad_ready_hook(_PREV_HOOK)
+        _PREV_HOOK = None
+        _HOOK_ON = False
+
+
+class _Bucket:
+    __slots__ = ("idx", "positions", "waiting", "launched", "result",
+                 "error", "t0_us", "t1_us", "thread")
+
+    def __init__(self, idx, positions):
+        self.idx = idx
+        self.positions = list(positions)
+        self.waiting = set(positions)
+        self.launched = False
+        self.result = None
+        self.error = None
+        self.t0_us = self.t1_us = 0.0
+        self.thread = None
+
+
+class OverlapSession:
+    """One armed iteration: buckets waiting for their gradients, then
+    in-flight on the engine lane, then drained by ``run_fused_step``."""
+
+    def __init__(self, trainer, slots, kvstore, zero_plan):
+        self.slot_ids = [s for s, _ in slots]
+        self.params = [p for _, p in slots]
+        self.grads = [p.grad() for _, p in slots]
+        self.kvstore = kvstore
+        self.zero_plan = zero_plan
+        if zero_plan is not None:
+            self.shardings = zero_plan.grad_shardings(
+                [tuple(p.data().shape) for _, p in slots])
+        else:
+            self.shardings = None
+        self.plan = bucket_plan(self.grads)
+        self.buckets = [_Bucket(i, ps) for i, ps in enumerate(self.plan)]
+        self.dirty = False
+        self._dispatched = 0
+        self._next_launch = len(self.buckets) - 1   # descending launches
+        self._lock = threading.Lock()
+        self._notify_thread = None
+        self._eng = _engine.engine()
+        self._lane = self._eng.new_variable()
+        self._closed = False
+        import weakref
+        ref = weakref.ref(self)
+        with _WATCH_LOCK:
+            _sweep_dead_watch()
+            for pos, p in enumerate(self.params):
+                _WATCH[id(p.data())] = (ref, pos)
+            _hook_sync()
+
+    # -- grad-ready side (backward thread) ---------------------------------
+
+    def _on_ready(self, pos, data_nd):
+        if self.params[pos].data() is not data_nd:
+            return            # stale id-reuse of a dead trainer's buffer
+        launch = []
+        with self._lock:
+            if self._closed:
+                return
+            if self._notify_thread is None:
+                self._notify_thread = threading.get_ident()
+            for b in self.buckets:
+                if pos in b.waiting:
+                    b.waiting.discard(pos)
+                    break
+            else:
+                # a gradient re-written after its bucket was counted:
+                # the dispatched reduce may have consumed a superseded
+                # value — discard the whole session at drain
+                self.dirty = True
+                return
+            while self._next_launch >= 0 \
+                    and not self.buckets[self._next_launch].waiting:
+                b = self.buckets[self._next_launch]
+                b.launched = True
+                launch.append(b)
+                self._next_launch -= 1
+        for b in launch:
+            self._launch(b)
+
+    def _launch(self, b):
+        _prof.bump("overlap_bucket_dispatches")
+        try:
+            self._eng.push(lambda b=b: self._reduce_bucket(b),
+                           mutable_vars=(self._lane,),
+                           tag="overlap_bucket_%d" % b.idx)
+        except Exception:
+            # an un-pushable task must not break backward; the drain
+            # notices the missing result and falls back synchronously
+            with self._lock:
+                self.dirty = True
+
+    def _reduce_bucket(self, b):
+        """The engine task: this bucket's kvstore round (PR-8 deadlines
+        bound every RPC inside — a dead peer raises structured
+        ``PeerLost`` here and re-raises at the drain wait point)."""
+        b.t0_us = _now_us()
+        b.thread = threading.get_ident()
+        keys = [self.slot_ids[p] for p in b.positions]
+        vals = [[self.grads[p]] for p in b.positions]
+        with _tel.span("kvstore_push_pull", cat="kvstore",
+                       args={"bucket": b.idx, "overlap": True}):
+            if self.shardings is None:
+                b.result = self.kvstore.push_pull_all(keys, vals)
+            else:
+                b.result = self.kvstore.reduce_scatter_all(
+                    keys, vals,
+                    [self.shardings[p] for p in b.positions])
+        b.t1_us = _now_us()
+
+    # -- drain side (Trainer.step) -----------------------------------------
+
+    def _deactivate(self):
+        with _WATCH_LOCK:
+            for p in self.params:
+                try:
+                    key = id(p.data())
+                except Exception:
+                    continue
+                entry = _WATCH.get(key)
+                if entry is not None and entry[0]() is self:
+                    del _WATCH[key]
+            _sweep_dead_watch()
+            _hook_sync()
+        with self._lock:
+            self._closed = True
+
+    def _release_lane(self):
+        lane, self._lane = self._lane, None
+        if lane is not None:
+            self._eng.delete_variable(lane)
+
+    def drain(self, kvstore, slot_ids, zero_plan):
+        """Collect the overlapped results for this step, or None when
+        the armed plan cannot serve it (the caller then runs the
+        synchronous round).  Raises what a bucket task raised — e.g. a
+        structured ``PeerLost`` from a dead peer — with the params
+        untouched and nothing half-reduced escaping: results are only
+        returned when EVERY bucket landed cleanly."""
+        global _LAST_STATS
+        self._deactivate()
+        usable = (not self.dirty
+                  and kvstore is self.kvstore
+                  and zero_plan is self.zero_plan
+                  and slot_ids == self.slot_ids
+                  and all(b.launched for b in self.buckets))
+        if not usable:
+            dispatched = any(b.launched for b in self.buckets)
+            self.discard()
+            _prof.bump("overlap_fallbacks")
+            self._refuse_dist_refallback(dispatched)
+            return None
+        t_drain = _now_us()
+        try:
+            self._eng.wait_for_var(self._lane)
+        finally:
+            self._release_lane()
+        exposed_us = _now_us() - t_drain
+        if any(b.result is None for b in self.buckets):
+            # a task died without raising here (error already consumed
+            # by an earlier wait point): fall back, don't guess
+            _prof.bump("overlap_fallbacks")
+            self._refuse_dist_refallback(True)
+            return None
+        reduced = [None] * len(self.slot_ids)
+        for b in self.buckets:
+            for p, r in zip(b.positions, b.result):
+                reduced[p] = r
+        if self.shardings is None:
+            # per-slot grad buffers observe the reduced value, exactly
+            # like the synchronous round's pull(out=g) contract
+            for g, r in zip(self.grads, reduced):
+                if r is not g:
+                    g._set_data(r._data)
+        busy = sum(b.t1_us - b.t0_us for b in self.buckets)
+        off_busy = sum(b.t1_us - b.t0_us for b in self.buckets
+                       if b.thread != self._notify_thread)
+        inline_busy = busy - off_busy
+        hidden_us = max(0.0, off_busy - exposed_us)
+        stats = {"buckets": len(self.buckets),
+                 "collective_busy_us": round(busy, 1),
+                 "hidden_us": round(hidden_us, 1),
+                 "exposed_us": round(exposed_us + inline_busy, 1)}
+        _LAST_STATS = stats
+        _prof.bump("overlap_steps")
+        _tel.set_gauge("overlap_hidden_us", stats["hidden_us"])
+        _tel.set_gauge("overlap_exposed_us", stats["exposed_us"])
+        _tel.device.note_overlap(stats["hidden_us"], stats["exposed_us"])
+        return [r._data for r in reduced]
+
+    def _refuse_dist_refallback(self, dispatched):
+        """On a DIST kvstore, a synchronous re-run after this session
+        already pushed bucket frames would advance this rank's per-key
+        push timestamps one ahead of every other rank — the server
+        would then silently aggregate mismatched steps forever.  Local
+        stores re-reduce harmlessly; dist must fail LOUDLY instead
+        (the rank-asymmetric causes — a failed engine push, a consumed
+        task error — are unrecoverable in-band; symmetric causes can
+        rerun with MXNET_OVERLAP=0)."""
+        from .. import kvstore as kvs
+        from ..base import MXNetError
+        if dispatched and isinstance(self.kvstore, kvs.KVStoreDist):
+            raise MXNetError(
+                "overlap session cannot fall back to the synchronous "
+                "round on a dist kvstore after bucket pushes reached "
+                "the wire (per-key push timestamps would desync across "
+                "ranks); restart the step pattern with MXNET_OVERLAP=0")
+
+    def discard(self):
+        """Abandon the session: wait out in-flight bucket tasks (their
+        results are dropped; a task error is logged, not raised — a
+        synchronous retry on a LOCAL store resurfaces anything real)
+        and release the lane."""
+        self._deactivate()
+        try:
+            if self._lane is not None:
+                self._eng.wait_for_var(self._lane)
+        except Exception as exc:    # noqa: BLE001
+            _flight.record("overlap", "abandoned-bucket-error",
+                           error=repr(exc)[:300])
+        finally:
+            self._release_lane()
+
+
+def maybe_arm(trainer, slots):
+    """Arm an overlap session for the NEXT iteration, when the next
+    step can actually use it: overlap on, fused path on, a kvstore
+    without server-side update semantics, dense gradients.  Called at
+    the end of every ``Trainer.step``."""
+    from . import fused_trainer as _ft
+    old = getattr(trainer, "_overlap_session", None)
+    if old is not None:
+        old.discard()
+        trainer._overlap_session = None
+    if not _ENABLED:
+        return None
+    kv = trainer._kvstore
+    if kv is None or not _ft.fused_trainer_enabled() \
+            or not trainer._optimizer.supports_fused():
+        return None
+    if kv._updater is not None or kv._optimizer is not None:
+        return None                 # update_on_kvstore: per-key path
+    if any(getattr(p.grad(), "stype", "default") != "default"
+           for _, p in slots):
+        return None                 # sparse rows don't map onto buckets
+    zero_plan = getattr(trainer, "_zero_plan", None) \
+        if _ft.zero_enabled() else None
+    session = OverlapSession(trainer, slots, kv, zero_plan)
+    trainer._overlap_session = session
+    return session
+
+
+def take_session(trainer):
+    """Claim (and detach) the trainer's armed session, if any."""
+    session = getattr(trainer, "_overlap_session", None)
+    trainer._overlap_session = None
+    return session
+
+
+def abandon_session(trainer):
+    """Discard an armed session without using it (the per-slot oracle
+    loop, a de-fused optimizer, trainer teardown)."""
+    session = getattr(trainer, "_overlap_session", None)
+    if session is not None:
+        trainer._overlap_session = None
+        session.discard()
+
+
+def last_step_stats():
+    """The most recent drained step's overlap stats (the MULTICHIP
+    dryrun's reporting hook), or None."""
+    return _LAST_STATS

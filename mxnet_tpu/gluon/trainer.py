@@ -19,6 +19,7 @@ from .. import optimizer as opt
 from .. import telemetry as _tel
 from ..checkpoint import hooks as _ckpt_hooks
 from ..guardian import core as _guard
+from . import overlap as _overlap
 from .fused_trainer import (ensure_unsharded, fused_trainer_enabled,
                             run_fused_step)
 from .parameter import Parameter, ParameterDict
@@ -112,6 +113,16 @@ class Trainer(object):
         the guardian's loss scale into the traced rescale (see
         docs/GUARDIAN.md); a skipped step does not notify the
         checkpoint step boundary.
+
+        ``MXNET_OVERLAP`` (default on): each step arms a bucket-ready
+        overlap session for the next iteration — backward dispatches
+        every gradient bucket's kvstore reduce as an engine task the
+        moment the bucket's gradients exist, and this step *drains*
+        the in-flight buckets instead of launching the round itself
+        (``gluon/overlap.py``; ``MXNET_OVERLAP=0`` is the bitwise
+        oracle).  The guardian verdict is unaffected: it is computed
+        inside the one update program, which only runs after every
+        bucket has landed.
         """
         if not self._kv_initialized:
             self._init_kvstore()
@@ -166,6 +177,12 @@ class Trainer(object):
         # nothing advanced, so nothing to snapshot.
         if not skipped:
             _ckpt_hooks.note_step_boundary()
+        # arm comm/compute overlap for the NEXT iteration: the coming
+        # backward will dispatch each gradient bucket's reduce as soon
+        # as the bucket is ready (no-op when MXNET_OVERLAP=0, no
+        # kvstore, or the step won't take the fused path)
+        if slots:
+            _overlap.maybe_arm(self, slots)
 
     def _loop_step(self, slots):
         """Per-slot fallback: one kvstore round + one eager Updater
@@ -178,6 +195,9 @@ class Trainer(object):
         the skip machinery too.  Returns True when the step was skipped.
         """
         guard = _guard.current()
+        # an armed overlap session belongs to the fused path: its
+        # results target the bucketed round, not this per-slot loop
+        _overlap.abandon_session(self)
         # state left mesh-sharded by an earlier ZeRO step must come home
         # before eager per-slot dispatch mixes devices
         ensure_unsharded(self, slots)

@@ -275,6 +275,7 @@ class KVStore:
             else:
                 multi.append(i)
         if multi:
+            from .parallel import collective as _coll
             # group key includes the leading copy's device: each key's
             # reduction must land where its own copy-0 lives (the per-key
             # _ctx_group_sum contract) — mixing devices in one bucket
@@ -292,15 +293,27 @@ class KVStore:
                           for i in idxs)
                     for j in range(n_copies))
                 _prof.bump("kvstore_bucket_reduce")
-                _prof.bump("xla_program_calls")
                 nbytes = sum(metas[b][1] for b in bucket)
                 if _tel.enabled():
                     _tel.bump("kvstore_reduce_bytes", nbytes)
                     _tel.observe("bucket_bytes", nbytes)
+                chunked = len(idxs) == 1 and nbytes > _coll.chunk_bytes()
                 with _tel.span("kvstore_bucket_reduce", cat="kvstore",
                                args={"bytes": nbytes, "keys": len(idxs),
-                                     "copies": n_copies}):
-                    outs = _bucket_reduce(copies)
+                                     "copies": n_copies,
+                                     "chunked": chunked}):
+                    if chunked:
+                        # single-oversize-tensor bucket: pipelined
+                        # chunked reduce (arXiv 2112.01075) — bounded
+                        # peak memory, per-chunk program accounting
+                        # inside the collective module
+                        i = idxs[0]
+                        flat = _coll.chunked_reduce(
+                            [jnp.ravel(c[0]) for c in copies])
+                        outs = (flat.reshape(vlists[i][0].shape),)
+                    else:
+                        _prof.bump("xla_program_calls")
+                        outs = _bucket_reduce(copies)
                 for i, o in zip(idxs, outs):
                     reduced[i] = NDArray(o, ctx=vlists[i][0].context)
         return reduced
@@ -389,14 +402,17 @@ class KVStore:
         """The inverse leg: materialize each (possibly update-sharded)
         value fully on its own context device — what a consumer outside
         the sharded step program (evaluation, host export) needs.  Pure
-        data movement; returns new NDArrays."""
+        data movement (chunked: an update-sharded value streams home
+        shard by shard through ``parallel.collective.gather_home``
+        instead of staging a full extra copy); returns new NDArrays."""
+        from .parallel import collective as _coll
         skeys, vlists = self._normalize_all(keys, values)
         outs = []
         for k, vl in zip(skeys, vlists):
             v = vl[0]
             _prof.bump("kvstore_pull")
-            outs.append(NDArray(jax.device_put(v._data,
-                                               v.context.jax_device),
+            outs.append(NDArray(_coll.gather_home(v._data,
+                                                  v.context.jax_device),
                                 ctx=v.context))
         return outs
 

@@ -527,6 +527,7 @@ def run_rules(record, select=None, config=None):
 # yielding (name, fn, args, kwargs) specimens for every program it ships.
 ENTRY_POINTS = (
     ("kvstore", "mxnet_tpu.kvstore"),
+    ("collective", "mxnet_tpu.parallel.collective"),
     ("optimizer", "mxnet_tpu.optimizer"),
     ("fused_trainer", "mxnet_tpu.gluon.fused_trainer"),
     ("executor", "mxnet_tpu.executor"),

@@ -13,6 +13,10 @@ distribution stack (SURVEY §2.5, §5.8):
                     data/tensor-parallel shardings; replaces per-device
                     executor groups + kvstore push/pull
                     (reference ``model.py:105-140``).
+- ``collective``  — chunked device-side redistribution (pipelined
+                    all-gather / reduce-scatter per arXiv 2112.01075)
+                    shared by kvstore buckets, the ZeRO-1 weight
+                    all-gather, and elastic checkpoint restore.
 - ``ring_attention`` — sequence/context parallelism via ppermute rings
                     (beyond the reference, which only had bucketing;
                     SURVEY §5.7).
@@ -28,6 +32,7 @@ from .sharded import (ShardedTrainer, block_pure_fn, sharded_data,
                       zero1_update_spec)
 from .ring_attention import ring_attention, local_attention
 from .pipeline import pipeline_apply
+from . import collective
 from . import multihost
 from .multihost import init_from_env
 
@@ -37,5 +42,5 @@ __all__ = [
     "all_to_all", "axis_index", "axis_size", "barrier", "host_allreduce",
     "ShardedTrainer", "block_pure_fn", "sharded_data", "zero1_update_spec",
     "ring_attention", "local_attention", "pipeline_apply",
-    "multihost", "init_from_env",
+    "collective", "multihost", "init_from_env",
 ]

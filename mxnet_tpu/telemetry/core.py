@@ -492,6 +492,23 @@ COUNTERS = {
     "fleet_reloads": "per-replica reload RPCs completed during rolling "
                      "rollouts",
     "replica_predicts": "predict RPCs served by this replica process",
+    "overlap_bucket_dispatches": "gradient-bucket reduces dispatched as "
+                                 "engine tasks under backward "
+                                 "(comm/compute overlap)",
+    "overlap_steps": "trainer steps that consumed an overlapped "
+                     "bucket-reduce session at drain",
+    "overlap_fallbacks": "armed overlap sessions discarded at drain "
+                         "(changed slot set, re-written gradient, "
+                         "flipped ZeRO plan) — the step fell back to "
+                         "the synchronous round",
+    "collective_chunk_programs": "chunk-sum programs launched by the "
+                                 "chunked collective path (pipelined "
+                                 "reduce, arXiv 2112.01075)",
+    "collective_gather_home": "sharded arrays streamed home chunk by "
+                              "chunk (the chunked all-gather leg)",
+    "collective_redistribute": "arrays re-placed onto a new sharding "
+                               "through the chunked redistribution "
+                               "schedule",
 }
 
 GAUGES = {
@@ -569,6 +586,12 @@ GAUGES = {
     "fleet_outstanding": "predict attempts in flight across all "
                          "replicas (the least-outstanding balancing "
                          "signal, summed)",
+    "overlap_hidden_us": "collective wall time of the last drained "
+                         "step that ran under backward (overlapped "
+                         "bucket reduces completed before the drain)",
+    "overlap_exposed_us": "collective wall time of the last drained "
+                          "step paid inside the step (drain wait + "
+                          "buckets that could not run off-thread)",
 }
 
 # fixed bucket edges (upper bounds; +Inf is implicit)

@@ -53,8 +53,9 @@ from . import core as _core
 
 __all__ = ["enabled", "sample_period", "configure", "refresh_from_env",
            "register_collective", "is_collective", "maybe_time",
-           "take_serving_sample", "record_program", "open_step_window",
-           "close_step_window", "device_report", "timelines", "reset"]
+           "take_serving_sample", "record_program", "note_overlap",
+           "open_step_window", "close_step_window", "device_report",
+           "timelines", "reset"]
 
 
 def _parse_rate(raw):
@@ -134,13 +135,19 @@ def is_collective(name):
 class _Window:
     """One step (or serving batch) being decomposed."""
 
-    __slots__ = ("sampled", "compute_us", "collective_us", "data_wait_us")
+    __slots__ = ("sampled", "compute_us", "collective_us", "data_wait_us",
+                 "overlap_hidden_us", "overlap_exposed_us")
 
     def __init__(self, sampled, data_wait_us):
         self.sampled = sampled
         self.compute_us = 0.0
         self.collective_us = 0.0
         self.data_wait_us = data_wait_us
+        # direct measurement from the overlap tier (gluon/overlap.py):
+        # collective wall time hidden under backward vs exposed in the
+        # step's drain — None when the step ran un-overlapped
+        self.overlap_hidden_us = None
+        self.overlap_exposed_us = None
 
 
 _tls = threading.local()               # .window — thread-local, see above
@@ -200,6 +207,24 @@ def maybe_time(name, t0_us, out):
     record_program(name, _core.now_us() - t0_us, window=win)
 
 
+def note_overlap(hidden_us, exposed_us):
+    """Attribute one drained overlap step to the current thread's step
+    window: *hidden_us* of collective wall time ran under backward
+    (engine-thread bucket tasks completed before the drain), and
+    *exposed_us* was paid inside the step (the drain wait plus any
+    bucket that could not run off-thread).  With these present the
+    window's ``overlap_ratio`` is the DIRECT measurement
+    ``hidden / (hidden + exposed)`` instead of the EWMA estimate — it
+    works even at sample rate 1.0, where every step serializes and the
+    free-wall baseline never exists.  No window (device time off, or
+    called outside a step span) = no-op."""
+    win = getattr(_tls, "window", None)
+    if win is None:
+        return
+    win.overlap_hidden_us = float(hidden_us)
+    win.overlap_exposed_us = float(exposed_us)
+
+
 def record_program(name, dur_us, window=None, collective=None):
     """Book one sampled device-time measurement for program *name*."""
     if collective is None:
@@ -248,7 +273,13 @@ def close_step_window(dur_us):
     with _lock:
         base = _free_wall_ewma
     overlap = 0.0
-    if win.collective_us > 0 and base is not None:
+    if win.overlap_hidden_us is not None:
+        # direct measurement from the overlap tier: fraction of the
+        # step's collective wall time that ran under backward
+        total = win.overlap_hidden_us + (win.overlap_exposed_us or 0.0)
+        if total > 0:
+            overlap = min(1.0, max(0.0, win.overlap_hidden_us / total))
+    elif win.collective_us > 0 and base is not None:
         overlap = min(1.0, max(0.0, (dur_us - base) / win.collective_us))
     entry = {"wall_us": round(dur_us, 1),
              "data_wait_us": round(win.data_wait_us, 1),
@@ -256,6 +287,10 @@ def close_step_window(dur_us):
              "device_us": round(win.compute_us, 1),
              "collective_us": round(win.collective_us, 1),
              "overlap_ratio": round(overlap, 4),
+             "overlap_hidden_us": None if win.overlap_hidden_us is None
+             else round(win.overlap_hidden_us, 1),
+             "overlap_exposed_us": None if win.overlap_exposed_us is None
+             else round(win.overlap_exposed_us, 1),
              "free_wall_us": None if base is None else round(base, 1)}
     with _lock:
         _timelines.append(entry)

@@ -533,6 +533,96 @@ def test_nan_fault_grammar_and_grad_seam():
     assert [e[2] for e in chaos.fault_log()] == ["nan"]
 
 
+def test_keyed_decide_is_dispatch_order_independent():
+    """ISSUE-15 satellite: keyed counters (bucket ids) make decisions a
+    function of (key, occurrence), not of arrival order — the same
+    calls in any interleaving yield the identical fault_log()."""
+    spec = "seed=8;conn.send.push:drop~0.4;grad.bucket:exc@2"
+    keys = ["__bucket__a", "__bucket__b", "__bucket__c"]
+
+    def run(order):
+        chaos.configure(spec)
+        for step in range(4):
+            for k in order(step, keys):
+                chaos.decide("conn.send.push", key=k)
+            for b in order(step, range(len(keys))):
+                chaos.decide("grad.bucket", key=b)
+        return chaos.fault_log()
+
+    forward = run(lambda s, ks: list(ks))
+    reverse = run(lambda s, ks: list(ks)[::-1])
+    shuffled = run(lambda s, ks: list(ks)[s % len(list(ks)):]
+                   + list(ks)[:s % len(list(ks))])
+    assert forward == reverse == shuffled
+    assert any(e[2] == "drop" for e in forward)
+    # the @2 window fired once per bucket id, at that key's 2nd step
+    excs = [e for e in forward if e[2] == "exc"]
+    assert [(e[3], e[4]) for e in excs] == [(2, 0), (2, 1), (2, 2)]
+
+
+def test_overlap_on_off_same_fault_log():
+    """The seeded replay acceptance: a bucketed training run injects
+    the IDENTICAL fault sequence whether bucket reduces run overlapped
+    under backward (MXNET_OVERLAP=1) or synchronously in the step
+    (MXNET_OVERLAP=0) — grad.bucket and push counters are keyed by
+    bucket id, not dispatch order."""
+    import os
+    import numpy as np
+    from mxnet_tpu import autograd, gluon, kvstore as kvs
+    from mxnet_tpu.gluon import nn, overlap
+
+    prev_bucket = os.environ.get("MXNET_KVSTORE_BUCKET_BYTES")
+    prev_overlap = os.environ.get("MXNET_OVERLAP")
+    os.environ["MXNET_KVSTORE_BUCKET_BYTES"] = "256"   # several buckets
+    kvs.refresh_from_env()
+
+    def run(overlap_on):
+        os.environ["MXNET_OVERLAP"] = "1" if overlap_on else "0"
+        overlap.refresh_from_env()
+        chaos.configure("seed=6;grad.bucket:delay~0.5=1us")
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = nn.Sequential()
+        for _ in range(3):
+            net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(3))
+        net.initialize(init=mx.initializer.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, kvstore="device")
+        loss_fn = gluon.loss.L2Loss()
+        rng = np.random.RandomState(1)
+        for _ in range(4):
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(
+                    rng.randn(4, 6).astype(np.float32))),
+                    mx.nd.array(rng.randn(4, 3).astype(np.float32)))
+            loss.backward()
+            tr.step(4)
+        overlap.abandon_session(tr)
+        log = chaos.fault_log()
+        params = {i: p.data().asnumpy().tobytes()
+                  for i, p in enumerate(net.collect_params().values())}
+        return log, params
+
+    try:
+        log_off, params_off = run(False)
+        log_on, params_on = run(True)
+    finally:
+        for name, prev in (("MXNET_KVSTORE_BUCKET_BYTES", prev_bucket),
+                           ("MXNET_OVERLAP", prev_overlap)):
+            if prev is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prev
+        kvs.refresh_from_env()
+        overlap.refresh_from_env()
+    assert log_off, "the spec injected nothing — the replay is vacuous"
+    assert log_on == log_off
+    assert params_on == params_off       # transient faults stay bitwise
+    # multiple buckets existed, each keyed independently
+    assert len({e[4] for e in log_off if len(e) > 4}) > 1
+
+
 def test_nan_fault_log_is_deterministic():
     spec = "seed=5;grad.bucket:nan~0.5"
     import jax.numpy as jnp

@@ -238,6 +238,90 @@ def test_push_pull_all_outs_accounting():
     assert profiler.counter("xla_program_calls") - before == 1 + len(keys)
 
 
+def test_oversize_single_tensor_bucket_reduces_chunked_bitwise():
+    """A single-oversize-tensor bucket (payload > chunk budget) routes
+    through the pipelined chunked reduce (parallel/collective.py) —
+    bitwise equal to the per-key oracle, uneven tail included, with no
+    zero-padding leaking out of the chunk machinery."""
+    import os
+    from mxnet_tpu import profiler
+    from mxnet_tpu.parallel import collective
+    prev = os.environ.get("MXNET_OVERLAP_CHUNK_BYTES")
+    os.environ["MXNET_OVERLAP_CHUNK_BYTES"] = "4096"
+    collective.refresh_from_env()
+    try:
+        rng = np.random.RandomState(7)
+        shape = (2473, 3)               # 29676 B payload, uneven tail
+        copies = [rng.randn(*shape).astype(np.float32)
+                  for _ in range(3)]
+        kv_a = mx.kv.create("device")
+        kv_a.init("big", nd.zeros(shape))
+        kv_a.push("big", [nd.array(c) for c in copies])
+        oracle = nd.empty(shape)
+        kv_a.pull("big", out=oracle)
+
+        kv_b = mx.kv.create("device")
+        kv_b.init("big", nd.zeros(shape))
+        before = profiler.counter("collective_chunk_programs")
+        (out,) = kv_b.push_pull_all(
+            ["big"], [[nd.array(c) for c in copies]])
+        assert profiler.counter("collective_chunk_programs") \
+            - before > 1, "oversize bucket did not take the chunked path"
+        np.testing.assert_array_equal(out.asnumpy(), oracle.asnumpy())
+        assert out.shape == shape, "padding leaked past the tail"
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_OVERLAP_CHUNK_BYTES", None)
+        else:
+            os.environ["MXNET_OVERLAP_CHUNK_BYTES"] = prev
+        collective.refresh_from_env()
+
+
+def test_reduce_scatter_all_uneven_tails_and_mixed_dtype():
+    """ISSUE-15 satellite: ``reduce_scatter_all`` over a model whose
+    bucket payloads don't divide the shard count, with an oversize
+    tensor and mixed dtypes — reductions bitwise-match the per-key
+    oracle, indivisible leading dims fall back to the replicated
+    sharding (never a padded one), and no padding row reaches a result.
+    """
+    import jax
+    from mxnet_tpu.parallel import zero as z
+    if jax.local_device_count() < 4:
+        import pytest
+        pytest.skip("needs 4 local devices")
+    mesh = z.zero1_axis_mesh(4, "zero")
+    rng = np.random.RandomState(11)
+    # (div by 4, indivisible 10 % 4, odd vector, f16 pair)
+    shapes = [(8, 3), (10, 3), (5,), (8, 2), (6, 2)]
+    dtypes = [np.float32, np.float32, np.float32, np.float16,
+              np.float16]
+    copies = [[(rng.randn(*s) * 0.1).astype(dt) for _ in range(2)]
+              for s, dt in zip(shapes, dtypes)]
+    shardings = [z.update_sharding(mesh, s, "zero") for s in shapes]
+    assert shardings[0] is not None          # divisible: sharded
+    assert shardings[1] is None              # 10 % 4: replicated
+
+    kv = mx.kv.create("device")
+    keys = list(range(len(shapes)))
+    for k, s, dt in zip(keys, shapes, dtypes):
+        kv.init(k, nd.zeros(s, dtype=dt))
+    results = kv.reduce_scatter_all(
+        keys, [[nd.array(c, dtype=c.dtype) for c in cps]
+               for cps in copies], shardings)
+
+    kv_o = mx.kv.create("device")
+    for k, s, dt in zip(keys, shapes, dtypes):
+        kv_o.init(k, nd.zeros(s, dtype=dt))
+    for k, cps, r, s, dt in zip(keys, copies, results, shapes, dtypes):
+        kv_o.push(k, [nd.array(c, dtype=c.dtype) for c in cps])
+        oracle = nd.empty(s, dtype=dt)
+        kv_o.pull(k, out=oracle)
+        got = np.asarray(r._data)            # gathers sharded results
+        assert got.dtype == np.dtype(dt)
+        assert got.shape == tuple(s), "padding rows leaked into weights"
+        np.testing.assert_array_equal(got, oracle.asnumpy())
+
+
 def test_push_all_runs_updater_per_key():
     kv = _init_kv()
     seen = []

@@ -1,0 +1,484 @@
+"""Comm/compute overlap (ISSUE 15): bucket-ready gradient reduction
+under backward + the chunked device-side collective path.
+
+Contract: with ``MXNET_OVERLAP`` on (default) backward dispatches each
+gradient bucket's kvstore reduce as an engine task the moment the
+bucket's gradients exist, ``Trainer.step`` drains the in-flight buckets
+instead of launching them, and the loss/param trajectory is BITWISE
+identical to ``MXNET_OVERLAP=0`` across {fused, fused+zero1,
+fused+guardian}.  A dead peer mid-overlap surfaces as a structured
+``PeerLost`` within the PR-8 deadline — no hang, params untouched.
+``tools/trace_report.py --gate-overlap`` turns the win-condition
+``overlap_ratio`` into a CI-checkable exit code.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, chaos, gluon, profiler
+from mxnet_tpu.gluon import fused_trainer, nn, overlap
+from mxnet_tpu.parallel import collective
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _set_env(name, value, refresh):
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
+    refresh()
+
+
+@pytest.fixture(autouse=True)
+def _clean_overlap_env():
+    prev = {k: os.environ.get(k)
+            for k in ("MXNET_OVERLAP", "MXNET_ZERO", "MXNET_ZERO_SHARDS",
+                      "MXNET_KVSTORE_BUCKET_BYTES",
+                      "MXNET_OVERLAP_CHUNK_BYTES")}
+    yield
+    for k, v in prev.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    overlap.refresh_from_env()
+    fused_trainer.refresh_from_env()
+    collective.refresh_from_env()
+    from mxnet_tpu import kvstore as kvs
+    kvs.refresh_from_env()
+    chaos.configure(None)
+
+
+def _net(n_layers=4, width=16, out=3):
+    net = nn.Sequential()
+    for _ in range(n_layers - 1):
+        net.add(nn.Dense(width, activation="relu"))
+    net.add(nn.Dense(out))
+    return net
+
+
+def _train(overlap_on, steps=5, optimizer="sgd",
+           opt_params=None, seed=0, guard=None, poison=None,
+           batch=8):
+    """Run a small regression net; returns (params, states, losses)."""
+    _set_env("MXNET_OVERLAP", "1" if overlap_on else "0",
+             overlap.refresh_from_env)
+    chaos.configure(poison)
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed + 1)
+    net = _net()
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(
+        net.collect_params(), optimizer,
+        dict(opt_params or {"learning_rate": 0.05, "momentum": 0.9}),
+        kvstore="device")
+    loss_fn = gluon.loss.L2Loss()
+    X = rng.randn(steps, batch, 6).astype(np.float32)
+    Y = rng.randn(steps, batch, 3).astype(np.float32)
+    losses = []
+    for step in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(mx.nd.array(X[step])),
+                           mx.nd.array(Y[step]))
+        if guard is not None:
+            guard.observe_loss(loss)
+        loss.backward()
+        trainer.step(batch)
+        losses.append(loss.asnumpy().tobytes())
+    overlap.abandon_session(trainer)
+    params = {i: p.data().asnumpy()
+              for i, p in enumerate(net.collect_params().values())}
+    states = {}
+    for idx, st in trainer._updater.states.items():
+        leaves = []
+
+        def _collect(s):
+            if s is None:
+                leaves.append(None)
+            elif isinstance(s, (tuple, list)):
+                for x in s:
+                    _collect(x)
+            else:
+                leaves.append(s.asnumpy())
+        _collect(st)
+        states[idx] = leaves
+    return params, states, losses
+
+
+def _assert_bitwise(a, b, what):
+    assert a.keys() == b.keys()
+    for k in a:
+        if isinstance(a[k], list):
+            for i, (x, y) in enumerate(zip(a[k], b[k])):
+                if x is None:
+                    assert y is None
+                else:
+                    np.testing.assert_array_equal(
+                        x, y, err_msg="%s[%s][%d]" % (what, k, i))
+        else:
+            np.testing.assert_array_equal(a[k], b[k],
+                                          err_msg="%s[%s]" % (what, k))
+
+
+# ---------------------------------------------------------------------------
+# grad-ready notification order
+# ---------------------------------------------------------------------------
+
+def test_grad_ready_hook_fires_during_backward_in_reverse_order():
+    """Backward finalizes later layers' gradients FIRST (their last
+    consumer sits deepest in the tape), and the hook fires while the
+    sweep is still running — the seam overlap dispatch hangs off."""
+    order = []
+    prev = autograd.set_grad_ready_hook(lambda v: order.append(id(v)))
+    try:
+        net = _net(n_layers=3, width=8)
+        net.initialize(init=mx.initializer.Xavier())
+        params = list(net.collect_params().values())
+        with autograd.record():
+            loss = gluon.loss.L2Loss()(net(mx.nd.array(
+                np.random.randn(4, 6).astype(np.float32))),
+                mx.nd.array(np.random.randn(4, 3).astype(np.float32)))
+        loss.backward()
+    finally:
+        autograd.set_grad_ready_hook(prev)
+    ids = {id(p.data()): i for i, p in enumerate(params)}
+    ranked = [ids[x] for x in order if x in ids]
+    assert len(ranked) == len(params), "every param grad notified"
+    # the FIRST notification comes from the last layer, not the first
+    assert ranked[0] >= len(params) - 2, \
+        "expected output-layer grads first, got slot order %r" % ranked
+    assert ranked[-1] <= 1, \
+        "expected input-layer grads last, got slot order %r" % ranked
+
+
+# ---------------------------------------------------------------------------
+# bitwise oracles (the acceptance identity)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_overlap_bitwise_vs_oracle(optimizer, opt_params):
+    ref = _train(False, optimizer=optimizer, opt_params=opt_params)
+    drained0 = profiler.counter("overlap_steps")
+    got = _train(True, optimizer=optimizer, opt_params=opt_params)
+    assert profiler.counter("overlap_steps") > drained0, \
+        "overlap never engaged — the comparison proved nothing"
+    assert got[2] == ref[2], "loss trajectory diverged"
+    _assert_bitwise(got[0], ref[0], "param")
+    _assert_bitwise(got[1], ref[1], "state")
+
+
+def test_overlap_bitwise_vs_oracle_zero1():
+    import jax
+    if jax.local_device_count() < 2:
+        pytest.skip("needs >1 local device")
+    _set_env("MXNET_ZERO", "1", fused_trainer.refresh_from_env)
+    _set_env("MXNET_ZERO_SHARDS", "2", fused_trainer.refresh_from_env)
+    ref = _train(False)
+    drained0 = profiler.counter("overlap_steps")
+    got = _train(True)
+    assert profiler.counter("overlap_steps") > drained0
+    assert got[2] == ref[2]
+    _assert_bitwise(got[0], ref[0], "param")
+    _assert_bitwise(got[1], ref[1], "state")
+
+
+def test_overlap_bitwise_vs_oracle_guardian_transient_nan():
+    """Guardian + overlap: the poisoned step is skipped on both paths,
+    the verdict reads only after every bucket landed, and the
+    trajectories stay bitwise identical."""
+    from mxnet_tpu import guardian, telemetry
+    results = []
+    for overlap_on in (False, True):
+        before = telemetry.counter("guardian_skipped_steps")
+        g = guardian.TrainingGuardian()
+        try:
+            results.append(_train(overlap_on, guard=g,
+                                  poison="grad.bucket:nan@3"))
+        finally:
+            g.close()
+        assert telemetry.counter("guardian_skipped_steps") == before + 1
+    ref, got = results
+    assert got[2] == ref[2]
+    _assert_bitwise(got[0], ref[0], "param")
+    _assert_bitwise(got[1], ref[1], "state")
+
+
+# ---------------------------------------------------------------------------
+# the overlap actually overlaps
+# ---------------------------------------------------------------------------
+
+def test_buckets_dispatch_under_backward_and_drain():
+    d0 = profiler.counter("overlap_bucket_dispatches")
+    s0 = profiler.counter("overlap_steps")
+    f0 = profiler.counter("overlap_fallbacks")
+    steps = 5
+    _train(True, steps=steps)
+    # session arms at the end of step k for step k+1: steps-1 drains
+    assert profiler.counter("overlap_steps") - s0 == steps - 1
+    assert profiler.counter("overlap_bucket_dispatches") - d0 >= steps - 1
+    assert profiler.counter("overlap_fallbacks") == f0
+    stats = overlap.last_step_stats()
+    assert stats is not None and stats["buckets"] >= 1
+    assert stats["hidden_us"] >= 0.0 and stats["exposed_us"] >= 0.0
+
+
+def test_rewritten_grad_falls_back_not_wrong():
+    """A gradient re-written after its bucket dispatched (double
+    backward) dirties the session: the step falls back to the
+    synchronous round — counted, and still bitwise-correct."""
+    _set_env("MXNET_OVERLAP", "1", overlap.refresh_from_env)
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = _net()
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore="device")
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(1)
+    X = mx.nd.array(rng.randn(4, 6).astype(np.float32))
+    Y = mx.nd.array(rng.randn(4, 3).astype(np.float32))
+    # step 1 arms the session for step 2
+    with autograd.record():
+        loss = loss_fn(net(X), Y)
+    loss.backward()
+    trainer.step(4)
+    f0 = profiler.counter("overlap_fallbacks")
+    with autograd.record():
+        loss = loss_fn(net(X), Y)
+    autograd.backward([loss], retain_graph=True)
+    autograd.backward([loss])            # re-writes every gradient
+    trainer.step(4)
+    assert profiler.counter("overlap_fallbacks") == f0 + 1
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all()
+    overlap.abandon_session(trainer)
+
+
+def test_defused_step_abandons_armed_session():
+    """Flipping MXNET_FUSED_TRAINER off mid-run routes the next step
+    through the per-slot loop: the armed session must be discarded, not
+    half-consumed."""
+    _set_env("MXNET_OVERLAP", "1", overlap.refresh_from_env)
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = _net()
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore="device")
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(1)
+    for _ in range(2):
+        with autograd.record():
+            loss = loss_fn(net(mx.nd.array(
+                rng.randn(4, 6).astype(np.float32))),
+                mx.nd.array(rng.randn(4, 3).astype(np.float32)))
+        loss.backward()
+        trainer.step(4)
+    assert getattr(trainer, "_overlap_session", None) is not None
+    _set_env("MXNET_FUSED_TRAINER", "0", fused_trainer.refresh_from_env)
+    try:
+        with autograd.record():
+            loss = loss_fn(net(mx.nd.array(
+                rng.randn(4, 6).astype(np.float32))),
+                mx.nd.array(rng.randn(4, 3).astype(np.float32)))
+        loss.backward()
+        trainer.step(4)
+    finally:
+        _set_env("MXNET_FUSED_TRAINER", None,
+                 fused_trainer.refresh_from_env)
+    assert getattr(trainer, "_overlap_session", None) is None
+
+
+# ---------------------------------------------------------------------------
+# dead peer mid-overlap: structured failure within the deadline
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_overlapped_reduce_on_dead_peer_raises_peerlost(monkeypatch):
+    """An overlapped bucket push whose server never acks (the dead-peer
+    shape, injected as a chaos `drop` of the push frame) must surface a
+    structured PeerLost/RPCTimeout from Trainer.step within the PR-8
+    deadline — engine task errors re-raise at the drain — with the
+    params untouched.  No hang, no half-reduced state."""
+    from mxnet_tpu import dist_ps
+    port = _free_port()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("MXNET_PS_RPC_TIMEOUT_S", "1.0")
+    monkeypatch.delenv("DMLC_WORKER_RANK", raising=False)
+    dist_ps.refresh_from_env()
+    _set_env("MXNET_OVERLAP", "1", overlap.refresh_from_env)
+    sched = dist_ps.Scheduler(1, 1, port=port)
+    threading.Thread(target=sched.run, daemon=True).start()
+    threading.Thread(target=dist_ps.run_server, daemon=True).start()
+    kv = mx.kv.KVStoreDist("dist_sync")
+    try:
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = _net(n_layers=2, width=8)
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=kv)
+        loss_fn = gluon.loss.L2Loss()
+        rng = np.random.RandomState(1)
+
+        def one_step():
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(
+                    rng.randn(4, 6).astype(np.float32))),
+                    mx.nd.array(rng.randn(4, 3).astype(np.float32)))
+            loss.backward()
+            trainer.step(4)
+
+        one_step()                      # arms the overlap session
+        # drop the next push of every bucket key (counting starts at
+        # configure): the overlapped push's ack never comes, the PR-8
+        # per-RPC deadline fires in-task
+        chaos.configure("conn.send.push:drop@1")
+        before = {i: p.data().asnumpy()
+                  for i, p in enumerate(net.collect_params().values())}
+        t0 = time.monotonic()
+        with pytest.raises(dist_ps.PeerLost):
+            one_step()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2 * 1.0 + 2.0, \
+            "PeerLost took %.1fs (deadline contract: <= 2x timeout)" \
+            % elapsed
+        chaos.configure(None)
+        after = {i: p.data().asnumpy()
+                 for i, p in enumerate(net.collect_params().values())}
+        _assert_bitwise(after, before, "params-after-failed-drain")
+        overlap.abandon_session(trainer)
+    finally:
+        chaos.configure(None)
+        kv._finalize()
+
+
+# ---------------------------------------------------------------------------
+# the chunked collective module
+# ---------------------------------------------------------------------------
+
+def test_chunked_reduce_bitwise_and_padless_tail():
+    import jax.numpy as jnp
+    from mxnet_tpu.kvstore import _stack_sum
+    rng = np.random.RandomState(3)
+    n = 10_003                               # uneven vs any chunk size
+    flats = [jnp.asarray(rng.randn(n).astype(np.float32))
+             for _ in range(3)]
+    ref = np.asarray(_stack_sum(flats))
+    c0 = profiler.counter("collective_chunk_programs")
+    out = np.asarray(collective.chunked_reduce(flats, limit=4096))
+    assert profiler.counter("collective_chunk_programs") - c0 > 1
+    np.testing.assert_array_equal(out, ref)
+    assert out.shape == (n,), "padding leaked past the tail"
+
+
+def test_chunked_reduce_scatter_uneven_tail_and_gather():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.kvstore import _stack_sum
+    rng = np.random.RandomState(4)
+    n = 5_001                                # 5001 % 4 != 0
+    flats = [jnp.asarray(rng.randn(n).astype(np.float32))
+             for _ in range(2)]
+    ref = np.asarray(_stack_sum(flats))
+    segs = collective.chunked_reduce_scatter(flats, 4, limit=2048)
+    assert len(segs) == 4
+    assert sum(int(s.shape[0]) for s in segs) == n
+    np.testing.assert_array_equal(
+        np.asarray(jnp.concatenate(segs)), ref)
+    full = collective.chunked_all_gather(segs, device=jax.devices()[0],
+                                         limit=2048)
+    np.testing.assert_array_equal(np.asarray(full), ref)
+
+
+def test_redistribution_schedule_every_element_exactly_once():
+    for n, nf, nt, ch in [(101, 4, 3, 17), (64, 2, 8, 9), (7, 3, 5, 100)]:
+        covered = np.zeros(n, bool)
+        for src, dst, lo, hi in collective.redistribution_schedule(
+                n, nf, nt, ch):
+            assert hi - lo <= ch
+            assert not covered[lo:hi].any(), "element moved twice"
+            covered[lo:hi] = True
+        assert covered.all(), "elements dropped by the schedule"
+
+
+def test_redistribute_and_gather_home_round_trip():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    if jax.local_device_count() < 4:
+        pytest.skip("needs 4 local devices")
+    rng = np.random.RandomState(5)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("zero",))
+    arr = jax.numpy.asarray(rng.randn(16, 7).astype(np.float32))
+    sh = NamedSharding(mesh, P("zero"))
+    placed = collective.redistribute(arr, sh, limit=64)
+    assert placed.sharding == sh
+    np.testing.assert_array_equal(np.asarray(placed), np.asarray(arr))
+    home = collective.gather_home(placed, jax.devices()[0], limit=64)
+    np.testing.assert_array_equal(np.asarray(home), np.asarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# the CI gate
+# ---------------------------------------------------------------------------
+
+def _run_gate(snapshot, threshold):
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        snap = os.path.join(tmp, "snap.json")
+        trace = os.path.join(tmp, "trace.json")
+        with open(snap, "w") as fh:
+            json.dump(snapshot, fh)
+        with open(trace, "w") as fh:
+            json.dump({"traceEvents": []}, fh)
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"), trace,
+             "--snapshot", snap, "--gate-overlap", str(threshold)],
+            capture_output=True, text=True, timeout=120)
+
+
+def test_gate_overlap_exit_codes():
+    tl = [{"wall_us": 100.0, "data_wait_us": 0.0, "host_us": 10.0,
+           "device_us": 60.0, "collective_us": 30.0,
+           "overlap_ratio": r, "overlap_hidden_us": 30.0 * r,
+           "overlap_exposed_us": 30.0 * (1 - r)}
+          for r in (0.5, 0.7)]
+    snap = {"device": {"enabled": True, "sample_period": 1,
+                       "timelines": tl, "last_step": tl[-1],
+                       "programs": {}}}
+    ok = _run_gate(snap, 0.4)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "gate-overlap: ok" in ok.stdout
+    low = _run_gate(snap, 0.9)
+    assert low.returncode == 3, low.stdout + low.stderr
+    assert "FAIL" in low.stderr
+    empty = _run_gate({"device": {"enabled": False, "timelines": [],
+                                  "last_step": None, "programs": {}}},
+                      0.1)
+    assert empty.returncode == 4, \
+        "a gate that cannot measure must fail loudly"

@@ -28,6 +28,8 @@ OWNED_PROGRAMS = {
     "clip_global_norm",
     "kvstore_stack_sum",
     "kvstore_bucket_reduce",
+    "collective_chunk_sum",
+    "collective_chunk_write",
     "module_cached_step",
     "optimizer_update_step",
     "predictor_forward",

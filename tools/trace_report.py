@@ -23,7 +23,10 @@ did the step go" — and "what could the hardware have done":
     the snapshot's ``device`` section: data-wait / host-gap / device-
     compute / collective-comm per sampled step plus ``overlap_ratio``
     (the fraction of collective time hidden under compute — ROADMAP
-    item 2's win condition) and the per-program device-time table
+    item 2's win condition) and the per-program device-time table.
+    ``--gate-overlap RATIO`` turns the win condition into a CI gate:
+    nonzero exit when the mean ``overlap_ratio`` falls below RATIO
+    (exit 3) or when no timeline exists to measure it (exit 4)
 
 ``--fleet DIR`` switches to fleet mode: every ``trace_<role>_<rank>.json``
 artifact in DIR (written by ``dist_ps.dump_trace_artifacts`` /
@@ -247,7 +250,8 @@ def timeline_stats(snapshot):
     mean = None
     if timelines:
         keys = ("wall_us", "data_wait_us", "host_us", "device_us",
-                "collective_us", "overlap_ratio")
+                "collective_us", "overlap_ratio", "overlap_hidden_us",
+                "overlap_exposed_us")
         mean = {k: sum(t.get(k) or 0 for t in timelines) / len(timelines)
                 for k in keys}
         mean["samples"] = len(timelines)
@@ -541,6 +545,8 @@ def render(report, top):
                            ("host_us", "host"),
                            ("device_us", "device"),
                            ("collective_us", "collective"),
+                           ("overlap_hidden_us", "comm hidden"),
+                           ("overlap_exposed_us", "comm exposed"),
                            ("wall_us", "step wall")):
             lines.append("%-12s %14s %14s"
                          % (label,
@@ -616,6 +622,12 @@ def main(argv=None):
                          "DIR/fleet_merged.json)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout (CI)")
+    ap.add_argument("--gate-overlap", type=float, default=None,
+                    metavar="RATIO",
+                    help="exit nonzero unless the step timeline's mean "
+                         "overlap_ratio (collective time hidden under "
+                         "backward) reaches RATIO — the ROADMAP item-2 "
+                         "win condition as a CI gate")
     args = ap.parse_args(argv)
 
     if args.fleet is not None:
@@ -637,7 +649,32 @@ def main(argv=None):
         print("no events")
     else:
         print(render(report, args.top))
+    if args.gate_overlap is not None:
+        return gate_overlap(report, args.gate_overlap)
     return 0
+
+
+def gate_overlap(report, threshold):
+    """The --gate-overlap exit policy: 0 when the sampled step
+    timeline's mean ``overlap_ratio`` reaches *threshold*; 3 when it
+    falls short; 4 when no timeline exists at all (a gate that cannot
+    measure must fail loudly, not vacuously pass)."""
+    tl = report.get("timeline") or {}
+    mean = tl.get("mean") or {}
+    ratio = mean.get("overlap_ratio")
+    if ratio is None:
+        last = tl.get("last_step") or {}
+        ratio = last.get("overlap_ratio")
+    if ratio is None:
+        print("gate-overlap: FAIL — no step-timeline overlap_ratio in "
+              "the snapshot (run with MXNET_DEVICE_TIME)",
+              file=sys.stderr)
+        return 4
+    verdict = "ok" if ratio >= threshold else "FAIL"
+    print("gate-overlap: %s — mean overlap_ratio %.3f vs threshold %.3f"
+          % (verdict, ratio, threshold),
+          file=sys.stderr if verdict == "FAIL" else sys.stdout)
+    return 0 if verdict == "ok" else 3
 
 
 if __name__ == "__main__":
