@@ -137,8 +137,8 @@ class _ZeroPlan:
         self._z = z
         self.mesh = z.zero1_axis_mesh(n_shards, self.axis)
         self.n = int(self.mesh.shape[self.axis])
-        from jax.sharding import NamedSharding, PartitionSpec
-        self.replicated = NamedSharding(self.mesh, PartitionSpec())
+        from ..parallel import mesh as mesh_mod
+        self.replicated = mesh_mod.replicated(self.mesh)
         self._upd_cache = {}           # weight shape -> sharding or None
         self._bytes = None             # (per_device, replicated) cache
 

@@ -537,6 +537,10 @@ ENTRY_POINTS = (
     ("serving", "mxnet_tpu.serving.program"),
     ("guardian", "mxnet_tpu.guardian"),
     ("gluon_utils", "mxnet_tpu.gluon.utils"),
+    ("pipeline", "mxnet_tpu.parallel.pipeline"),
+    ("ring_attention", "mxnet_tpu.parallel.ring_attention"),
+    ("sharded_trainer", "mxnet_tpu.parallel.sharded"),
+    ("transformer", "mxnet_tpu.models.transformer"),
 )
 
 

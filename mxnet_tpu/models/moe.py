@@ -20,7 +20,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import mesh as mesh_mod
 
 __all__ = ["init_moe_params", "moe_ffn", "moe_param_specs"]
 
@@ -50,10 +52,7 @@ def init_moe_params(key, d_model, d_ff, n_experts, mesh=None,
             v = (jax.random.normal(sub, shape, dtype)
                  * (1.0 / math.sqrt(max(fan_in, 1))))
         if mesh is not None:
-            if any(ax is not None and ax not in mesh.shape
-                   for ax in tuple(spec)):
-                spec = P()
-            v = jax.device_put(v, NamedSharding(mesh, spec))
+            v = mesh_mod.shard_put(v, mesh_mod.named_sharding(mesh, spec))
         params[name] = v
     return params
 
@@ -104,7 +103,7 @@ def moe_ffn(x, params, capacity_factor=1.25, mesh=None,
 
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, flat)   # [E, C, D]
     if mesh is not None and expert_axis in mesh.shape:
-        espec = NamedSharding(mesh, P(expert_axis, None, None))
+        espec = mesh_mod.named_sharding(mesh, P(expert_axis, None, None))
         expert_in = jax.lax.with_sharding_constraint(expert_in, espec)
     h = jax.nn.gelu(
         jnp.einsum("ecd,edf->ecf", expert_in, params["expert_w1"])

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import telemetry as _tel
+from ..parallel import mesh as mesh_mod
 from ..parallel.ring_attention import ring_attention
 
 __all__ = ["TransformerLMConfig", "init_transformer_params",
@@ -72,24 +72,10 @@ def _param_specs(cfg):
     return specs
 
 
-def _filter_spec(spec, mesh):
-    """Drop axis names the mesh doesn't have (lets one model definition run
-    on dp-only, dp+tp, or dp+tp+sp meshes)."""
-    if mesh is None:
-        return spec
-    return P(*[a if a in mesh.axis_names else None for a in spec])
-
-
-def global_put(value, sharding):
-    """Place a host value under *sharding*, working in multi-process SPMD
-    too: each process materializes only its addressable shards
-    (jax.make_array_from_callback), so the same call serves one host or a
-    jax.distributed fleet."""
-    if jax.process_count() == 1:
-        return jax.device_put(value, sharding)
-    host = np.asarray(value)
-    return jax.make_array_from_callback(host.shape, sharding,
-                                        lambda idx: host[idx])
+# the spec/placement helpers moved into the sharding substrate
+# (parallel/mesh.py); these names remain the model-layer spelling
+_filter_spec = mesh_mod.filter_spec
+global_put = mesh_mod.shard_put
 
 
 def init_transformer_params(key, cfg, mesh=None):
@@ -136,11 +122,12 @@ def transformer_forward(params, tokens, cfg, mesh=None, seq_axis="seq"):
 
     if use_ring:
         qkv_spec = _filter_spec(P("data", "model", seq_axis, None), mesh)
-        attn = jax.shard_map(
+        attn = mesh_mod.shard_map(
             functools.partial(ring_attention, axis_name=seq_axis,
                               causal=True),
             mesh=mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec)
+            in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
+            check=False)
     else:
         attn = functools.partial(_causal_attn_local, mesh=mesh)
 
@@ -178,9 +165,9 @@ def _causal_attn_local(q, k, v, mesh=None):
             # pallas_call is opaque to GSPMD: shard batch/heads explicitly
             # so the TP split survives (each shard runs the kernel locally)
             spec = _filter_spec(P("data", "model", None, None), mesh)
-            return jax.shard_map(lambda a, b_, c: fn(a, b_, c), mesh=mesh,
-                                 in_specs=(spec,) * 3, out_specs=spec)(
-                                     q, k, v)
+            return mesh_mod.shard_map(lambda a, b_, c: fn(a, b_, c),
+                                      mesh=mesh, in_specs=(spec,) * 3,
+                                      out_specs=spec, check=False)(q, k, v)
         return fn(q, k, v)
     from ..parallel.ring_attention import local_attention
     return local_attention(q, k, v, causal=True)
@@ -211,8 +198,8 @@ def make_train_step(cfg, mesh, lr=0.1, seq_axis="seq"):
             lambda p, g: p - lr * g.astype(p.dtype), params, grads)
         return new_params, loss
 
-    return _tel.watch_jit(jax.jit(step, donate_argnums=(0,)),
-                          "transformer_train_step")
+    return mesh_mod.jit_sharded(step, "transformer_train_step",
+                                donate_argnums=(0,))
 
 
 def make_train_step_zero1(cfg, mesh, params, lr=0.1, momentum=0.9,
@@ -257,11 +244,42 @@ def make_train_step_zero1(cfg, mesh, params, lr=0.1, momentum=0.9,
                 upd_shardings[n], param_shardings[n])
         return new_p, new_m, loss
 
-    return _tel.watch_jit(jax.jit(step, donate_argnums=(0, 1)),
-                          "transformer_train_step_zero1"), momenta
+    return mesh_mod.jit_sharded(step, "transformer_train_step_zero1",
+                                donate_argnums=(0, 1)), momenta
 
 
 def place_batch(tokens, labels, mesh, seq_axis="seq"):
     """Shard a [B, S] token batch over (data, seq)."""
     spec = NamedSharding(mesh, _filter_spec(P("data", seq_axis), mesh))
     return global_put(tokens, spec), global_put(labels, spec)
+
+
+# the provider's programs close over live params/momenta; keep them
+# alive until the driver traces (same idiom as gluon/fused_trainer)
+_TRACECHECK_KEEPALIVE = []
+
+
+def tracecheck_programs():
+    """graftcheck provider: the plain and ZeRO-1 train steps of a tiny
+    LM over the live 3D mesh (whatever device count the process has —
+    auto_mesh collapses absent axes to size 1)."""
+    mesh = mesh_mod.auto_mesh(("data", "seq", "model"))
+    dp, sp, tp = (mesh.shape[a] for a in ("data", "seq", "model"))
+    cfg = TransformerLMConfig(vocab=32, d_model=8 * max(tp, 1),
+                              n_heads=max(tp, 2), d_ff=16 * max(tp, 1),
+                              n_layers=1, max_len=8 * max(sp, 1))
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg, mesh)
+    b, s = 2 * dp, 8 * sp
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab, (b, s)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab, (b, s)).astype(np.int32)
+    tokens, labels = place_batch(tokens, labels, mesh)
+
+    step = make_train_step(cfg, mesh, lr=0.1)
+    step_z, momenta = make_train_step_zero1(cfg, mesh, params, lr=0.1)
+    _TRACECHECK_KEEPALIVE.append((params, momenta, tokens, labels))
+    return [
+        ("transformer_train_step", step, (params, tokens, labels), {}),
+        ("transformer_train_step_zero1", step_z,
+         (params, momenta, tokens, labels), {}),
+    ]

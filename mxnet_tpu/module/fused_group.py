@@ -24,10 +24,10 @@ from __future__ import annotations
 import logging
 
 import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..executor import Executor
+from ..parallel import mesh as mesh_mod
 from .. import ndarray as nd
 
 __all__ = ["FusedExecutorGroup", "fused_enabled"]
@@ -45,8 +45,8 @@ class _ShardedExecutor(Executor):
     def __init__(self, symbol, ctx, mesh, batch_arg_names, **kwargs):
         self._mesh = mesh
         self._batch_args = set(batch_arg_names)
-        self._data_sharding = NamedSharding(mesh, P("data"))
-        self._replicated = NamedSharding(mesh, P())
+        self._data_sharding = mesh_mod.named_sharding(mesh, P("data"))
+        self._replicated = mesh_mod.replicated(mesh)
         super().__init__(symbol, ctx, **kwargs)
 
     def _place(self, name, arr):
@@ -80,8 +80,8 @@ class FusedExecutorGroup(object):
                 "fused group: batch size %d not divisible by %d devices"
                 % (self.batch_size, len(contexts)))
         self._contexts = contexts
-        devices = np.array([c.jax_device for c in contexts])
-        self._mesh = Mesh(devices, ("data",))
+        devices = [c.jax_device for c in contexts]
+        self._mesh = mesh_mod.make_mesh({"data": len(devices)}, devices)
 
         fixed = set(fixed_param_names or [])
         batch_args = [d.name for d in data_shapes] + \

@@ -3,17 +3,21 @@
 This package is the TPU-native replacement for the reference's entire
 distribution stack (SURVEY §2.5, §5.8):
 
-- ``mesh``        — `jax.sharding.Mesh` construction/management; replaces
-                    context lists + `DataParallelExecutorGroup` device slicing
+- ``mesh``        — THE sharding substrate: `jax.sharding.Mesh`
+                    construction (local + multi-host topology), sharding
+                    helpers, and the one ``shard_map``/``jit_sharded``
+                    program entry point; replaces context lists +
+                    `DataParallelExecutorGroup` device slicing
                     (reference ``module/executor_group.py:233-258``).
-- ``collectives`` — named XLA collectives (psum/all_gather/reduce_scatter/
-                    ppermute) over ICI/DCN; replaces ps-lite + Comm
-                    (reference ``src/kvstore/comm.h``, ``kvstore_dist.h``).
 - ``sharded``     — one jitted SPMD train step over a mesh with
                     data/tensor-parallel shardings; replaces per-device
                     executor groups + kvstore push/pull
                     (reference ``model.py:105-140``).
-- ``collective``  — chunked device-side redistribution (pipelined
+- ``collective``  — the communication surface: named in-program
+                    collectives (psum/all_gather/reduce_scatter/ppermute)
+                    over ICI/DCN replacing ps-lite + Comm (reference
+                    ``src/kvstore/comm.h``, ``kvstore_dist.h``), plus
+                    chunked device-side redistribution (pipelined
                     all-gather / reduce-scatter per arXiv 2112.01075)
                     shared by kvstore buckets, the ZeRO-1 weight
                     all-gather, and elastic checkpoint restore.
@@ -24,13 +28,17 @@ distribution stack (SURVEY §2.5, §5.8):
                     contract; replaces the dmlc tracker rendezvous
                     (reference ``tools/launch.py:22-30``).
 """
-from .mesh import make_mesh, auto_mesh, factor_devices, current_mesh, using_mesh
-from .collectives import (psum, pmean, pmax, all_gather, reduce_scatter,
-                          ppermute_shift, all_to_all, axis_index, axis_size,
-                          barrier, host_allreduce)
+from .mesh import (make_mesh, auto_mesh, factor_devices, current_mesh,
+                   using_mesh, shard_map, named_sharding, filter_spec,
+                   replicated, shard_put, jit_sharded, multihost_mesh,
+                   mesh_from_env, default_mesh, topology)
+from .collective import (psum, pmean, pmax, all_gather, reduce_scatter,
+                         ppermute_shift, all_to_all, axis_index, axis_size,
+                         barrier, host_allreduce)
 from .sharded import (ShardedTrainer, block_pure_fn, sharded_data,
                       zero1_update_spec)
-from .ring_attention import ring_attention, local_attention
+from .ring_attention import (ring_attention, local_attention,
+                             ring_attention_sharded)
 from .pipeline import pipeline_apply
 from . import collective
 from . import multihost
@@ -38,9 +46,13 @@ from .multihost import init_from_env
 
 __all__ = [
     "make_mesh", "auto_mesh", "factor_devices", "current_mesh", "using_mesh",
+    "shard_map", "named_sharding", "filter_spec", "replicated", "shard_put",
+    "jit_sharded", "multihost_mesh", "mesh_from_env", "default_mesh",
+    "topology",
     "psum", "pmean", "pmax", "all_gather", "reduce_scatter", "ppermute_shift",
     "all_to_all", "axis_index", "axis_size", "barrier", "host_allreduce",
     "ShardedTrainer", "block_pure_fn", "sharded_data", "zero1_update_spec",
-    "ring_attention", "local_attention", "pipeline_apply",
+    "ring_attention", "local_attention", "ring_attention_sharded",
+    "pipeline_apply",
     "collective", "multihost", "init_from_env",
 ]

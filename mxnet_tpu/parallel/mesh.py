@@ -1,4 +1,4 @@
-"""Device-mesh construction and management.
+"""Device-mesh construction and management — THE sharding substrate.
 
 The reference expresses multi-device placement as a context list handed to
 ``Module``/``DataParallelExecutorGroup`` (reference ``module/module.py:39``,
@@ -8,18 +8,48 @@ parallelism shards weights over ``"model"``, sequence parallelism shards the
 sequence over ``"seq"``.  Collectives ride ICI within a slice and DCN across
 slices — axis order puts the fastest-varying (innermost) axis on the
 best-connected devices.
+
+This module is the single owner of three things every SPMD consumer
+(models, pipeline, ring attention, ZeRO placement, fused executor group)
+used to carry privately:
+
+1. **Mesh construction** — local single-host meshes (:func:`make_mesh`,
+   :func:`auto_mesh`) and the multi-host topology where the
+   jax.distributed process fleet is a first-class leading axis
+   (:func:`multihost_mesh`); ``MXNET_MESH_SHAPE`` /
+   ``MXNET_MESH_SPAN_HOSTS`` select a fleet-wide default without code
+   changes (:func:`mesh_from_env`).
+2. **Sharding helpers** — :func:`filter_spec` (one model definition runs
+   on dp-only, dp+tp, or dp+tp+sp meshes), :func:`named_sharding`,
+   :func:`replicated`, and :func:`shard_put` (multi-process-safe
+   placement: each process materializes only its addressable shards).
+3. **Program entry points** — :func:`shard_map`, a version-adaptive
+   wrapper over jax's drifting shard_map surface (``jax.shard_map`` +
+   ``check_vma`` on current jax, ``jax.experimental.shard_map`` +
+   ``check_rep`` on older releases), plus the :func:`pvary` /
+   :func:`vma_axes` capability shims its callers need; and
+   :func:`jit_sharded`, ``jax.jit`` + ``watch_jit`` in one call so every
+   SPMD program lands in the telemetry retrace watchdog, cost accounting
+   and ``MXNET_DEVICE_TIME`` attribution from day one.
+
+No other module in the tree may call ``shard_map`` directly — graftcheck's
+coverage gate and tests/test_mesh.py enforce the single-substrate rule.
 """
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 
 import numpy as np
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "auto_mesh", "factor_devices", "current_mesh",
-           "using_mesh"]
+           "using_mesh", "shard_map", "pvary", "vma_axes", "filter_spec",
+           "named_sharding", "replicated", "shard_put", "jit_sharded",
+           "multihost_mesh", "mesh_from_env", "default_mesh", "topology",
+           "refresh_from_env"]
 
 _tls = threading.local()
 
@@ -103,3 +133,231 @@ def using_mesh(mesh):
             yield mesh
     finally:
         _tls.stack.pop()
+
+
+# --------------------------------------------------------------------------
+# Multi-host topology: the jax.distributed fleet as a first-class axis
+# --------------------------------------------------------------------------
+
+def multihost_mesh(axis_shapes=None, host_axis="host", devices=None,
+                   n_hosts=None):
+    """A mesh spanning every jax.distributed process, with the process
+    fleet as the leading ``host_axis`` and ``axis_shapes`` (default
+    ``{"data": -1}``) laid over each host's devices.
+
+    This is the dist_ps worker fleet become a mesh dimension: collectives
+    over ``host_axis`` ride DCN between processes, the inner axes ride
+    ICI within each host.  ``devices``/``n_hosts`` are injectable so a
+    faked multi-host topology (one process, N virtual hosts) is testable
+    on CPU — production callers pass neither and get the live
+    ``jax.devices()`` / ``jax.process_count()`` fleet.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_hosts is None:
+        n_hosts = jax.process_count()
+    n_hosts = max(1, int(n_hosts))
+    if len(devices) % n_hosts:
+        raise ValueError(
+            "multihost mesh: %d devices not divisible by %d hosts"
+            % (len(devices), n_hosts))
+    shapes = {host_axis: n_hosts}
+    for name, size in (axis_shapes or {"data": -1}).items():
+        if name == host_axis:
+            raise ValueError("axis %r collides with host axis" % name)
+        shapes[name] = size
+    return make_mesh(shapes, devices)
+
+
+def topology():
+    """One JSON-shaped dict describing the device fleet this process can
+    build meshes over (the MULTICHIP dryrun and docs/SPMD.md contract)."""
+    devices = jax.devices()
+    return {
+        "n_devices": len(devices),
+        "n_local_devices": len(jax.local_devices()),
+        "n_hosts": jax.process_count(),
+        "process_index": jax.process_index(),
+        "platform": devices[0].platform if devices else None,
+    }
+
+
+# --------------------------------------------------------------------------
+# Env-selected default mesh (MXNET_MESH_* knobs)
+# --------------------------------------------------------------------------
+
+def _parse_mesh_shape(text):
+    """``"data=-1,model=2"`` → {"data": -1, "model": 2} (ordered)."""
+    shapes = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                "MXNET_MESH_SHAPE entry %r is not name=size" % part)
+        name, _, size = part.partition("=")
+        shapes[name.strip()] = int(size)
+    if not shapes:
+        raise ValueError("MXNET_MESH_SHAPE set but empty")
+    return shapes
+
+
+def _env_mesh_config():
+    shape = os.environ.get("MXNET_MESH_SHAPE", "").strip()
+    span = os.environ.get("MXNET_MESH_SPAN_HOSTS", "0").strip()
+    return (_parse_mesh_shape(shape) if shape else None,
+            span not in ("", "0", "false", "False"))
+
+
+# cached at import (the JG006 pattern); refresh_from_env re-reads
+_ENV_SHAPE, _ENV_SPAN_HOSTS = _env_mesh_config()
+
+
+def refresh_from_env():
+    """Re-read MXNET_MESH_SHAPE / MXNET_MESH_SPAN_HOSTS (tests / late
+    configuration)."""
+    global _ENV_SHAPE, _ENV_SPAN_HOSTS
+    _ENV_SHAPE, _ENV_SPAN_HOSTS = _env_mesh_config()
+
+
+def mesh_from_env(devices=None):
+    """The fleet-selected mesh, or None when ``MXNET_MESH_SHAPE`` is
+    unset.  ``MXNET_MESH_SHAPE="data=-1,model=2"`` names the axes and
+    sizes (one ``-1`` = all remaining devices);
+    ``MXNET_MESH_SPAN_HOSTS=1`` prepends the jax.distributed process
+    fleet as a leading ``host`` axis (:func:`multihost_mesh`)."""
+    if _ENV_SHAPE is None:
+        return None
+    if _ENV_SPAN_HOSTS:
+        return multihost_mesh(_ENV_SHAPE, devices=devices)
+    return make_mesh(_ENV_SHAPE, devices=devices)
+
+
+def default_mesh(axis_names=("data",)):
+    """The mesh an SPMD consumer should use when none was passed: the
+    innermost ``using_mesh``, else the ``MXNET_MESH_*`` env selection,
+    else all devices balanced over ``axis_names``."""
+    mesh = current_mesh()
+    if mesh is not None:
+        return mesh
+    mesh = mesh_from_env()
+    if mesh is not None:
+        return mesh
+    return auto_mesh(axis_names)
+
+
+# --------------------------------------------------------------------------
+# Sharding helpers
+# --------------------------------------------------------------------------
+
+def filter_spec(spec, mesh):
+    """Drop axis names the mesh doesn't have (lets one model definition
+    run on dp-only, dp+tp, or dp+tp+sp meshes)."""
+    if mesh is None:
+        return spec
+    names = mesh.axis_names
+    return P(*[a if a in names else None for a in spec])
+
+
+def named_sharding(mesh, spec):
+    """``NamedSharding(mesh, filter_spec(spec, mesh))`` — the one spelling
+    of "this spec, on this mesh, minus axes the mesh lacks"."""
+    return NamedSharding(mesh, filter_spec(spec, mesh))
+
+
+def replicated(mesh):
+    """Fully replicated NamedSharding on ``mesh``."""
+    return NamedSharding(mesh, P())
+
+
+def shard_put(value, sharding, spec=None):
+    """Place a host value under *sharding*, working in multi-process SPMD
+    too: each process materializes only its addressable shards
+    (jax.make_array_from_callback), so the same call serves one host or a
+    jax.distributed fleet.  ``sharding`` may be a Mesh when ``spec`` is
+    given."""
+    if isinstance(sharding, Mesh):
+        sharding = named_sharding(sharding, P() if spec is None else spec)
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    host = np.asarray(value)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
+# --------------------------------------------------------------------------
+# Program entry points: shard_map (version-adaptive) and watched jit
+# --------------------------------------------------------------------------
+
+def _resolve_shard_map():
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, "check_vma"          # current jax: top-level API
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm, "check_rep"             # older jax: experimental API
+
+
+_SHARD_MAP, _CHECK_KW = _resolve_shard_map()
+
+
+def shard_map(fn, mesh=None, in_specs=None, out_specs=None, check=None):
+    """Map ``fn`` over mesh shards with explicit collectives — the ONE
+    shard_map entry point in the tree.
+
+    jax renamed both the callable (``jax.experimental.shard_map`` →
+    ``jax.shard_map``) and the replication-check kwarg (``check_rep`` →
+    ``check_vma``) across releases; this wrapper presents one stable
+    surface (``check=False`` disables the replication/varying-manual-axes
+    checker on either API).  ``mesh`` defaults to the innermost
+    :func:`using_mesh` scope.
+    """
+    if mesh is None:
+        mesh = current_mesh()
+        if mesh is None:
+            raise ValueError(
+                "shard_map: no mesh passed and no using_mesh() scope "
+                "active")
+    kwargs = {}
+    if check is not None:
+        kwargs[_CHECK_KW] = check
+    return _SHARD_MAP(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def vma_axes(*arrays, extra=()):
+    """The union of mesh axes ``arrays`` are device-varying over, plus
+    ``extra`` — the axes a shard_map scan carry must be cast to.  On jax
+    without the varying-manual-axes type system (no ``jax.typeof``) the
+    answer is just ``extra``: the old ``check_rep`` tracker needs no
+    explicit casts."""
+    axes = set(extra)
+    typeof = getattr(jax, "typeof", None)
+    if typeof is not None:
+        for a in arrays:
+            axes |= set(getattr(typeof(a), "vma", ()) or ())
+    return tuple(sorted(axes))
+
+
+def pvary(x, axes):
+    """Cast ``x`` to be device-varying over ``axes`` inside shard_map.
+    Identity on jax versions whose shard_map has no varying-axis types
+    (their replication checker infers it, or ``check=False`` skips it)."""
+    if not axes:
+        return x
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axes), to="varying")
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, tuple(axes))
+    return x
+
+
+def jit_sharded(fn, name, **jit_kwargs):
+    """``watch_jit(jax.jit(fn, **jit_kwargs), name)`` — every SPMD
+    program the framework owns goes through here so it lands in the
+    retrace watchdog, XLA cost accounting, MXNET_DEVICE_TIME attribution
+    and the MXNET_TRACECHECK hook with one line."""
+    from .. import telemetry as _tel
+    return _tel.watch_jit(jax.jit(fn, **jit_kwargs), name)

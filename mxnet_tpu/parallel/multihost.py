@@ -89,14 +89,47 @@ def global_devices():
     return jax.devices()
 
 
-def barrier(name="mx_barrier"):
+def _coord_client():
+    """The jax.distributed coordination-service client, or None.  Its
+    barrier/KV ops are plain gRPC to the coordinator — no XLA program, so
+    they work on backends whose compiler can't span processes (CPU
+    before jaxlib 0.5)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+def barrier(name="mx_barrier", timeout_ms=600_000):
     """Block until every process arrives (ref kvstore.h:339 Barrier).
 
-    Implemented as a tiny all-reduce across one device per process —
-    completion of the collective is the synchronisation.
+    Prefers the coordination-service barrier (host-level, backend-
+    independent); falls back to a tiny all-reduce whose completion is
+    the synchronisation.
     """
     if jax.process_count() == 1:
         return
-    import numpy as np
+    client = _coord_client()
+    if client is not None:
+        client.wait_at_barrier(name, timeout_in_ms=timeout_ms)
+        return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(name)
+
+
+def host_gather_floats(name, value, timeout_ms=600_000):
+    """Every process contributes one float; returns the rank-ordered
+    list on all of them.  Rides the coordination-service KV store
+    (host-level), so it agrees values across processes even when the
+    backend can't compile a cross-process program."""
+    world = jax.process_count()
+    if world == 1:
+        return [float(value)]
+    client = _coord_client()
+    if client is None:
+        raise RuntimeError("host_gather_floats needs jax.distributed")
+    client.key_value_set("%s/%d" % (name, jax.process_index()),
+                         repr(float(value)))
+    return [float(client.blocking_key_value_get(
+        "%s/%d" % (name, r), timeout_ms)) for r in range(world)]

@@ -8,10 +8,15 @@ mesh axis (one stage's parameters per device), and microbatches stream
 through the stages with ``lax.ppermute`` hops. The schedule is the
 classic GPipe fill-drain loop: ``M + S - 1`` ticks for M microbatches,
 each device computing its stage on whatever activation sits in its slot.
-Implemented with ``shard_map`` so the collective is explicit and the
-whole schedule stays inside one jitted program; differentiable end to
-end (``ppermute`` has a transpose rule), so ``jax.grad`` of a pipelined
-loss trains all stages.
+Implemented with the substrate's ``shard_map`` so the collective is
+explicit and the whole schedule stays inside one jitted program;
+differentiable end to end (``ppermute`` has a transpose rule), so
+``jax.grad`` of a pipelined loss trains all stages.
+
+Each (stage_fn, mesh, schedule) pair compiles to ONE watched jitted
+program (``pipeline_apply``) — stable identity for the retrace watchdog,
+cost accounting, and graftcheck's ledger; calling it under an outer
+``jax.jit`` trace simply inlines it.
 """
 from __future__ import annotations
 
@@ -20,38 +25,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .collectives import ppermute_shift
+from . import mesh as mesh_mod
+from .collective import ppermute_shift
 
 __all__ = ["pipeline_apply"]
 
 
-def pipeline_apply(stage_fn, stage_params, x, mesh, n_microbatches,
-                   axis="pipe"):
-    """Apply S pipeline stages to ``x`` with microbatch streaming.
-
-    Parameters
-    ----------
-    stage_fn : callable(params_slice, activation) -> activation; the
-        per-stage computation. ``params_slice`` is one stage's leaves
-        (leading stage dim removed); activations keep one shape across
-        stages.
-    stage_params : pytree whose leaves have a leading stage dim of size
-        S == mesh.shape[axis] (stack per-stage params with
-        ``jnp.stack``).
-    x : [B, ...] batch; B must divide by ``n_microbatches``.
-    mesh : jax.sharding.Mesh containing ``axis``.
-    n_microbatches : GPipe M; ≥ S keeps the bubble fraction at
-        (S-1)/(M+S-1).
-
-    Returns the full-batch output, numerically identical to applying
-    the stages sequentially.
-    """
-    n_stages = mesh.shape[axis]
-    b = x.shape[0]
-    assert b % n_microbatches == 0, "batch must divide into microbatches"
-    mb = b // n_microbatches
-    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
-
+def _build_spmd(stage_fn, n_stages, n_microbatches, axis):
     def spmd(params_local, micro_all):
         # params_local: this stage's leaves with leading dim 1
         params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
@@ -82,8 +62,10 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, n_microbatches,
             y = ppermute_shift(y, axis)
             return (y, outs), None
 
+        # int32 tick counter: under jax_enable_x64 a bare arange is int64
+        # and would widen the whole program (JX102)
         (state, outs), _ = lax.scan(tick, (state, outs),
-                                    jnp.arange(n_ticks))
+                                    jnp.arange(n_ticks, dtype=jnp.int32))
         # only the last stage's `outs` is real; broadcast it to every
         # shard so the out_spec can be replicated
         outs = lax.psum(
@@ -91,11 +73,77 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, n_microbatches,
                       jnp.zeros_like(outs)), axis)
         return outs
 
-    spec_params = jax.tree_util.tree_map(
-        lambda _: P(axis), stage_params)
-    fn = jax.shard_map(
-        spmd, mesh=mesh,
-        in_specs=(spec_params, P()), out_specs=P(),
-        check_vma=False)
-    outs = fn(stage_params, micro)
+    return spmd
+
+
+# (stage_fn, mesh, params treedef, M, axis) -> watched jitted program
+_PROGRAMS = {}
+
+
+def _pipeline_program(stage_fn, stage_params, mesh, n_microbatches, axis):
+    treedef = jax.tree_util.tree_structure(stage_params)
+    key = (stage_fn, mesh, treedef, n_microbatches, axis)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        spec_params = jax.tree_util.tree_map(lambda _: P(axis),
+                                             stage_params)
+        spmd = _build_spmd(stage_fn, mesh.shape[axis], n_microbatches,
+                           axis)
+        fn = mesh_mod.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(spec_params, P()), out_specs=P(),
+            check=False)
+        prog = mesh_mod.jit_sharded(fn, "pipeline_apply")
+        _PROGRAMS[key] = prog
+    return prog
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, n_microbatches,
+                   axis="pipe"):
+    """Apply S pipeline stages to ``x`` with microbatch streaming.
+
+    Parameters
+    ----------
+    stage_fn : callable(params_slice, activation) -> activation; the
+        per-stage computation. ``params_slice`` is one stage's leaves
+        (leading stage dim removed); activations keep one shape across
+        stages.
+    stage_params : pytree whose leaves have a leading stage dim of size
+        S == mesh.shape[axis] (stack per-stage params with
+        ``jnp.stack``).
+    x : [B, ...] batch; B must divide by ``n_microbatches``.
+    mesh : jax.sharding.Mesh containing ``axis``.
+    n_microbatches : GPipe M; ≥ S keeps the bubble fraction at
+        (S-1)/(M+S-1).
+
+    Returns the full-batch output, numerically identical to applying
+    the stages sequentially.
+    """
+    b = x.shape[0]
+    assert b % n_microbatches == 0, "batch must divide into microbatches"
+    mb = b // n_microbatches
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+    prog = _pipeline_program(stage_fn, stage_params, mesh, n_microbatches,
+                             axis)
+    outs = prog(stage_params, micro)
     return outs.reshape((b,) + outs.shape[2:])
+
+
+def _tracecheck_stage(params, h):
+    return jnp.tanh(h @ params["w"] + params["b"])
+
+
+def tracecheck_programs():
+    """graftcheck provider: one representative GPipe program (S = pipe
+    axis size of the live mesh, M = 2·S microbatches)."""
+    mesh = mesh_mod.auto_mesh(("pipe",))
+    s = mesh.shape["pipe"]
+    m = 2 * s
+    stage_params = {
+        "w": jnp.zeros((s, 8, 8), jnp.float32),
+        "b": jnp.zeros((s, 8), jnp.float32),
+    }
+    prog = _pipeline_program(_tracecheck_stage, stage_params, mesh, m,
+                             "pipe")
+    micro = jax.ShapeDtypeStruct((m, 4, 8), jnp.float32)
+    return [("pipeline_apply", prog, (stage_params, micro), {})]

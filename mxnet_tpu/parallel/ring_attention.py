@@ -8,9 +8,11 @@ while an online-softmax accumulator (flash-attention numerics) combines
 partial results.  Communication overlaps compute and rides ICI; memory per
 device is O(S/n · S/n) per block instead of O(S²).
 
-Use inside ``jax.shard_map`` over a mesh with the sequence axis bound to
-``axis_name``.  ``local_attention`` is the single-device exact reference
-(also the per-block kernel).
+Use inside :func:`mesh.shard_map` over a mesh with the sequence axis bound
+to ``axis_name``.  ``local_attention`` is the single-device exact reference
+(also the per-block kernel).  ``ring_attention_sharded`` is the standalone
+entry point: a watched jitted program per (mesh, axis, flags) — graftcheck
+proves it via this module's ``tracecheck_programs`` provider.
 """
 from __future__ import annotations
 
@@ -21,7 +23,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "local_attention"]
+from . import mesh as mesh_mod
+
+__all__ = ["ring_attention", "local_attention", "ring_attention_sharded"]
 
 _NEG = -1e30  # large-negative mask; avoids -inf NaN edge cases in exp
 
@@ -63,15 +67,17 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
     idx = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    q_pos = idx * s_loc + jnp.arange(s_loc)
+    # int32 indices throughout: under jax_enable_x64 a bare arange is
+    # int64 and would widen the whole program (JX102)
+    q_pos = idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
 
     # scan carries must be device-varying over every mesh axis the inputs
-    # vary on (not just the ring axis), or the carry types won't match
-    vary_axes = tuple(jax.typeof(q).vma | jax.typeof(k).vma |
-                      jax.typeof(v).vma | {axis_name})
+    # vary on (not just the ring axis), or the carry types won't match;
+    # on jax without varying-axis types these are identity shims
+    vary_axes = mesh_mod.vma_axes(q, k, v, extra=(axis_name,))
 
     def _vary(x):
-        return lax.pcast(x, vary_axes, to="varying")
+        return mesh_mod.pvary(x, vary_axes)
     acc = _vary(jnp.zeros((b, h, s_loc, d), dtype=jnp.float32))
     m = _vary(jnp.full((b, h, s_loc), _NEG, dtype=jnp.float32))
     l = _vary(jnp.zeros((b, h, s_loc), dtype=jnp.float32))
@@ -84,7 +90,7 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
         s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                        kb.astype(jnp.float32)) * scale
         if causal:
-            k_pos = src * s_loc + jnp.arange(s_loc)
+            k_pos = src * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask, s, _NEG)
         blk_max = jnp.max(s, axis=-1)
@@ -101,21 +107,49 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
         return (new_acc, new_m, new_l, kb, vb), None
 
     (acc, m, l, _, _), _ = lax.scan(step, (acc, m, l, k, v),
-                                    jnp.arange(n))
+                                    jnp.arange(n, dtype=jnp.int32))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
 
+# one watched program per (mesh, axis, causal, sm_scale): a stable
+# program identity is what makes the retrace watchdog and cost
+# accounting meaningful (a fresh shard_map per call would recompile —
+# and re-register — every step)
+_SHARDED_PROGRAMS = {}
+
+
+def _ring_program(mesh, axis_name, causal, sm_scale):
+    key = (mesh, axis_name, causal, sm_scale)
+    prog = _SHARDED_PROGRAMS.get(key)
+    if prog is None:
+        spec = mesh_mod.filter_spec(
+            jax.sharding.PartitionSpec(None, None, axis_name, None), mesh)
+        fn = mesh_mod.shard_map(
+            functools.partial(ring_attention, axis_name=axis_name,
+                              causal=causal, sm_scale=sm_scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check=False)
+        prog = mesh_mod.jit_sharded(fn, "ring_attention")
+        _SHARDED_PROGRAMS[key] = prog
+    return prog
+
+
 def ring_attention_sharded(q, k, v, mesh, axis_name="seq", causal=False,
                            sm_scale=None):
-    """Convenience wrapper: shard_map ring_attention over ``mesh``.
+    """Standalone entry point: the watched jitted shard_map ring over
+    ``mesh``.
 
     q, k, v: global arrays [B, H, S, D]; the sequence dim is sharded over
     ``axis_name``, everything else replicated.
     """
-    from jax.sharding import PartitionSpec as P
-    spec = P(None, None, axis_name, None)
-    fn = functools.partial(ring_attention, axis_name=axis_name,
-                           causal=causal, sm_scale=sm_scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return _ring_program(mesh, axis_name, causal, sm_scale)(q, k, v)
+
+
+def tracecheck_programs():
+    """graftcheck provider: the sharded ring program over the live mesh."""
+    mesh = mesh_mod.auto_mesh(("seq",))
+    prog = _ring_program(mesh, "seq", True, None)
+    s = 4 * mesh.shape["seq"]
+    q = jax.ShapeDtypeStruct((2, 2, s, 8), jnp.float32)
+    return [("ring_attention", prog, (q, q, q), {})]

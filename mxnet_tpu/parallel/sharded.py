@@ -26,8 +26,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import autograd
 from .. import random as _random
-from .. import telemetry as _tel
 from ..ndarray.ndarray import NDArray, _wrap
+from . import mesh as mesh_mod
 from .mesh import auto_mesh
 from .zero import sharded_update, zero1_update_spec
 
@@ -100,7 +100,7 @@ def sharded_data(x, mesh, spec=None, axis="data"):
         spec = P(axis)
     arr = x._data if isinstance(x, NDArray) else jnp.asarray(
         np.asarray(x, dtype=getattr(x, "dtype", np.float32)))
-    return jax.device_put(arr, NamedSharding(mesh, spec))
+    return mesh_mod.shard_put(arr, mesh_mod.named_sharding(mesh, spec))
 
 
 class ShardedTrainer:
@@ -158,8 +158,8 @@ class ShardedTrainer:
         def shard_for(name, val):
             spec = self._tp_spec(name)
             if spec is not None:
-                return NamedSharding(self._mesh, spec)
-            return NamedSharding(self._mesh, P())  # replicated
+                return mesh_mod.named_sharding(self._mesh, spec)
+            return mesh_mod.replicated(self._mesh)
         # jnp.copy first: device_put may alias the source buffer as one
         # shard, and the jitted step donates these — donating an aliased
         # buffer would invalidate the block's own parameters.
@@ -169,7 +169,7 @@ class ShardedTrainer:
             for n in self._grad_names}
         self.aux = {
             n: jax.device_put(jnp.copy(pd[n]._data._data),
-                              NamedSharding(self._mesh, P()))
+                              mesh_mod.replicated(self._mesh))
             for n in self._aux_names}
 
         # --- optimizer state: sharded like its weight, or (ZeRO-1)
@@ -184,7 +184,7 @@ class ShardedTrainer:
                 if spec is not None:
                     self._update_shardings[n] = NamedSharding(self._mesh,
                                                               spec)
-        replicated = NamedSharding(self._mesh, P())
+        replicated = mesh_mod.replicated(self._mesh)
         self.states = {}
         for n in self._grad_names:
             st = optimizer.create_state(self._index[n], pd[n]._data)
@@ -254,8 +254,8 @@ class ShardedTrainer:
                 params, grads, states, lrs, wds, ts)
             return new_params, new_states, new_aux, loss
 
-        return _tel.watch_jit(jax.jit(step, donate_argnums=(0, 1, 2)),
-                              "sharded_train_step")
+        return mesh_mod.jit_sharded(step, "sharded_train_step",
+                                    donate_argnums=(0, 1, 2))
 
     def step(self, data, label):
         """Run one sharded train step; returns the scalar loss (host float).
@@ -291,7 +291,7 @@ class ShardedTrainer:
             def fwd(params, aux, data, key):
                 outs, _ = fn(params, aux, (data,), key, False)
                 return outs[0] if len(outs) == 1 else outs
-            self._fwd_fn = _tel.watch_jit(jax.jit(fwd), "sharded_forward")
+            self._fwd_fn = mesh_mod.jit_sharded(fwd, "sharded_forward")
         data = sharded_data(data, self._mesh, axis=self._batch_axis)
         out = self._fwd_fn(self.params, self.aux, data, _random.next_key())
         return _wrap(out)
@@ -309,3 +309,44 @@ class ShardedTrainer:
                 else jax.devices()[0]
             self._pd[n]._data._set_data(
                 jax.device_put(np.asarray(src), dev))
+
+
+# the provider's programs close over a live trainer; keep it alive until
+# the driver traces (same idiom as gluon/fused_trainer)
+_TRACECHECK_KEEPALIVE = []
+
+
+def tracecheck_programs():
+    """graftcheck provider: the SPMD train step and inference forward of
+    a tiny Dense regression over the live mesh."""
+    from .. import init as mx_init
+    from .. import gluon
+    net = gluon.nn.Dense(4)
+    net.initialize(mx_init.Xavier())
+    x_host = np.zeros((8, 4), np.float32)
+    y_host = np.zeros((8, 4), np.float32)
+    net(_wrap(jnp.asarray(x_host)))          # shape-infer the params
+    st = ShardedTrainer(net, gluon.loss.L2Loss(), "sgd",
+                        optimizer_params={"learning_rate": 0.1})
+    step = st._build_step()
+    data = sharded_data(x_host, st._mesh, axis=st._batch_axis)
+    label = sharded_data(y_host, st._mesh, spec=P(st._batch_axis))
+    key = jax.random.PRNGKey(0)
+    one = jnp.float32(0.1)
+    lrs = {n: one for n in st._grad_names}
+    wds = {n: jnp.float32(0.0) for n in st._grad_names}
+    ts = {n: jnp.int32(1) for n in st._grad_names}
+
+    def fwd(params, aux, data, key):
+        outs, _ = st._fn(params, aux, (data,), key, False)
+        return outs[0]
+
+    fwd_prog = mesh_mod.jit_sharded(fwd, "sharded_forward")
+    _TRACECHECK_KEEPALIVE.append(st)
+    return [
+        ("sharded_train_step", step,
+         (st.params, st.states, st.aux, data, label, key, lrs, wds, ts),
+         {}),
+        ("sharded_forward", fwd_prog,
+         (st.params, st.aux, data, key), {}),
+    ]

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import numpy as np
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["zero1_update_spec", "zero1_axis_mesh", "update_sharding",
            "sharded_update", "shard_state_tree_spec", "state_bytes"]
@@ -50,10 +50,11 @@ def zero1_update_spec(shape, current_spec, ndata, batch_axis="data"):
 def zero1_axis_mesh(n_shards, axis="zero", devices=None):
     """A 1-D mesh of the first *n_shards* local devices — the replica
     axis the fused Trainer's sharded update lives on."""
+    from . import mesh as mesh_mod
     if devices is None:
         devices = jax.local_devices()
     n = max(1, min(int(n_shards), len(devices)))
-    return Mesh(np.asarray(devices[:n]), (axis,))
+    return mesh_mod.make_mesh({axis: n}, devices[:n])
 
 
 def update_sharding(mesh, shape, axis, current_spec=None):

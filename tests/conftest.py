@@ -59,3 +59,14 @@ def pytest_configure(config):
                            check=True, capture_output=True, timeout=300)
         except Exception as exc:  # tests will skip; don't block the run
             print("native build failed: %s" % exc)
+
+    # a previous suite run killed by the CI timeout leaves its own
+    # flight_<pid>.json at the repo root; sweep those so the
+    # dump-policing test only sees leaks from THIS session
+    for name in os.listdir(repo):
+        if (name.startswith("flight_") and name.endswith(".json")
+                and name[7:-5].isdigit()):
+            try:
+                os.unlink(os.path.join(repo, name))
+            except OSError:
+                pass

@@ -39,13 +39,13 @@ def test_matrix_factorization_learns(capsys):
 
 def test_word_language_model_beats_uniform(capsys):
     out = run_example("word_language_model.py",
-                      ["--num-epochs", "1", "--max-batches", "30"], capsys)
+                      ["--num-epochs", "1", "--max-batches", "20"], capsys)
     ppl = float(out.strip().rsplit(" ", 1)[-1])
     assert ppl < 64.0          # uniform baseline on the synthetic vocab
 
 
 def test_model_parallel_lstm_group2ctx(capsys):
-    out = run_example("model_parallel_lstm.py", ["--num-steps", "60"],
+    out = run_example("model_parallel_lstm.py", ["--num-steps", "40"],
                       capsys)
     assert "final-loss" in out
 
@@ -71,7 +71,7 @@ def test_dcgan_adversarial_loop_runs(capsys):
     """GAN training is too unstable for a convergence gate at this
     scale; the gate is: the adversarial loop completes with finite
     losses and produces the metric line (ref example/gluon/dcgan.py)."""
-    out = run_example("dcgan.py", ["--num-iters", "20"], capsys)
+    out = run_example("dcgan.py", ["--num-iters", "12"], capsys)
     assert "final-mean-gap" in out
 
 
@@ -109,14 +109,14 @@ def test_rcnn_toy_detector_learns(capsys):
     """Proposal -> ROIPooling -> head end-to-end learnability
     (reference example/rcnn/train_end2end.py skeleton)."""
     out = run_example("train_rcnn_toy.py",
-                      ["--num-epochs", "6", "--lr", "4e-3"], capsys)
+                      ["--num-epochs", "4", "--lr", "4e-3"], capsys)
     miou = float(out.strip().rsplit(" ", 1)[-1])
     assert miou > 0.3, "refined-proposal IoU %.3f too low" % miou
 
 
 def test_cnn_text_classification_learns(capsys):
     out = run_example("cnn_text_classification.py",
-                      ["--num-epochs", "4"], capsys)
+                      ["--num-epochs", "3"], capsys)
     acc = float(out.strip().rsplit(" ", 1)[-1])
     assert acc > 0.8
 
@@ -141,8 +141,11 @@ def test_publish_and_serve_zoo_artifact(capsys, tmp_path, monkeypatch):
     reproduce the recorded accuracy surface (VERDICT r3 #10)."""
     import json
     import numpy as np
+    # lr tuned so 3 epochs clears the bar with margin (0.91 on the
+    # seeded corpus) — each mobilenet epoch costs ~40s on the 1-core CI
     out = run_example("train_publish_cifar.py",
-                      ["--num-epochs", "6", "--publish", str(tmp_path),
+                      ["--num-epochs", "3", "--lr", "0.01",
+                       "--publish", str(tmp_path),
                        "--min-acc", "0.5"], capsys)
     assert "published" in out
     import mxnet_tpu as mx
@@ -178,7 +181,7 @@ def test_publish_and_serve_zoo_artifact(capsys, tmp_path, monkeypatch):
 def test_ctc_ocr_learns(capsys):
     """LSTM + CTC through the symbolic Module path (reference lstm_ocr);
     greedy decode must reach near-zero label error."""
-    out = run_example("ctc_ocr_toy.py", ["--num-epochs", "60"], capsys)
+    out = run_example("ctc_ocr_toy.py", ["--num-epochs", "40"], capsys)
     rate = float(out.strip().rsplit(" ", 1)[-1])
     assert rate < 0.15, "label error rate %.3f" % rate
 
@@ -261,7 +264,7 @@ def test_tree_lstm_pearson(capsys):
 def test_dqn_windy_grid(capsys):
     """DQN with replay + target net reaches the goal reliably
     (ref example/reinforcement-learning/dqn/)."""
-    out = run_example("dqn.py", ["--num-episodes", "250"], capsys)
+    out = run_example("dqn.py", ["--num-episodes", "200"], capsys)
     ret = float(out.strip().rsplit(" ", 1)[-1])
     assert ret > 0.5, "greedy return %.3f" % ret
 
@@ -280,7 +283,7 @@ def test_autoencoder_dec_clusters(capsys):
     DEC refinement does not regress k-means accuracy
     (ref example/autoencoder + example/dec)."""
     out = run_example("autoencoder_dec.py",
-                      ["--num-points", "500", "--dec-epochs", "80"], capsys)
+                      ["--num-points", "500", "--dec-epochs", "40"], capsys)
     lines = dict(l.rsplit(" ", 1) for l in out.strip().splitlines()
                  if " " in l)
     e0, e1 = (float(v) for v in
@@ -296,7 +299,7 @@ def test_stochastic_depth_trains(capsys):
     """Randomly-dropped residual blocks still train to well above chance
     on the 4-class texture task (ref example/stochastic-depth/)."""
     out = run_example("stochastic_depth.py",
-                      ["--num-epochs", "3", "--num-images", "512"], capsys)
+                      ["--num-epochs", "2", "--num-images", "512"], capsys)
     acc = float(out.strip().rsplit(" ", 1)[-1])
     assert acc > 0.6, "accuracy %.3f vs 0.25 chance" % acc
 
@@ -326,7 +329,7 @@ def test_captcha_multi_head(capsys):
     """Grouped 4-head captcha CNN: per-char accuracy well above the 0.1
     chance level (ref example/captcha/)."""
     out = run_example("captcha.py",
-                      ["--num-epochs", "10", "--num-images", "1024"],
+                      ["--num-epochs", "6", "--num-images", "1024"],
                       capsys)
     acc = float(out.strip().rsplit(" ", 1)[-1])
     assert acc > 0.6, "char acc %.3f" % acc

@@ -1,0 +1,30 @@
+"""Tier-1 fast variant of the MULTICHIP dryrun (ISSUE 16 satellite).
+
+Runs the in-process legs of ``__graft_entry__.dryrun_multichip`` — train,
+zero1, ep, pp — on the 8-virtual-CPU-device tier-1 mesh, exactly the code
+path the driver exercises, minus the subprocess-heavy overlap/multihost
+legs (those stay in the full dryrun, where ``--gate-overlap`` is
+enforced).  Keeps the SPMD substrate's end-to-end story inside the test
+suite instead of only in the driver.
+"""
+import os
+import sys
+
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+import __graft_entry__ as graft_entry  # noqa: E402
+
+
+def test_dryrun_fast_legs(capsys):
+    n = len(jax.devices())
+    assert n >= 2, "tier-1 harness pins 8 virtual CPU devices"
+    graft_entry.dryrun_multichip(n, legs=("train", "zero1", "ep", "pp"))
+    out = capsys.readouterr().out
+    assert "dryrun_multichip(%d)" % n in out
+    assert "zero1" in out
+    assert "ep: moe loss" in out
+    assert "GPipe pipeline matches sequential" in out
+    # the subprocess legs must NOT have run in the fast variant
+    assert "overlap" not in out
+    assert "multihost" not in out

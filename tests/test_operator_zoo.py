@@ -143,9 +143,11 @@ def test_numeric_gradient_zoo(sym_fn):
     data = mx.sym.Variable("data")
     sym = sym_fn(data)
     loc = {"data": np.random.randn(3, 7).astype(np.float64) * 0.5}
-    # forward evaluates in float32: eps 1e-3 keeps finite-difference noise
-    # (~machine_eps/eps) an order below the tolerance
-    check_numeric_gradient(sym, loc, numeric_eps=1e-3, rtol=2e-2, atol=2e-3)
+    # forward evaluates in float32, so FD round-off noise is
+    # ~machine_eps*|loss|/eps ≈ 5e-4 at eps=1e-2 (|loss| up to ~50 for
+    # log_softmax) while central-difference truncation stays O(eps^2);
+    # eps=1e-3 left the noise above atol and flaked on log_softmax
+    check_numeric_gradient(sym, loc, numeric_eps=1e-2, rtol=2e-2, atol=2e-3)
 
 
 def test_numeric_gradient_conv():
@@ -153,7 +155,7 @@ def test_numeric_gradient_conv():
     weight = mx.sym.Variable("weight")
     sym = mx.sym.Convolution(data, weight, kernel=(3, 3), num_filter=2,
                              no_bias=True)
-    loc = {"data": np.random.randn(1, 2, 6, 6) * 0.5,
+    loc = {"data": np.random.randn(1, 2, 5, 5) * 0.5,
            "weight": np.random.randn(2, 2, 3, 3) * 0.5}
     check_numeric_gradient(sym, loc, numeric_eps=1e-3, rtol=2e-2, atol=2e-2)
 
@@ -163,7 +165,7 @@ def test_numeric_gradient_batchnorm_like():
     gamma = mx.sym.Variable("gamma")
     beta = mx.sym.Variable("beta")
     sym = mx.sym.InstanceNorm(data, gamma, beta)
-    loc = {"data": np.random.randn(2, 3, 4, 4) * 0.5 + 1.0,
+    loc = {"data": np.random.randn(2, 3, 3, 3) * 0.5 + 1.0,
            "gamma": np.random.rand(3) + 0.5,
            "beta": np.random.randn(3) * 0.1}
     check_numeric_gradient(sym, loc, numeric_eps=1e-3, rtol=2e-2, atol=2e-2)

@@ -35,18 +35,18 @@ def test_collectives_shard_map():
 
     def f(s):
         return par.psum(s, "x")
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
+    out = par.shard_map(f, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
     assert np.allclose(np.asarray(out), np.full(8, x.sum()))
 
     def g(s):
         return par.ppermute_shift(s, "x", 1)
-    out = jax.shard_map(g, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
+    out = par.shard_map(g, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
     assert np.allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
 
     def h(s):
         return par.all_gather(s, "x", axis=0)
-    out = jax.shard_map(h, mesh=mesh, in_specs=P("x"), out_specs=P(None),
-                        check_vma=False)(x)
+    out = par.shard_map(h, mesh=mesh, in_specs=P("x"), out_specs=P(None),
+                        check=False)(x)
     assert np.allclose(np.asarray(out), np.arange(8.0))
 
 

@@ -34,6 +34,13 @@ OWNED_PROGRAMS = {
     "optimizer_update_step",
     "predictor_forward",
     "serving_predict",
+    # the SPMD tier (PR 16: one mesh substrate under models/parallel)
+    "pipeline_apply",
+    "ring_attention",
+    "sharded_train_step",
+    "sharded_forward",
+    "transformer_train_step",
+    "transformer_train_step_zero1",
 }
 
 

@@ -62,10 +62,7 @@ def bench_allreduce(mesh, sizes_mb=(1, 4, 16, 64)):
     """psum over the 'x' axis at several payload sizes; returns
     [{mb, seconds, algo_gbps}]."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    try:
-        from jax import shard_map as _shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as _shard_map
+    from mxnet_tpu.parallel import mesh as mesh_mod
 
     n = mesh.devices.size
     results = []
@@ -76,7 +73,7 @@ def bench_allreduce(mesh, sizes_mb=(1, 4, 16, 64)):
 
         @jax.jit
         def allreduce(v):
-            return _shard_map(
+            return mesh_mod.shard_map(
                 lambda s: jax.lax.psum(s, "x"),
                 mesh=mesh, in_specs=P("x", None), out_specs=P("x", None))(v)
 
