@@ -379,7 +379,17 @@ class Executor:
         return outs, new_aux
 
     def _forward_monitored(self, arg_vals, aux_vals, rng, is_train):
-        """Monitor path: eager walk tapping every intermediate."""
+        """Monitor path: eager walk tapping every intermediate.
+
+        THE SLOW PATH, by design: a compiled XLA program has no per-op
+        boundaries, so an armed monitor abandons whole-program
+        compilation for this batch and runs node by node.  Reserve it
+        for per-activation ``pattern=`` taps; for per-parameter health
+        (grad/weight norms, update ratios, loss) set
+        ``MXNET_MODEL_STATS`` instead — the Monitor's compiled mode
+        reads those out of the training program itself and
+        ``is_active()`` keeps this walk dormant (mxnet_tpu/model_stats,
+        docs/OBSERVABILITY.md §model-health)."""
         def observe(node, i, o):
             name = node.output_name(i) if i < node.num_outputs() \
                 else "%s_aux%d" % (node.name, i)

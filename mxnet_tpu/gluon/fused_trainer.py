@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import chaos as _chaos
+from .. import model_stats as _mstats
 from .. import profiler as _prof
 from .. import random as _random
 from .. import telemetry as _tel
@@ -283,7 +284,8 @@ def _zero_plan(trainer, slots):
     return plan
 
 
-def _signature(opt, params_raw, states_raw, donate, guarded, zero=None):
+def _signature(opt, params_raw, states_raw, donate, guarded, zero=None,
+               stats=False):
     leaves, treedef = jax.tree_util.tree_flatten(states_raw)
     return (type(opt), static_hypers(opt),
             tuple((tuple(w.shape), str(w.dtype)) for w in params_raw),
@@ -294,11 +296,12 @@ def _signature(opt, params_raw, states_raw, donate, guarded, zero=None):
             str(treedef),
             tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
             bool(donate), bool(guarded),
-            None if zero is None else ("zero", zero.n))
+            None if zero is None else ("zero", zero.n),
+            bool(stats))
 
 
 def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False,
-                  zero=None):
+                  zero=None, stats=False):
     """The jitted whole-model step for this (optimizer, model) signature,
     compiled once per signature process-wide.
 
@@ -326,8 +329,20 @@ def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False,
     to replicated outputs.  Guarding composes unchanged — the verdict
     reduces over the sharded gradients (same truth value) and the
     ``jnp.where`` pass-through keeps each replica's state rows.
+
+    With ``stats=True`` (``MXNET_MODEL_STATS``) the SAME program emits
+    the model-health side-output (``model_stats.stats_block``): one
+    stacked f32 block of per-slot grad-norm²/weight-norm²/update-ratio/
+    grad-absmax (+ a loss row when the loop recorded one) as a final
+    output.  Its inputs pass through an ``optimization_barrier`` so the
+    stat reductions compile as their own fusion islands — the update
+    clusters keep the exact codegen of the stats-off program, and
+    training stays bitwise-identical (the ZeRO bitwise trick, reused).
+    Still one XLA launch, still no host callback (graftcheck-proven on
+    the ``*_stats`` specimens).
     """
-    sig = _signature(opt, params_raw, states_raw, donate, guarded, zero)
+    sig = _signature(opt, params_raw, states_raw, donate, guarded, zero,
+                     stats)
     # prune entries whose owning optimizer died (their compiled programs
     # would otherwise pin memory forever)
     for dead in [k for k, (r, _) in _STEP_CACHE.items() if r() is None]:
@@ -410,13 +425,22 @@ def fused_step_fn(opt, params_raw, states_raw, donate, guarded=False,
                     lambda x, s=s, w=w: wsc(x, s)
                     if tuple(x.shape) == w else x, ns)
                 for ns, s, w in zip(new_states, zero_upd, wshapes)]
-        if not guarded:
-            return new_params, new_states
-        return new_params, new_states, finite
+        out = [new_params, new_states]
+        if guarded:
+            out.append(finite)
+        if stats:
+            # the model-health side-output, LAST: barrier'd inputs keep
+            # the stat reductions out of the update clusters, so the
+            # update math compiles (and rounds) exactly as without stats
+            s_old, s_g, s_new = jax.lax.optimization_barrier(
+                (tuple(params), tuple(grads), tuple(new_params)))
+            out.append(_mstats.stats_block(s_old, s_g, s_new,
+                                           hyper.get("loss")))
+        return tuple(out)
 
     # params + states donated: the update happens in place in HBM
     name = "fused_trainer_step" + ("_zero1" if zero is not None else "") \
-        + ("_guarded" if guarded else "")
+        + ("_guarded" if guarded else "") + ("_stats" if stats else "")
     fn = _tel.watch_jit(jax.jit(step, donate_argnums=(0, 2) if donate else ()),
                         name)
     _STEP_CACHE[sig] = (opt_ref, fn)
@@ -463,6 +487,17 @@ def tracecheck_programs():
     zfn = fused_step_fn(opt, zparams, zstates, donate=True, zero=zero)
     zguarded = fused_step_fn(opt, zparams, zstates, donate=True,
                              guarded=True, zero=zero)
+    # the MXNET_MODEL_STATS variants: same donated layouts with the
+    # stacked health side-output — graftcheck proves the stats math adds
+    # no host callback (JX103) and no f64 widening (JX102) to any path
+    sfn = fused_step_fn(opt, params_raw, states_raw, donate=True,
+                        stats=True)
+    sguarded = fused_step_fn(opt, params_raw, states_raw, donate=True,
+                             guarded=True, stats=True)
+    zsfn = fused_step_fn(opt, zparams, zstates, donate=True, zero=zero,
+                         stats=True)
+    zsguarded = fused_step_fn(opt, zparams, zstates, donate=True,
+                              guarded=True, zero=zero, stats=True)
     return [("fused_trainer_step", fn,
              (params_raw, params_raw, states_raw, hyper), {}),
             ("fused_trainer_step_guarded", guarded,
@@ -470,6 +505,14 @@ def tracecheck_programs():
             ("fused_trainer_step_zero1", zfn,
              (zparams, zgrads, zstates, hyper), {}),
             ("fused_trainer_step_zero1_guarded", zguarded,
+             (zparams, zgrads, zstates, guarded_hyper), {}),
+            ("fused_trainer_step_stats", sfn,
+             (params_raw, params_raw, states_raw, hyper), {}),
+            ("fused_trainer_step_guarded_stats", sguarded,
+             (params_raw, params_raw, states_raw, guarded_hyper), {}),
+            ("fused_trainer_step_zero1_stats", zsfn,
+             (zparams, zgrads, zstates, hyper), {}),
+            ("fused_trainer_step_zero1_guarded_stats", zsguarded,
              (zparams, zgrads, zstates, guarded_hyper), {})]
 
 
@@ -586,8 +629,14 @@ def run_fused_step(trainer, slots):
         _tel.set_gauge("zero_optimizer_bytes_replicated", rep_bytes)
     states_raw = [_state_raw(updater.states[s]) for s, _ in slots]
     donate = slots and slots[0][1].data().context.device_type != "cpu"
+    # model stats ride as a side-output of the SAME program: the flag is
+    # part of the signature (one retrace when first enabled), the
+    # interval is not — only the host fetch below is rationed by it
+    stats_on = _mstats.enabled()
+    stats_due = _mstats.recorder().note_step() if stats_on else False
     fn = fused_step_fn(opt, params_raw, states_raw, donate,
-                       guarded=guard is not None, zero=plan)
+                       guarded=guard is not None, zero=plan,
+                       stats=stats_on)
     trainer._fused_step_jit = fn                   # introspection / tests
 
     _prof.bump("xla_program_calls")
@@ -595,12 +644,15 @@ def run_fused_step(trainer, slots):
     if plan is not None:
         _prof.bump("trainer_zero_step")
     with _tel.span("fused_optimizer_step", cat="program"):
-        if guard is not None:
-            new_params, new_states, verdict = fn(params_raw, raw_grads,
-                                                 states_raw, hyper)
-        else:
-            new_params, new_states = fn(params_raw, raw_grads,
-                                        states_raw, hyper)
+        outs = fn(params_raw, raw_grads, states_raw, hyper)
+    new_params, new_states = outs[0], outs[1]
+    verdict = outs[2] if guard is not None else None
+    if stats_due:
+        # the only host cost of recording: one read of an output the
+        # program produced anyway (a guarded step pays this sync for the
+        # verdict regardless)
+        _mstats.recorder().record_block([p.name for _, p in slots],
+                                        outs[-1], "loss" in hyper)
 
     # ALWAYS rebind: on a donate backend the inputs were consumed, and on
     # a skipped step the outputs carry the old values through jnp.where

@@ -15,6 +15,7 @@ import pickle
 
 from .. import chaos as _chaos
 from .. import kvstore as kvs
+from .. import model_stats as _mstats
 from .. import optimizer as opt
 from .. import telemetry as _tel
 from ..checkpoint import hooks as _ckpt_hooks
@@ -201,6 +202,14 @@ class Trainer(object):
         # state left mesh-sharded by an earlier ZeRO step must come home
         # before eager per-slot dispatch mixes devices
         ensure_unsharded(self, slots)
+        # MXNET_MODEL_STATS on the oracle path: snapshot the pre-update
+        # weights now; one extra watched `model_stats` program computes
+        # the identical stats block the fused paths emit as a side-output
+        # (due steps only — the update math is untouched either way)
+        stats_due = _mstats.recorder().note_step() \
+            if _mstats.enabled() else False
+        old_raw = [param.data()._data for _, param in slots] \
+            if stats_due else None
         if _chaos.active():          # the same grad seam, once per step
             raws = _chaos.poison_grads(
                 [param.grad()._data for _, param in slots])
@@ -218,6 +227,7 @@ class Trainer(object):
                         self._kvstore.pull(slot, out=[grad])
                 with _tel.span("optimizer_update", cat="program"):
                     self._updater(slot, grad, param.data())
+            self._record_loop_stats(slots, old_raw, None)
             return False
         if self._kvstore is not None:
             for slot, param in slots:
@@ -225,14 +235,32 @@ class Trainer(object):
                 with _tel.span("kvstore_push_pull", cat="kvstore"):
                     self._kvstore.push(slot, [grad])
                     self._kvstore.pull(slot, out=[grad])
+        loss_raw = guard.take_loss_raw()
         finite = guard.grads_finite(
-            [param.grad()._data for _, param in slots],
-            guard.take_loss_raw())
+            [param.grad()._data for _, param in slots], loss_raw)
         if finite:
             for slot, param in slots:
                 with _tel.span("optimizer_update", cat="program"):
                     self._updater(slot, param.grad(), param.data())
+        self._record_loop_stats(slots, old_raw, loss_raw)
         return guard.after_step(finite)
+
+    def _record_loop_stats(self, slots, old_raw, loss_raw):
+        """The oracle path's model-stats leg: one extra watched
+        ``model_stats`` program over (old weights, reduced grads, new
+        weights) — a skipped guardian step records update_ratio 0 over
+        its nonfinite grads, exactly what the fused side-output yields
+        through its ``jnp.where`` passthrough."""
+        if old_raw is None:
+            return
+        grads_raw = [param.grad()._data for _, param in slots]
+        new_raw = [param.data()._data for _, param in slots]
+        _tel.bump("xla_program_calls")     # the oracle's one extra program
+        block = _mstats.stats_program()(old_raw, grads_raw, new_raw,
+                                        loss_raw)
+        _mstats.recorder().record_block(
+            [param.name for _, param in slots], block,
+            loss_raw is not None)
 
     def save_states(self, fname):
         """Serialise optimizer state (moments etc.) to *fname*.

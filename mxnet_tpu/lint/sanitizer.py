@@ -34,8 +34,8 @@ import threading
 import traceback
 
 __all__ = ["SanitizerError", "enabled", "mode", "configure",
-           "refresh_from_env", "check_host_sync", "guard_task",
-           "engine_checker_enabled"]
+           "refresh_from_env", "check_host_sync", "allow_host_sync",
+           "guard_task", "engine_checker_enabled"]
 
 _LOG = logging.getLogger("mxnet_tpu.sanitizer")
 
@@ -123,6 +123,28 @@ def _user_frame():
 # tracer-leak / host-sync-under-trace
 # ---------------------------------------------------------------------------
 
+_sync_tls = threading.local()
+
+
+@contextlib.contextmanager
+def allow_host_sync():
+    """Suppress the *sync-under-trace* check on this thread.
+
+    For framework code whose host materialization is deliberate and
+    observation-only — ``monitor.Monitor._render`` formatting its stat
+    values while a user's trace happens to be open on the same thread.
+    The value is concrete and never flows back into traced math, so the
+    "baked constant" hazard the check guards against cannot occur; a
+    genuine TRACER leak still raises (a tracer escaping into a print is
+    a real bug regardless of who formats it)."""
+    depth = getattr(_sync_tls, "depth", 0)
+    _sync_tls.depth = depth + 1
+    try:
+        yield
+    finally:
+        _sync_tls.depth = depth
+
+
 def check_host_sync(data, what="asnumpy"):
     """Validate one host materialization.  Called from NDArray.asnumpy;
     off mode returns after a single module-bool check."""
@@ -134,6 +156,8 @@ def check_host_sync(data, what="asnumpy"):
         tracing = not jax.core.trace_state_clean()
     except Exception:       # pragma: no cover - jax internals moved
         return
+    if tracing and not is_tracer and getattr(_sync_tls, "depth", 0):
+        return              # an allow_host_sync() scope: deliberate read
     if is_tracer:
         site = _user_frame()
         _violation(

@@ -541,6 +541,7 @@ ENTRY_POINTS = (
     ("ring_attention", "mxnet_tpu.parallel.ring_attention"),
     ("sharded_trainer", "mxnet_tpu.parallel.sharded"),
     ("transformer", "mxnet_tpu.models.transformer"),
+    ("model_stats", "mxnet_tpu.model_stats"),
 )
 
 

@@ -1,0 +1,259 @@
+"""In-program model-health statistics: the compiled Monitor substrate.
+
+The reference framework's ``Monitor`` taps per-op outputs through an
+executor callback — on this build that means abandoning the compiled
+program for an eager node-by-node forward, the exact anti-pattern the
+whole-program doctrine forbids.  This module restores the capability the
+other way around: the statistics ride OUT of the one program that
+already runs.
+
+``MXNET_MODEL_STATS=1`` (or ``=<interval>`` to record every Nth step)
+makes the fused trainer step — plain, ZeRO-1, and guarded alike — emit
+one extra ``stack``-shaped f32 side-output computed inside the donated
+program: per-slot
+
+    grad_norm_sq     sum g², f32-accumulated (guardian/health.py rules:
+                     cast BEFORE the reduction, never f64)
+    weight_norm_sq   sum w_new² over the updated weight
+    update_ratio     ||w_new - w_old|| / (||w_old|| + 1e-12)
+    grad_absmax      max |g| (the overflow/underflow early-warning)
+
+plus, when the step carries a recorded loss, one trailing ``loss`` row.
+No host callback, no second XLA launch on the fused paths (graftcheck
+specimens prove it on the ``fused_trainer_step*_stats`` programs); the
+``MXNET_FUSED_TRAINER=0`` per-slot oracle computes the identical block
+through :func:`stats_program` — ONE small watched jit, the
+``guardian_verdict`` pattern — on due steps only.
+
+The statistics math is isolated from the update clusters by
+``jax.lax.optimization_barrier`` on its inputs, so stats-on training is
+bitwise-identical to stats-off (tests/test_model_health.py pins it
+across {fused, zero1, guardian-nan-retry}).  The host only *fetches*
+the side-output on due-interval steps; the program itself is one fixed
+signature either way, so flipping intervals never retraces.
+
+Consumers: :class:`mxnet_tpu.monitor.Monitor`'s compiled mode drains
+:func:`recorder`'s rows; ``telemetry/timeseries.py`` keys them by
+optimizer step for export, the ``/timeseries`` endpoint, and
+``tools/health_gate.py``'s drift envelopes (docs/OBSERVABILITY.md
+§model-health).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+
+from . import telemetry as _tel
+
+__all__ = ["STAT_NAMES", "enabled", "interval", "configure",
+           "refresh_from_env", "stats_block", "stats_program",
+           "recorder", "Recorder", "tracecheck_programs"]
+
+# column order of the stacked side-output (and of every Recorder row)
+STAT_NAMES = ("grad_norm_sq", "weight_norm_sq", "update_ratio",
+              "grad_absmax")
+
+
+def _parse_interval(raw):
+    """MXNET_MODEL_STATS: unset/'0' = off; '1' = record every step; an
+    integer N > 1 records every Nth step (the program computes stats on
+    EVERY step either way — only the host fetch is rationed, so the
+    interval never changes the compiled signature)."""
+    if raw is None:
+        return 0
+    raw = raw.strip().lower()
+    if raw in ("", "0", "false", "off", "no"):
+        return 0
+    if raw in ("1", "true", "on", "yes"):
+        return 1
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
+
+
+_INTERVAL = _parse_interval(os.environ.get("MXNET_MODEL_STATS"))
+
+
+def enabled():
+    return _INTERVAL > 0
+
+
+def interval():
+    """Steps between recorded fetches (0 = off, 1 = every step)."""
+    return _INTERVAL
+
+
+def configure(interval=None):
+    """Programmatic override of MXNET_MODEL_STATS (tests / notebooks)."""
+    global _INTERVAL
+    if interval is not None:
+        _INTERVAL = max(0, int(interval))
+
+
+def refresh_from_env():
+    global _INTERVAL
+    _INTERVAL = _parse_interval(os.environ.get("MXNET_MODEL_STATS"))
+
+
+# --------------------------------------------------------------------------
+# the in-program math
+# --------------------------------------------------------------------------
+
+def _slot_stats(w_old, g, w_new):
+    """One slot's 4-stat row.  All accumulation in f32 (the cast happens
+    BEFORE each reduction — guardian/health.py's rule: an f16 vdot
+    saturates at 65504 and reports inf for finite half gradients; f64
+    would trip JX102 and double HBM traffic)."""
+    go = g.ravel().astype(jnp.float32)
+    wo = w_old.ravel().astype(jnp.float32)
+    wn = w_new.ravel().astype(jnp.float32)
+    upd = wn - wo
+    gsq = jnp.vdot(go, go)
+    wsq = jnp.vdot(wn, wn)
+    ratio = jnp.sqrt(jnp.vdot(upd, upd)) \
+        / (jnp.sqrt(jnp.vdot(wo, wo)) + jnp.float32(1e-12))
+    absmax = jnp.max(jnp.abs(go))
+    return jnp.stack([gsq, wsq, ratio, absmax])
+
+
+def stats_block(params_old, grads, params_new, loss=None):
+    """The full side-output: ``(n_slots [+1], 4)`` f32.  With *loss*
+    (any float array; scalarized by mean) a trailing ``[loss, 0, 0, 0]``
+    row rides along, so loss, gradients, and update magnitudes share one
+    device fetch.  Pure math — callers on the fused paths barrier the
+    inputs first so these reductions cannot fuse into (and re-codegen)
+    the update clusters."""
+    rows = [_slot_stats(w, g, n)
+            for w, g, n in zip(params_old, grads, params_new)]
+    if loss is not None:
+        loss32 = jnp.mean(jnp.asarray(loss).astype(jnp.float32))
+        zero = jnp.float32(0.0)
+        rows.append(jnp.stack([loss32, zero, zero, zero]))
+    return jnp.stack(rows)
+
+
+def _stats(params_old, grads, params_new, loss):
+    return stats_block(params_old, grads, params_new, loss)
+
+
+# one watched jit for the whole process: jax keys its own cache on the
+# leaves' shapes/dtypes, so every model shares this single entry point
+# (the guardian_verdict pattern)
+_STATS_JIT = None
+
+
+def stats_program():
+    """The per-slot oracle's stats program (lazy, process-wide): the
+    ``MXNET_FUSED_TRAINER=0`` loop calls this ONE extra watched program
+    on due steps — the eager path's whole cost of model stats."""
+    global _STATS_JIT
+    if _STATS_JIT is None:
+        _STATS_JIT = _tel.watch_jit(jax.jit(_stats), "model_stats")
+    return _STATS_JIT
+
+
+# --------------------------------------------------------------------------
+# host-side recorder
+# --------------------------------------------------------------------------
+
+class Recorder:
+    """Bounded host-side record of fetched stats blocks, keyed by
+    optimizer step (its own monotonic count of stats-enabled trainer
+    steps — guardian-skipped steps included: a skipped step's zero
+    update_ratio and nonfinite grad stats are exactly the signal a
+    drift table wants to show).
+
+    Rows are ``(step, names, stats, loss)`` with *names* the per-slot
+    parameter names and *stats* an ``(n_slots, 4)`` float ndarray in
+    :data:`STAT_NAMES` column order.  ``drain()`` feeds the Monitor's
+    compiled mode; every ``record`` also lands in
+    ``telemetry.timeseries`` under ``model/<param>/<stat>`` keys.
+    """
+
+    def __init__(self, cap=256):
+        self._lock = threading.Lock()
+        self._rows = deque(maxlen=cap)
+        self._step = 0
+
+    def note_step(self):
+        """Advance the optimizer-step count; True when this step's stats
+        are due a host fetch under the current interval."""
+        with self._lock:
+            step = self._step
+            self._step += 1
+        return _INTERVAL > 0 and step % _INTERVAL == 0
+
+    @property
+    def step(self):
+        with self._lock:
+            return self._step
+
+    def record(self, names, stats, loss=None):
+        """Book one fetched block against the CURRENT step (the one
+        ``note_step`` just counted)."""
+        import numpy as np
+        stats = np.asarray(stats, np.float32)
+        with self._lock:
+            step = self._step - 1
+            self._rows.append((step, tuple(names), stats, loss))
+        _tel.bump("model_stats_records")
+        ts = _timeseries()
+        if ts is not None:
+            ts.record_model_stats(step, names, stats, loss)
+
+    def record_block(self, names, block, has_loss):
+        """Split one raw device side-output into (stats, loss) and book
+        it: *block* is the ``(n_slots [+1], 4)`` program output, *has_loss*
+        whether a loss row trails (static per program signature)."""
+        import numpy as np
+        arr = np.asarray(block, np.float32)
+        loss = float(arr[-1, 0]) if has_loss else None
+        self.record(names, arr[:len(names)], loss)
+
+    def drain(self, start=None):
+        """Rows with step >= *start* (None = everything buffered)."""
+        with self._lock:
+            rows = list(self._rows)
+        if start is None:
+            return rows
+        return [r for r in rows if r[0] >= start]
+
+    def latest(self):
+        with self._lock:
+            return self._rows[-1] if self._rows else None
+
+    def reset(self):
+        with self._lock:
+            self._rows.clear()
+            self._step = 0
+
+
+def _timeseries():
+    import sys
+    return sys.modules.get("mxnet_tpu.telemetry.timeseries")
+
+
+_RECORDER = Recorder()
+
+
+def recorder():
+    """The process-wide recorder (one trainer step stream per process,
+    like the update-count bookkeeping it mirrors)."""
+    return _RECORDER
+
+
+def tracecheck_programs():
+    """AOT specimens for graftcheck: the oracle-path stats program over
+    the mixed two-slot layout ``Trainer._loop_step`` feeds it, with and
+    without the trailing loss row."""
+    import numpy as np
+    params = [jnp.zeros((32, 16), jnp.float32),
+              jnp.zeros((32,), jnp.float32)]
+    loss = jnp.asarray(np.float32(0.0))
+    return [("model_stats", stats_program(),
+             (params, params, params, loss), {})]

@@ -1,17 +1,34 @@
-"""Monitor: sample intermediate op outputs during Executor forward.
+"""Monitor: sample model statistics during training/forward.
 
 API parity with the reference ``python/mxnet/monitor.py:33`` + the executor
 monitor callback (``GraphExecutor::SetMonitorCallback`` graph_executor.cc:120,
-``ExecuteMonCallback`` :1380). On the TPU build an installed, *active*
-monitor flips the Executor onto its eager node-by-node path for that batch —
-a compiled XLA program has no per-op boundaries to tap — and off-interval
-batches keep the fast compiled program.
+``ExecuteMonCallback`` :1380).  Two modes on the TPU build:
+
+* **Compiled mode** (``MXNET_MODEL_STATS`` set): the monitor reads the
+  per-parameter statistics the fused trainer step already emits as an
+  in-program side-output (``mxnet_tpu.model_stats``) — grad-norm²,
+  weight-norm², update/weight ratio, grad absmax, and the loss — so the
+  Executor/CachedOp stays on its one compiled program.  ``toc()`` rows
+  are named ``<param>:<stat>`` (plus ``loss``) and still honor
+  ``pattern=``/``sort=``.  ``stat_func`` does not apply (the statistics
+  are fixed, computed on device).
+* **Eager mode** (the default, and the only way to tap per-ACTIVATION
+  outputs with ``pattern=``): an installed, *active* monitor flips the
+  Executor onto its eager node-by-node path for that batch — THE SLOW
+  PATH: a compiled XLA program has no per-op boundaries, so every
+  monitored batch abandons whole-program compilation.  Off-interval
+  batches keep the fast compiled program.
+
+docs/OBSERVABILITY.md §model-health documents the stat definitions and
+when to reach for which mode.
 """
 from __future__ import annotations
 
 import re
 
+from . import model_stats as _mstats
 from .base import MXNetError
+from .lint import sanitizer as _sanitizer
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -23,24 +40,32 @@ def _default_stat(x):
 
 
 def _render(value):
-    """Format one stat NDArray (or list thereof) as a tab-joined string."""
+    """Format one stat NDArray (or list thereof) as a tab-joined string.
+
+    The ``asnumpy`` reads are deliberate, observation-only host syncs:
+    under MXNET_SANITIZE an active monitor formatting its own stats must
+    not read as a sync-under-trace violation (``allow_host_sync``) — a
+    genuine tracer leak still raises.
+    """
     items = value if isinstance(value, list) else [value]
     parts = []
-    for v in items:
-        if not isinstance(v, NDArray):
-            raise MXNetError("the argument must be NDArray")
-        if v.shape in ((), (1,)):
-            parts.append(str(v.asnumpy().reshape(-1)[0]))
-        else:
-            parts.append(str(v.asnumpy()))
+    with _sanitizer.allow_host_sync():
+        for v in items:
+            if not isinstance(v, NDArray):
+                raise MXNetError("the argument must be NDArray")
+            if v.shape in ((), (1,)):
+                parts.append(str(v.asnumpy().reshape(-1)[0]))
+            else:
+                parts.append(str(v.asnumpy()))
     return "\t".join(parts) + "\t"
 
 
 class Monitor(object):
-    """Collect per-op output statistics every ``interval`` batches.
+    """Collect model statistics every ``interval`` batches.
 
-    ``stat_func`` maps an output NDArray to its statistic; ``pattern``
-    filters by output name; ``sort`` orders ``toc()`` results by name.
+    ``stat_func`` maps an output NDArray to its statistic (eager mode
+    only); ``pattern`` filters by output/parameter name; ``sort`` orders
+    ``toc()`` results by name.
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
@@ -49,6 +74,7 @@ class Monitor(object):
         self.re_prog = re.compile(pattern)
         self.activated, self.queue = False, []
         self.step, self.exes = 0, []
+        self._mark = 0          # compiled mode: recorder step at tic()
 
         mon = self
 
@@ -57,11 +83,17 @@ class Monitor(object):
                 mon.queue.append((mon.step, name, mon.stat_func(arr)))
         # The Executor polls is_active to decide whether this forward must
         # run node-by-node; keeping it a callable avoids a stale snapshot.
-        stat_helper.is_active = lambda: mon.activated
+        # Compiled mode never flips the executor eager: the statistics
+        # come out of the training program itself.
+        stat_helper.is_active = \
+            lambda: mon.activated and not _mstats.enabled()
         self.stat_helper = stat_helper
 
     def install(self, exe):
-        """Attach this monitor's tap to an Executor (ref monitor.py:install)."""
+        """Attach this monitor's tap to an Executor (ref monitor.py:install).
+        A no-op source in compiled mode (is_active stays False there), but
+        installing is still valid — flipping MXNET_MODEL_STATS off mid-run
+        reactivates the eager taps on the next armed batch."""
         exe.set_monitor_callback(self.stat_helper)
         self.exes += [exe]
 
@@ -71,17 +103,40 @@ class Monitor(object):
         self.step += 1
         if due:
             self.queue, self.activated = [], True
+            if _mstats.enabled():
+                # compiled mode: remember where the stats stream is now;
+                # toc() drains whatever the trainer records past this
+                self._mark = _mstats.recorder().step
 
     def toc(self):
         """Disarm and drain: returns [(step, name, stat_string), ...]."""
         was_armed, self.activated = self.activated, False
         if not was_armed:
             return []
-        drained = [(step, name, _render(val))
-                   for step, name, val in self.queue]
+        if _mstats.enabled():
+            drained = self._drain_compiled()
+        else:
+            drained = [(step, name, _render(val))
+                       for step, name, val in self.queue]
         self.queue = []
         if self.sort:
             drained.sort(key=lambda row: row[1])
+        return drained
+
+    def _drain_compiled(self):
+        """Compiled-mode drain: the model_stats recorder rows booked
+        since tic(), flattened to ``<param>:<stat>`` (+ ``loss``) and
+        filtered by ``pattern=`` like any eager tap."""
+        drained = []
+        for _, names, stats, loss in _mstats.recorder().drain(self._mark):
+            for row, pname in enumerate(names):
+                for col, sname in enumerate(_mstats.STAT_NAMES):
+                    name = "%s:%s" % (pname, sname)
+                    if self.re_prog.match(name):
+                        drained.append((self.step, name,
+                                        "%s\t" % stats[row][col]))
+            if loss is not None and self.re_prog.match("loss"):
+                drained.append((self.step, "loss", "%s\t" % loss))
         return drained
 
     def toc_print(self):

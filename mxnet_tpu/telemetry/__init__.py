@@ -17,6 +17,11 @@ Three tiers, youngest on top:
   compiled program, folded into ``step_model_flops`` / ``step_mfu`` /
   ``step_hbm_bw_util`` at step-span exit against a per-device peak
   table (``MXNET_PEAK_FLOPS`` / ``MXNET_PEAK_HBM_BW`` override).
+* :mod:`.timeseries` — the step-indexed health record: bounded
+  per-metric rings fed at every step-span exit (and by the
+  MXNET_MODEL_STATS recorder), JSON export/merge, the ``/timeseries``
+  endpoint, and the raw material of ``tools/health_gate.py``'s drift
+  envelopes (docs/OBSERVABILITY.md §model-health).
 
 Import side effects, all cheap and all opt-out-able: crash hooks are
 chained (``MXNET_FLIGHT_EVENTS=0`` disables), the hang watchdog starts
@@ -25,7 +30,7 @@ iff ``MXNET_HANG_DUMP_SECS`` is set, and the HTTP server starts iff
 """
 from __future__ import annotations
 
-from . import core, costs, device, flight, server  # noqa: F401
+from . import core, costs, device, flight, server, timeseries  # noqa: F401
 from .core import *                                # noqa: F401,F403
 from .core import (_set_profiler_running,          # noqa: F401  (profiler)
                    current_span, refresh_from_env, retrace_limit)
@@ -37,7 +42,7 @@ from .server import (health, start_server,         # noqa: F401
 
 __all__ = list(core.__all__) + [
     "current_span", "refresh_from_env", "retrace_limit",
-    "core", "costs", "device", "flight", "server",
+    "core", "costs", "device", "flight", "server", "timeseries",
     "dump_flight", "install_crash_hooks", "start_hang_watchdog",
     "thread_stacks", "health", "start_server", "stop_server",
 ]

@@ -157,6 +157,9 @@ def refresh_from_env():
     dev = sys.modules.get("mxnet_tpu.telemetry.device")
     if dev is not None:
         dev.refresh_from_env()
+    ts = sys.modules.get("mxnet_tpu.telemetry.timeseries")
+    if ts is not None:
+        ts.refresh_from_env()
 
 
 def retrace_limit():
@@ -509,6 +512,10 @@ COUNTERS = {
     "collective_redistribute": "arrays re-placed onto a new sharding "
                                "through the chunked redistribution "
                                "schedule",
+    "model_stats_records": "model-health stats blocks fetched and "
+                           "recorded (MXNET_MODEL_STATS due steps)",
+    "timeseries_evictions": "points evicted from full time-series rings "
+                            "(ring capacity: MXNET_TIMESERIES_STEPS)",
 }
 
 GAUGES = {
@@ -983,6 +990,15 @@ def _close_step_window(dur_us):
     if _DEVICE_TIME:
         _device().close_step_window(dur_us)
     _sample_engine_pending()
+    # step time-series hook: the store keys every step-span exit's
+    # gauges by step (sys.modules, not an import — core stays the
+    # package's dependency root)
+    ts = sys.modules.get("mxnet_tpu.telemetry.timeseries")
+    if ts is not None:
+        try:
+            ts.note_step_exit(dur_us)
+        except Exception:
+            pass
 
 
 def _sample_engine_pending():
@@ -1271,4 +1287,7 @@ def reset():
     dev = sys.modules.get("mxnet_tpu.telemetry.device")
     if dev is not None:
         dev.reset()
+    ts = sys.modules.get("mxnet_tpu.telemetry.timeseries")
+    if ts is not None:
+        ts.reset()
     _flight.reset()

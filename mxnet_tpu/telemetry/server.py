@@ -148,7 +148,8 @@ def health():
 
 _INDEX = ("mxnet_tpu introspection\n"
           "endpoints: /metrics /healthz /readyz /snapshot /trace "
-          "/flight /stacks /checkpoints /peers /fleet /guardian\n"
+          "/flight /stacks /checkpoints /peers /fleet /guardian "
+          "/timeseries\n"
           "serving:   /v1/models  /v1/models/<name>[/predict|/load|"
           "/unload|/reload]\n")
 
@@ -288,6 +289,21 @@ class _Handler(BaseHTTPRequestHandler):
                                   "process)"}, 404)
                 else:
                     self._reply_json(dist.peer_view())
+            elif path == "/timeseries":
+                # observe-only sys.modules lookup, like /checkpoints:
+                # the summary reports per-ring bounds and last values,
+                # never the full rings (timeseries.export_json is the
+                # bulk path); ?full=1 serves the whole export for a
+                # quick scrape of a short run
+                ts = sys.modules.get("mxnet_tpu.telemetry.timeseries")
+                if ts is None:
+                    self._reply_json(
+                        {"error": "timeseries store not initialized "
+                                  "(import mxnet_tpu.telemetry)"}, 404)
+                elif "full=1" in (self.path.split("?", 1) + [""])[1]:
+                    self._reply_json(ts.export())
+                else:
+                    self._reply_json(ts.summary())
             elif path == "/stacks":
                 stacks = flight.thread_stacks()
                 text = "\n".join("--- %s ---\n%s" % (k, "".join(v))

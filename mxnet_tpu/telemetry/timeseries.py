@@ -1,0 +1,216 @@
+"""Step-indexed time series: the run's health, one bounded ring per metric.
+
+Spans answer "where did THIS step go"; counters answer "how many, ever".
+Neither can answer the convergence questions ROADMAP item 4 is gated on
+— was the loss at step N where the last good run had it, did the grad
+norm spike, did an update/weight ratio wander out of its band.  Those
+need values **keyed by step**, kept for the whole run, exportable, and
+comparable across runs.  This module is that store:
+
+* every **step-span exit** (``core._close_step_window``) appends the
+  step's wall time and the live step gauges (overlap_ratio, MFU,
+  device/collective decomposition, queue depths) to per-metric rings,
+  keyed by an internal step counter (the count of step-span exits);
+* every **model-stats fetch** (``mxnet_tpu.model_stats.Recorder``)
+  appends per-param ``model/<param>/<stat>`` series plus ``model/loss``,
+  keyed by the recorder's OPTIMIZER step — the two step clocks are
+  recorded as-is and documented apart (a guardian-skipped step advances
+  the optimizer-step clock but may share one step span with a retry);
+* rings are bounded at ``MXNET_TIMESERIES_STEPS`` points (default 4096;
+  the JG006 read-once + ``refresh_from_env`` contract), evictions are
+  counted (``timeseries_evictions``) — a week-long run cannot grow host
+  RSS through its own health record;
+* :func:`export` / :func:`export_json` produce the JSON
+  ``tools/health_gate.py`` and ``tools/trace_report.py --health``
+  consume; :func:`merge` folds several exports (fleet ranks, or the
+  chunks of a long run) into one; the ``/timeseries`` endpoint serves a
+  live observe-only summary.
+
+Stdlib-only at import; recording is a deque append under one lock.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+
+from . import core as _core
+
+__all__ = ["cap", "configure", "refresh_from_env", "record",
+           "note_step_exit", "record_model_stats", "series", "names",
+           "export", "export_json", "load_export", "merge", "summary",
+           "reset"]
+
+_DEFAULT_CAP = 4096
+
+
+def _parse_cap(raw):
+    """MXNET_TIMESERIES_STEPS: points kept per metric ring (default
+    4096); anything unparsable or < 1 falls back to the default."""
+    try:
+        n = int(raw)
+    except (TypeError, ValueError):
+        return _DEFAULT_CAP
+    return n if n >= 1 else _DEFAULT_CAP
+
+
+_CAP = _parse_cap(os.environ.get("MXNET_TIMESERIES_STEPS"))
+
+_lock = threading.Lock()
+_series = {}                 # name -> deque((step, value), maxlen=_CAP)
+_step_seq = 0                # step-span exits seen (the gauge-series key)
+
+# gauges snapshotted at every step-span exit — only the ones actually
+# set this run land (a CPU run without MXNET_DEVICE_TIME has no
+# overlap_ratio to record, and records none)
+_GAUGE_SERIES = ("overlap_ratio", "step_mfu", "step_model_flops",
+                 "step_hbm_bw_util", "step_device_us",
+                 "step_collective_us", "step_data_wait_us",
+                 "step_host_us", "io_batch_wait_us",
+                 "engine_pending_tasks", "serving_queue_depth",
+                 "guardian_loss_scale")
+
+
+def cap():
+    return _CAP
+
+
+def configure(steps=None):
+    """Programmatic override of MXNET_TIMESERIES_STEPS.  Existing rings
+    are re-bounded in place (oldest points drop first on a shrink)."""
+    global _CAP
+    if steps is None:
+        return
+    new = max(1, int(steps))
+    with _lock:
+        _CAP = new
+        for name, ring in list(_series.items()):
+            _series[name] = deque(ring, maxlen=new)
+
+
+def refresh_from_env():
+    configure(_parse_cap(os.environ.get("MXNET_TIMESERIES_STEPS")))
+
+
+def record(name, step, value):
+    """Append one (step, value) point to *name*'s ring."""
+    value = float(value)
+    with _lock:
+        ring = _series.get(name)
+        if ring is None:
+            ring = _series[name] = deque(maxlen=_CAP)
+        evict = len(ring) == _CAP
+        ring.append((int(step), value))
+    if evict:
+        _core.bump("timeseries_evictions")
+
+
+def note_step_exit(dur_us):
+    """Step-span exit hook (called by ``core._close_step_window`` at
+    depth 0): book the step's wall time and whichever step gauges are
+    live under the next step index."""
+    global _step_seq
+    with _lock:
+        step = _step_seq
+        _step_seq += 1
+        with _core._mlock:
+            live = [(n, _core._gauges[n]) for n in _GAUGE_SERIES
+                    if n in _core._gauges]
+    record("step_time_us", step, dur_us)
+    for name, value in live:
+        record(name, step, value)
+
+
+def record_model_stats(step, names, stats, loss=None):
+    """Book one fetched model-stats block (``model_stats.Recorder``):
+    per-param ``model/<param>/<stat>`` series in STAT_NAMES column
+    order, plus ``model/loss`` when the step carried one.  Keyed by the
+    OPTIMIZER step the recorder counted, not the step-span clock."""
+    from .. import model_stats as _ms
+    for row, pname in enumerate(names):
+        for col, sname in enumerate(_ms.STAT_NAMES):
+            record("model/%s/%s" % (pname, sname), step,
+                   stats[row][col])
+    if loss is not None:
+        record("model/loss", step, loss)
+
+
+def names():
+    with _lock:
+        return sorted(_series)
+
+
+def series(name):
+    """The (step, value) points of one metric, oldest first."""
+    with _lock:
+        ring = _series.get(name)
+        return [] if ring is None else list(ring)
+
+
+def export():
+    """JSON-shaped dump of every ring — the wire format health_gate and
+    ``trace_report --health`` consume (and :func:`merge` folds)."""
+    with _lock:
+        return {"version": 1, "cap": _CAP,
+                "steps_seen": _step_seq,
+                "series": {name: [[s, v] for s, v in ring]
+                           for name, ring in sorted(_series.items())}}
+
+
+def export_json(path):
+    with open(path, "w") as fh:
+        json.dump(export(), fh, indent=1, sort_keys=True)
+    return path
+
+
+def load_export(path):
+    with open(path) as fh:
+        out = json.load(fh)
+    if not isinstance(out, dict) or "series" not in out:
+        raise ValueError("%s is not a timeseries export "
+                         "(missing 'series')" % path)
+    return out
+
+
+def merge(exports):
+    """Fold several exports into one (the ``--fleet`` shape: one file
+    per rank, or one per chunk of a long run): same-name series are
+    concatenated and sorted by step — duplicate steps are kept in input
+    order, so callers can tell ranks apart by position if they need to."""
+    merged = {}
+    steps_seen = 0
+    for exp in exports:
+        steps_seen = max(steps_seen, int(exp.get("steps_seen", 0)))
+        for name, points in exp.get("series", {}).items():
+            merged.setdefault(name, []).extend(
+                (int(s), float(v)) for s, v in points)
+    for name in merged:
+        merged[name].sort(key=lambda p: p[0])
+    return {"version": 1, "cap": None, "steps_seen": steps_seen,
+            "series": {name: [[s, v] for s, v in pts]
+                       for name, pts in sorted(merged.items())}}
+
+
+def summary():
+    """Live observe-only view for the ``/timeseries`` endpoint: per-ring
+    bounds and last value, never the full payload (export_json is the
+    bulk path)."""
+    with _lock:
+        out = {}
+        for name, ring in sorted(_series.items()):
+            first = ring[0]
+            last = ring[-1]
+            out[name] = {"points": len(ring),
+                         "first_step": first[0], "last_step": last[0],
+                         "last_value": last[1]}
+        return {"cap": _CAP, "steps_seen": _step_seq,
+                "n_series": len(out), "series": out}
+
+
+def reset():
+    """Clear every ring and the step clock (tests / new session)."""
+    global _step_seq
+    with _lock:
+        _series.clear()
+        _step_seq = 0

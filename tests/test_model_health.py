@@ -1,0 +1,407 @@
+"""In-program model health (ISSUE 17): the fused stats side-output is
+bitwise-free, costs no extra programs, feeds the Monitor's compiled
+mode, and the drift gate consumes the exports with CI exit codes.
+
+Acceptance contract: stats-on training is bitwise-identical to
+stats-off on the fused, ZeRO-1, and guardian-NaN-retry paths with
+``program_calls_per_step`` unchanged; ``tools/health_gate.py`` passes a
+recorded envelope and exits nonzero on injected loss divergence.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, chaos, gluon, guardian, model_stats
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.gluon import fused_trainer, nn
+from mxnet_tpu.telemetry import timeseries as ts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    model_stats.recorder().reset()
+    ts.reset()
+    yield
+    for key in ("MXNET_MODEL_STATS", "MXNET_FUSED_TRAINER",
+                "MXNET_ZERO", "MXNET_ZERO_SHARDS"):
+        os.environ.pop(key, None)
+    model_stats.refresh_from_env()
+    fused_trainer.refresh_from_env()
+    model_stats.recorder().reset()
+    ts.reset()
+    g = guardian.current()
+    if g is not None:
+        guardian.uninstall(g)
+    chaos.configure(None)
+
+
+def _set_mode(fused=True, zero=None):
+    os.environ["MXNET_FUSED_TRAINER"] = "1" if fused else "0"
+    if zero is None:
+        os.environ.pop("MXNET_ZERO", None)
+        os.environ.pop("MXNET_ZERO_SHARDS", None)
+    else:
+        os.environ["MXNET_ZERO"] = "1"
+        os.environ["MXNET_ZERO_SHARDS"] = str(zero)
+    fused_trainer.refresh_from_env()
+
+
+def _train(stats=0, fused=True, zero=None, guard=False, poison=None,
+           steps=5, seed=0):
+    """Seeded mini-run; returns (params, states, per-step call counts)."""
+    _set_mode(fused=fused, zero=zero)
+    model_stats.configure(interval=stats)
+    model_stats.recorder().reset()
+    ts.reset()
+    g = None
+    try:
+        if poison is not None:
+            chaos.configure(poison)
+        if guard:
+            g = guardian.TrainingGuardian()
+            guardian.install(g)
+        np.random.seed(seed)
+        mx.random.seed(seed)
+        rng = np.random.RandomState(seed + 1)
+        net = nn.Sequential()
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(4))
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9},
+                                kvstore="device")
+        loss_fn = gluon.loss.L2Loss()
+        X = rng.randn(steps, 8, 6).astype(np.float32)
+        Y = rng.randn(steps, 8, 4).astype(np.float32)
+        calls = []
+        for step in range(steps):
+            attempt = 0
+            while True:
+                with autograd.record():
+                    loss = loss_fn(net(mx.nd.array(X[step])),
+                                   mx.nd.array(Y[step]))
+                    scaled = g.scale_loss(loss) if g is not None else loss
+                scaled.backward()
+                before = profiler.counter("xla_program_calls")
+                trainer.step(8)
+                calls.append(profiler.counter("xla_program_calls")
+                             - before)
+                # the retrying-loop contract: a skipped update redoes
+                # the SAME batch (tools/guardian_smoke.py)
+                if g is not None and g.last_action() == "skipped" \
+                        and attempt < 2:
+                    attempt += 1
+                    continue
+                break
+        params = [p.data().asnumpy()
+                  for p in net.collect_params().values()]
+        names = [p.name for p in net.collect_params().values()]
+        return params, names, calls
+    finally:
+        if g is not None:
+            guardian.uninstall(g)
+        if poison is not None:
+            chaos.configure(None)
+        model_stats.configure(interval=0)
+        _set_mode(fused=True, zero=None)
+
+
+def _assert_bitwise(a, b, what):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        np.testing.assert_array_equal(x, y, err_msg="%s[%d]" % (what, i))
+
+
+# ---------------------------------------------------------------------------
+# bitwise: the optimization_barrier isolation holds on every path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["fused", "zero1", "oracle"])
+def test_stats_on_is_bitwise_identical(mode):
+    kw = {"fused": mode != "oracle",
+          "zero": 2 if mode == "zero1" else None}
+    off, _, calls_off = _train(stats=0, **kw)
+    on, _, calls_on = _train(stats=1, **kw)
+    _assert_bitwise(off, on, "%s params" % mode)
+    assert model_stats.recorder().latest() is not None, \
+        "stats-on run recorded nothing (vacuous bitwise pass)"
+    if mode != "oracle":
+        # the side-output rides the ONE donated program: no extra calls
+        assert calls_on[-1] == calls_off[-1] == 1
+
+
+def test_stats_bitwise_under_guardian_nan_retry():
+    skipped0 = telemetry.counter("guardian_skipped_steps")
+    off, _, _ = _train(stats=0, guard=True, poison="grad.bucket:nan@2")
+    mid = telemetry.counter("guardian_skipped_steps")
+    assert mid - skipped0 == 1, "chaos NaN never skipped (vacuous)"
+    on, _, _ = _train(stats=1, guard=True, poison="grad.bucket:nan@2")
+    assert telemetry.counter("guardian_skipped_steps") - mid == 1
+    _assert_bitwise(off, on, "guarded params")
+    # the skipped attempt is IN the record: its update_ratio is zero
+    # (weights untouched) — exactly what a drift table should show
+    rows = model_stats.recorder().drain()
+    ratios = [float(stats[:, 2].max()) for _, _, stats, _ in rows]
+    assert any(r == 0.0 for r in ratios), \
+        "the skipped step's zero update_ratio was not recorded"
+
+
+# ---------------------------------------------------------------------------
+# program budget + retrace discipline
+# ---------------------------------------------------------------------------
+
+def test_oracle_extra_program_only_on_due_steps():
+    """MXNET_FUSED_TRAINER=0 + interval 2: the one extra model_stats
+    program launches on steps 0/2/4 only."""
+    _, _, calls = _train(stats=2, fused=False, steps=5)
+    assert len(model_stats.recorder().drain()) == 3
+    # steady state (compile noise settled): a due step costs exactly
+    # one launch more than its non-due neighbor
+    assert calls[4] == calls[3] + 1
+
+
+def test_interval_change_never_retraces():
+    """The program computes stats unconditionally when enabled; the
+    interval rations the HOST fetch — so flipping it reuses the cached
+    step program (one signature, no recompile)."""
+    _set_mode(fused=True)
+    model_stats.configure(interval=1)
+    model_stats.recorder().reset()
+    try:
+        np.random.seed(3)
+        mx.random.seed(3)
+        rng = np.random.RandomState(4)
+        net = nn.Sequential()
+        net.add(nn.Dense(4))
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        loss_fn = gluon.loss.L2Loss()
+        X = rng.randn(6, 4, 3).astype(np.float32)
+        Y = rng.randn(6, 4, 4).astype(np.float32)
+
+        def one(step):
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(X[step])),
+                               mx.nd.array(Y[step]))
+            loss.backward()
+            trainer.step(4)
+
+        one(0)
+        cached = len(fused_trainer._STEP_CACHE)
+        model_stats.configure(interval=3)
+        for step in range(1, 6):
+            one(step)
+        assert len(fused_trainer._STEP_CACHE) == cached, \
+            "interval flip retraced the step program"
+        # fetches follow the live interval: steps 0 (int 1), 3 (int 3)
+        assert [r[0] for r in model_stats.recorder().drain()] == [0, 3]
+    finally:
+        model_stats.configure(interval=0)
+
+
+# ---------------------------------------------------------------------------
+# recorder -> timeseries -> Monitor compiled mode
+# ---------------------------------------------------------------------------
+
+def test_recorder_feeds_timeseries():
+    _, names, _ = _train(stats=1, guard=True, steps=3)
+    step, rnames, stats, loss = model_stats.recorder().latest()
+    assert list(rnames) == names
+    assert stats.shape == (len(names), len(model_stats.STAT_NAMES))
+    assert np.isfinite(stats).all()
+    assert loss is not None and np.isfinite(loss)
+    assert ts.series("model/loss")[-1] == (step, loss)
+    got = ts.series("model/%s/grad_norm_sq" % names[0])
+    assert got[-1][0] == step
+
+
+def test_monitor_compiled_mode_parity():
+    """An installed Monitor under MXNET_MODEL_STATS drains the SAME
+    numbers the recorder holds, as <param>:<stat> rows, pattern-filtered
+    — and never flips the executor onto the eager path."""
+    from mxnet_tpu.monitor import Monitor
+    _set_mode(fused=True)
+    model_stats.configure(interval=1)
+    model_stats.recorder().reset()
+    try:
+        mon = Monitor(interval=1, pattern=".*weight.*grad_norm_sq",
+                      sort=True)
+        assert not mon.stat_helper.is_active(), \
+            "compiled mode must not arm the eager executor tap"
+        np.random.seed(5)
+        mx.random.seed(5)
+        rng = np.random.RandomState(6)
+        net = nn.Sequential()
+        net.add(nn.Dense(4))
+        net.initialize(init=mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        loss_fn = gluon.loss.L2Loss()
+        mon.tic()
+        with autograd.record():
+            loss = loss_fn(net(mx.nd.array(rng.randn(4, 3)
+                                           .astype(np.float32))),
+                           mx.nd.array(rng.randn(4, 4)
+                                       .astype(np.float32)))
+        loss.backward()
+        trainer.step(4)
+        rows = mon.toc()
+        _, names, stats, _ = model_stats.recorder().latest()
+        # rows carry the monitor's batch clock (tic() already ticked it)
+        want = [(mon.step, "%s:grad_norm_sq" % n, "%s\t" % stats[i][0])
+                for i, n in enumerate(names) if "weight" in n]
+        assert rows == sorted(want, key=lambda r: r[1])
+    finally:
+        model_stats.configure(interval=0)
+
+
+def test_monitor_eager_tap_reactivates_when_stats_off():
+    from mxnet_tpu.monitor import Monitor
+    mon = Monitor(interval=1)
+    mon.activated = True
+    model_stats.configure(interval=1)
+    assert not mon.stat_helper.is_active()
+    model_stats.configure(interval=0)
+    assert mon.stat_helper.is_active()
+
+
+def test_monitor_render_is_sanctioned_host_sync():
+    """Monitor._render's asnumpy inside an open trace is deliberate:
+    allow_host_sync exempts the sync check, but a real tracer leak
+    still raises."""
+    import jax
+    from mxnet_tpu import nd
+    from mxnet_tpu.lint import sanitizer
+    from mxnet_tpu.monitor import _render
+    sanitizer.configure(mode="raise")
+    try:
+        const = nd.array(np.ones((2, 2), np.float32))
+
+        def f(v):
+            _render(const)            # sync under trace: sanctioned
+            return v + 1
+
+        jax.jit(f)(np.ones(3, np.float32))
+
+        def g(v):
+            return (_render(nd.NDArray(v)), v * 2)[1]   # tracer leak
+
+        with pytest.raises(sanitizer.SanitizerError, match="tracer"):
+            jax.jit(g)(np.ones(3, np.float32))
+    finally:
+        sanitizer.configure(mode="off")
+
+
+# ---------------------------------------------------------------------------
+# the drift gate CLI
+# ---------------------------------------------------------------------------
+
+def _synthetic_export(steps=8):
+    series = {"model/loss": [[s, 2.0 / (s + 2)] for s in range(steps)]}
+    for p in ("dense0_weight", "dense0_bias"):
+        series["model/%s/grad_norm_sq" % p] = \
+            [[s, 4.0 / (s + 1)] for s in range(steps)]
+        series["model/%s/weight_norm_sq" % p] = \
+            [[s, 1.0 + 0.01 * s] for s in range(steps)]
+        series["model/%s/update_ratio" % p] = \
+            [[s, 0.01] for s in range(steps)]
+        series["model/%s/grad_absmax" % p] = \
+            [[s, 0.5] for s in range(steps)]
+    return {"version": 1, "cap": 4096, "steps_seen": steps,
+            "series": series}
+
+
+def _gate(tmp_path, run, *extra):
+    run_path = tmp_path / "run.json"
+    run_path.write_text(json.dumps(run))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "health_gate.py"),
+         str(run_path), "--envelope", str(tmp_path / "env.json")]
+        + list(extra),
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    return proc
+
+
+def test_health_gate_record_then_pass(tmp_path):
+    ref = _synthetic_export()
+    assert _gate(tmp_path, ref, "--record").returncode == 0
+    proc = _gate(tmp_path, ref)
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout
+
+
+def test_health_gate_catches_loss_divergence(tmp_path):
+    ref = _synthetic_export()
+    assert _gate(tmp_path, ref, "--record").returncode == 0
+    bad = _synthetic_export()
+    bad["series"]["model/loss"][-1][1] *= 10.0
+    proc = _gate(tmp_path, bad)
+    assert proc.returncode == 3
+    assert "loss off-envelope" in proc.stderr
+
+
+def test_health_gate_catches_grad_spike_and_band_escape(tmp_path):
+    ref = _synthetic_export()
+    assert _gate(tmp_path, ref, "--record").returncode == 0
+    bad = _synthetic_export()
+    bad["series"]["model/dense0_weight/grad_norm_sq"][-1][1] = 1e9
+    proc = _gate(tmp_path, bad)
+    assert proc.returncode == 3
+    assert "grad-norm spike" in proc.stderr
+    bad = _synthetic_export()
+    bad["series"]["model/dense0_bias/update_ratio"][-1][1] = 50.0
+    proc = _gate(tmp_path, bad)
+    assert proc.returncode == 3
+    assert "update_ratio out of band" in proc.stderr
+
+
+def test_health_gate_unmeasurable_and_usage(tmp_path):
+    ref = _synthetic_export()
+    assert _gate(tmp_path, ref, "--record").returncode == 0
+    bare = {"version": 1, "steps_seen": 2,
+            "series": {"step_time_us": [[0, 9.0], [1, 8.0]]}}
+    assert _gate(tmp_path, bare).returncode == 4
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "health_gate.py"),
+         str(tmp_path / "missing.json"),
+         "--envelope", str(tmp_path / "env.json")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 2
+
+
+def test_health_gate_refuses_spiking_reference(tmp_path):
+    ref = _synthetic_export()
+    ref["series"]["model/dense0_weight/grad_norm_sq"][-1][1] = 1e9
+    proc = _gate(tmp_path, ref, "--record")
+    assert proc.returncode == 3
+    assert "refusing to record" in proc.stderr
+    assert not (tmp_path / "env.json").exists()
+
+
+def test_trace_report_health_renders(tmp_path):
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps(_synthetic_export()))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         "--health", str(run)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "model health" in proc.stdout
+    assert "dense0_weight" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         "--health", str(run), "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert set(report["params"]) == {"dense0_weight", "dense0_bias"}
+    assert report["loss"]["n"] == 8

@@ -23,6 +23,13 @@ OWNED_PROGRAMS = {
     "fused_trainer_step_guarded",
     "fused_trainer_step_zero1",
     "fused_trainer_step_zero1_guarded",
+    # MXNET_MODEL_STATS: the health side-output composed onto every
+    # fused path, plus the oracle loop's one extra program (ISSUE 17)
+    "fused_trainer_step_stats",
+    "fused_trainer_step_guarded_stats",
+    "fused_trainer_step_zero1_stats",
+    "fused_trainer_step_zero1_guarded_stats",
+    "model_stats",
     "gluon_cached_op",
     "guardian_verdict",
     "clip_global_norm",

@@ -22,6 +22,15 @@ four times:
    ``MXNET_GUARDIAN_MAX_SKIPS`` (+1 step to the first clean update) and
    the run ends applying finite updates.
 
+Every child also runs under ``MXNET_MODEL_STATS=1`` and exports its
+step time-series (the bitwise checks double as proof the fused stats
+side-output perturbs nothing), and the parent drives the drift gate
+over them: ``tools/health_gate.py --record`` on the clean run, a
+re-check against that envelope (exit 0), and a check of the transient
+run — whose injected NaN gradients MUST surface as a nonfinite
+grad-norm breach (exit 3).  Chaos faults are visible to the health
+gate, not just to the guardian.
+
 Exit is nonzero on ANY violated property.  Usage::
 
     python tools/guardian_smoke.py [--steps 12] [--poison-at 4]
@@ -133,6 +142,10 @@ def child_main():
         guard.close()
     if mgr is not None:
         mgr.close()
+    ts_path = os.environ.get("GUARDIAN_SMOKE_TIMESERIES")
+    if ts_path:
+        from mxnet_tpu.telemetry import timeseries
+        timeseries.export_json(ts_path)
     with open(out_path, "w") as fh:
         json.dump(result, fh)
     return 0
@@ -159,6 +172,11 @@ def run_child(label, scratch, args, guardian=False, manager=False,
         "MXNET_CHAOS": chaos,
         "MXNET_GUARDIAN_LOSS_SCALE": "dynamic" if guardian else "0",
         "MXNET_GUARDIAN_MAX_SKIPS": str(args.max_skips),
+        # every run doubles as a stats-on trial: the bitwise asserts
+        # prove the fused health side-output perturbs nothing, and the
+        # exports feed the health_gate wiring below
+        "MXNET_MODEL_STATS": "1",
+        "GUARDIAN_SMOKE_TIMESERIES": timeseries_path(scratch, label),
     })
     env.pop("MXNET_GUARDIAN", None)       # instances, not env auto-install
     env.update(extra_env or {})
@@ -175,6 +193,44 @@ def run_child(label, scratch, args, guardian=False, manager=False,
                             proc.stderr))
     with open(out) as fh:
         return json.load(fh)
+
+
+def timeseries_path(scratch, label):
+    return os.path.join(scratch, "ts-%s.json" % label)
+
+
+def gate_health(scratch, args, problems):
+    """Drive tools/health_gate.py over the children's exports: record
+    from the clean run, re-check it (rc 0), and require the transient
+    run's injected NaN grads to breach (rc 3)."""
+    gate = os.path.join(REPO, "tools", "health_gate.py")
+    envelope = os.path.join(scratch, "envelope.json")
+
+    def run_gate(run_path, record=False):
+        cmd = [sys.executable, gate, run_path, "--envelope", envelope]
+        if record:
+            cmd.append("--record")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=args.timeout)
+        return proc.returncode, (proc.stdout + proc.stderr).strip()
+
+    rc, out = run_gate(timeseries_path(scratch, "clean"), record=True)
+    if rc != 0:
+        problems.append("health_gate --record rejected the clean run "
+                        "(rc %d): %s" % (rc, out))
+        return {"health_gate_rc": rc, "health_divergence_rc": None}
+    rc, out = run_gate(timeseries_path(scratch, "clean"))
+    if rc != 0:
+        problems.append("health_gate failed the clean run against its "
+                        "own envelope (rc %d): %s" % (rc, out))
+    check_rc = rc
+    rc, out = run_gate(timeseries_path(scratch, "transient"))
+    if rc != 3:
+        problems.append(
+            "health_gate returned rc %d on the NaN-poisoned run, want 3 "
+            "— injected faults must surface as a drift breach: %s"
+            % (rc, out))
+    return {"health_gate_rc": check_rc, "health_divergence_rc": rc}
 
 
 def burst_lengths(actions):
@@ -269,6 +325,7 @@ def main(argv=None):
                             "(no recovery): %s" % rollback["actions"])
         if not rollback["params_finite"]:
             problems.append("rollback run ended with nonfinite params")
+        health = gate_health(scratch, args, problems)
 
         summary = {
             "ok": not problems,
@@ -279,16 +336,20 @@ def main(argv=None):
             "last_good_step": rollback["last_good_step"],
             "calls_last_step": plain["calls_last_step"],
             "final_loss": plain["losses"][-1],
+            "health_gate_rc": health["health_gate_rc"],
+            "health_divergence_rc": health["health_divergence_rc"],
             "problems": problems,
         }
         if args.json:
             print(json.dumps(summary))
         else:
             print("guardian_smoke: %s — 1 skip absorbed, %d rollback(s), "
-                  "%d calls/step, final loss %r"
+                  "%d calls/step, final loss %r, health gate rc=%s "
+                  "(poisoned run rc=%s)"
                   % ("OK" if not problems else "FAIL",
                      summary["rollbacks"], summary["calls_last_step"],
-                     summary["final_loss"]))
+                     summary["final_loss"], summary["health_gate_rc"],
+                     summary["health_divergence_rc"]))
             for p in problems:
                 print("  PROBLEM: %s" % p)
         return 0 if not problems else 1

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""health_gate: compare a run's health time-series against an envelope.
+
+The drift gate ROADMAP item 4's sync-vs-async convergence acceptance
+consumes: a reference run records an **envelope** — loss-at-step-N with
+a tolerance, grad-norm EWMA spike parameters, update/weight-ratio bands
+— and every later run's ``telemetry.timeseries.export_json()`` artifact
+is checked against it with a CI-consumable exit code:
+
+    0   every check passed
+    3   a check breached (loss off-envelope, grad-norm spike,
+        update ratio out of band)
+    4   unmeasurable: the run lacks the series or the step the envelope
+        pins (a gate that cannot measure must fail loudly, not
+        vacuously pass — the --gate-overlap convention)
+    2   bad invocation / unreadable files
+
+Checks (each skipped when its envelope section is absent):
+
+* **loss-at-step-N**: the run's ``model/loss`` value at the envelope's
+  step is within ``rel_tol`` of the reference value (relative to
+  ``max(|ref|, abs_floor)``); a nonfinite loss breaches outright.
+* **grad-norm EWMA spike-free**: the per-step global gradient norm
+  (sqrt of the summed per-param ``grad_norm_sq``) never exceeds
+  ``spike_mult`` × its own trailing EWMA after ``warmup`` points, and
+  is finite throughout.
+* **update-ratio bands**: every nonzero per-param ``update_ratio``
+  point past warmup lies within [min/band_mult, max*band_mult] of the
+  reference run's observed range (zero ratios are guardian-skipped
+  steps, excluded on both sides).
+
+``--record`` derives the envelope FROM the given run and writes it —
+after first self-checking the run (a reference that spikes against its
+own parameters is refused with exit 3, so a bad baseline cannot become
+the fleet's yardstick).
+
+Stdlib-only on purpose (the trace_report rule): runs wherever the JSON
+can be copied.  Producers: train under ``MXNET_MODEL_STATS=1`` (plus a
+guardian or an explicit ``timeseries.record("model/loss", ...)`` for
+the loss series) and call ``telemetry.timeseries.export_json(path)``.
+
+Usage:
+    python tools/health_gate.py RUN.json --envelope ENV.json --record
+    python tools/health_gate.py RUN.json --envelope ENV.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+OK, BREACH, UNMEASURABLE, USAGE = 0, 3, 4, 2
+
+
+def _load(path):
+    with open(path) as fh:
+        out = json.load(fh)
+    if not isinstance(out, dict):
+        raise ValueError("not a JSON object")
+    return out
+
+
+def _finite(v):
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+def loss_series(export):
+    return [(int(s), float(v))
+            for s, v in export.get("series", {}).get("model/loss", [])]
+
+
+def grad_norm_series(export):
+    """Per-step global grad norm: sqrt of the per-param grad_norm_sq
+    sum, over the steps where every recorded param has a point."""
+    by_step = {}
+    n_params = 0
+    for name, points in export.get("series", {}).items():
+        if not (name.startswith("model/")
+                and name.endswith("/grad_norm_sq")):
+            continue
+        n_params += 1
+        for s, v in points:
+            by_step.setdefault(int(s), []).append(float(v))
+    return [(s, math.sqrt(sum(vs)) if all(map(math.isfinite, vs))
+             and sum(vs) >= 0 else float("nan"))
+            for s, vs in sorted(by_step.items())
+            if len(vs) == n_params], n_params
+
+
+def update_ratio_points(export, warmup):
+    """Every nonzero per-param update_ratio point past *warmup* (zero =
+    a guardian-skipped step, excluded by contract)."""
+    out = []
+    for name, points in export.get("series", {}).items():
+        if not (name.startswith("model/")
+                and name.endswith("/update_ratio")):
+            continue
+        pname = name.split("/", 2)[1]
+        out.extend((pname, int(s), float(v)) for s, v in points
+                   if int(s) >= warmup and float(v) != 0.0)
+    return out
+
+
+def check_grad_spikes(series, alpha, spike_mult, warmup):
+    """The EWMA spike sweep; returns a list of breach strings."""
+    problems = []
+    ewma = None
+    for i, (step, v) in enumerate(series):
+        if not math.isfinite(v):
+            problems.append("grad norm nonfinite at step %d" % step)
+            continue
+        if ewma is not None and i >= warmup and v > spike_mult * ewma:
+            problems.append(
+                "grad-norm spike at step %d: %.6g > %.2g x EWMA %.6g"
+                % (step, v, spike_mult, ewma))
+        ewma = v if ewma is None else ewma + alpha * (v - ewma)
+    return problems
+
+
+def record_envelope(run, args):
+    """Derive an envelope from *run*; returns (envelope, problems,
+    unmeasurable)."""
+    losses = loss_series(run)
+    gseries, n_params = grad_norm_series(run)
+    if not losses or not gseries:
+        return None, ["run lacks model/loss or model/*/grad_norm_sq "
+                      "series (train with MXNET_MODEL_STATS=1 and a "
+                      "recorded loss)"], True
+    problems = check_grad_spikes(gseries, args.ewma_alpha,
+                                 args.spike_mult, args.warmup)
+    last_step, last_loss = losses[-1]
+    if not math.isfinite(last_loss):
+        problems.append("reference loss nonfinite at step %d" % last_step)
+    ratios = [v for _, _, v in update_ratio_points(run, args.warmup)]
+    finite_ratios = [v for v in ratios if math.isfinite(v)]
+    if len(finite_ratios) != len(ratios):
+        problems.append("reference update_ratio has nonfinite points")
+    env = {"version": 1,
+           "source_steps": run.get("steps_seen", 0),
+           "n_params": n_params,
+           "loss": {"step": last_step, "value": last_loss,
+                    "rel_tol": args.loss_tol, "abs_floor": 1e-6},
+           "grad_norm": {"ewma_alpha": args.ewma_alpha,
+                         "spike_mult": args.spike_mult,
+                         "warmup": args.warmup,
+                         "reference_max": max(
+                             (v for _, v in gseries
+                              if math.isfinite(v)), default=None)}}
+    if finite_ratios:
+        env["update_ratio"] = {"min": min(finite_ratios),
+                               "max": max(finite_ratios),
+                               "band_mult": args.band_mult,
+                               "warmup": args.warmup}
+    return env, problems, False
+
+
+def check_run(run, env):
+    """Check *run* against *env*; returns (problems, unmeasurable)."""
+    problems = []
+    unmeasurable = []
+
+    spec = env.get("loss")
+    if spec is not None:
+        losses = dict(loss_series(run))
+        step = int(spec["step"])
+        if step not in losses:
+            unmeasurable.append(
+                "no model/loss point at envelope step %d (run has %d "
+                "loss points)" % (step, len(losses)))
+        else:
+            got, want = losses[step], float(spec["value"])
+            tol = float(spec.get("rel_tol", 0.05)) \
+                * max(abs(want), float(spec.get("abs_floor", 1e-6)))
+            if not math.isfinite(got):
+                problems.append("loss nonfinite at step %d" % step)
+            elif abs(got - want) > tol:
+                problems.append(
+                    "loss off-envelope at step %d: %.6g vs reference "
+                    "%.6g (tol %.3g)" % (step, got, want, tol))
+
+    spec = env.get("grad_norm")
+    if spec is not None:
+        gseries, _ = grad_norm_series(run)
+        if not gseries:
+            unmeasurable.append("no model/*/grad_norm_sq series in run")
+        else:
+            problems.extend(check_grad_spikes(
+                gseries, float(spec.get("ewma_alpha", 0.3)),
+                float(spec.get("spike_mult", 5.0)),
+                int(spec.get("warmup", 2))))
+
+    spec = env.get("update_ratio")
+    if spec is not None:
+        pts = update_ratio_points(run, int(spec.get("warmup", 2)))
+        if not pts:
+            unmeasurable.append("no nonzero model/*/update_ratio points "
+                                "in run")
+        else:
+            band = float(spec.get("band_mult", 4.0))
+            lo = float(spec["min"]) / band
+            hi = float(spec["max"]) * band
+            for pname, step, v in pts:
+                if not math.isfinite(v) or v < lo or v > hi:
+                    problems.append(
+                        "update_ratio out of band for %s at step %d: "
+                        "%.6g vs [%.6g, %.6g]" % (pname, step, v, lo, hi))
+
+    return problems, unmeasurable
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Gate a run's health timeseries against a reference "
+                    "envelope (exit 0 ok / 3 breach / 4 unmeasurable).")
+    ap.add_argument("run", help="telemetry.timeseries export_json() of "
+                                "the run under test")
+    ap.add_argument("--envelope", required=True,
+                    help="envelope JSON (read in check mode, written by "
+                         "--record)")
+    ap.add_argument("--record", action="store_true",
+                    help="derive the envelope FROM this run (self-checks "
+                         "first; a spiking reference is refused)")
+    ap.add_argument("--loss-tol", type=float, default=0.05,
+                    help="relative loss tolerance recorded into the "
+                         "envelope (default 0.05)")
+    ap.add_argument("--spike-mult", type=float, default=5.0,
+                    help="grad-norm spike threshold as a multiple of the "
+                         "trailing EWMA (default 5.0)")
+    ap.add_argument("--ewma-alpha", type=float, default=0.3,
+                    help="grad-norm EWMA smoothing (default 0.3)")
+    ap.add_argument("--band-mult", type=float, default=4.0,
+                    help="update-ratio band slack around the reference "
+                         "min/max (default 4.0)")
+    ap.add_argument("--warmup", type=int, default=2,
+                    help="steps exempt from spike/band checks "
+                         "(default 2)")
+    args = ap.parse_args(argv)
+
+    try:
+        run = _load(args.run)
+    except (OSError, ValueError) as exc:
+        print("health-gate: cannot read run %s: %s" % (args.run, exc),
+              file=sys.stderr)
+        return USAGE
+
+    if args.record:
+        env, problems, unmeasurable = record_envelope(run, args)
+        if unmeasurable:
+            print("health-gate: UNMEASURABLE — %s" % "; ".join(problems),
+                  file=sys.stderr)
+            return UNMEASURABLE
+        if problems:
+            print("health-gate: FAIL — refusing to record an envelope "
+                  "from an unhealthy reference:\n  "
+                  + "\n  ".join(problems), file=sys.stderr)
+            return BREACH
+        with open(args.envelope, "w") as fh:
+            json.dump(env, fh, indent=1, sort_keys=True)
+        print("health-gate: recorded %s (loss %.6g @ step %d, %d params)"
+              % (args.envelope, env["loss"]["value"],
+                 env["loss"]["step"], env["n_params"]))
+        return OK
+
+    try:
+        env = _load(args.envelope)
+    except (OSError, ValueError) as exc:
+        print("health-gate: cannot read envelope %s: %s"
+              % (args.envelope, exc), file=sys.stderr)
+        return USAGE
+
+    problems, unmeasurable = check_run(run, env)
+    if unmeasurable:
+        print("health-gate: UNMEASURABLE — %s" % "; ".join(unmeasurable),
+              file=sys.stderr)
+        return UNMEASURABLE
+    if problems:
+        print("health-gate: FAIL —\n  " + "\n  ".join(problems),
+              file=sys.stderr)
+        return BREACH
+    print("health-gate: ok — loss on envelope, grad norms spike-free, "
+          "update ratios in band")
+    return OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,14 @@ estimated offset in its ``rank_meta``, re-pid'd per rank, and the
 shared span id.  A missing or corrupt rank artifact degrades to a warning
 and a partial merge, never a traceback.
 
+``--health TIMESERIES.json`` switches to model-health mode: reads a
+``telemetry.timeseries.export_json()`` artifact (the MXNET_MODEL_STATS
+record) and renders per-parameter drift tables — weight-norm first→last,
+grad-norm last/max, update/weight-ratio mean/max, grad-absmax peak —
+plus a loss-curve summary and the step-gauge means.  The comparing twin
+(same series vs a reference envelope, with exit codes) is
+``tools/health_gate.py``.
+
 Degrades gracefully: an empty or missing ``traceEvents`` array, or a
 snapshot from an older build lacking the newer keys, prints "(no ...)"
 placeholders instead of a traceback — this tool runs in CI pipelines on
@@ -50,6 +58,7 @@ Usage:
     python tools/trace_report.py trace.json [--snapshot snap.json]
                                  [--top 10] [--json]
     python tools/trace_report.py --fleet DIR [--out merged.json] [--json]
+    python tools/trace_report.py --health timeseries.json [--json]
 """
 from __future__ import annotations
 
@@ -603,6 +612,111 @@ def render(report, top):
     return "\n".join(lines)
 
 
+# --------------------------------------------------------------------------
+# model-health mode (--health): the timeseries export, rendered
+# --------------------------------------------------------------------------
+
+def _series_stats(points):
+    """min/max/mean/first/last over one [[step, value], ...] series."""
+    vals = [float(v) for _, v in points]
+    finite = [v for v in vals if v == v and abs(v) != float("inf")]
+    return {"n": len(vals),
+            "first": vals[0] if vals else None,
+            "last": vals[-1] if vals else None,
+            "min": min(finite) if finite else None,
+            "max": max(finite) if finite else None,
+            "mean": sum(finite) / len(finite) if finite else None,
+            "nonfinite": len(vals) - len(finite)}
+
+
+def health_report(export):
+    """JSON-shaped model-health summary of one timeseries export: the
+    per-parameter drift table, the loss curve, and the step gauges."""
+    series = export.get("series", {})
+    params = {}
+    for name, points in series.items():
+        if not name.startswith("model/") or name == "model/loss":
+            continue
+        try:
+            _, pname, stat = name.split("/", 2)
+        except ValueError:
+            continue
+        params.setdefault(pname, {})[stat] = _series_stats(points)
+    drift = {}
+    for pname, stats in sorted(params.items()):
+        wsq = stats.get("weight_norm_sq", {})
+        gsq = stats.get("grad_norm_sq", {})
+        ratio = stats.get("update_ratio", {})
+        absmax = stats.get("grad_absmax", {})
+        sqrt = lambda v: None if v is None else max(0.0, v) ** 0.5
+        drift[pname] = {
+            "weight_norm_first": sqrt(wsq.get("first")),
+            "weight_norm_last": sqrt(wsq.get("last")),
+            "grad_norm_last": sqrt(gsq.get("last")),
+            "grad_norm_max": sqrt(gsq.get("max")),
+            "update_ratio_mean": ratio.get("mean"),
+            "update_ratio_max": ratio.get("max"),
+            "grad_absmax_max": absmax.get("max"),
+            "nonfinite_points": sum(s.get("nonfinite", 0)
+                                    for s in stats.values()),
+            "points": max((s.get("n", 0) for s in stats.values()),
+                          default=0),
+        }
+    gauges = {name: _series_stats(points)
+              for name, points in sorted(series.items())
+              if not name.startswith("model/")}
+    loss = _series_stats(series["model/loss"]) \
+        if "model/loss" in series else None
+    return {"steps_seen": export.get("steps_seen", 0),
+            "cap": export.get("cap"),
+            "loss": loss, "params": drift, "gauges": gauges}
+
+
+def render_health(report):
+    lines = ["== model health (MXNET_MODEL_STATS timeseries) =="]
+    loss = report.get("loss")
+    if loss and loss.get("n"):
+        lines.append(
+            "loss: %d points  first %.6g  last %.6g  min %.6g  "
+            "nonfinite %d"
+            % (loss["n"], loss["first"], loss["last"],
+               loss["min"] if loss["min"] is not None else float("nan"),
+               loss["nonfinite"]))
+    else:
+        lines.append("loss: (no model/loss series — train under a "
+                     "guardian or record it explicitly)")
+    params = report.get("params", {})
+    if params:
+        lines.append("")
+        lines.append("%-28s %10s %10s %10s %10s %10s" %
+                     ("param", "|w| first", "|w| last", "|g| last",
+                      "upd/w mean", "|g|max max"))
+        fmt = lambda v: "-" if v is None else "%.4g" % v
+        for pname, row in params.items():
+            lines.append("%-28s %10s %10s %10s %10s %10s" %
+                         (pname[:28], fmt(row["weight_norm_first"]),
+                          fmt(row["weight_norm_last"]),
+                          fmt(row["grad_norm_last"]),
+                          fmt(row["update_ratio_mean"]),
+                          fmt(row["grad_absmax_max"])))
+            if row["nonfinite_points"]:
+                lines.append("%-28s   ^ %d nonfinite stat points "
+                             "(overflow/NaN steps)" %
+                             ("", row["nonfinite_points"]))
+    else:
+        lines.append("(no model/* series — run with MXNET_MODEL_STATS=1)")
+    gauges = report.get("gauges", {})
+    if gauges:
+        lines.append("")
+        lines.append("step gauges (per step-span exit):")
+        for name, st in gauges.items():
+            if st.get("mean") is None:
+                continue
+            lines.append("  %-24s mean %.4g  last %.4g  (%d points)"
+                         % (name, st["mean"], st["last"], st["n"]))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Summarise an mxnet_tpu Chrome trace "
@@ -628,7 +742,26 @@ def main(argv=None):
                          "overlap_ratio (collective time hidden under "
                          "backward) reaches RATIO — the ROADMAP item-2 "
                          "win condition as a CI gate")
+    ap.add_argument("--health", default=None, metavar="TIMESERIES",
+                    help="model-health mode: render the per-param drift "
+                         "table and loss summary of a "
+                         "telemetry.timeseries export_json() file")
     args = ap.parse_args(argv)
+
+    if args.health is not None:
+        try:
+            with open(args.health) as fh:
+                export = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print("health: cannot read %s: %s" % (args.health, exc),
+                  file=sys.stderr)
+            return 2
+        report = health_report(export)
+        if args.as_json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            print(render_health(report))
+        return 0
 
     if args.fleet is not None:
         summary = fleet_report(args.fleet, out_path=args.out)
