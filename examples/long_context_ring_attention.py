@@ -157,7 +157,8 @@ def main():
     zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
     opt_state = {"m": zeros, "v": zeros, "t": jnp.zeros((), jnp.int32)}
 
-    @jax.jit
+    from mxnet_tpu.telemetry import watch_jit
+
     def step(p, s, x, y):
         loss, g = jax.value_and_grad(loss_fn)(p, x, y)
         t = s["t"] + 1
@@ -171,6 +172,8 @@ def main():
             lambda w, mm, vv: w - corr * mm / (jnp.sqrt(vv) + eps),
             p, m, v)
         return new_p, {"m": m, "v": v, "t": t}, loss
+
+    step = watch_jit(jax.jit(step), "ring_example_step")
 
     loss = None
     for it in range(args.num_steps):

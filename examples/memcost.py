@@ -50,6 +50,10 @@ def make_loss(remat):
 
 
 def temp_bytes(fn, *args):
+    # AOT lower/compile probe: the executable is inspected for its
+    # memory_analysis() and never dispatched, so there is no retrace
+    # stream for the watchdog to book
+    # graftlint: disable=JG002
     compiled = jax.jit(fn).lower(*args).compile()
     mem = compiled.memory_analysis()
     return int(getattr(mem, "temp_size_in_bytes", 0))

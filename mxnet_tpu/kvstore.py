@@ -140,9 +140,14 @@ def tracecheck_programs():
     two keys of different shapes, like a real small bucket)."""
     a = jax.ShapeDtypeStruct((64, 32), jnp.float32)
     b = jax.ShapeDtypeStruct((128,), jnp.float32)
+    # sharding metadata (JX202): both programs dispatch on the engine's
+    # serialized collective lane — their per-axis collective order must
+    # match the other lane members' (PR 13 canonical-order contract)
+    lane = {"lane": "engine-collective"}
     return [
-        ("kvstore_stack_sum", _stack_sum, ([a, a],), {}),
-        ("kvstore_bucket_reduce", _bucket_reduce, (((a, b), (a, b)),), {}),
+        ("kvstore_stack_sum", _stack_sum, ([a, a],), {}, lane),
+        ("kvstore_bucket_reduce", _bucket_reduce, (((a, b), (a, b)),), {},
+         lane),
     ]
 
 

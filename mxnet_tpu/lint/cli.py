@@ -53,7 +53,23 @@ def build_parser():
                         "groups, e.g. 'executor kvstore')")
     p.add_argument("--diff", default=None, metavar="GIT_REF",
                    help="lint only .py files changed vs GIT_REF "
-                        "(working tree included) — fast pre-commit mode")
+                        "(working tree included) — fast pre-commit mode; "
+                        "under --trace, re-check only the entry groups "
+                        "whose provider modules changed")
+    p.add_argument("--no-memory", action="store_true",
+                   help="--trace: skip the JX204 memory-budget pass "
+                        "(no compiles; jaxpr rules only)")
+    p.add_argument("--mem-baseline", default=None, metavar="PATH",
+                   help="--trace: memory budget file (default: "
+                        "<repo>/MEM_BASELINE.json)")
+    p.add_argument("--write-mem-baseline", action="store_true",
+                   help="--trace: measure every (selected) program and "
+                        "write the budgets to the mem baseline, then "
+                        "exit 0")
+    p.add_argument("--memory-json", default=None, metavar="PATH",
+                   help="--trace: write the per-program memory report "
+                        "(bytes vs budget) as JSON for trace_report.py "
+                        "--memory/--gate-memory")
     return p
 
 
@@ -99,18 +115,19 @@ def main(argv=None):
 
     root = repo_root()
 
-    if args.trace and args.diff is not None:
-        # the trace tier analyzes whole programs, not files — a silently
-        # ignored --diff would read as "scoped to my changes" when it ran
-        # everything
-        print("graftcheck: --diff applies to the AST tier only "
-              "(trace programs have no file scope); drop one of the two",
-              file=sys.stderr)
-        return 2
-
     if args.trace:
+        # the standalone launcher (tools/graftlint.py) loads this package
+        # by file path, so the repo root is not on sys.path — but trace
+        # providers import mxnet_tpu.* for real
+        if root not in sys.path:
+            sys.path.insert(0, root)
         from . import tracecheck
         entries = None
+        if args.paths and args.diff is not None:
+            print("graftcheck: give entry groups OR --diff, not both "
+                  "(two scopes would silently intersect)",
+                  file=sys.stderr)
+            return 2
         if args.paths:
             known = {g for g, _m in tracecheck.ENTRY_POINTS}
             bad = sorted(set(args.paths) - known)
@@ -120,8 +137,57 @@ def main(argv=None):
                       file=sys.stderr)
                 return 2
             entries = set(args.paths)
-        findings, names = tracecheck.check_entry_points(entries=entries,
-                                                        select=select)
+        elif args.diff is not None:
+            changed = _changed_files(root, args.diff)
+            if changed is None:
+                print("graftlint: git diff against %r failed" % args.diff,
+                      file=sys.stderr)
+                return 2
+            entries = tracecheck.groups_for_paths(changed)
+            if not entries:
+                print("graftcheck: no changed trace providers vs %s"
+                      % args.diff)
+                return 0
+            print("graftcheck: --diff %s -> entry group(s): %s"
+                  % (args.diff, ", ".join(sorted(entries))),
+                  file=sys.stderr)
+        findings, names, mem_report = tracecheck.analyze_entry_points(
+            entries=entries, select=select,
+            memory=not args.no_memory,
+            mem_baseline_path=args.mem_baseline)
+        if args.write_mem_baseline:
+            if mem_report is None:
+                print("graftcheck: --write-mem-baseline needs the memory "
+                      "pass (drop --no-memory / include JX204)",
+                      file=sys.stderr)
+                return 2
+            records = [p for p in mem_report["programs"]]
+            measured = {p["name"]: {k: p[k] for k in
+                                    tracecheck.MEM_FIELDS
+                                    + ("total_bytes", "specimens",
+                                       "digest")}
+                        for p in records}
+            path = args.mem_baseline \
+                or tracecheck.default_mem_baseline_path()
+            prior = tracecheck.load_mem_baseline(path)
+            tracecheck.save_mem_baseline(
+                measured, path=path, prior=prior,
+                scoped_names=set(measured) if entries is not None
+                else None)
+            print("graftcheck: wrote %d memory budget(s) to %s "
+                  "(n_devices=%d)"
+                  % (len(measured), os.path.relpath(path),
+                     mem_report["n_devices"]))
+            return 0
+        if args.memory_json:
+            if mem_report is None:
+                print("graftcheck: --memory-json needs the memory pass "
+                      "(drop --no-memory / include JX204)",
+                      file=sys.stderr)
+                return 2
+            with open(args.memory_json, "w", encoding="utf-8") as f:
+                json.dump(mem_report, f, indent=1, sort_keys=True)
+                f.write("\n")
         scanned = {"trace://%s" % n for n in names} \
             | {f.path for f in findings}
         # the full-run staleness sweep covers entries whose program was
@@ -134,6 +200,16 @@ def main(argv=None):
               "trace(s)): %s"
               % (len(distinct), len(names), ", ".join(distinct)),
               file=sys.stderr)
+        if args.check_baseline and mem_report is not None:
+            # the memory-budget twin of LINT staleness: budgets for
+            # programs that no longer exist rot exactly like stale
+            # suppressions
+            stale_mem = mem_report.get("stale_budgets") or []
+            if stale_mem:
+                print("graftcheck: %d stale memory budget(s) (program "
+                      "gone) — re-run --write-mem-baseline: %s"
+                      % (len(stale_mem), ", ".join(stale_mem)))
+                return 1
     else:
         paths = args.paths or [
             p for p in (os.path.join(repo_root(), d)

@@ -42,6 +42,13 @@ JG007 unbounded-blocking-call `.recv(...)` / queue-ish `.get()` with no
                               hang.  Pass a deadline — or an explicit
                               ``timeout=None`` documenting a deliberate
                               unbounded wait.
+JG008 shard-map-outside-      direct jax shard_map use (import, alias,
+      substrate               or attribute) anywhere but parallel/
+                              mesh.py: the substrate exists because
+                              jax's shard_map API drifts; one module
+                              absorbs the drift, everyone else routes
+                              through mesh.shard_map (ISSUE 16's grep
+                              test, promoted to a rule).
 """
 from __future__ import annotations
 
@@ -875,6 +882,78 @@ def _jg007(mod, facts):
                 "'%s.get()' without a timeout blocks forever when the "
                 "producer dies; pass timeout= (or block=False) — or an "
                 "explicit timeout=None for a deliberate wait" % base)
+
+
+# ---------------------------------------------------------------------------
+# JG008 shard-map-outside-substrate
+# ---------------------------------------------------------------------------
+#
+# ISSUE 16 put every SPMD program on one mesh substrate
+# (mxnet_tpu/parallel/mesh.py) precisely because jax's shard_map surface
+# drifts between releases — the 15 seed failures were exactly this.  The
+# single-substrate invariant was a grep test
+# (test_mesh.py::test_no_shard_map_outside_the_substrate); this is its
+# promotion to a real rule: alias-resolved (``from jax.experimental
+# import shard_map as sm`` does not hide it), suppression-capable, and
+# scoped to everything EXCEPT the substrate module itself.
+
+_JG008_EXEMPT_RE = re.compile(r"(^|/)mxnet_tpu/parallel/mesh\.py$")
+
+
+def _jg008_is_jax_shard_map(qual):
+    if qual is None or not qual.startswith("jax."):
+        return False
+    return qual == "jax.shard_map" \
+        or qual.startswith("jax.experimental.shard_map") \
+        or qual.endswith(".shard_map")
+
+
+@register("JG008", "shard-map-outside-substrate",
+          "direct jax shard_map use outside parallel/mesh.py: the one "
+          "place allowed to track jax's drifting shard_map API is the "
+          "substrate module — route through "
+          "mxnet_tpu.parallel.mesh.shard_map")
+def _jg008(mod, facts):
+    if _JG008_EXEMPT_RE.search(mod.path.replace(os.sep, "/")):
+        return
+    seen_lines = set()
+
+    def fire(node, what):
+        if node.lineno in seen_lines:
+            return None
+        seen_lines.add(node.lineno)
+        return mod.finding(
+            "JG008", node,
+            "%s reaches jax's shard_map surface directly — only "
+            "parallel/mesh.py (the substrate) may; use "
+            "mxnet_tpu.parallel.mesh.shard_map so API drift is "
+            "absorbed in one module" % what)
+
+    for node in ast.walk(mod.tree):
+        f = None
+        if isinstance(node, ast.ImportFrom) and node.level == 0:
+            module = node.module or ""
+            if module.startswith("jax") and (
+                    "shard_map" in module.split(".")
+                    or any(a.name == "shard_map" for a in node.names)):
+                f = fire(node, "import of '%s'" % module)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax") \
+                        and "shard_map" in a.name.split("."):
+                    f = fire(node, "import of '%s'" % a.name)
+                    break
+        elif isinstance(node, (ast.Attribute, ast.Name)):
+            # outermost expression of each attribute chain only; chains
+            # resolve through the alias table, so `sm.shard_map(...)`
+            # after `from jax.experimental import shard_map as sm` is
+            # still caught
+            if not isinstance(parent(node), ast.Attribute):
+                qual = facts.qualname(node)
+                if _jg008_is_jax_shard_map(qual):
+                    f = fire(node, "'%s'" % qual)
+        if f is not None:
+            yield f
 
 
 def _hot_functions(facts):

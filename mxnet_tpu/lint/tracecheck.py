@@ -34,6 +34,39 @@ JX105 retrace-explainer       on a ``watch_jit`` recompile, diff the new
                               telemetry retrace-storm warning into a
                               diagnosis.  Runtime-only (``MXNET_TRACECHECK``).
 
+The JX2xx family (ISSUE 18) adds the SPMD/memory tier — collective
+safety and device-memory budgets proven AOT over the same ledger:
+
+JX201 collective-divergence   a collective (psum/all_gather/ppermute/
+                              all_to_all/reduce_scatter) whose rendezvous
+                              depends on a data-dependent branch: the two
+                              arms of a ``lax.cond`` disagree on their
+                              collective sequence, or a collective sits
+                              inside a ``while`` whose trip count ranks
+                              can disagree on — one rank enters the
+                              collective, its peers never do, the mesh
+                              deadlocks.  The guardian ``jnp.where``-skip
+                              pattern is the clean twin: every rank runs
+                              the same collectives, the *values* branch.
+JX202 collective-order        per-mesh-axis collective sequences must be
+                              identical across programs sharing a lane
+                              (provider ``meta={"lane": ...}``) and must
+                              only touch axes the provider declared
+                              (``meta={"mesh_axes": ...}``) — the PR-13
+                              descending-bucket canonical-order contract
+                              as a proven invariant, not a comment.
+JX203 replication-waste       an ``all_gather`` whose fully-replicated
+                              result is returned as a program/shard_map
+                              output: the sharded producer's bytes are
+                              multiplied by the axis size in HBM — the
+                              accidental gather that blows memory.
+JX204 memory-budget           per-program ``compiled.memory_analysis()``
+                              (argument/output/temp/generated-code bytes)
+                              against the count-keyed MEM_BASELINE.json
+                              with an ``MXNET_MEM_TOLERANCE`` band: a
+                              program growing past budget is a lint-time
+                              finding instead of an OOM at step time.
+
 Two drivers share the registry:
 
 * AOT (``check_entry_points`` / ``tools/graftcheck.py`` /
@@ -60,10 +93,14 @@ import os
 
 from .core import Finding
 
-__all__ = ["TRACE_RULES", "TraceRule", "TraceConfig", "ProgramRecord",
-           "trace_program", "run_rules", "check_entry_points",
-           "iter_owned_programs", "on_compile", "signature",
-           "explain_retrace", "ENTRY_POINTS"]
+__all__ = ["TRACE_RULES", "GROUP_RULES", "TraceRule", "TraceConfig",
+           "ProgramRecord", "trace_program", "run_rules",
+           "run_group_rules", "check_entry_points", "analyze_entry_points",
+           "iter_owned_programs", "groups_for_paths", "on_compile",
+           "signature", "explain_retrace", "ENTRY_POINTS",
+           "collective_sequence", "measure_memory", "mem_tolerance",
+           "load_mem_baseline", "save_mem_baseline",
+           "default_mem_baseline_path", "MEM_FIELDS"]
 # NOTE: the MXNET_TRACECHECK gate itself lives in telemetry.core
 # (_env_tracecheck) — the hook's caller owns the env parsing.
 
@@ -82,13 +119,15 @@ class TraceConfig:
     shrink the thresholds to exercise the rules on toy programs.
     """
 
-    __slots__ = ("const_bytes", "donation_bytes", "passthrough_bytes")
+    __slots__ = ("const_bytes", "donation_bytes", "passthrough_bytes",
+                 "replication_bytes")
 
     def __init__(self, const_bytes=64 << 10, donation_bytes=1 << 20,
-                 passthrough_bytes=64 << 10):
+                 passthrough_bytes=64 << 10, replication_bytes=64 << 10):
         self.const_bytes = const_bytes
         self.donation_bytes = donation_bytes
         self.passthrough_bytes = passthrough_bytes
+        self.replication_bytes = replication_bytes
 
 
 DEFAULT_CONFIG = TraceConfig()
@@ -155,13 +194,18 @@ def _fmt_aval(aval):
 
 
 class ProgramRecord:
-    """One owned program, traced: jaxpr + flat arg labels/avals/donation."""
+    """One owned program, traced: jaxpr + flat arg labels/avals/donation.
+
+    ``lowered`` keeps the AOT lowering so JX204 can compile for
+    ``memory_analysis()`` without re-tracing; ``meta`` carries the
+    provider's sharding metadata (``lane``/``mesh_axes``) for JX202.
+    """
 
     __slots__ = ("name", "origin", "closed_jaxpr", "arg_labels", "in_avals",
-                 "donated", "out_avals")
+                 "donated", "out_avals", "lowered", "meta")
 
     def __init__(self, name, origin, closed_jaxpr, arg_labels, in_avals,
-                 donated, out_avals):
+                 donated, out_avals, lowered=None, meta=None):
         self.name = name
         self.origin = origin
         self.closed_jaxpr = closed_jaxpr
@@ -169,6 +213,8 @@ class ProgramRecord:
         self.in_avals = in_avals
         self.donated = donated            # set of flat arg indices
         self.out_avals = out_avals
+        self.lowered = lowered
+        self.meta = dict(meta or {})
 
     @property
     def jaxpr(self):
@@ -192,11 +238,13 @@ class ProgramRecord:
                        snippet=key or rule)
 
 
-def trace_program(name, fn, args, kwargs=None, origin=""):
+def trace_program(name, fn, args, kwargs=None, origin="", meta=None):
     """Trace *fn* (a jitted callable or its watch_jit wrapper) with
     ShapeDtypeStruct skeletons of *args*/*kwargs* and return the
     :class:`ProgramRecord` the JX rules analyze.  Nothing is compiled or
     executed; lowering metadata supplies per-argument donation flags.
+    (JX204 compiles *later*, from the kept lowering, only when a memory
+    budget is actually being checked.)
     """
     import jax
     kwargs = dict(kwargs or {})
@@ -224,7 +272,8 @@ def trace_program(name, fn, args, kwargs=None, origin=""):
 
     return ProgramRecord(name, origin, closed, labels,
                          list(closed.in_avals), donated,
-                         list(closed.out_avals))
+                         list(closed.out_avals), lowered=lowered,
+                         meta=meta)
 
 
 def _iter_eqns(jaxpr):
@@ -251,6 +300,73 @@ def _extract_jaxprs(val):
     elif isinstance(val, (tuple, list)):
         for item in val:
             yield from _extract_jaxprs(item)
+
+
+def _all_jaxprs(jaxpr):
+    """*jaxpr* and every nested sub-jaxpr, each as its own scope (JX203
+    needs per-scope outvars, not just the flat eqn stream)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for sub in _sub_jaxprs(eqn):
+            yield from _all_jaxprs(sub)
+
+
+# ---------------------------------------------------------------------------
+# collective extraction (shared by JX201/JX202/JX203)
+# ---------------------------------------------------------------------------
+
+# jaxpr-level cross-rank primitives.  GSPMD-inserted collectives (from
+# jit out_shardings) are out of scope on purpose: the partitioner emits
+# them uniformly on every rank — divergence risk lives in hand-written
+# shard_map bodies, which is exactly what lowers to these primitives.
+_COLLECTIVE_PRIMS = {"psum", "psum2", "pmax", "pmin", "all_gather",
+                     "all_to_all", "reduce_scatter", "psum_scatter",
+                     "ppermute", "pshuffle", "axis_index"}
+# psum2: what shard_map's replication checker rewrites psum into — the
+# same all-reduce rendezvous under a different primitive name.
+# axis_index is rank-local (no rendezvous): tracked for JX202's declared-
+# axis check but excluded from order/divergence sequences.
+_RENDEZVOUS_PRIMS = _COLLECTIVE_PRIMS - {"axis_index"}
+
+
+def _collective_axes(eqn):
+    """Named mesh axes a collective eqn communicates over.  ``psum``
+    carries ``axes``, the permute/gather family ``axis_name``; positional
+    (int) axes are vmap-internal, not cross-rank, and are dropped.  An
+    empty result means no communication (e.g. ``psum(x, axes=())``)."""
+    axes = eqn.params.get("axes")
+    if axes is None:
+        axes = eqn.params.get("axis_name")
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes if not isinstance(a, int))
+
+
+def _collectives_in(jaxpr):
+    """Ordered ``(primitive, axes)`` rendezvous sequence of *jaxpr*
+    (nested scopes included, eqn order — the order ranks meet in)."""
+    out = []
+    for eqn in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if prim in _RENDEZVOUS_PRIMS:
+            axes = _collective_axes(eqn)
+            if axes:
+                # one rendezvous, two spellings: sequences must compare
+                # equal whether or not the rep-checker rewrote the prim
+                out.append(("psum" if prim == "psum2" else prim, axes))
+    return tuple(out)
+
+
+def collective_sequence(record):
+    """Per-mesh-axis ordered collective op sequence of a program —
+    ``{"pipe": ("ppermute", "psum"), ...}`` — the JX202 comparison key."""
+    seq = {}
+    for prim, axes in _collectives_in(record.jaxpr):
+        for axis in axes:
+            seq.setdefault(axis, []).append(prim)
+    return {axis: tuple(ops) for axis, ops in seq.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -421,6 +537,426 @@ def _jx104(rec, cfg):
 
 
 # ---------------------------------------------------------------------------
+# JX201 collective-divergence
+# ---------------------------------------------------------------------------
+
+def _branch_label(i, n):
+    if n == 2:
+        return ("false-branch", "true-branch")[i]
+    return "branch %d" % i
+
+
+@trace_rule("JX201", "collective-divergence",
+            "a collective under a data-dependent branch: lax.cond arms "
+            "that disagree on their collective sequence, or a collective "
+            "inside a while whose trip count ranks can disagree on — one "
+            "rank enters the rendezvous, its peers never do, the mesh "
+            "deadlocks; branch the VALUES with jnp.where instead")
+def _jx201(rec, cfg):
+    # Conservative on purpose: a cond predicate we could prove uniform
+    # across ranks would be safe, but nothing at the jaxpr level proves
+    # uniformity — suppress/baseline the (rare) justified case.
+    for eqn in _iter_eqns(rec.jaxpr):
+        prim = eqn.primitive.name
+        if prim == "cond":
+            branches = eqn.params.get("branches", ())
+            sigs = [_collectives_in(br) for br in _extract_jaxprs(
+                tuple(branches))]
+            if len(set(sigs)) <= 1:
+                continue          # all arms rendezvous identically: safe
+            parts = []
+            for i, sig in enumerate(sigs):
+                shown = ",".join("%s@%s" % (p, "/".join(a))
+                                 for p, a in sig) or "none"
+                parts.append("%s: %s" % (_branch_label(i, len(sigs)),
+                                         shown))
+            yield rec.finding(
+                "JX201",
+                "lax.cond arms disagree on their collective sequence "
+                "(%s) — a data-dependent predicate lets ranks take "
+                "different arms and deadlock on the missing rendezvous; "
+                "run the collective unconditionally and jnp.where the "
+                "values" % "; ".join(parts),
+                key="cond-divergence")
+        elif prim == "while":
+            colls = []
+            for pkey in ("cond_jaxpr", "body_jaxpr"):
+                sub = eqn.params.get(pkey)
+                if sub is not None:
+                    for j in _extract_jaxprs(sub):
+                        colls.extend(_collectives_in(j))
+            if not colls:
+                continue
+            shown = ",".join("%s@%s" % (p, "/".join(a))
+                             for p, a in colls)
+            yield rec.finding(
+                "JX201",
+                "collective(s) %s inside a lax.while_loop: the trip "
+                "count is data-dependent by construction, so ranks can "
+                "run the rendezvous a different number of times and "
+                "deadlock — use a static-length scan (mask the tail) or "
+                "hoist the collective out of the loop" % shown,
+                key="while-collective")
+
+
+# ---------------------------------------------------------------------------
+# JX202 collective-order (per-record declared-axis check + lane groups)
+# ---------------------------------------------------------------------------
+
+@trace_rule("JX202", "collective-order",
+            "per-mesh-axis collective sequences must match across "
+            "programs sharing a lane and stay on the axes the provider "
+            "declared — the canonical reduction order (PR 13) as a "
+            "proven invariant")
+def _jx202(rec, cfg):
+    declared = rec.meta.get("mesh_axes")
+    if declared is None:
+        return
+    declared = {str(a) for a in declared}
+    seen = set()
+    for eqn in _iter_eqns(rec.jaxpr):
+        if eqn.primitive.name not in _COLLECTIVE_PRIMS:
+            continue
+        for axis in _collective_axes(eqn):
+            if axis in declared or axis in seen:
+                continue
+            seen.add(axis)
+            yield rec.finding(
+                "JX202",
+                "'%s' communicates over mesh axis '%s' which the "
+                "provider did not declare (mesh_axes=%s) — an "
+                "undeclared axis is invisible to the lane-order "
+                "contract; declare it or drop the collective"
+                % (eqn.primitive.name, axis, sorted(declared)),
+                key="undeclared-axis:%s" % axis)
+
+
+GROUP_RULES = {}
+
+
+def _group_rule(code):
+    def deco(fn):
+        GROUP_RULES[code] = fn
+        return fn
+    return deco
+
+
+@_group_rule("JX202")
+def _jx202_group(records, cfg):
+    """Cross-program half of JX202: programs sharing a provider-declared
+    ``lane`` run concurrently on the same serialized collective stream,
+    so their per-axis collective sequences must be identical — two
+    members disagreeing on order is the classic cross-program deadlock
+    (rank A runs program P's psum while rank B runs program Q's
+    ppermute).  Today's lane members are collective-free or identical;
+    the rule is the tripwire for drift."""
+    lanes = {}
+    for rec in records:
+        lane = rec.meta.get("lane")
+        if lane:
+            lanes.setdefault(lane, []).append(rec)
+    for lane in sorted(lanes):
+        recs = lanes[lane]
+        if len(recs) < 2:
+            continue
+        ref, ref_seq = recs[0], collective_sequence(recs[0])
+        for rec in recs[1:]:
+            seq = collective_sequence(rec)
+            axes = sorted(set(ref_seq) | set(seq))
+            for axis in axes:
+                if ref_seq.get(axis, ()) == seq.get(axis, ()):
+                    continue
+                yield rec.finding(
+                    "JX202",
+                    "lane '%s' collective order diverges from '%s' on "
+                    "axis '%s': %s vs %s — concurrent programs on one "
+                    "lane must rendezvous in one canonical order"
+                    % (lane, ref.name, axis,
+                       list(seq.get(axis, ())),
+                       list(ref_seq.get(axis, ()))),
+                    key="lane-order:%s:%s" % (lane, axis))
+
+
+# ---------------------------------------------------------------------------
+# JX203 replication-waste
+# ---------------------------------------------------------------------------
+
+# ops that forward a gathered value unchanged (same bytes, new var)
+_TRANSPARENT_PRIMS = {"convert_element_type", "reshape", "transpose",
+                      "squeeze", "expand_dims", "copy", "stop_gradient",
+                      "rev"}
+
+
+@trace_rule("JX203", "replication-waste",
+            "an all_gather whose fully-replicated result is returned as "
+            "a program output: the sharded producer's bytes are "
+            "multiplied by the axis size in HBM — keep the output "
+            "sharded (out_specs) or reduce before returning")
+def _jx203(rec, cfg):
+    for jaxpr in _all_jaxprs(rec.jaxpr):
+        gathered = {}          # id(var) -> (axes, nbytes)
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in ("all_gather", "all_gather_invariant"):
+                axes = _collective_axes(eqn)
+                if not axes:
+                    continue
+                for ov in eqn.outvars:
+                    gathered[id(ov)] = (axes, _aval_nbytes(ov.aval))
+            elif prim in _TRANSPARENT_PRIMS and eqn.invars \
+                    and id(eqn.invars[0]) in gathered:
+                axes, _n = gathered[id(eqn.invars[0])]
+                for ov in eqn.outvars:
+                    gathered[id(ov)] = (axes, _aval_nbytes(ov.aval))
+        seen = set()
+        for k, var in enumerate(jaxpr.outvars):
+            info = gathered.get(id(var))
+            if info is None or id(var) in seen:
+                continue
+            seen.add(id(var))
+            axes, nbytes = info
+            if nbytes < cfg.replication_bytes:
+                continue
+            yield rec.finding(
+                "JX203",
+                "output #%d (%s, %d bytes) is an all_gather over axis "
+                "%s returned fully replicated — every rank materializes "
+                "the whole array; shard the output spec or reduce "
+                "before returning"
+                % (k, _fmt_aval(getattr(var, "aval", None)), nbytes,
+                   "/".join(axes)),
+                key="gathered-output:%s" % "/".join(axes))
+
+
+# ---------------------------------------------------------------------------
+# JX204 memory-budget (driver-level: needs compile + MEM_BASELINE.json)
+# ---------------------------------------------------------------------------
+
+MEM_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+              "generated_code_bytes", "alias_bytes")
+# the budgeted figure: alias bytes are savings, not spend
+_MEM_TOTAL_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+                     "generated_code_bytes")
+
+TRACE_RULES["JX204"] = TraceRule(
+    "JX204", "memory-budget",
+    "per-program compiled.memory_analysis() bytes (argument/output/temp/"
+    "generated-code) vs the count-keyed MEM_BASELINE.json budget with an "
+    "MXNET_MEM_TOLERANCE band — growth past budget is a lint-time "
+    "finding, not an OOM at step time (driver tier: needs a compile)",
+    None)
+
+
+def default_mem_baseline_path():
+    from .core import repo_root
+    return os.path.join(repo_root(), "MEM_BASELINE.json")
+
+
+def mem_tolerance(default=0.25):
+    """The MXNET_MEM_TOLERANCE fractional band (0.25 = +25% headroom).
+    Parsed per call — this only runs in the AOT driver and on compile
+    events, never on the step path."""
+    # driver/compile-event tier only, never the step path; a fresh read
+    # per check lets tests and CI move the band without process restarts
+    raw = os.environ.get("MXNET_MEM_TOLERANCE", "")  # graftlint: disable=JG006
+    try:
+        val = float(raw) if raw else default
+    except ValueError:
+        return default
+    return val if val >= 0 else default
+
+
+# byte jitter floor: sub-4KiB drift on tiny specimens is allocator noise,
+# not a regression — the tolerance band is fractional, this is absolute
+_MEM_SLACK_BYTES = 4096
+
+
+def record_digest(rec):
+    """Stable identity of a specimen's trace signature (in/out avals).
+    Budgets are per-specimen: the runtime hook only compares a compile
+    whose signature matches what the budget was captured from."""
+    import hashlib
+    sig = ";".join(_fmt_aval(a) for a in rec.in_avals) + "->" + \
+        ";".join(_fmt_aval(a) for a in rec.out_avals)
+    return hashlib.sha1(sig.encode("utf-8")).hexdigest()[:12]
+
+
+def measure_memory(rec):
+    """Compile *rec*'s kept lowering and return its memory_analysis()
+    byte fields, or None when the backend cannot report them."""
+    if rec.lowered is None:
+        return None
+    try:
+        ma = rec.lowered.compile().memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for field in MEM_FIELDS:
+        xla_name = field.replace("_bytes", "_size_in_bytes")
+        try:
+            out[field] = int(getattr(ma, xla_name))
+        except (AttributeError, TypeError, ValueError):
+            out[field] = 0
+    out["total_bytes"] = sum(out[f] for f in _MEM_TOTAL_FIELDS)
+    return out
+
+
+def measure_programs(records):
+    """Aggregate measured memory per program NAME (count-keyed: a name
+    traced from k specimens sums its bytes and records ``specimens: k``
+    so dropping a specimen is as visible as growing one).  Returns
+    ``{name: entry}``; an unmeasurable specimen is recorded with
+    ``measured: False`` rather than silently skipped."""
+    import hashlib
+    out = {}
+    for rec in records:
+        entry = out.setdefault(rec.name, dict(
+            {f: 0 for f in MEM_FIELDS}, total_bytes=0, specimens=0,
+            measured=True, digests=[]))
+        entry["specimens"] += 1
+        entry["digests"].append(record_digest(rec))
+        m = measure_memory(rec)
+        if m is None:
+            entry["measured"] = False
+            continue
+        for f in MEM_FIELDS:
+            entry[f] += m[f]
+        entry["total_bytes"] += m["total_bytes"]
+    for entry in out.values():
+        digest = hashlib.sha1(
+            ",".join(sorted(entry.pop("digests"))).encode()).hexdigest()
+        entry["digest"] = digest[:12]
+    return out
+
+
+def _device_count():
+    import jax
+    return len(jax.devices())
+
+
+def load_mem_baseline(path=None):
+    """MEM_BASELINE.json -> dict, or None when absent/unreadable."""
+    path = path or default_mem_baseline_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload.get("programs"), dict):
+        return None
+    return payload
+
+
+def save_mem_baseline(measured, path=None, n_devices=None, prior=None,
+                      scoped_names=None):
+    """Write *measured* (from :func:`measure_programs`) as the budget.
+    A scoped run (``--diff``/entry groups) merges: names outside
+    *scoped_names* keep their prior entries untouched, exactly like the
+    LINT baseline's out-of-scope preservation."""
+    path = path or default_mem_baseline_path()
+    programs = {}
+    if prior and scoped_names is not None:
+        programs.update({k: v for k, v in prior.get("programs", {}).items()
+                         if k not in scoped_names})
+    programs.update(measured)
+    payload = {"version": 1,
+               "n_devices": int(n_devices if n_devices is not None
+                                else _device_count()),
+               "tolerance": mem_tolerance(),
+               "programs": {k: programs[k] for k in sorted(programs)}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return payload
+
+
+def check_memory(records, baseline=None, tolerance=None, full=True):
+    """JX204 over measured *records* vs *baseline* (a loaded
+    MEM_BASELINE payload).  Returns ``(findings, report)`` where report
+    is the stdlib-renderable dict ``trace_report.py --memory`` consumes.
+
+    Topology honesty: memory bytes are a function of the device count
+    the specimens lower against (conftest pins 8 virtual CPU devices);
+    when the live topology differs from the baseline's, comparison is
+    SKIPPED and the report says so — a gate that cannot measure must
+    fail loudly downstream (``--gate-memory`` exits 4), never drift."""
+    tol = mem_tolerance() if tolerance is None else tolerance
+    n_dev = _device_count()
+    measured = measure_programs(records)
+    base_progs = (baseline or {}).get("programs", {})
+    base_dev = (baseline or {}).get("n_devices")
+    topology_match = baseline is not None and int(base_dev or 0) == n_dev
+    findings = []
+    report_programs = []
+    by_name = {}
+    for rec in records:
+        by_name.setdefault(rec.name, rec)
+    for name in sorted(measured):
+        entry = dict(measured[name])
+        rec = by_name[name]
+        budget = base_progs.get(name) if topology_match else None
+        entry.update(name=name, origin=rec.origin,
+                     budget_total_bytes=None, over_budget=False,
+                     unbudgeted=False)
+        if not entry.pop("measured"):
+            entry["unbudgeted"] = True
+            findings.append(rec.finding(
+                "JX204", "program could not be compiled for "
+                "memory_analysis() — the budget gate cannot see it",
+                key="mem:unmeasurable"))
+        elif baseline is None or (topology_match and budget is None):
+            entry["unbudgeted"] = True
+            findings.append(rec.finding(
+                "JX204",
+                "no memory budget for this program in MEM_BASELINE.json "
+                "— every owned program is born budgeted; run "
+                "graftcheck --write-mem-baseline", key="mem:unbudgeted"))
+        elif budget is not None:
+            if int(budget.get("specimens", 1)) != entry["specimens"]:
+                findings.append(rec.finding(
+                    "JX204",
+                    "specimen count changed (%d budgeted, %d traced) — "
+                    "the budget no longer describes this program; "
+                    "re-run --write-mem-baseline"
+                    % (int(budget.get("specimens", 1)),
+                       entry["specimens"]), key="mem:specimens"))
+            b_total = int(budget.get("total_bytes", 0))
+            limit = b_total + max(int(b_total * tol), _MEM_SLACK_BYTES)
+            entry["budget_total_bytes"] = b_total
+            if entry["total_bytes"] > limit:
+                entry["over_budget"] = True
+                deltas = ", ".join(
+                    "%s %+d" % (f, entry[f] - int(budget.get(f, 0)))
+                    for f in _MEM_TOTAL_FIELDS
+                    if entry[f] != int(budget.get(f, 0)))
+                findings.append(rec.finding(
+                    "JX204",
+                    "memory over budget: %d bytes vs %d budgeted "
+                    "(+%d%% tolerance -> limit %d) [%s] — an HBM "
+                    "regression caught at lint time; shrink the program "
+                    "or re-budget deliberately with --write-mem-baseline"
+                    % (entry["total_bytes"], b_total, int(tol * 100),
+                       limit, deltas or "same fields"),
+                    key="mem:over"))
+        report_programs.append(entry)
+    stale = []
+    if topology_match and full:
+        stale = sorted(set(base_progs) - set(measured))
+    report = {"schema": "memcheck-v1", "n_devices": n_dev,
+              "tolerance": tol,
+              "baseline_n_devices": base_dev,
+              "baseline_present": baseline is not None,
+              "topology_match": bool(topology_match),
+              "stale_budgets": stale,
+              "programs": report_programs}
+    return findings, report
+
+
+# ---------------------------------------------------------------------------
 # JX105 retrace-explainer (runtime-only; registered for the catalogue)
 # ---------------------------------------------------------------------------
 
@@ -519,6 +1055,19 @@ def run_rules(record, select=None, config=None):
     return findings
 
 
+def run_group_rules(records, select=None, config=None):
+    """The cross-program rules (JX202 lane order): per-record checks
+    cannot see two programs at once, so the driver hands the whole
+    record set over after tracing."""
+    cfg = config or DEFAULT_CONFIG
+    findings = []
+    for code in sorted(GROUP_RULES):
+        if select is not None and code not in select:
+            continue
+        findings.extend(GROUP_RULES[code](records, cfg))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # AOT driver over the owned entry points
 # ---------------------------------------------------------------------------
@@ -563,10 +1112,14 @@ def iter_owned_programs(entries=None):
                 "entry point provider %s failed: %r" % (modpath, exc),
                 snippet="provider:%s" % group)
             continue
-        for name, fn, args, kwargs in programs:
+        for spec in programs:
+            # 4-tuple (name, fn, args, kwargs) or 5-tuple with a trailing
+            # sharding-metadata dict ({"lane": ..., "mesh_axes": ...})
+            name, fn, args, kwargs = spec[:4]
+            meta = spec[4] if len(spec) > 4 else None
             try:
                 yield group, trace_program(name, fn, args, kwargs,
-                                           origin=origin)
+                                           origin=origin, meta=meta)
             except Exception as exc:
                 yield group, Finding(
                     "JX000", "trace://%s" % name, 0, 0,
@@ -574,17 +1127,56 @@ def iter_owned_programs(entries=None):
                     snippet="trace:%s" % name)
 
 
-def check_entry_points(entries=None, select=None, config=None):
-    """Run the JX rules over every owned program; returns (findings,
-    program_names) — names prove coverage to the CI gate."""
-    findings, names = [], []
+def groups_for_paths(paths):
+    """Map changed repo-relative .py paths onto the ENTRY_POINTS groups
+    they provide — the ``--diff`` scope for the trace tier.  A change to
+    the analyzer itself (``mxnet_tpu/lint/``) dirties every group: the
+    rules changed, so every verdict did."""
+    norm = {p.replace(os.sep, "/") for p in paths}
+    if any(p.startswith("mxnet_tpu/lint/") for p in norm):
+        return {g for g, _m in ENTRY_POINTS}
+    hit = set()
+    for group, modpath in ENTRY_POINTS:
+        mod_file = modpath.replace(".", "/") + ".py"
+        pkg_init = modpath.replace(".", "/") + "/__init__.py"
+        if mod_file in norm or pkg_init in norm:
+            hit.add(group)
+    return hit
+
+
+def analyze_entry_points(entries=None, select=None, config=None,
+                         memory=True, mem_baseline_path=None):
+    """The full JX driver: trace every owned program, run the
+    per-record rules, the cross-program lane rules, and (when *memory*)
+    the JX204 budget comparison.  Returns ``(findings, names,
+    mem_report)`` — mem_report is None when the memory pass was skipped
+    or JX204 deselected."""
+    findings, names, records = [], [], []
     for _group, item in iter_owned_programs(entries):
         if isinstance(item, Finding):
             findings.append(item)
             continue
         names.append(item.name)
+        records.append(item)
         findings.extend(run_rules(item, select=select, config=config))
+    findings.extend(run_group_rules(records, select=select, config=config))
+    mem_report = None
+    if memory and (select is None or "JX204" in select):
+        baseline = load_mem_baseline(mem_baseline_path)
+        mem_findings, mem_report = check_memory(
+            records, baseline, full=entries is None)
+        findings.extend(mem_findings)
     findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    return findings, names, mem_report
+
+
+def check_entry_points(entries=None, select=None, config=None,
+                       memory=True, mem_baseline_path=None):
+    """Run the JX rules over every owned program; returns (findings,
+    program_names) — names prove coverage to the CI gate."""
+    findings, names, _mem = analyze_entry_points(
+        entries=entries, select=select, config=config, memory=memory,
+        mem_baseline_path=mem_baseline_path)
     return findings, names
 
 
@@ -593,11 +1185,51 @@ def check_entry_points(entries=None, select=None, config=None):
 # ---------------------------------------------------------------------------
 
 _SIG_HISTORY = {}    # (watch name, id(jit)) -> [signature, ...] (last 8)
+_SEQ_HISTORY = {}    # (watch name, id(jit)) -> first variant's per-axis seq
+_MEM_BASELINE_CACHE = []   # [payload-or-None], loaded once per process
 _RUNTIME_CONFIG = DEFAULT_CONFIG
 
 
 def reset_runtime():
     _SIG_HISTORY.clear()
+    _SEQ_HISTORY.clear()
+    del _MEM_BASELINE_CACHE[:]
+
+
+def _runtime_spmd_checks(name, fn, record):
+    """The JX2xx runtime slice: JX202 across a program's own compiled
+    variants (two variants of one watch name disagreeing on collective
+    order is the same lane hazard, caught live), and JX204 only when the
+    compile's trace signature matches the digest its budget was captured
+    from — a real model compiling under the same watch name is a
+    different program and must not be judged by the specimen's budget
+    (or pay a second compile)."""
+    findings = []
+    key = (name, id(fn))
+    seq = collective_sequence(record)
+    prev = _SEQ_HISTORY.setdefault(key, seq)
+    if prev is not seq and prev != seq:
+        findings.append(record.finding(
+            "JX202",
+            "compiled variant changed the collective order: %s vs the "
+            "first variant's %s — variants of one program must "
+            "rendezvous in one canonical order"
+            % ({a: list(s) for a, s in sorted(seq.items())},
+               {a: list(s) for a, s in sorted(prev.items())}),
+            key="variant-order"))
+    if not _MEM_BASELINE_CACHE:
+        _MEM_BASELINE_CACHE.append(load_mem_baseline())
+    baseline = _MEM_BASELINE_CACHE[0]
+    if baseline is not None:
+        budget = baseline.get("programs", {}).get(record.name)
+        if budget is not None \
+                and int(baseline.get("n_devices", 0)) == _device_count() \
+                and int(budget.get("specimens", 1)) == 1 \
+                and budget.get("digest") == record_digest(record):
+            mem_findings, _report = check_memory(
+                [record], baseline, full=False)
+            findings.extend(mem_findings)
+    return findings
 
 
 def on_compile(name, fn, args, kwargs):
@@ -631,6 +1263,7 @@ def on_compile(name, fn, args, kwargs):
     try:
         record = trace_program(name, fn, args, kwargs)
         findings.extend(run_rules(record, config=_RUNTIME_CONFIG))
+        findings.extend(_runtime_spmd_checks(name, fn, record))
     except Exception:
         pass                   # analysis must never break a step
     _book(findings)

@@ -278,8 +278,10 @@ def tracecheck_programs():
     step = make_train_step(cfg, mesh, lr=0.1)
     step_z, momenta = make_train_step_zero1(cfg, mesh, params, lr=0.1)
     _TRACECHECK_KEEPALIVE.append((params, momenta, tokens, labels))
+    axes = {"mesh_axes": ("data", "seq", "model")}
     return [
-        ("transformer_train_step", step, (params, tokens, labels), {}),
+        ("transformer_train_step", step, (params, tokens, labels), {},
+         axes),
         ("transformer_train_step_zero1", step_z,
-         (params, momenta, tokens, labels), {}),
+         (params, momenta, tokens, labels), {}, axes),
     ]

@@ -253,8 +253,12 @@ def tracecheck_programs():
     c = jax.ShapeDtypeStruct((4096,), jnp.float32)
     buf = jax.ShapeDtypeStruct((8192,), jnp.float32)
     lo = jax.ShapeDtypeStruct((), jnp.int32)
-    return [("collective_chunk_sum", _chunk_sum, ((c, c),), {}),
-            ("collective_chunk_write", _chunk_write, (buf, c, lo), {})]
+    # sharding metadata (JX202): the chunk programs share the engine's
+    # serialized collective lane with the kvstore reducers
+    lane = {"lane": "engine-collective"}
+    return [("collective_chunk_sum", _chunk_sum, ((c, c),), {}, lane),
+            ("collective_chunk_write", _chunk_write, (buf, c, lo), {},
+             lane)]
 
 
 def _streams(device):

@@ -146,4 +146,5 @@ def tracecheck_programs():
     prog = _pipeline_program(_tracecheck_stage, stage_params, mesh, m,
                              "pipe")
     micro = jax.ShapeDtypeStruct((m, 4, 8), jnp.float32)
-    return [("pipeline_apply", prog, (stage_params, micro), {})]
+    return [("pipeline_apply", prog, (stage_params, micro), {},
+             {"mesh_axes": ("pipe",)})]

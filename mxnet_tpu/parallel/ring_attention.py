@@ -152,4 +152,5 @@ def tracecheck_programs():
     prog = _ring_program(mesh, "seq", True, None)
     s = 4 * mesh.shape["seq"]
     q = jax.ShapeDtypeStruct((2, 2, s, 8), jnp.float32)
-    return [("ring_attention", prog, (q, q, q), {})]
+    return [("ring_attention", prog, (q, q, q), {},
+             {"mesh_axes": ("seq",)})]

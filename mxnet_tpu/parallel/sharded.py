@@ -343,10 +343,11 @@ def tracecheck_programs():
 
     fwd_prog = mesh_mod.jit_sharded(fwd, "sharded_forward")
     _TRACECHECK_KEEPALIVE.append(st)
+    axes = {"mesh_axes": (st._batch_axis,)}
     return [
         ("sharded_train_step", step,
          (st.params, st.states, st.aux, data, label, key, lrs, wds, ts),
-         {}),
+         {}, axes),
         ("sharded_forward", fwd_prog,
-         (st.params, st.aux, data, key), {}),
+         (st.params, st.aux, data, key), {}, axes),
     ]

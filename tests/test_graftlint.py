@@ -335,6 +335,63 @@ def test_jg007_scoped_to_dist_engine_serving():
                      {"JG007"}) == ["JG007", "JG007"]
 
 
+# ---------------------------------------------------------------------------
+# JG008 shard-map-outside-substrate
+# ---------------------------------------------------------------------------
+
+def test_jg008_fires_on_shard_map_import_forms():
+    assert codes("""
+    from jax.experimental.shard_map import shard_map
+    """, {"JG008"}) == ["JG008"]
+    assert codes("""
+    from jax.experimental import shard_map
+    """, {"JG008"}) == ["JG008"]
+    assert codes("""
+    import jax.experimental.shard_map as shmap
+    """, {"JG008"}) == ["JG008"]
+
+
+def test_jg008_fires_on_attribute_use():
+    src = """
+    import jax
+
+    def split(fn, mesh, specs):
+        return jax.experimental.shard_map.shard_map(
+            fn, mesh=mesh, in_specs=specs, out_specs=specs)
+    """
+    assert codes(src, {"JG008"}) == ["JG008"]
+
+
+def test_jg008_quiet_on_the_substrate_wrapper():
+    # the blessed spelling: every caller goes through parallel/mesh.py
+    src = """
+    from mxnet_tpu.parallel import mesh as mesh_mod
+
+    def split(fn, mesh, specs):
+        return mesh_mod.shard_map(fn, mesh=mesh, in_specs=specs,
+                                  out_specs=specs)
+    """
+    assert codes(src, {"JG008"}) == []
+
+
+def test_jg008_exempt_inside_parallel_mesh():
+    """parallel/mesh.py IS the substrate: the one module allowed to
+    touch jax's shard_map surface."""
+    src = """
+    from jax.experimental.shard_map import shard_map
+    """
+    assert _codes_at(src, "mxnet_tpu/parallel/mesh.py", {"JG008"}) == []
+    assert _codes_at(src, "mxnet_tpu/parallel/sharded.py",
+                     {"JG008"}) == ["JG008"]
+
+
+def test_jg008_inline_suppression():
+    src = """
+    from jax.experimental.shard_map import shard_map  # graftlint: disable=JG008
+    """
+    assert codes(src, {"JG008"}) == []
+
+
 def test_jg007_repo_has_no_unannotated_blocking_calls():
     """The tentpole burn-down: every remaining unbounded wait in the
     dist/engine/serving tier is either deadline-bounded, an explicit
@@ -425,7 +482,7 @@ def test_baseline_round_trip(tmp_path):
 
 def test_every_rule_registered_with_rationale():
     assert set(RULES) == {"JG001", "JG002", "JG003", "JG004", "JG005",
-                          "JG006", "JG007"}
+                          "JG006", "JG007", "JG008"}
     for rule in RULES.values():
         assert rule.name and rule.rationale
 
@@ -903,9 +960,79 @@ def test_diff_mode_catches_untracked_files(tmp_path, monkeypatch):
     assert paths == {"mxnet_tpu/brand_new.py"}
 
 
-def test_trace_rejects_diff_as_usage_error(capsys):
-    """--trace analyzes whole programs, not files; silently ignoring
-    --diff would read as 'scoped to my changes' when it ran everything."""
-    rc, _out = _run_cli(["--trace", "--diff", "HEAD"])
+def test_trace_rejects_paths_plus_diff_as_usage_error(capsys):
+    """Two scopes (entry groups AND --diff) would silently intersect —
+    the CLI must refuse rather than guess."""
+    rc, _out = _run_cli(["--trace", "--diff", "HEAD", "guardian"])
     assert rc == 2
-    assert "AST tier only" in capsys.readouterr().err
+    assert "OR --diff" in capsys.readouterr().err
+
+
+def test_groups_for_paths_maps_providers_to_entry_groups():
+    from mxnet_tpu.lint import tracecheck
+    assert tracecheck.groups_for_paths(["mxnet_tpu/guardian.py"]) \
+        == {"guardian"}
+    assert tracecheck.groups_for_paths(
+        ["mxnet_tpu/models/transformer.py", "README.md"]) \
+        == {"transformer"}
+    assert tracecheck.groups_for_paths(["docs/LINT.md"]) == set()
+    # a change to the analyzer itself dirties every verdict
+    assert tracecheck.groups_for_paths(["mxnet_tpu/lint/tracecheck.py"]) \
+        == {g for g, _m in tracecheck.ENTRY_POINTS}
+
+
+def _tmp_trace_repo(tmp_path):
+    """A throwaway git repo whose file layout mirrors the provider
+    paths groups_for_paths keys on (content never imported — the trace
+    tier loads the REAL modules; only the diff scoping is under test)."""
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    _git(tmp_path, "init", "-q")
+    (pkg / "guardian.py").write_text("# provider stand-in\n")
+    (tmp_path / "README.md").write_text("seed\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    return pkg
+
+
+def test_trace_diff_scopes_to_changed_providers(tmp_path, monkeypatch,
+                                                capsys):
+    """--diff parity for the trace tier: a working-tree edit to a
+    provider module re-checks exactly that entry group's programs."""
+    from mxnet_tpu.lint import cli
+    pkg = _tmp_trace_repo(tmp_path)
+    monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+
+    (pkg / "guardian.py").write_text("# provider stand-in, edited\n")
+    rc, _out = _run_cli(["--trace", "--diff", "HEAD", "--no-baseline"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "entry group(s): guardian" in err
+    assert "guardian_verdict" in err          # the group's program ran
+    assert "transformer_train_step" not in err  # out-of-scope group didn't
+
+
+def test_trace_diff_with_no_changed_providers_is_clean_noop(
+        tmp_path, monkeypatch, capsys):
+    """An edit that touches no provider (docs, README) exits 0 with an
+    explicit 'nothing to trace' note — NOT a full sweep, NOT an error."""
+    from mxnet_tpu.lint import cli
+    _tmp_trace_repo(tmp_path)
+    monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+
+    (tmp_path / "README.md").write_text("edited\n")
+    rc, out = _run_cli(["--trace", "--diff", "HEAD", "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    assert "no changed trace providers" in out
+
+
+def test_trace_diff_bad_ref_is_usage_error(tmp_path, monkeypatch,
+                                           capsys):
+    from mxnet_tpu.lint import cli
+    _tmp_trace_repo(tmp_path)
+    monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+    rc, _out = _run_cli(["--trace", "--diff", "no-such-ref",
+                         "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 2

@@ -56,3 +56,21 @@ def test_no_naked_jit_in_mxnet_tpu():
     assert not findings, (
         "naked jax.jit sites (wrap in telemetry.watch_jit):\n"
         + "\n".join(f.format_text() for f in findings))
+
+
+def test_jg002_baseline_fully_burned_down():
+    """ISSUE 18 satellite: the standalone tools/examples JG002 debt is
+    paid — zero JG002 entries remain in LINT_BASELINE.json and the scan
+    roots produce none outside justified inline suppressions.  The
+    baseline only ever shrinks; this pins the shrink."""
+    import json
+    with open(default_baseline_path()) as f:
+        entries = json.load(f)["entries"]
+    burned = [e for e in entries if e["rule"] == "JG002"]
+    assert burned == [], (
+        "JG002 re-entered the baseline (wrap the jit in watch_jit "
+        "instead): %s" % [e["path"] for e in burned])
+    findings = lint_paths(SCAN_ROOTS, select={"JG002"}, rel_root=REPO)
+    assert not findings, (
+        "un-suppressed naked jax.jit sites:\n"
+        + "\n".join(f.format_text() for f in findings))

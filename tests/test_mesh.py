@@ -211,12 +211,30 @@ def test_no_shard_map_outside_the_substrate():
                 path = os.path.join(dirpath, fname)
                 if path.endswith(os.path.join("parallel", "mesh.py")):
                     continue
+                # the analyzer (JG008) names the forbidden spellings in
+                # its matcher strings — mentions, not uses
+                if os.sep + "lint" + os.sep in path:
+                    continue
                 with open(path) as f:
                     if pat.search(f.read()):
                         offenders.append(os.path.relpath(path, root))
     assert not offenders, (
         "direct jax shard_map use outside parallel/mesh.py: %s"
         % sorted(offenders))
+
+
+def test_single_substrate_rule_is_a_lint_rule():
+    """ISSUE 18 satellite: the grep above is promoted to graftlint JG008
+    — the rule must fire on the exact spellings the regex hunts, so the
+    invariant is enforced at lint time (pre-commit, --diff) too, not
+    only when this test file runs."""
+    from mxnet_tpu.lint import lint_source
+    bad = "from jax.experimental.shard_map import shard_map\n"
+    assert [f.rule for f in lint_source(bad, path="mxnet_tpu/foo.py",
+                                        select={"JG008"})] == ["JG008"]
+    # and the substrate module itself stays exempt
+    assert lint_source(bad, path="mxnet_tpu/parallel/mesh.py",
+                       select={"JG008"}) == []
 
 
 # ---------------------------------------------------------------------------

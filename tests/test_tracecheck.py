@@ -311,10 +311,11 @@ def test_provider_failure_suppresses_baseline_sweep(tmp_path, monkeypatch):
         {"rule": "JX104", "path": "trace://executor_train",
          "snippet": "donate-missed:arg[0]", "count": 1}]}))
     monkeypatch.setattr(
-        tracecheck, "check_entry_points",
-        lambda entries=None, select=None: (
+        tracecheck, "analyze_entry_points",
+        lambda entries=None, select=None, memory=True,
+        mem_baseline_path=None: (
             [Finding("JX000", "trace://executor", 0, 0, "provider failed",
-                     snippet="provider:executor")], []))
+                     snippet="provider:executor")], [], None))
     cli.main(["--trace", "--write-baseline", "--baseline", str(baseline)])
     kept = json.dumps(json.loads(baseline.read_text()))
     assert "trace://executor_train" in kept
@@ -330,3 +331,320 @@ def test_list_rules_shows_jx_catalogue():
     text = buf.getvalue()
     for code in ("JX101", "JX102", "JX103", "JX104", "JX105"):
         assert code in text
+
+
+# ---------------------------------------------------------------------------
+# JX2xx SPMD fixtures: a live mesh + the substrate's shard_map
+# ---------------------------------------------------------------------------
+
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+from mxnet_tpu.parallel import mesh as mesh_mod  # noqa: E402
+from mxnet_tpu.lint.tracecheck import (collective_sequence,  # noqa: E402
+                                       run_group_rules)
+
+# the JX203 fixtures are a few KB; the production 64 KiB floor would
+# hide them
+SPMD_CFG = TraceConfig(replication_bytes=256)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()), ("x",))
+
+
+def _smap(body, mesh, out_specs=P("x", None), check=None):
+    return mesh_mod.shard_map(body, mesh=mesh, in_specs=P("x", None),
+                              out_specs=out_specs, check=check)
+
+
+def spmd_rules(fn, select, meta=None, name="fixture"):
+    rec = trace_program(name, jax.jit(fn), (spec((8, 64)),), meta=meta)
+    return [(f.rule, f.snippet)
+            for f in run_rules(rec, select={select}, config=SPMD_CFG)]
+
+
+# ---------------------------------------------------------------------------
+# JX201 collective-divergence
+# ---------------------------------------------------------------------------
+
+def test_jx201_fires_on_collective_under_one_cond_arm(mesh):
+    """The canonical SPMD deadlock: ranks whose data makes the predicate
+    disagree take different arms — one enters the psum rendezvous, its
+    peers never do."""
+    def prog(v):
+        def body(s):
+            pred = jnp.sum(s) > 0.0
+            return jax.lax.cond(pred, lambda t: jax.lax.psum(t, "x"),
+                                lambda t: t, s)
+        return _smap(body, mesh)(v)
+
+    assert spmd_rules(prog, "JX201") == [("JX201", "cond-divergence")]
+
+
+def test_jx201_quiet_on_where_skip_twin(mesh):
+    """The fix the rule message prescribes: run the collective
+    unconditionally, branch the VALUES with jnp.where."""
+    def prog(v):
+        def body(s):
+            pred = jnp.sum(s) > 0.0
+            return jnp.where(pred, jax.lax.psum(s, "x"), s)
+        return _smap(body, mesh)(v)
+
+    assert spmd_rules(prog, "JX201") == []
+
+
+def test_jx201_quiet_when_arms_rendezvous_identically(mesh):
+    """Both arms psum over the same axis: every rank meets the
+    rendezvous whichever arm it takes — safe, must stay quiet."""
+    def prog(v):
+        def body(s):
+            pred = jnp.sum(s) > 0.0
+            return jax.lax.cond(pred,
+                                lambda t: jax.lax.psum(t, "x"),
+                                lambda t: jax.lax.psum(t * 2.0, "x"), s)
+        return _smap(body, mesh)(v)
+
+    assert spmd_rules(prog, "JX201") == []
+
+
+def test_jx201_fires_on_collective_inside_while(mesh):
+    """A while trip count is data-dependent by construction: ranks can
+    run the rendezvous a different number of times."""
+    def prog(v):
+        def body(s):
+            def w_body(c):
+                i, t = c
+                return i + 1, jax.lax.psum(t, "x") * 0.5
+
+            def w_cond(c):
+                i, t = c
+                return (i < 4) & (jnp.sum(t) > 1.0)
+
+            _i, out = jax.lax.while_loop(w_cond, w_body, (0, s))
+            return out
+        return _smap(body, mesh, check=False)(v)
+
+    assert spmd_rules(prog, "JX201") == [("JX201", "while-collective")]
+
+
+# ---------------------------------------------------------------------------
+# JX202 collective-order
+# ---------------------------------------------------------------------------
+
+def test_jx202_fires_on_undeclared_axis(mesh):
+    def prog(v):
+        def body(s):
+            return jax.lax.psum(s, "x")
+        return _smap(body, mesh)(v)
+
+    assert spmd_rules(prog, "JX202", meta={"mesh_axes": ("data",)}) \
+        == [("JX202", "undeclared-axis:x")]
+
+
+def test_jx202_quiet_on_declared_axis(mesh):
+    def prog(v):
+        def body(s):
+            return jax.lax.psum(s, "x")
+        return _smap(body, mesh)(v)
+
+    assert spmd_rules(prog, "JX202", meta={"mesh_axes": ("x",)}) == []
+
+
+def test_jx202_quiet_without_declared_axes(mesh):
+    """No mesh_axes metadata means the provider opted out of the
+    declared-axis contract — not an implicit declare-nothing."""
+    def prog(v):
+        def body(s):
+            return jax.lax.psum(s, "x")
+        return _smap(body, mesh)(v)
+
+    assert spmd_rules(prog, "JX202", meta=None) == []
+
+
+def _lane_pair(mesh, flip):
+    perm = [(i, (i + 1) % mesh.devices.size)
+            for i in range(mesh.devices.size)]
+
+    def psum_then_permute(v):
+        def body(s):
+            return jax.lax.ppermute(jax.lax.psum(s, "x"), "x", perm)
+        return _smap(body, mesh)(v)
+
+    def permute_then_psum(v):
+        def body(s):
+            return jax.lax.psum(jax.lax.ppermute(s, "x", perm), "x")
+        return _smap(body, mesh)(v)
+
+    lane = {"lane": "fixture-lane"}
+    a = trace_program("lane_a", jax.jit(psum_then_permute),
+                      (spec((8, 64)),), meta=lane)
+    b = trace_program("lane_b", jax.jit(
+        permute_then_psum if flip else psum_then_permute),
+        (spec((8, 64)),), meta=lane)
+    return a, b
+
+
+def test_jx202_group_fires_on_lane_order_divergence(mesh):
+    """Two programs on one lane disagreeing on per-axis collective order
+    is the cross-program deadlock: rank A runs P's psum while rank B
+    runs Q's ppermute."""
+    a, b = _lane_pair(mesh, flip=True)
+    assert collective_sequence(a) == {"x": ("psum", "ppermute")}
+    assert collective_sequence(b) == {"x": ("ppermute", "psum")}
+    found = run_group_rules([a, b], select={"JX202"}, config=SPMD_CFG)
+    assert [(f.rule, f.snippet) for f in found] \
+        == [("JX202", "lane-order:fixture-lane:x")]
+
+
+def test_jx202_group_quiet_on_identical_lane_order(mesh):
+    a, b = _lane_pair(mesh, flip=False)
+    assert run_group_rules([a, b], select={"JX202"}, config=SPMD_CFG) == []
+
+
+# ---------------------------------------------------------------------------
+# JX203 replication-waste
+# ---------------------------------------------------------------------------
+
+def test_jx203_fires_on_gathered_output(mesh):
+    def prog(v):
+        def body(s):
+            return jax.lax.all_gather(s, "x", axis=0, tiled=True)
+        return _smap(body, mesh, out_specs=P(None, None), check=False)(v)
+
+    assert spmd_rules(prog, "JX203") == [("JX203", "gathered-output:x")]
+
+
+def test_jx203_quiet_when_gather_is_reduced_before_return(mesh):
+    def prog(v):
+        def body(s):
+            g = jax.lax.all_gather(s, "x", axis=0, tiled=True)
+            return jnp.sum(g, axis=0)
+        return _smap(body, mesh, out_specs=P(None), check=False)(v)
+
+    assert spmd_rules(prog, "JX203") == []
+
+
+def test_jx203_quiet_below_replication_threshold(mesh):
+    """Same gathered output, production 64 KiB floor: a few-KB fixture
+    is below the bar — the rule gates real HBM waste, not toys."""
+    def prog(v):
+        def body(s):
+            return jax.lax.all_gather(s, "x", axis=0, tiled=True)
+        return _smap(body, mesh, out_specs=P(None, None), check=False)(v)
+
+    rec = trace_program("fixture", jax.jit(prog), (spec((8, 64)),))
+    assert run_rules(rec, select={"JX203"}, config=TraceConfig()) == []
+
+
+# ---------------------------------------------------------------------------
+# JX204 memory-budget
+# ---------------------------------------------------------------------------
+
+from mxnet_tpu.lint.tracecheck import (check_memory,  # noqa: E402
+                                       measure_programs,
+                                       save_mem_baseline)
+
+
+def _mem_record(name="mem_fixture"):
+    def prog(x, w):
+        return jnp.tanh(x @ w)
+    # 128x128 f32 operands: ~196 KiB total, comfortably above the 4 KiB
+    # absolute slack so a halved budget must trip the fractional band
+    return trace_program(name, jax.jit(prog),
+                         (spec((128, 128)), spec((128, 128))))
+
+
+def test_jx204_quiet_within_budget(tmp_path):
+    rec = _mem_record()
+    baseline = save_mem_baseline(measure_programs([rec]),
+                                 path=str(tmp_path / "mem.json"))
+    findings, report = check_memory([rec], baseline, tolerance=0.25)
+    assert findings == []
+    entry = report["programs"][0]
+    assert entry["name"] == "mem_fixture" and not entry["over_budget"]
+    assert entry["budget_total_bytes"] == entry["total_bytes"]
+
+
+def test_jx204_fires_when_over_budget(tmp_path):
+    rec = _mem_record()
+    measured = measure_programs([rec])
+    measured["mem_fixture"]["total_bytes"] //= 2          # yesterday's
+    baseline = save_mem_baseline(measured,                # smaller program
+                                 path=str(tmp_path / "mem.json"))
+    findings, report = check_memory([rec], baseline, tolerance=0.25)
+    assert [(f.rule, f.snippet) for f in findings] \
+        == [("JX204", "mem:over")]
+    assert report["programs"][0]["over_budget"]
+
+
+def test_jx204_tolerance_band_absorbs_growth(tmp_path):
+    """The same halved budget passes under a wide MXNET_MEM_TOLERANCE:
+    the band is the deliberate-growth knob, read per check."""
+    rec = _mem_record()
+    measured = measure_programs([rec])
+    measured["mem_fixture"]["total_bytes"] //= 2
+    baseline = save_mem_baseline(measured,
+                                 path=str(tmp_path / "mem.json"))
+    findings, _report = check_memory([rec], baseline, tolerance=2.0)
+    assert findings == []
+
+
+def test_jx204_tolerance_env_knob(tmp_path, monkeypatch):
+    rec = _mem_record()
+    measured = measure_programs([rec])
+    measured["mem_fixture"]["total_bytes"] //= 2
+    baseline = save_mem_baseline(measured,
+                                 path=str(tmp_path / "mem.json"))
+    monkeypatch.setenv("MXNET_MEM_TOLERANCE", "2.0")
+    findings, _report = check_memory([rec], baseline)
+    assert findings == []
+    monkeypatch.setenv("MXNET_MEM_TOLERANCE", "0.01")
+    findings, _report = check_memory([rec], baseline)
+    assert [f.snippet for f in findings] == ["mem:over"]
+
+
+def test_jx204_fires_on_unbudgeted_program(tmp_path):
+    rec = _mem_record()
+    baseline = save_mem_baseline({}, path=str(tmp_path / "mem.json"))
+    findings, report = check_memory([rec], baseline)
+    assert [(f.rule, f.snippet) for f in findings] \
+        == [("JX204", "mem:unbudgeted")]
+    assert report["programs"][0]["unbudgeted"]
+
+
+def test_jx204_fires_on_specimen_count_drift(tmp_path):
+    """Dropping a specimen must be as visible as growing one: the
+    count-keyed budget fires when k changes, even if bytes shrink."""
+    rec = _mem_record()
+    measured = measure_programs([rec, _mem_record()])   # budget: k=2
+    baseline = save_mem_baseline(measured,
+                                 path=str(tmp_path / "mem.json"))
+    findings, _report = check_memory([rec], baseline)   # traced: k=1
+    assert "mem:specimens" in {f.snippet for f in findings}
+
+
+def test_jx204_topology_mismatch_skips_comparison(tmp_path):
+    """Memory bytes are a function of device count: a baseline captured
+    on a different topology must be SKIPPED (gate exits 4 downstream),
+    never compared against."""
+    rec = _mem_record()
+    measured = measure_programs([rec])
+    measured["mem_fixture"]["total_bytes"] //= 2
+    baseline = save_mem_baseline(measured, path=str(tmp_path / "mem.json"),
+                                 n_devices=2)            # conftest pins 8
+    findings, report = check_memory([rec], baseline)
+    assert findings == []
+    assert not report["topology_match"]
+    assert report["programs"][0]["budget_total_bytes"] is None
+
+
+def test_jx204_stale_budget_listed_on_full_run(tmp_path):
+    rec = _mem_record()
+    measured = measure_programs([rec])
+    measured["renamed_away"] = dict(measured["mem_fixture"])
+    baseline = save_mem_baseline(measured,
+                                 path=str(tmp_path / "mem.json"))
+    _f, report = check_memory([rec], baseline, full=True)
+    assert report["stale_budgets"] == ["renamed_away"]
+    _f, report = check_memory([rec], baseline, full=False)
+    assert report["stale_budgets"] == []

@@ -48,6 +48,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from mxnet_tpu.telemetry import watch_jit  # noqa: E402
+
 
 def _timeit(fn, warmup=2, iters=10):
     for _ in range(warmup):
@@ -71,11 +73,13 @@ def bench_allreduce(mesh, sizes_mb=(1, 4, 16, 64)):
         x = jnp.zeros((n, elems), jnp.float32)
         x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
 
-        @jax.jit
-        def allreduce(v):
+        def allreduce_fn(v):
             return mesh_mod.shard_map(
                 lambda s: jax.lax.psum(s, "x"),
                 mesh=mesh, in_specs=P("x", None), out_specs=P("x", None))(v)
+
+        allreduce = watch_jit(jax.jit(allreduce_fn),
+                              "bandwidth_allreduce_%dmb" % mb)
 
         def run():
             jax.block_until_ready(allreduce(x))
@@ -101,12 +105,14 @@ def bench_weak_scaling(mesh, per_device_batch=32, dim=1024, iters=10):
             jnp.ones((per_device_batch * n, dim), jnp.float32),
             NamedSharding(sub_mesh, P("x", None)))
 
-        @jax.jit
-        def step(w, x):
+        def step_fn(w, x):
             def loss(w):
                 return jnp.sum(jnp.tanh(x @ w) ** 2) / x.shape[0]
             g = jax.grad(loss)(w)
             return w - 0.01 * g
+
+        step = watch_jit(jax.jit(step_fn),
+                         "bandwidth_scaling_step_%d" % n)
 
         def run():
             jax.block_until_ready(step(w, x))

@@ -87,10 +87,13 @@ def main():
         oh = jax.nn.one_hot(y.astype(jnp.int32), 10)
         return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * oh, axis=1))
 
-    @jax.jit
-    def step(p, x, y):
+    from mxnet_tpu.telemetry import watch_jit
+
+    def step_fn(p, x, y):
         g = jax.grad(loss_fn)(p, x, y)
         return {k: p[k] - 0.05 * g[k] for k in p}
+
+    step = watch_jit(jax.jit(step_fn), "pipeline_overlap_step")
 
     def drain(do_compute, do_data=True, fixed=None):
         nonlocal params

@@ -746,7 +746,35 @@ def main(argv=None):
                     help="model-health mode: render the per-param drift "
                          "table and loss summary of a "
                          "telemetry.timeseries export_json() file")
+    ap.add_argument("--memory", default=None, metavar="MEMJSON",
+                    help="memory-budget mode: render the per-program "
+                         "bytes-vs-budget table of a graftcheck "
+                         "--memory-json report")
+    ap.add_argument("--gate-memory", action="store_true",
+                    help="with --memory: exit 3 when any program is "
+                         "over budget or unbudgeted, 4 when the report "
+                         "cannot measure (topology mismatch / empty) — "
+                         "the JX204 verdict as a CI gate")
     args = ap.parse_args(argv)
+
+    if args.gate_memory and args.memory is None:
+        ap.error("--gate-memory requires --memory MEMJSON")
+
+    if args.memory is not None:
+        try:
+            with open(args.memory) as fh:
+                report = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print("memory: cannot read %s: %s" % (args.memory, exc),
+                  file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            print(render_memory(report))
+        if args.gate_memory:
+            return gate_memory(report)
+        return 0
 
     if args.health is not None:
         try:
@@ -784,6 +812,90 @@ def main(argv=None):
         print(render(report, args.top))
     if args.gate_overlap is not None:
         return gate_overlap(report, args.gate_overlap)
+    return 0
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    if n >= 1 << 20:
+        return "%.1fMiB" % (n / float(1 << 20))
+    if n >= 1 << 10:
+        return "%.1fKiB" % (n / float(1 << 10))
+    return "%dB" % n
+
+
+def render_memory(report):
+    """The per-program bytes-vs-budget table of a graftcheck
+    --memory-json report (JX204's evidence, human-shaped)."""
+    lines = ["memory budgets: %d program(s), %d device(s), tolerance "
+             "+%d%%" % (len(report.get("programs", ())),
+                        report.get("n_devices") or 0,
+                        int((report.get("tolerance") or 0) * 100))]
+    if not report.get("baseline_present"):
+        lines.append("  (no MEM_BASELINE.json — every program reads as "
+                     "unbudgeted)")
+    elif not report.get("topology_match"):
+        lines.append("  (baseline captured at %s device(s), running %s — "
+                     "comparison skipped)"
+                     % (report.get("baseline_n_devices"),
+                        report.get("n_devices")))
+    lines.append("  %-40s %9s %9s %9s %9s %9s  %s"
+                 % ("program", "args", "outputs", "temps", "total",
+                    "budget", "verdict"))
+    for p in sorted(report.get("programs", ()),
+                    key=lambda e: -e.get("total_bytes", 0)):
+        if p.get("over_budget"):
+            verdict = "OVER"
+        elif p.get("unbudgeted"):
+            verdict = "unbudgeted"
+        elif p.get("budget_total_bytes") is None:
+            verdict = "skipped"
+        else:
+            verdict = "ok"
+        lines.append("  %-40s %9s %9s %9s %9s %9s  %s"
+                     % (p.get("name", "?"),
+                        _fmt_bytes(p.get("argument_bytes")),
+                        _fmt_bytes(p.get("output_bytes")),
+                        _fmt_bytes(p.get("temp_bytes")),
+                        _fmt_bytes(p.get("total_bytes")),
+                        _fmt_bytes(p.get("budget_total_bytes")),
+                        verdict))
+    stale = report.get("stale_budgets") or []
+    if stale:
+        lines.append("  stale budget(s) (program gone): %s"
+                     % ", ".join(stale))
+    return "\n".join(lines)
+
+
+def gate_memory(report):
+    """The --gate-memory exit policy (mirrors --gate-overlap and
+    health_gate): 0 when every program is within budget; 3 when any is
+    over budget or unbudgeted; 4 when the report cannot measure —
+    topology mismatch, no baseline comparison possible, or no programs
+    at all (a gate that cannot measure must fail loudly)."""
+    programs = report.get("programs") or []
+    if not programs or not report.get("topology_match"):
+        why = "no programs in the report" if not programs else \
+            ("baseline n_devices=%s vs live n_devices=%s"
+             % (report.get("baseline_n_devices"),
+                report.get("n_devices")))
+        print("gate-memory: UNMEASURABLE — %s" % why, file=sys.stderr)
+        return 4
+    over = [p["name"] for p in programs if p.get("over_budget")]
+    unbudgeted = [p["name"] for p in programs if p.get("unbudgeted")]
+    if over or unbudgeted:
+        parts = []
+        if over:
+            parts.append("over budget: %s" % ", ".join(sorted(over)))
+        if unbudgeted:
+            parts.append("unbudgeted: %s" % ", ".join(sorted(unbudgeted)))
+        print("gate-memory: FAIL — %s" % "; ".join(parts),
+              file=sys.stderr)
+        return 3
+    print("gate-memory: ok — %d program(s) within budget (+%d%% "
+          "tolerance)" % (len(programs),
+                          int((report.get("tolerance") or 0) * 100)))
     return 0
 
 
