@@ -98,8 +98,8 @@ __all__ = ["TRACE_RULES", "GROUP_RULES", "TraceRule", "TraceConfig",
            "run_group_rules", "check_entry_points", "analyze_entry_points",
            "iter_owned_programs", "groups_for_paths", "on_compile",
            "signature", "explain_retrace", "ENTRY_POINTS",
-           "collective_sequence", "measure_memory", "mem_tolerance",
-           "load_mem_baseline", "save_mem_baseline",
+           "collective_sequence", "measure_memory", "compile_record",
+           "mem_tolerance", "load_mem_baseline", "save_mem_baseline",
            "default_mem_baseline_path", "MEM_FIELDS"]
 # NOTE: the MXNET_TRACECHECK gate itself lives in telemetry.core
 # (_env_tracecheck) — the hook's caller owns the env parsing.
@@ -781,13 +781,27 @@ def record_digest(rec):
     return hashlib.sha1(sig.encode("utf-8")).hexdigest()[:12]
 
 
-def measure_memory(rec):
-    """Compile *rec*'s kept lowering and return its memory_analysis()
-    byte fields, or None when the backend cannot report them."""
+def compile_record(rec):
+    """Compile *rec*'s kept AOT lowering (the JX204 compile path — also
+    what ``telemetry.opprof`` reuses for its HLO walk, so attribution
+    adds zero new XLA entry points).  Returns the compiled executable,
+    or None when there is no lowering or the backend refuses."""
     if rec.lowered is None:
         return None
     try:
-        ma = rec.lowered.compile().memory_analysis()
+        return rec.lowered.compile()
+    except Exception:
+        return None
+
+
+def measure_memory(rec):
+    """Compile *rec*'s kept lowering and return its memory_analysis()
+    byte fields, or None when the backend cannot report them."""
+    compiled = compile_record(rec)
+    if compiled is None:
+        return None
+    try:
+        ma = compiled.memory_analysis()
     except Exception:
         return None
     if ma is None:
@@ -1127,13 +1141,24 @@ def iter_owned_programs(entries=None):
                     snippet="trace:%s" % name)
 
 
+# beyond lint/ itself, these files steer every trace-tier verdict: the
+# opprof HLO walk is an analyzer over the same specimen ledger, and the
+# costs peak tables decide its compute/HBM/comm classifications
+_FULL_SWEEP_PATHS = frozenset({
+    "mxnet_tpu/telemetry/opprof.py",
+    "mxnet_tpu/telemetry/costs.py",
+})
+
+
 def groups_for_paths(paths):
     """Map changed repo-relative .py paths onto the ENTRY_POINTS groups
     they provide — the ``--diff`` scope for the trace tier.  A change to
-    the analyzer itself (``mxnet_tpu/lint/``) dirties every group: the
+    the analyzer itself (``mxnet_tpu/lint/``), to the opprof attribution
+    walk, or to the cost-model peak tables dirties every group: the
     rules changed, so every verdict did."""
     norm = {p.replace(os.sep, "/") for p in paths}
-    if any(p.startswith("mxnet_tpu/lint/") for p in norm):
+    if any(p.startswith("mxnet_tpu/lint/") or p in _FULL_SWEEP_PATHS
+           for p in norm):
         return {g for g, _m in ENTRY_POINTS}
     hit = set()
     for group, modpath in ENTRY_POINTS:

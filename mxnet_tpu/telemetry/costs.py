@@ -41,7 +41,8 @@ import os
 from . import core
 
 __all__ = ["capture", "analyze_compiled", "finalize_step", "peaks",
-           "peaks_if_resolved", "refresh_from_env", "PEAK_TABLE"]
+           "peaks_if_resolved", "refresh_from_env", "machine_balance",
+           "PEAK_TABLE", "ICI_TABLE"]
 
 _TRUTHY = ("1", "true", "on", "yes")
 
@@ -65,6 +66,27 @@ PEAK_TABLE = {
 }
 _FALLBACK = PEAK_TABLE["cpu"]
 
+# peak interconnect bytes/s per JAX device (aggregate over a chip's ICI
+# links) — the denominator for the "comm" leg of the opprof roofline.
+# Same caveat as PEAK_TABLE: spec-sheet order-of-magnitude numbers, not
+# measurements; pin MXNET_PEAK_ICI_BW (aggregate, verbatim) for honesty.
+ICI_TABLE = {
+    "TPU v2":      (62e9,),
+    "TPU v3":      (82e9,),
+    "TPU v4":      (300e9,),
+    "TPU v4 lite": (150e9,),
+    "TPU v5":      (600e9,),
+    "TPU v5p":     (600e9,),
+    "TPU v5 lite": (200e9,),
+    "TPU v5e":     (200e9,),
+    "TPU v6 lite": (400e9,),
+    "TPU v6e":     (400e9,),
+    # CPU: virtual devices share one memory system; collectives are
+    # memcpys, so the "interconnect" placeholder sits below HBM peak
+    "cpu":         (10e9,),
+}
+_ICI_FALLBACK = ICI_TABLE["cpu"]
+
 
 def _env_float(name):
     raw = os.environ.get(name, "").strip()
@@ -86,16 +108,18 @@ def _env_capture_enabled():
 # step path); core.refresh_from_env() funnels into refresh_from_env()
 _ENV_PEAK_FLOPS = _env_float("MXNET_PEAK_FLOPS")
 _ENV_PEAK_BW = _env_float("MXNET_PEAK_HBM_BW")
+_ENV_PEAK_ICI = _env_float("MXNET_PEAK_ICI_BW")
 _CAPTURE = _env_capture_enabled()
 _peaks = None                   # resolved {"flops","hbm_bw",...} or None
 
 
 def refresh_from_env():
-    """Re-read MXNET_PEAK_FLOPS / MXNET_PEAK_HBM_BW /
-    MXNET_COST_ANALYSIS and drop the resolved-peak cache."""
-    global _ENV_PEAK_FLOPS, _ENV_PEAK_BW, _CAPTURE, _peaks
+    """Re-read MXNET_PEAK_FLOPS / MXNET_PEAK_HBM_BW / MXNET_PEAK_ICI_BW
+    / MXNET_COST_ANALYSIS and drop the resolved-peak cache."""
+    global _ENV_PEAK_FLOPS, _ENV_PEAK_BW, _ENV_PEAK_ICI, _CAPTURE, _peaks
     _ENV_PEAK_FLOPS = _env_float("MXNET_PEAK_FLOPS")
     _ENV_PEAK_BW = _env_float("MXNET_PEAK_HBM_BW")
+    _ENV_PEAK_ICI = _env_float("MXNET_PEAK_ICI_BW")
     _CAPTURE = _env_capture_enabled()
     _peaks = None
 
@@ -177,16 +201,28 @@ def peaks():
     except Exception:
         pass
     table_flops, table_bw = PEAK_TABLE.get(kind, _FALLBACK)
+    (table_ici,) = ICI_TABLE.get(kind, _ICI_FALLBACK)
     flops = _ENV_PEAK_FLOPS if _ENV_PEAK_FLOPS is not None \
         else table_flops * n_dev
     bw = _ENV_PEAK_BW if _ENV_PEAK_BW is not None else table_bw * n_dev
-    _peaks = {"flops": flops, "hbm_bw": bw,
+    ici = _ENV_PEAK_ICI if _ENV_PEAK_ICI is not None else table_ici * n_dev
+    _peaks = {"flops": flops, "hbm_bw": bw, "ici_bw": ici,
               "device_kind": kind, "n_devices": n_dev,
               "source": {"flops": "env" if _ENV_PEAK_FLOPS is not None
                          else "table",
                          "hbm_bw": "env" if _ENV_PEAK_BW is not None
+                         else "table",
+                         "ici_bw": "env" if _ENV_PEAK_ICI is not None
                          else "table"}}
     return _peaks
+
+
+def machine_balance():
+    """Peak FLOP/s over peak HBM bytes/s — the arithmetic-intensity
+    knee of the roofline.  A unit whose FLOP/byte sits above this is
+    compute-bound; below, HBM-bound."""
+    pk = peaks()
+    return pk["flops"] / pk["hbm_bw"] if pk["hbm_bw"] > 0 else 0.0
 
 
 def peaks_if_resolved():

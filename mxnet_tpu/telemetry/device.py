@@ -55,7 +55,7 @@ __all__ = ["enabled", "sample_period", "configure", "refresh_from_env",
            "register_collective", "is_collective", "maybe_time",
            "take_serving_sample", "record_program", "note_overlap",
            "open_step_window", "close_step_window", "device_report",
-           "timelines", "reset"]
+           "opprof_enabled", "timelines", "reset"]
 
 
 def _parse_rate(raw):
@@ -72,7 +72,16 @@ def _parse_rate(raw):
     return max(1, int(round(1.0 / rate)))
 
 
+def _parse_opprof(raw):
+    """MXNET_OPPROF (default on): feed sampled per-program device time
+    into the timeseries rings at step-window close.  Piggybacks on the
+    MXNET_DEVICE_TIME sampling gate, so with device-time off this costs
+    nothing regardless of the setting."""
+    return str(raw).strip().lower() not in ("0", "false", "off", "no")
+
+
 _PERIOD = _parse_rate(os.environ.get("MXNET_DEVICE_TIME", "0"))
+_OPPROF = _parse_opprof(os.environ.get("MXNET_OPPROF", "1"))
 _EWMA_ALPHA = 0.3
 _TIMELINE_CAP = 64
 
@@ -92,17 +101,25 @@ def _push_flag():
     _core._set_device_time(_PERIOD > 0)
 
 
-def configure(rate=None):
-    """Programmatic override of MXNET_DEVICE_TIME (tests / notebooks)."""
-    global _PERIOD
+def configure(rate=None, opprof=None):
+    """Programmatic override of MXNET_DEVICE_TIME / MXNET_OPPROF
+    (tests / notebooks)."""
+    global _PERIOD, _OPPROF
     if rate is not None:
         _PERIOD = _parse_rate(rate)
+    if opprof is not None:
+        _OPPROF = bool(opprof)
     _push_flag()
 
 
+def opprof_enabled():
+    return _OPPROF
+
+
 def refresh_from_env():
-    global _PERIOD
+    global _PERIOD, _OPPROF
     _PERIOD = _parse_rate(os.environ.get("MXNET_DEVICE_TIME", "0"))
+    _OPPROF = _parse_opprof(os.environ.get("MXNET_OPPROF", "1"))
     _push_flag()
 
 
@@ -136,13 +153,14 @@ class _Window:
     """One step (or serving batch) being decomposed."""
 
     __slots__ = ("sampled", "compute_us", "collective_us", "data_wait_us",
-                 "overlap_hidden_us", "overlap_exposed_us")
+                 "overlap_hidden_us", "overlap_exposed_us", "programs")
 
     def __init__(self, sampled, data_wait_us):
         self.sampled = sampled
         self.compute_us = 0.0
         self.collective_us = 0.0
         self.data_wait_us = data_wait_us
+        self.programs = {}     # name -> µs this window (the opprof feed)
         # direct measurement from the overlap tier (gluon/overlap.py):
         # collective wall time hidden under backward vs exposed in the
         # step's drain — None when the step ran un-overlapped
@@ -241,6 +259,7 @@ def record_program(name, dur_us, window=None, collective=None):
             window.collective_us += dur_us
         else:
             window.compute_us += dur_us
+        window.programs[name] = window.programs.get(name, 0.0) + dur_us
 
 
 # --------------------------------------------------------------------------
@@ -300,6 +319,18 @@ def close_step_window(dur_us):
     _core.set_gauge("step_device_us", win.compute_us)
     _core.set_gauge("step_collective_us", win.collective_us)
     _core.set_gauge("overlap_ratio", overlap)
+    if _OPPROF and win.programs:
+        # per-program device-time drift feed: sys.modules delegation so
+        # this module never imports timeseries (import-light contract);
+        # device close runs before note_step_exit, so the rings book
+        # under the same step index core is about to assign
+        import sys
+        ts = sys.modules.get("mxnet_tpu.telemetry.timeseries")
+        if ts is not None:
+            try:
+                ts.record_device_programs(win.programs)
+            except Exception:
+                pass
 
 
 # --------------------------------------------------------------------------

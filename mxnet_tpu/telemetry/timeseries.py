@@ -38,7 +38,8 @@ from collections import deque
 from . import core as _core
 
 __all__ = ["cap", "configure", "refresh_from_env", "record",
-           "note_step_exit", "record_model_stats", "series", "names",
+           "note_step_exit", "record_device_programs",
+           "record_model_stats", "series", "names",
            "export", "export_json", "load_export", "merge", "summary",
            "reset"]
 
@@ -120,6 +121,21 @@ def note_step_exit(dur_us):
     record("step_time_us", step, dur_us)
     for name, value in live:
         record(name, step, value)
+
+
+def record_device_programs(programs):
+    """Book one sampled step's per-program device time as
+    ``device/<program>/us`` rings — the opprof drift feed
+    (``device.close_step_window`` delegates here, gated by
+    MXNET_OPPROF).  Device close runs before :func:`note_step_exit`, so
+    the current ``_step_seq`` is exactly the index this step's gauge
+    series are about to book under.  Evictions are counted by
+    :func:`record` like every other ring — a long sampled run pays the
+    same honest accounting."""
+    with _lock:
+        step = _step_seq
+    for name in sorted(programs):
+        record("device/%s/us" % name, step, float(programs[name]))
 
 
 def record_model_stats(step, names, stats, loss=None):

@@ -981,6 +981,21 @@ def test_groups_for_paths_maps_providers_to_entry_groups():
         == {g for g, _m in tracecheck.ENTRY_POINTS}
 
 
+def test_groups_for_paths_full_sweep_for_opprof():
+    """A cost-model or attribution change invalidates EVERY perf
+    verdict, not one entry group — opprof/costs edits map to the full
+    re-sweep exactly like an analyzer edit does."""
+    from mxnet_tpu.lint import tracecheck
+    every = {g for g, _m in tracecheck.ENTRY_POINTS}
+    assert tracecheck.groups_for_paths(
+        ["mxnet_tpu/telemetry/opprof.py"]) == every
+    assert tracecheck.groups_for_paths(
+        ["mxnet_tpu/telemetry/costs.py", "README.md"]) == every
+    # other telemetry modules stay out of the blast radius
+    assert tracecheck.groups_for_paths(
+        ["mxnet_tpu/telemetry/flight.py"]) == set()
+
+
 def _tmp_trace_repo(tmp_path):
     """A throwaway git repo whose file layout mirrors the provider
     paths groups_for_paths keys on (content never imported — the trace

@@ -1,0 +1,401 @@
+"""Per-op roofline attribution (telemetry.opprof): the cost model sees
+what the HLO does.
+
+Three synthetic programs with KNOWN rooflines probe the attribution
+end-to-end through the real trace->compile->parse path (no mocked HLO):
+
+* a dot-heavy matmul whose arithmetic intensity sits far above the CPU
+  machine balance — must classify ``dot`` (or a dot-bearing fusion) and
+  read compute-bound;
+* a big elementwise add at intensity ~0.08 FLOP/B — must read
+  HBM-bound;
+* a psum under the substrate's shard_map on the 8-device test mesh —
+  must surface a ``collective`` unit bound by ``comm``.
+
+Plus the perf-budget comparison (check_perf) over synthetic measured
+sets, the device->timeseries drift feed, and the bench trajectory tool.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mxnet_tpu.lint import tracecheck
+from mxnet_tpu.parallel import mesh as mesh_mod
+from mxnet_tpu.telemetry import costs, opprof
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def analyze(name, fn, args):
+    rec = tracecheck.trace_program(name, jax.jit(fn), args)
+    analysis, compiled = opprof.analyze_record(rec, costs.peaks())
+    assert compiled is not None, "%s did not compile" % name
+    assert analysis is not None
+    return analysis
+
+
+# ---------------------------------------------------------------------------
+# op-class + roofline bucketing
+# ---------------------------------------------------------------------------
+
+def test_dot_heavy_program_reads_compute_bound():
+    a = jnp.ones((256, 256), jnp.float32)
+    analysis = analyze("opprof_dot", lambda x, y: x @ y, (a, a))
+    dots = [u for u in analysis["units"]
+            if u["op_class"] in ("dot", "fusion") and u["flops"] > 1e6]
+    assert dots, "no dot-bearing unit found: %r" % (
+        [(u["unit"], u["op_class"]) for u in analysis["units"]])
+    top = max(dots, key=lambda u: u["flops"])
+    # 2*256^3 flops over ~3*256*256*4 bytes: intensity ~40 FLOP/B,
+    # far above the CPU balance of 2
+    assert top["intensity"] > costs.machine_balance()
+    assert top["bound"] == "compute"
+    assert top["flops"] >= 2 * 256 ** 3
+    assert top["ceiling"] == costs.peaks()["flops"]
+
+
+def test_bandwidth_bound_program_reads_hbm():
+    x = jnp.ones((1024 * 1024,), jnp.float32)
+    analysis = analyze("opprof_bw", lambda a, b: a + b, (x, x))
+    adds = [u for u in analysis["units"]
+            if u["op_class"] in ("elementwise", "fusion")]
+    assert adds
+    top = max(adds, key=lambda u: u["bytes"])
+    # 1 flop per element over 12 bytes moved: intensity ~0.08
+    assert top["intensity"] < costs.machine_balance()
+    assert top["bound"] == "hbm"
+    # the slope region of the roofline: ceiling = intensity * HBM peak
+    assert top["ceiling"] < costs.peaks()["flops"]
+
+
+def test_collective_program_reads_comm():
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+
+    def body(x):
+        return jax.lax.psum(x, "x")
+
+    fn = mesh_mod.shard_map(body, mesh=mesh, in_specs=P("x", None),
+                            out_specs=P(None, None))
+    x = jnp.ones((8, 64), jnp.float32)
+    analysis = analyze("opprof_coll", fn, (x,))
+    colls = [u for u in analysis["units"]
+             if u["op_class"] == "collective"]
+    assert colls, "no collective unit in: %r" % (
+        [(u["unit"], u["opcode"]) for u in analysis["units"]])
+    assert all(u["bound"] == "comm" for u in colls)
+    assert all(u["ceiling"] == costs.peaks()["ici_bw"] for u in colls)
+    assert all(u["ceiling_kind"] == "bytes_per_s" for u in colls)
+
+
+def test_shares_sum_to_one_per_program():
+    a = jnp.ones((64, 64), jnp.float32)
+
+    def mixed(x, y):
+        z = jnp.tanh(x @ y)
+        return z.sum() + (x * y).mean()
+
+    analysis = analyze("opprof_mixed", mixed, (a, a))
+    assert len(analysis["units"]) > 1
+    total = sum(u["share"] for u in analysis["units"])
+    assert total == pytest.approx(1.0, abs=1e-6)
+    assert all(0.0 <= u["share"] <= 1.0 for u in analysis["units"])
+
+
+def test_classify_table():
+    assert opprof.classify("dot") == "dot"
+    assert opprof.classify("convolution") == "conv"
+    assert opprof.classify("fusion") == "fusion"
+    assert opprof.classify("while") == "fusion"
+    assert opprof.classify("all-reduce") == "collective"
+    assert opprof.classify("reduce-scatter") == "collective"
+    assert opprof.classify("collective-permute") == "collective"
+    assert opprof.classify("reduce") == "reduce"
+    assert opprof.classify("add") == "elementwise"
+    assert opprof.classify("exponential") == "elementwise"
+    assert opprof.classify("parameter") == "other"
+
+
+def test_parse_hlo_handles_tuple_operands_and_fusions():
+    text = """\
+HloModule m
+
+%fused_computation.1 (p0: f32[16,16], p1: f32[16,16]) -> f32[16,16] {
+  %p0 = f32[16,16]{1,0} parameter(0)
+  %p1 = f32[16,16]{1,0} parameter(1)
+  ROOT %add.1 = f32[16,16]{1,0} add(%p0, %p1)
+}
+
+ENTRY %main.9 (a: f32[16,16], t: (s32[], f32[16,16])) -> f32[16,16] {
+  %a = f32[16,16]{1,0} parameter(0)
+  %t = (s32[], f32[16,16]{1,0}) parameter(1)
+  %gte = f32[16,16]{1,0} get-tuple-element((s32[], f32[16,16]{1,0}) %t), index=1
+  ROOT %fusion = f32[16,16]{1,0} fusion(%a, %gte), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(f)/add"}
+}
+"""
+    comps, entry = opprof.parse_hlo(text)
+    assert entry == "main.9"
+    assert set(comps) == {"fused_computation.1", "main.9"}
+    fusion = [i for i in comps["main.9"] if i["opcode"] == "fusion"][0]
+    assert fusion["called"] == ["fused_computation.1"]
+    assert fusion["operands"] == ["a", "gte"]
+    assert fusion["op_name"] == "jit(f)/add"
+    gte = [i for i in comps["main.9"] if i["name"] == "gte"][0]
+    # the tuple-typed operand's internal parens must not truncate the
+    # operand scan
+    assert "t" in gte["operands"]
+    analysis = opprof.analyze_hlo(text, costs.peaks())
+    units = {u["unit"]: u for u in analysis["units"]}
+    assert "%fusion" in units
+    # the fusion recursed into its called computation: 16*16 adds
+    assert units["%fusion"]["flops"] == 16 * 16
+
+
+# ---------------------------------------------------------------------------
+# check_perf: the budget comparison
+# ---------------------------------------------------------------------------
+
+def _measured(name="prog", us=1000.0, digest="d0", specimens=1):
+    return {name: {"origin": "o.py", "specimens": specimens,
+                   "digest": digest, "median_us": us, "measured": True,
+                   "flops": 0, "bytes": 0, "units": []}}
+
+
+def _baseline(name="prog", us=1000.0, digest="d0", specimens=1,
+              n_devices=8):
+    return {"version": 1, "n_devices": n_devices, "tolerance": 1.5,
+            "programs": {name: {"specimens": specimens,
+                                "digest": digest, "median_us": us}}}
+
+
+def test_check_perf_within_budget():
+    report = opprof.check_perf(_measured(us=1200.0), _baseline(),
+                               tolerance=1.5, n_devices=8)
+    (p,) = report["programs"]
+    assert not p["over_budget"] and not p["unbudgeted"]
+    assert report["topology_match"]
+
+
+def test_check_perf_flags_regression_beyond_band_and_slack():
+    # budget 1000us, tolerance +150% + 500us slack -> limit 3000us
+    report = opprof.check_perf(_measured(us=3100.0), _baseline(),
+                               tolerance=1.5, n_devices=8)
+    (p,) = report["programs"]
+    assert p["over_budget"]
+
+
+def test_check_perf_slack_floor_absorbs_micro_jitter():
+    # 10us budget: the fractional band is meaningless, the 500us
+    # absolute floor keeps scheduler noise out of the verdict
+    report = opprof.check_perf(_measured(us=400.0),
+                               _baseline(us=10.0),
+                               tolerance=1.5, n_devices=8)
+    (p,) = report["programs"]
+    assert not p["over_budget"]
+
+
+def test_check_perf_digest_mismatch_is_unbudgeted():
+    report = opprof.check_perf(_measured(digest="NEW"), _baseline(),
+                               tolerance=1.5, n_devices=8)
+    (p,) = report["programs"]
+    assert p["unbudgeted"]
+
+
+def test_check_perf_specimen_count_mismatch_is_unbudgeted():
+    report = opprof.check_perf(_measured(specimens=2),
+                               _baseline(specimens=1),
+                               tolerance=1.5, n_devices=8)
+    (p,) = report["programs"]
+    assert p["unbudgeted"]
+
+
+def test_check_perf_topology_mismatch_skips_comparison():
+    report = opprof.check_perf(_measured(), _baseline(n_devices=2),
+                               tolerance=1.5, n_devices=8)
+    assert not report["topology_match"]
+    (p,) = report["programs"]
+    assert p["unbudgeted"] and not p["over_budget"]
+
+
+def test_check_perf_stale_budgets_named():
+    base = _baseline()
+    base["programs"]["gone_program"] = {"specimens": 1, "digest": "x",
+                                        "median_us": 5.0}
+    report = opprof.check_perf(_measured(), base, tolerance=1.5,
+                               n_devices=8)
+    assert report["stale_budgets"] == ["gone_program"]
+
+
+def test_perf_tolerance_env(monkeypatch):
+    monkeypatch.delenv("MXNET_PERF_TOLERANCE", raising=False)
+    assert opprof.perf_tolerance() == 1.5
+    monkeypatch.setenv("MXNET_PERF_TOLERANCE", "0.5")
+    assert opprof.perf_tolerance() == 0.5
+    monkeypatch.setenv("MXNET_PERF_TOLERANCE", "junk")
+    assert opprof.perf_tolerance() == 1.5
+    monkeypatch.setenv("MXNET_PERF_TOLERANCE", "-1")
+    assert opprof.perf_tolerance() == 1.5
+
+
+def test_kernel_candidates_rank_compute_and_comm():
+    programs = {
+        "big": {"origin": "o", "specimens": 1, "digest": "a",
+                "median_us": 900.0, "measured": True, "flops": 0,
+                "bytes": 0, "units": [
+                    {"unit": "%dot.1", "opcode": "dot",
+                     "op_class": "dot", "op_name": None,
+                     "bound": "compute", "intensity": 40.0,
+                     "ceiling": 8e11, "ceiling_kind": "flops_per_s",
+                     "est_us": 9.0, "share": 0.9,
+                     "attributed_us": 810.0},
+                    {"unit": "%all-reduce.1", "opcode": "all-reduce",
+                     "op_class": "collective", "op_name": None,
+                     "bound": "comm", "intensity": 0.1,
+                     "ceiling": 8e10, "ceiling_kind": "bytes_per_s",
+                     "est_us": 1.0, "share": 0.1,
+                     "attributed_us": 90.0}]},
+        "tiny": {"origin": "o", "specimens": 1, "digest": "b",
+                 "median_us": 100.0, "measured": True, "flops": 0,
+                 "bytes": 0, "units": [
+                     {"unit": "%collective-permute.1",
+                      "opcode": "collective-permute",
+                      "op_class": "collective", "op_name": None,
+                      "bound": "comm", "intensity": 0.0,
+                      "ceiling": 8e10, "ceiling_kind": "bytes_per_s",
+                      "est_us": 1.0, "share": 1.0,
+                      "attributed_us": 100.0}]},
+    }
+    cands = opprof.kernel_candidates(programs)
+    kinds = {c["kind"] for c in cands}
+    assert kinds == {"compute", "comm"}
+    compute = [c for c in cands if c["kind"] == "compute"]
+    assert compute[0]["unit"] == "%dot.1"
+    comm = [c for c in cands if c["kind"] == "comm"]
+    # ranked within the comm class by attributed time: the permute's
+    # 100us beats the all-reduce's 90us even though its global share
+    # is small — the separate tier exists exactly so collective cores
+    # are not buried under the matmuls
+    assert comm[0]["unit"] == "%collective-permute.1"
+
+
+# ---------------------------------------------------------------------------
+# the device -> timeseries drift feed
+# ---------------------------------------------------------------------------
+
+def test_sampled_window_feeds_device_series():
+    from mxnet_tpu.telemetry import device, timeseries
+    device.reset()
+    timeseries.reset()
+    device.configure(rate=1, opprof=True)
+    try:
+        device.open_step_window()
+        win = device._tls.window
+        assert win is not None and win.sampled
+        device.record_program("opprof_feed_prog", 123.0, window=win)
+        device.close_step_window(500.0)
+        pts = timeseries.series("device/opprof_feed_prog/us")
+        assert pts == [(0, 123.0)]
+    finally:
+        device.configure(rate=0, opprof=True)
+        device.reset()
+        timeseries.reset()
+
+
+def test_opprof_flag_gates_the_feed():
+    from mxnet_tpu.telemetry import device, timeseries
+    device.reset()
+    timeseries.reset()
+    device.configure(rate=1, opprof=False)
+    try:
+        assert not device.opprof_enabled()
+        device.open_step_window()
+        win = device._tls.window
+        device.record_program("opprof_gated_prog", 55.0, window=win)
+        device.close_step_window(100.0)
+        assert timeseries.series("device/opprof_gated_prog/us") == []
+    finally:
+        device.configure(rate=0, opprof=True)
+        device.reset()
+        timeseries.reset()
+
+
+def test_opprof_env_parse(monkeypatch):
+    from mxnet_tpu.telemetry import device
+    monkeypatch.setenv("MXNET_OPPROF", "0")
+    device.refresh_from_env()
+    assert not device.opprof_enabled()
+    monkeypatch.delenv("MXNET_OPPROF", raising=False)
+    device.refresh_from_env()
+    assert device.opprof_enabled()   # default on
+
+
+# ---------------------------------------------------------------------------
+# bench trajectory tool
+# ---------------------------------------------------------------------------
+
+TRAJECTORY = os.path.join(REPO, "tools", "bench_trajectory.py")
+
+
+def _round_files(tmp_path, rounds):
+    for n, (bench_rc, calls, value) in rounds.items():
+        (tmp_path / ("BENCH_r%02d.json" % n)).write_text(json.dumps({
+            "n": n, "cmd": "x", "rc": bench_rc, "tail": "",
+            "parsed": {"metric": "resnet50_infer", "value": value,
+                       "unit": "img/s", "vs_baseline": None,
+                       "program_calls_per_step": calls,
+                       "overlap_ratio": None, "gate_overlap": None,
+                       "health_gate": None}}))
+        (tmp_path / ("MULTICHIP_r%02d.json" % n)).write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+             "legs": ["train"], "multihost": None, "health": None,
+             "tail": ""}))
+
+
+def _run_traj(tmp_path, *extra):
+    return subprocess.run(
+        [sys.executable, TRAJECTORY, "--root", str(tmp_path), *extra],
+        capture_output=True, text=True)
+
+
+def test_trajectory_merges_rounds(tmp_path):
+    _round_files(tmp_path, {1: (0, 1.0, 100.0), 2: (0, 1.0, 110.0)})
+    proc = _run_traj(tmp_path)
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout)
+    assert [r["round"] for r in out["rounds"]] == [1, 2]
+    assert out["regressions"] == []
+
+
+def test_trajectory_check_flags_calls_per_step_growth(tmp_path):
+    _round_files(tmp_path, {1: (0, 1.0, 100.0), 2: (0, 2.0, 100.0)})
+    proc = _run_traj(tmp_path, "--check")
+    assert proc.returncode == 3
+    assert "program_calls_per_step grew" in proc.stderr
+
+
+def test_trajectory_check_flags_throughput_drop(tmp_path):
+    _round_files(tmp_path, {1: (0, 1.0, 100.0), 2: (0, 1.0, 80.0)})
+    proc = _run_traj(tmp_path, "--check")
+    assert proc.returncode == 3
+    assert "dropped" in proc.stderr
+
+
+def test_trajectory_check_unmeasurable_below_two_rounds(tmp_path):
+    _round_files(tmp_path, {1: (0, 1.0, 100.0)})
+    proc = _run_traj(tmp_path, "--check")
+    assert proc.returncode == 4
+
+
+def test_trajectory_check_ok_on_clean_rounds(tmp_path):
+    _round_files(tmp_path, {1: (0, 1.0, 100.0), 2: (0, 1.0, 99.0),
+                            3: (0, 1.0, 101.0)})
+    proc = _run_traj(tmp_path, "--check")
+    assert proc.returncode == 0
+    assert "trajectory: ok" in proc.stdout

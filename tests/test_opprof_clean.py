@@ -1,0 +1,163 @@
+"""Tier-1 opprof gate: the committed perf ledger is fresh, and the
+budget gate actually bites.
+
+Mirrors ``test_memcheck_clean.py`` for the round-20 perf ledger.  One
+module-scoped sweep (AOT-compile + measure all owned programs on the
+pinned 8-device CPU mesh — seconds, once):
+
+* PERF_BASELINE.json is fresh: present, topology-matched, every owned
+  program budgeted under its committed digest, nothing stale, and the
+  candidate ranking still names >= 2 concrete kernel targets;
+* ``trace_report.py --ops --gate-perf`` exits 0 on the real artifact and
+  3 on a deliberately shrunk budget re-gated through the REAL
+  ``check_perf`` comparison — the CI wire, not just the library.
+
+Measured medians on a shared CI host are noisy; the committed tolerance
+(+150% of budget, 500us floor) is deliberately wide so this test gates
+digests-and-order-of-magnitude, not microseconds.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.telemetry import costs, opprof
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
+
+MIN_PROGRAMS = 32            # same ledger floor as test_memcheck_clean
+MIN_CANDIDATES = 2           # the ISSUE's "name >= 2 kernel targets"
+
+
+@pytest.fixture(scope="module")
+def sweep():
+    programs, problems = opprof.sweep()
+    assert problems == [], "sweep problems: %s" % problems
+    return programs
+
+
+@pytest.fixture(scope="module")
+def artifact(sweep):
+    perf = opprof.check_perf(sweep, opprof.load_perf_baseline())
+    return opprof.build_report(sweep, [], perf, costs.peaks())
+
+
+def gate(report, tmp_path, extra=()):
+    path = tmp_path / "ops.json"
+    path.write_text(json.dumps(report))
+    proc = subprocess.run(
+        [sys.executable, TRACE_REPORT, "--ops", str(path),
+         "--gate-perf", *extra],
+        capture_output=True, text=True)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def test_perf_budgets_are_fresh(artifact):
+    perf = artifact["perf"]
+    assert perf["baseline_present"], \
+        "PERF_BASELINE.json missing — run opprof --write-perf-baseline"
+    assert perf["topology_match"], (
+        "baseline captured on %s devices, test mesh has %s"
+        % (perf["baseline_n_devices"], perf["n_devices"]))
+    assert perf["stale_budgets"] == []
+    bad = [p["name"] for p in perf["programs"] if p["unbudgeted"]]
+    assert bad == [], (
+        "unbudgeted programs (trace digest moved without refreshing the "
+        "ledger — rerun opprof --write-perf-baseline): %s" % bad)
+    assert len(perf["programs"]) >= MIN_PROGRAMS
+
+
+def test_all_owned_programs_measured(sweep):
+    assert len(sweep) >= MIN_PROGRAMS
+    unmeasured = [n for n, p in sweep.items() if not p["measured"]]
+    assert unmeasured == [], "programs that did not execute: %s" \
+        % unmeasured
+
+
+def test_candidates_named_with_ceilings(artifact):
+    cands = artifact["candidates"]
+    assert len(cands) >= MIN_CANDIDATES
+    kinds = {c["kind"] for c in cands}
+    assert kinds == {"compute", "comm"}, (
+        "candidate list must span both roofline regimes, got %s" % kinds)
+    for c in cands:
+        assert c["program"] and c["unit"]
+        assert c["ceiling"] > 0 and c["ceiling_kind"] in (
+            "flops_per_s", "bytes_per_s")
+
+
+def test_gate_perf_passes_on_real_artifact(artifact, tmp_path):
+    rc, out, err = gate(artifact, tmp_path)
+    assert rc == 0, "gate-perf failed on fresh sweep:\n%s%s" % (out, err)
+    assert "gate-perf: ok" in out
+
+
+def test_gate_perf_exits_3_on_shrunk_budget(sweep, tmp_path):
+    """The injected regression: shrink the slowest program's committed
+    budget twentyfold and re-run the REAL comparison (check_perf, not a
+    doctored flag) — the gate must exit 3 and name the program."""
+    baseline = opprof.load_perf_baseline()
+    victim = max(baseline["programs"],
+                 key=lambda n: baseline["programs"][n]["median_us"])
+    doctored = json.loads(json.dumps(baseline))
+    doctored["programs"][victim]["median_us"] /= 20.0
+    perf = opprof.check_perf(sweep, doctored)
+    report = opprof.build_report(sweep, [], perf, costs.peaks())
+    assert any(p["over_budget"] for p in perf["programs"]
+               if p["name"] == victim)
+    rc, _out, err = gate(report, tmp_path)
+    assert rc == 3
+    assert "gate-perf: FAIL" in err and victim in err
+
+
+def test_gate_perf_exits_3_on_unbudgeted(artifact, tmp_path):
+    doctored = json.loads(json.dumps(artifact))
+    doctored["perf"]["programs"][0]["unbudgeted"] = True
+    rc, _out, err = gate(doctored, tmp_path)
+    assert rc == 3 and "unbudgeted" in err
+
+
+def test_gate_perf_exits_4_when_unmeasurable(artifact, tmp_path):
+    doctored = json.loads(json.dumps(artifact))
+    doctored["perf"]["topology_match"] = False
+    rc, _out, err = gate(doctored, tmp_path)
+    assert rc == 4 and "UNMEASURABLE" in err
+
+
+def test_gate_perf_requires_ops_json():
+    proc = subprocess.run(
+        [sys.executable, TRACE_REPORT, "--gate-perf"],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+def test_combined_gates_report_every_gate(artifact, tmp_path):
+    """Regression for the silent-degradation bug: when perf and memory
+    gates are requested together, BOTH verdict lines print and the exit
+    code is the worst of the two — a failing second gate can no longer
+    hide behind a passing first one."""
+    ops_path = tmp_path / "ops.json"
+    ops_path.write_text(json.dumps(artifact))
+    mem_path = tmp_path / "mem.json"
+    mem_path.write_text(json.dumps({
+        "n_devices": 8, "baseline_present": True,
+        "baseline_n_devices": 8, "topology_match": True,
+        "stale_budgets": [],
+        "programs": [{"name": "p", "origin": "o.py", "specimens": 1,
+                      "total_bytes": 10, "argument_bytes": 5,
+                      "output_bytes": 5, "temp_bytes": 0,
+                      "generated_code_bytes": 0, "budget_bytes": 1,
+                      "over_budget": True, "unbudgeted": False,
+                      "headroom": -9.0}]}))
+    proc = subprocess.run(
+        [sys.executable, TRACE_REPORT,
+         "--memory", str(mem_path), "--gate-memory",
+         "--ops", str(ops_path), "--gate-perf"],
+        capture_output=True, text=True)
+    both = proc.stdout + proc.stderr
+    assert "gate-memory: FAIL" in both
+    assert "gate-perf: ok" in both
+    assert proc.returncode == 3

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Fold the per-round BENCH_r*.json / MULTICHIP_r*.json artifacts into
+one round-sorted trajectory — the ROADMAP "bench trajectory" as a tool
+instead of a pile of files.
+
+Each growth round leaves two breadcrumbs at the repo root: the bench
+harness verdict (``BENCH_rNN.json``: rc, the parsed headline metric,
+calls/step, overlap, health gate) and the multichip dryrun verdict
+(``MULTICHIP_rNN.json``: rc, legs run, health line).  This tool merges
+them per round, attaches the committed PERF_BASELINE.json per-program
+device-time medians (the round-20 ledger), and emits one JSON.
+
+``--check`` turns the trajectory into a regression gate between
+CONSECUTIVE rounds (exit 3 on any flag, 4 when fewer than two rounds
+exist to compare, 0 otherwise):
+
+* bench rc went 0 -> nonzero, or multichip ok went True -> False;
+* ``program_calls_per_step`` grew (the one-program-per-step invariant);
+* ``overlap_ratio`` dropped more than 0.25 absolute;
+* the headline metric dropped more than 10% — compared only when both
+  rounds report the SAME metric name with an img/s-style unit (rounds
+  change workloads; comparing resnet img/s against an overhead delta
+  would be noise dressed as signal, so incomparable pairs are skipped
+  and said so in the output).
+
+Stdlib-only, like the other tools/ CLIs.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+# metric units where bigger is better and cross-round comparison makes
+# sense (throughput); deltas/ratios are gated by their own fields
+_THROUGHPUT_UNIT_RE = re.compile(r"(img|samples|steps|tokens)/s")
+_DROP_FRACTION = 0.10
+_OVERLAP_DROP = 0.25
+
+
+def _load(path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def collect(root):
+    """All rounds found under *root*, sorted by round number."""
+    rounds = {}
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(path)
+        if m:
+            rounds.setdefault(int(m.group(1)), {})["bench"] = _load(path)
+    for path in glob.glob(os.path.join(root, "MULTICHIP_r*.json")):
+        m = _ROUND_RE.search(path)
+        if m:
+            rounds.setdefault(int(m.group(1)), {})["multichip"] = \
+                _load(path)
+    out = []
+    for n in sorted(rounds):
+        bench = rounds[n].get("bench") or {}
+        multi = rounds[n].get("multichip") or {}
+        parsed = bench.get("parsed") or {}
+        out.append({
+            "round": n,
+            "bench_rc": bench.get("rc"),
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "program_calls_per_step": parsed.get(
+                "program_calls_per_step"),
+            "overlap_ratio": parsed.get("overlap_ratio"),
+            "gate_overlap": parsed.get("gate_overlap"),
+            "health_gate": parsed.get("health_gate"),
+            "multichip_rc": multi.get("rc"),
+            "multichip_ok": multi.get("ok"),
+            "multichip_legs": multi.get("legs") or [],
+            "multichip_health": multi.get("health"),
+        })
+    return out
+
+
+def perf_medians(root):
+    """The committed PERF_BASELINE.json per-program device-time medians
+    (None when not recorded yet)."""
+    payload = _load(os.path.join(root, "PERF_BASELINE.json"))
+    if not payload or not isinstance(payload.get("programs"), dict):
+        return None
+    return {"n_devices": payload.get("n_devices"),
+            "tolerance": payload.get("tolerance"),
+            "programs": {name: p.get("median_us")
+                         for name, p in
+                         sorted(payload["programs"].items())}}
+
+
+def check(rounds):
+    """Regressions between consecutive rounds -> list of flag strings
+    (empty = clean)."""
+    flags = []
+    skipped = []
+    for prev, cur in zip(rounds, rounds[1:]):
+        tag = "r%02d->r%02d" % (prev["round"], cur["round"])
+        if prev["bench_rc"] == 0 and (cur["bench_rc"] or 0) != 0:
+            flags.append("%s: bench rc went 0 -> %s"
+                         % (tag, cur["bench_rc"]))
+        if prev["multichip_ok"] is True and cur["multichip_ok"] is False:
+            flags.append("%s: multichip dryrun went ok -> failed" % tag)
+        pc, cc = prev["program_calls_per_step"], \
+            cur["program_calls_per_step"]
+        if pc is not None and cc is not None and cc > pc + 1e-6:
+            flags.append("%s: program_calls_per_step grew %.2f -> %.2f"
+                         % (tag, pc, cc))
+        po, co = prev["overlap_ratio"], cur["overlap_ratio"]
+        if po is not None and co is not None \
+                and co < po - _OVERLAP_DROP:
+            flags.append("%s: overlap_ratio dropped %.3f -> %.3f"
+                         % (tag, po, co))
+        if prev["metric"] and prev["metric"] == cur["metric"] \
+                and _THROUGHPUT_UNIT_RE.search(prev.get("unit") or ""):
+            pv, cv = prev["value"], cur["value"]
+            if pv and cv is not None and pv > 0 \
+                    and cv < pv * (1 - _DROP_FRACTION):
+                flags.append("%s: %s dropped %.2f -> %.2f (>%d%%)"
+                             % (tag, prev["metric"], pv, cv,
+                                int(_DROP_FRACTION * 100)))
+        elif prev["metric"] and cur["metric"] \
+                and prev["metric"] != cur["metric"]:
+            skipped.append("%s: metric changed (%s -> %s), value not "
+                           "compared" % (tag, prev["metric"],
+                                         cur["metric"]))
+    return flags, skipped
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge BENCH_r*/MULTICHIP_r* rounds into one "
+                    "trajectory JSON; --check gates consecutive-round "
+                    "regressions")
+    ap.add_argument("--root", default=None,
+                    help="directory holding the round files (default: "
+                         "the repo root this tool lives in)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the trajectory JSON here instead of "
+                         "stdout")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 3 on a regression between consecutive "
+                         "rounds, 4 when <2 rounds exist")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    rounds = collect(root)
+    flags, skipped = check(rounds)
+    trajectory = {
+        "version": 1,
+        "rounds": rounds,
+        "perf_baseline": perf_medians(root),
+        "regressions": flags,
+        "incomparable": skipped,
+    }
+    blob = json.dumps(trajectory, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(blob + "\n")
+    else:
+        print(blob)
+
+    if args.check:
+        if len(rounds) < 2:
+            print("trajectory: UNMEASURABLE — %d round(s), need 2 to "
+                  "compare" % len(rounds), file=sys.stderr)
+            return 4
+        for line in skipped:
+            print("trajectory: skip — %s" % line, file=sys.stderr)
+        if flags:
+            for line in flags:
+                print("trajectory: FAIL — %s" % line, file=sys.stderr)
+            return 3
+        print("trajectory: ok — %d rounds, no consecutive-round "
+              "regressions" % len(rounds))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

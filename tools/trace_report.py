@@ -755,41 +755,27 @@ def main(argv=None):
                          "over budget or unbudgeted, 4 when the report "
                          "cannot measure (topology mismatch / empty) — "
                          "the JX204 verdict as a CI gate")
+    ap.add_argument("--ops", default=None, metavar="OPSJSON",
+                    help="hot-op mode: render the ranked per-op "
+                         "roofline table and kernel candidates of a "
+                         "``python -m mxnet_tpu.telemetry.opprof "
+                         "--json`` artifact")
+    ap.add_argument("--gate-perf", action="store_true",
+                    help="with --ops: exit 3 when any program exceeds "
+                         "its PERF_BASELINE device-time budget or is "
+                         "unbudgeted, 4 when the report cannot measure "
+                         "(topology mismatch / empty)")
     args = ap.parse_args(argv)
 
+    # gates declare their evidence up front: a gate whose input section
+    # is missing is a usage error (2), never a silent skip
     if args.gate_memory and args.memory is None:
         ap.error("--gate-memory requires --memory MEMJSON")
-
-    if args.memory is not None:
-        try:
-            with open(args.memory) as fh:
-                report = json.load(fh)
-        except (OSError, ValueError) as exc:
-            print("memory: cannot read %s: %s" % (args.memory, exc),
-                  file=sys.stderr)
-            return 2
-        if args.as_json:
-            print(json.dumps(report, indent=1, sort_keys=True))
-        else:
-            print(render_memory(report))
-        if args.gate_memory:
-            return gate_memory(report)
-        return 0
-
-    if args.health is not None:
-        try:
-            with open(args.health) as fh:
-                export = json.load(fh)
-        except (OSError, ValueError) as exc:
-            print("health: cannot read %s: %s" % (args.health, exc),
-                  file=sys.stderr)
-            return 2
-        report = health_report(export)
-        if args.as_json:
-            print(json.dumps(report, indent=1, sort_keys=True))
-        else:
-            print(render_health(report))
-        return 0
+    if args.gate_perf and args.ops is None:
+        ap.error("--gate-perf requires --ops OPSJSON")
+    if args.gate_overlap is not None and args.trace is None \
+            and args.fleet is None:
+        ap.error("--gate-overlap requires a trace file")
 
     if args.fleet is not None:
         summary = fleet_report(args.fleet, out_path=args.out)
@@ -799,20 +785,68 @@ def main(argv=None):
             print(render_fleet(summary))
         return 0 if summary["ranks"] else 2
 
-    if args.trace is None:
-        ap.error("a trace file is required (or use --fleet DIR)")
-    events = load_events(args.trace)
-    snapshot = load_snapshot(args.snapshot) if args.snapshot else None
-    report = build_report(events, snapshot, args.top)
+    def _load(path, label):
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError) as exc:
+            print("%s: cannot read %s: %s" % (label, path, exc),
+                  file=sys.stderr)
+            raise
+
+    # every requested section renders; every requested gate runs; the
+    # exit code is the worst gate verdict — combining --gate-overlap /
+    # --gate-memory / --gate-perf must never silently drop one
+    sections = []               # (key, payload, render thunk)
+    mem_payload = ops_payload = trace_payload = None
+    try:
+        if args.memory is not None:
+            mem_payload = _load(args.memory, "memory")
+            sections.append(("memory", mem_payload,
+                             lambda r=mem_payload: render_memory(r)))
+        if args.ops is not None:
+            ops_payload = _load(args.ops, "ops")
+            sections.append(("ops", ops_payload,
+                             lambda r=ops_payload:
+                             render_ops(r, args.top)))
+        if args.health is not None:
+            export = _load(args.health, "health")
+            hreport = health_report(export)
+            sections.append(("health", hreport,
+                             lambda r=hreport: render_health(r)))
+    except (OSError, ValueError):
+        return 2
+    if args.trace is not None:
+        events = load_events(args.trace)
+        snapshot = load_snapshot(args.snapshot) if args.snapshot \
+            else None
+        trace_payload = build_report(events, snapshot, args.top)
+        empty = not events and not snapshot
+        sections.append(("trace", trace_payload,
+                         lambda r=trace_payload, e=empty:
+                         "no events" if e else render(r, args.top)))
+
+    if not sections:
+        ap.error("a trace file is required (or use --fleet DIR / "
+                 "--memory / --ops / --health)")
+
     if args.as_json:
-        print(json.dumps(report, indent=1, sort_keys=True))
-    elif not events and not snapshot:
-        print("no events")
+        if len(sections) == 1:
+            print(json.dumps(sections[0][1], indent=1, sort_keys=True))
+        else:
+            print(json.dumps({k: p for k, p, _r in sections},
+                             indent=1, sort_keys=True))
     else:
-        print(render(report, args.top))
-    if args.gate_overlap is not None:
-        return gate_overlap(report, args.gate_overlap)
-    return 0
+        print("\n\n".join(r() for _k, _p, r in sections))
+
+    rcs = []
+    if args.gate_overlap is not None and trace_payload is not None:
+        rcs.append(gate_overlap(trace_payload, args.gate_overlap))
+    if args.gate_memory:
+        rcs.append(gate_memory(mem_payload))
+    if args.gate_perf:
+        rcs.append(gate_perf(ops_payload.get("perf") or {}))
+    return max(rcs) if rcs else 0
 
 
 def _fmt_bytes(n):
@@ -896,6 +930,123 @@ def gate_memory(report):
     print("gate-memory: ok — %d program(s) within budget (+%d%% "
           "tolerance)" % (len(programs),
                           int((report.get("tolerance") or 0) * 100)))
+    return 0
+
+
+def _fmt_rate(v, unit):
+    if v is None or v <= 0:
+        return "-"
+    for scale, prefix in ((1e12, "T"), (1e9, "G"), (1e6, "M")):
+        if v >= scale:
+            return "%.1f%s%s" % (v / scale, prefix, unit)
+    return "%.0f%s" % (v, unit)
+
+
+def render_ops(report, top=10):
+    """The ranked hot-op table and kernel-candidate list of an opprof
+    ``--json`` artifact: every owned program's fusions, rooflined."""
+    lines = ["hot ops: %d program(s), %.1f ms measured, machine "
+             "balance %.2f FLOP/B (peaks: %s, HBM %s, ICI %s)"
+             % (len(report.get("programs", {})),
+                (report.get("total_measured_us") or 0) / 1e3,
+                report.get("machine_balance") or 0,
+                _fmt_rate((report.get("peaks") or {}).get("flops"),
+                          "FLOP/s"),
+                _fmt_rate((report.get("peaks") or {}).get("hbm_bw"),
+                          "B/s"),
+                _fmt_rate((report.get("peaks") or {}).get("ici_bw"),
+                          "B/s"))]
+    rows = []
+    for name, p in (report.get("programs") or {}).items():
+        for u in p.get("units", ()):
+            rows.append((u.get("attributed_us") or 0.0, name, u))
+    rows.sort(key=lambda r: -r[0])
+    lines.append("  %-26s %-28s %-11s %8s %-7s %10s %7s %9s"
+                 % ("program", "unit", "class", "FLOP/B", "bound",
+                    "ceiling", "share", "us"))
+    for us, name, u in rows[:top]:
+        lines.append("  %-26s %-28s %-11s %8.2f %-7s %10s %6.1f%% %9.1f"
+                     % (name[:26], u.get("unit", "?")[:28],
+                        u.get("op_class", "?"),
+                        u.get("intensity") or 0.0,
+                        u.get("bound", "?"),
+                        _fmt_rate(u.get("ceiling"),
+                                  "F/s" if u.get("ceiling_kind") ==
+                                  "flops_per_s" else "B/s"),
+                        100 * (u.get("share") or 0.0), us))
+    cands = report.get("candidates") or []
+    if cands:
+        lines.append("")
+        lines.append("kernel candidates (ROADMAP item-2 handoff):")
+        for i, c in enumerate(cands, 1):
+            lines.append(
+                "  %d. [%s] %s :: %s  %s/%s  ceiling %s  "
+                "share %.2f%%  score %.4f"
+                % (i, c.get("kind", "?"), c.get("program", "?"),
+                   c.get("unit", "?"), c.get("op_class", "?"),
+                   c.get("bound", "?"),
+                   _fmt_rate(c.get("ceiling"),
+                             "F/s" if c.get("ceiling_kind") ==
+                             "flops_per_s" else "B/s"),
+                   100 * (c.get("global_share") or 0.0),
+                   c.get("score") or 0.0))
+    perf = report.get("perf") or {}
+    if perf:
+        lines.append("")
+        lines.append("device-time budgets: %d program(s), tolerance "
+                     "+%d%% (slack %dus)"
+                     % (len(perf.get("programs", ())),
+                        int((perf.get("tolerance") or 0) * 100),
+                        int(perf.get("slack_us") or 0)))
+        for p in sorted(perf.get("programs", ()),
+                        key=lambda e: -(e.get("median_us") or 0)):
+            if p.get("over_budget"):
+                verdict = "OVER"
+            elif p.get("unbudgeted"):
+                verdict = "unbudgeted"
+            else:
+                verdict = "ok"
+            lines.append("  %-40s %9.1fus  budget %9s  %s"
+                         % (p.get("name", "?"),
+                            p.get("median_us") or 0.0,
+                            ("%.1fus" % p["budget_us"])
+                            if p.get("budget_us") is not None else "-",
+                            verdict))
+    problems = report.get("problems") or []
+    for prob in problems:
+        lines.append("  problem: %s" % prob)
+    return "\n".join(lines)
+
+
+def gate_perf(report):
+    """The --gate-perf exit policy (mirrors --gate-memory over the
+    opprof perf section): 0 when every program is within its
+    device-time budget; 3 when any is over budget or unbudgeted; 4
+    when the comparison cannot measure — topology mismatch or no
+    programs (a gate that cannot measure must fail loudly)."""
+    programs = report.get("programs") or []
+    if not programs or not report.get("topology_match"):
+        why = "no programs in the report" if not programs else \
+            ("baseline n_devices=%s vs live n_devices=%s"
+             % (report.get("baseline_n_devices"),
+                report.get("n_devices")))
+        print("gate-perf: UNMEASURABLE — %s" % why, file=sys.stderr)
+        return 4
+    over = [p["name"] for p in programs if p.get("over_budget")]
+    unbudgeted = [p["name"] for p in programs if p.get("unbudgeted")]
+    if over or unbudgeted:
+        parts = []
+        if over:
+            parts.append("over budget: %s" % ", ".join(sorted(over)))
+        if unbudgeted:
+            parts.append("unbudgeted: %s" % ", ".join(sorted(unbudgeted)))
+        print("gate-perf: FAIL — %s" % "; ".join(parts),
+              file=sys.stderr)
+        return 3
+    print("gate-perf: ok — %d program(s) within device-time budget "
+          "(+%d%% tolerance)" % (len(programs),
+                                 int((report.get("tolerance") or 0)
+                                     * 100)))
     return 0
 
 
