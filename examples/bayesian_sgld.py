@@ -35,7 +35,6 @@ def main():
     ap.add_argument("--lr", type=float, default=0.05)
     args = ap.parse_args()
 
-    np.random.seed(3)
     mx.random.seed(3)
     rng = np.random.RandomState(8)
 

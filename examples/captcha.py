@@ -114,7 +114,6 @@ def main():
     ap.add_argument("--lr", type=float, default=0.005)
     args = ap.parse_args()
 
-    np.random.seed(1)
     mx.random.seed(1)
     rng = np.random.RandomState(6)
     x, y = make_dataset(args.num_images, rng)

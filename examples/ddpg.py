@@ -91,7 +91,6 @@ def main():
     ap.add_argument("--noise", type=float, default=0.3)
     args = ap.parse_args()
 
-    np.random.seed(10)
     mx.random.seed(10)
     env = Docking(seed=1)
     rng = np.random.RandomState(2)

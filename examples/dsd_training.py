@@ -93,7 +93,6 @@ def main():
     ap.add_argument("--lr", type=float, default=0.1)
     args = ap.parse_args()
 
-    np.random.seed(2)
     mx.random.seed(2)
     rng = np.random.RandomState(4)
     x, y, w_true = make_data(rng)

@@ -67,7 +67,6 @@ def main():
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args()
 
-    np.random.seed(6)
     mx.random.seed(6)
     workdir = args.workdir or tempfile.mkdtemp(prefix="ndsb_")
 

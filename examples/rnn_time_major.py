@@ -62,8 +62,7 @@ def build(seq_len, vocab, hidden, layout):
 
 
 def train(layout, data, target, args, vocab):
-    np.random.seed(100)      # identical init across layouts
-    mx.random.seed(100)
+    mx.random.seed(100)   # identical init across layouts
     it = mx.io.NDArrayIter(data, target, batch_size=args.batch_size,
                            label_name="softmax_label")
     sym = build(args.seq_len, vocab, args.hidden, layout)

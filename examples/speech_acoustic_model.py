@@ -92,7 +92,6 @@ def main():
     ap.add_argument("--lr", type=float, default=0.01)
     args = ap.parse_args()
 
-    np.random.seed(4)
     mx.random.seed(4)
     rng = np.random.RandomState(14)
     bank = phoneme_bank(rng)

@@ -74,7 +74,8 @@ class StochasticDepthBlock(gluon.Block):
     def forward(self, x):
         branch = self.body(x)
         if autograd.is_training():
-            gate = float(np.random.rand() >= self.death_rate)
+            gate = float(mx.random.host_rng().random()
+                         >= self.death_rate)
             out = x + gate * branch
         else:
             out = x + (1.0 - self.death_rate) * branch
@@ -108,7 +109,6 @@ def main():
     ap.add_argument("--lr", type=float, default=0.005)
     args = ap.parse_args()
 
-    np.random.seed(13)
     mx.random.seed(13)
     rng = np.random.RandomState(21)
     x, y = make_texture_data(args.num_images, args.image_size, rng)

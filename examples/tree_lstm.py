@@ -198,7 +198,6 @@ def main():
     ap.add_argument("--lr", type=float, default=0.01)
     args = ap.parse_args()
 
-    np.random.seed(5)
     mx.random.seed(5)
     rng = np.random.RandomState(11)
     lefts, rights, ys = [], [], []

@@ -64,7 +64,9 @@ def main():
         def forward(self, x):
             h = self.enc(x)
             mu, logvar = self.mu(h), self.logvar(h)
-            eps = nd.array(np.random.randn(*mu.shape).astype(np.float32))
+            eps = nd.array(mx.random.host_rng()
+                           .standard_normal(mu.shape)
+                           .astype(np.float32))
             z = mu + nd.exp(0.5 * logvar) * eps     # reparameterization
             logits = self.dec2(self.dec1(z))
             return logits, mu, logvar
@@ -85,7 +87,7 @@ def main():
 
     train_x = make_set(1024)
     val_x = make_set(256, rng=np.random.RandomState(91))
-    np.random.seed(0)
+    mx.random.seed(0)
     elbo0, _ = elbo_terms(net, nd.array(val_x))
     elbo0 = float(elbo0.asnumpy())
 

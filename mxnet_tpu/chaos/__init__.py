@@ -67,6 +67,7 @@ import time
 import zlib
 from random import Random
 
+from ..lint import lockwitness as _lockwitness
 from .spec import (ChaosSpecError, Fault, Rule, KINDS, SITES,  # noqa: F401
                    parse_spec, parse_duration)
 
@@ -90,7 +91,7 @@ class ChaosPlan:
         self.seed = env_seed if env_seed is not None \
             else (0 if seed is None else int(seed))
         self.rules = rules
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("ChaosPlan._lock")
         self._counts = [0] * len(rules)
         self._kcounts = [{} for _ in rules]   # per-rule {key: count}
         self._rngs = {}
@@ -186,7 +187,7 @@ class ChaosPlan:
 
 _PLAN = None
 _ACTIVE = False
-_CONF_LOCK = threading.Lock()
+_CONF_LOCK = _lockwitness.make_lock("chaos._CONF_LOCK")
 
 
 def active():

@@ -203,8 +203,12 @@ class CheckpointManager:
         self._final_done = True
         if self._grace_timer is not None:
             self._grace_timer.cancel()
-            self._grace_timer = None
-        self._preempt_at = None
+            # the signal/timer side is lock-free BY DESIGN (_on_sigterm
+            # runs in signal context where taking locks can deadlock);
+            # both fields are single-word writes and every reader
+            # tolerates either ordering
+            self._grace_timer = None      # graftlint: disable=JG011
+        self._preempt_at = None           # graftlint: disable=JG011
 
     def wait(self):
         """Block until every enqueued snapshot has been committed (or
@@ -348,7 +352,9 @@ class CheckpointManager:
                 return self.last_committed_step == step
             return True
         snap = self._capture(step, reason)
-        self._last_enqueued = step
+        # racing the writer's failure-path reset (_write_with_retry) is
+        # benign: worst case one extra re-save of an already-landed step
+        self._last_enqueued = step        # graftlint: disable=JG011
         self._queue.put(snap)
         if sync:
             self._queue.join()
@@ -501,7 +507,9 @@ class CheckpointManager:
                 self._last_enqueued = None     # re-saves must re-attempt
             if self.last_committed_step is not None \
                     and self.last_committed_step > step:
-                self.last_committed_step = step
+                # not racing _commit: self.wait() above drained the
+                # writer queue, so the writer thread is parked in get()
+                self.last_committed_step = step  # graftlint: disable=JG011
             _flight.record("checkpoint", "discard-newer", than=step,
                            discarded=discarded)
         return discarded

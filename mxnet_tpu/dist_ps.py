@@ -49,6 +49,7 @@ from random import Random as _JitterRandom
 import numpy as np
 
 from . import chaos as _chaos
+from .lint import lockwitness as _lockwitness
 from .telemetry import core as _tel
 from .telemetry import flight as _flight
 
@@ -329,7 +330,7 @@ class Conn:
 
     def __init__(self, sock, timeout=None):
         self.sock = sock
-        self._wlock = threading.Lock()
+        self._wlock = _lockwitness.make_lock("Conn._wlock")
         self._timeout = timeout
         self._broken = None
         try:
@@ -391,6 +392,10 @@ class Conn:
                 if kind == "drop":
                     return                  # frame vanishes on the wire
                 if kind in ("delay", "stall"):
+                    # a lock held across this injected stall is exactly
+                    # the wedge JG010 hunts — tell the witness
+                    _lockwitness.note_blocking("conn.send(chaos-%s)"
+                                               % kind)
                     time.sleep(act[1])
                 elif kind == "close":
                     self.close()
@@ -398,11 +403,15 @@ class Conn:
                         "chaos: connection closed before send")
                 elif kind == "garbage":
                     with self._wlock:
+                        # _wlock IS the frame-write serializer: leaf
+                        # lock, nothing ever nests under it
+                        # graftlint: disable=JG010
                         self.sock.sendall(b"\xde\xad\xbe\xef" * 4)
                     return
                 else:
                     _chaos.apply_inline(act)
         with self._wlock:
+            # graftlint: disable=JG010 — leaf write lock, see above
             self.sock.sendall(
                 _HDR.pack(_MAGIC, _WIRE_VERSION, len(blob)) + blob)
 
@@ -422,6 +431,8 @@ class Conn:
             if act is not None:
                 kind = act[0]
                 if kind in ("delay", "stall"):
+                    _lockwitness.note_blocking("conn.recv(chaos-%s)"
+                                               % kind)
                     time.sleep(act[1])
                 elif kind == "close":
                     self.close()            # the read below sees EOF
@@ -601,7 +612,7 @@ def placement(key, shape, nserv):
 # ---------------------------------------------------------------------------
 
 _NODES = {}               # (role, rank) -> zero-arg dict provider
-_NODES_LOCK = threading.Lock()
+_NODES_LOCK = _lockwitness.make_lock("dist_ps._NODES_LOCK")
 _SCHEDULER_REF = None     # weakref to the in-process Scheduler, if any
 _PEER_SNAPSHOT = None     # (unix_time, table) last fetched by a worker
 _FLEET_SNAPSHOT = None    # (unix_time, table) last fetched by a worker
@@ -850,8 +861,9 @@ class Scheduler:
         self.server_addrs = [None] * nservers
         self.server_conns = []
         self.worker_conns = {}
-        self._lock = threading.Lock()
-        self._registered = threading.Condition(self._lock)
+        self._lock = _lockwitness.make_lock("Scheduler._lock")
+        self._registered = _lockwitness.make_condition(
+            self._lock, "Scheduler._registered")
         self._barrier_waiters = []
         self._barrier_gen = 0
         self._finalized = 0
@@ -1088,13 +1100,17 @@ class Scheduler:
                     self._hb[("worker", rank)] = time.monotonic()
                 continue
             if msg[0] == "num_dead":
+                # snapshot under the lock, write to the peer outside it:
+                # a stalled reader must not wedge the scheduler table
                 with self._lock:
-                    conn.send(("num_dead", len(self.dead_workers)))
+                    reply = ("num_dead", len(self.dead_workers))
+                conn.send(reply)
                 continue
             if msg[0] == "servers":
                 with self._lock:
-                    conn.send(("servers", list(self.server_addrs),
-                               sorted(self.dead_servers)))
+                    reply = ("servers", list(self.server_addrs),
+                             sorted(self.dead_servers))
+                conn.send(reply)
                 continue
             if msg[0] == "peers":
                 conn.send(("peers", self.peer_table()))
@@ -1104,6 +1120,7 @@ class Scheduler:
                 continue
             if msg[0] == "barrier":
                 fail = None
+                done = []
                 with self._lock:
                     departed = self.dead_workers | self._finalized_ranks
                     if departed:
@@ -1118,8 +1135,9 @@ class Scheduler:
                         gen = self._barrier_gen
                         self._barrier_waiters.append(conn)
                         if len(self._barrier_waiters) == self.nworkers:
-                            for c in self._barrier_waiters:
-                                c.send(("barrier_done",))
+                            # release outside the lock: one slow worker
+                            # socket must not hold the whole table hostage
+                            done = self._barrier_waiters
                             self._barrier_waiters = []
                             self._barrier_gen += 1
                             self._registered.notify_all()
@@ -1129,6 +1147,8 @@ class Scheduler:
                                 self._registered.wait()
                             # woken by _mark_dead's sweep: it already
                             # sent barrier_failed on this conn
+                for c in done:
+                    c.send(("barrier_done",))
                 if fail is not None:
                     conn.send(("barrier_failed", fail))
                 continue
@@ -1199,8 +1219,8 @@ class Server:
         self.pending = {}      # (key, ts) -> _PendingAgg
         self.updater = None
         self.sync = True
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = _lockwitness.make_lock("Server._lock")
+        self._cv = _lockwitness.make_condition(self._lock, "Server._cv")
 
     def handle(self, msg):
         """Process one request; return the reply (or None)."""
@@ -1494,7 +1514,7 @@ class WorkerTransport:
         self.server_conns = [Conn.connect(a) for a in self.server_addrs]
         self.nservers = len(self.server_conns)
         self._ts = {}     # key -> push timestamp counter
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("WorkerTransport._lock")
         self._hb_stop = _start_heartbeat("worker", self.rank)
         _register_node("worker", self.rank,
                        lambda: {"nservers": self.nservers})

@@ -41,6 +41,7 @@ import jax
 import numpy as np
 
 from . import chaos as _chaos
+from .lint import lockwitness as _lockwitness
 from .lint import sanitizer as _san
 from .telemetry import flight as _flight
 
@@ -111,7 +112,7 @@ def push(fn, *args, **kwargs):
 # time.  This avoids per-task CFUNCTYPE closures entirely — nothing to
 # keep alive per task, nothing to free while a C stack frame might still
 # reference it.
-_TASKS_LOCK = threading.Lock()
+_TASKS_LOCK = _lockwitness.make_lock("engine._TASKS_LOCK")
 _LIVE_TASKS = {}          # key -> (engine, callable)
 _KEY_SEQ = itertools.count(1)
 _TRAMPOLINE = None        # created on first native engine
@@ -144,8 +145,9 @@ class _EngineCore:
     def __init__(self, nat, h):
         self.nat = nat
         self.h = h
-        self.lock = threading.Lock()
-        self.idle = threading.Condition(self.lock)
+        self.lock = _lockwitness.make_lock("_EngineCore.lock")
+        self.idle = _lockwitness.make_condition(self.lock,
+                                                "_EngineCore.idle")
         self.inflight = 0
 
     def enter(self):
@@ -411,7 +413,7 @@ class ThreadedEngine:
 
 
 _SINGLETON = None
-_SINGLETON_LOCK = threading.Lock()
+_SINGLETON_LOCK = _lockwitness.make_lock("engine._SINGLETON_LOCK")
 
 
 def engine():

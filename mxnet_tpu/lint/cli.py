@@ -21,6 +21,21 @@ import sys
 from .core import (Baseline, default_baseline_path, iter_python_files,
                    lint_paths, load_baseline, repo_root)
 
+# a diff touching any of these re-lints the whole concurrency tier: the
+# JG009 order graph and JG010 blocking closures span module boundaries
+import re as _re
+_CONCURRENCY_TIER_RE = _re.compile(
+    r"(^|/)(dist_ps\.py|engine\.py)$|(^|/)(serving|checkpoint)/")
+_CONCURRENCY_TIER = (
+    "mxnet_tpu/dist_ps.py",
+    "mxnet_tpu/engine.py",
+    "mxnet_tpu/serving",
+    "mxnet_tpu/checkpoint",
+    "mxnet_tpu/guardian",
+    "mxnet_tpu/chaos",
+    "mxnet_tpu/gluon/overlap.py",
+)
+
 
 def build_parser():
     p = argparse.ArgumentParser(
@@ -237,6 +252,18 @@ def main(argv=None):
                 print("graftlint: no changed Python files vs %s"
                       % args.diff)
                 return 0
+            # the lock graph is a WHOLE-TIER property: a diff touching
+            # any threaded module re-lints the full concurrency tier, or
+            # a new acquisition edge in the changed file would be judged
+            # against a lock graph that was never linked
+            if any(_CONCURRENCY_TIER_RE.search(
+                    os.path.relpath(p, root).replace(os.sep, "/"))
+                    for p in paths):
+                tier = [os.path.join(root, rel) for rel in
+                        _CONCURRENCY_TIER if
+                        os.path.exists(os.path.join(root, rel))]
+                known = set(paths)
+                paths.extend(p for p in tier if p not in known)
 
         files = iter_python_files(paths)
         if not files:

@@ -1,0 +1,1106 @@
+"""graftlock — static lock-graph analysis for the threaded tier.
+
+Three rules over the shared graftlint AST facts plus the cross-module
+``link_project`` call graph:
+
+* **JG009 lock-order-cycle** — build the per-module lock-acquisition
+  graph (``with self._lock:`` / ``.acquire()`` sites), propagate
+  acquisitions through the call graph (including cross-module edges),
+  and flag cycles in the global lock-order digraph.  A cycle means two
+  threads taking the same locks in opposite orders can deadlock.
+* **JG010 blocking-under-lock** — a JG007-class blocking call (socket
+  recv, connection send, ``queue.get``/``Condition.wait`` without
+  timeout, engine/device waits) reachable while a lock is held turns
+  one slow peer into a process-wide stall.
+* **JG011 unguarded-shared-mutation** — a ``self.X`` attribute written
+  both from a thread-entry path (``Thread(target=...)`` / ``Timer`` /
+  an escaping bound-method callback) and from a public method with no
+  common guarding lock.
+
+Lock identity
+-------------
+A lock is identified by its *declaring* attribute, class-qualified:
+``self._lock = threading.Lock()`` inside ``class Scheduler`` is the
+node ``Scheduler._lock``; module-level locks are module-qualified
+(``engine._TASKS_LOCK``).  ``threading.Condition(self._lock)`` — and
+the :mod:`.lockwitness` funnel's ``make_condition(self._lock, ...)`` —
+alias the condition attribute to its underlying lock, so waiting on
+``self._cv`` *is* holding ``Server._lock``.  An acquisition through a
+receiver whose class cannot be inferred (``handle._lock`` where
+``handle`` came out of a dict) counts as *held* for JG010 but
+contributes no order edge: a wrong identity guess would fabricate
+cycles, and a fabricated deadlock report is worse than a missed edge.
+
+Non-blocking acquires (``acquire(blocking=False)`` or with a timeout)
+take no order edge either — a trylock cannot complete a deadlock cycle
+— but the lock still counts as held for everything nested under it.
+``Condition.wait()`` while holding only that condition's own lock is
+the sanctioned wait idiom and is exempt from JG010; the same wait
+reached while any *other* lock is held is flagged.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import parent
+from .rules import (_facts, _fixpoint, _import_targets, _module_dotted,
+                    register)
+
+__all__ = ["link_lock_project"]
+
+# constructor spellings that declare a lock: stdlib threading plus the
+# lockwitness runtime funnel (the repo's own constructors after PR 20)
+_CTOR_KIND = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "make_lock": "lock", "make_rlock": "rlock",
+    "make_condition": "condition",
+}
+_THREADING_HEADS = ("threading", "_thread")
+
+# sync primitives whose internal state is already thread-safe: writes to
+# these attributes are not JG011 shared-mutation hazards
+_PRIMITIVE_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "LifoQueue", "PriorityQueue",
+    "SimpleQueue", "local", "make_lock", "make_rlock", "make_condition",
+}
+
+# receivers that look like a lock even when undeclared (for `with x:`
+# disambiguation against files/meshes/jit-disable context managers)
+_LOCKISH_RE = re.compile(
+    r"(^|_)(lock|rlock|mutex|cv|cond|condition)\d*$", re.IGNORECASE)
+
+# receivers that look like a connection/socket (blocking send surface)
+_CONNISH_RE = re.compile(
+    r"(^|_)(conn|sock|socket|peer|sched|chan|pipe)\d*$", re.IGNORECASE)
+
+# receivers that look like a queue (same doctrine as JG007)
+_QUEUEISH_RE = re.compile(r"(^|_)(q|queue|inbox|mailbox)$", re.IGNORECASE)
+
+_ENGINE_WAITS = {"wait_for_all", "wait_for_var", "wait_to_read",
+                 "block_until_ready"}
+
+_MUTATOR_METHODS = {"append", "extend", "add", "insert", "remove",
+                    "discard", "pop", "popleft", "popitem", "clear",
+                    "update", "setdefault", "appendleft"}
+
+# names collections/stdlib primitives answer to: never resolve these via
+# the unique-method-owner fallback
+_GENERIC_METHODS = {"get", "put", "wait", "notify", "notify_all", "join",
+                    "send", "recv", "close", "items", "keys", "values",
+                    "copy", "start", "cancel", "set", "read", "write"}
+
+_THREAD_CTOR_RE = re.compile(r"(^|\.)(Thread|Timer)$")
+
+
+# ---------------------------------------------------------------------------
+# project model
+# ---------------------------------------------------------------------------
+
+class _ClassInfo:
+    def __init__(self, name, mod, node):
+        self.name = name
+        self.mod = mod
+        self.node = node
+        self.locks = {}            # attr -> kind
+        self.cond_alias = {}       # condition attr -> underlying lock attr
+        self.attr_types = {}       # attr -> class name (self.x = Foo())
+        self.primitive_attrs = set()
+        self.methods = {}          # name -> [(mod, FunctionDef)]
+
+    def lock_id(self, attr):
+        seen = set()
+        while attr in self.cond_alias and attr not in seen:
+            seen.add(attr)
+            attr = self.cond_alias[attr]
+        return "%s.%s" % (self.name, attr)
+
+
+class _FuncScan:
+    """Per-function summary: what it acquires, where it blocks, whom it
+    calls (with the locks held at each point), and what it mutates."""
+
+    def __init__(self, fkey, mod, fd, cls):
+        self.fkey = fkey
+        self.mod = mod
+        self.fd = fd
+        self.cls = cls
+        self.local_types = {}
+        self.acquires = []    # (lock_id|None, label, node, held, blocking)
+        self.blockings = []   # (desc, node, held, exempt)
+        self.calls = []       # (call_node, held)
+        self.call_targets = {}    # id(call_node) -> [callee fkeys]
+        self.mutations = []   # (attr, node, held)
+        self.acq_closure = set()
+        self.block_closure = {}   # desc -> "path:line"
+        self.caller_guard = None  # locks held at EVERY call site, or None
+
+
+class _Project:
+    """One linked analysis over every module in the scan."""
+
+    def __init__(self, mods):
+        self.mods = mods
+        self.classes = {}          # class name -> _ClassInfo (first wins)
+        self.module_locks = {}     # (modtail, name) -> kind
+        self.lock_decl_attr = {}   # attr -> {class names declaring it}
+        self.method_owners = {}    # method name -> {class names}
+        self.funcs = {}            # fkey -> _FuncScan
+        self.edges = {}            # (held_id, acquired_id) -> (mod, node)
+        self.findings = {}         # mod -> rule -> [(node, message)]
+        self.modnames = {}         # mod -> dotted name
+        self.modtails = {}         # mod -> short name
+        for mod in mods:
+            dotted = _module_dotted(mod.path) or mod.path
+            self.modnames[mod] = dotted
+            self.modtails[mod] = dotted.rsplit(".", 1)[-1]
+            self.findings[mod] = {"JG009": [], "JG010": [], "JG011": []}
+
+    def book(self, rule, mod, node, message):
+        self.findings[mod][rule].append((node, message))
+
+
+def _held_ids(held):
+    return frozenset(h[0] for h in held if h[0] is not None)
+
+
+def _held_names(held):
+    out = []
+    for h in held:
+        name = h[0] or h[1]
+        if name not in out:
+            out.append(name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 1: declarations (locks, aliases, attribute types, methods)
+# ---------------------------------------------------------------------------
+
+def _ctor_kind(facts, value):
+    if not isinstance(value, ast.Call):
+        return None
+    qual = facts.qualname(value.func)
+    if qual is None:
+        return None
+    last = qual.rsplit(".", 1)[-1]
+    if last in ("Lock", "RLock", "Condition"):
+        # require a threading base so e.g. multiprocessing.Lock or a
+        # project class named Lock does not register as one
+        head = qual.split(".")[0].lstrip(".")
+        if head in _THREADING_HEADS or "lockwitness" in qual:
+            return _CTOR_KIND[last]
+        return None
+    return _CTOR_KIND.get(last)
+
+
+def _is_primitive_ctor(facts, value):
+    if not isinstance(value, ast.Call):
+        return False
+    qual = facts.qualname(value.func)
+    return qual is not None \
+        and qual.rsplit(".", 1)[-1] in _PRIMITIVE_CTORS
+
+
+def _enclosing_class(node):
+    p = parent(node)
+    while p is not None:
+        if isinstance(p, ast.ClassDef):
+            return p
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a method's statements belong to the class; keep climbing
+            p = parent(p)
+            continue
+        if isinstance(p, ast.Module):
+            return None
+        p = parent(p)
+    return None
+
+
+def _inside_function(node):
+    p = parent(node)
+    while p is not None:
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return True
+        p = parent(p)
+    return False
+
+
+def _cond_underlying(call):
+    """The ``self.X`` attr a Condition/make_condition wraps, if any."""
+    cands = list(call.args[:1]) + \
+        [kw.value for kw in call.keywords if kw.arg == "lock"]
+    for arg in cands:
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id == "self":
+            return arg.attr
+    return None
+
+
+def _collect_declarations(proj):
+    for mod in proj.mods:
+        facts = _facts(mod)
+        tail = proj.modtails[mod]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                info = proj.classes.setdefault(
+                    node.name, _ClassInfo(node.name, mod, node))
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        info.methods.setdefault(item.name, []).append(
+                            (mod, item))
+                        proj.method_owners.setdefault(
+                            item.name, set()).add(node.name)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt, val = node.targets[0], node.value
+            kind = _ctor_kind(facts, val)
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                cls = _enclosing_class(node)
+                if cls is None or cls.name not in proj.classes:
+                    continue
+                info = proj.classes[cls.name]
+                if kind is not None:
+                    info.locks[tgt.attr] = kind
+                    proj.lock_decl_attr.setdefault(
+                        tgt.attr, set()).add(cls.name)
+                    if kind == "condition":
+                        under = _cond_underlying(val)
+                        if under is not None:
+                            info.cond_alias[tgt.attr] = under
+                if _is_primitive_ctor(facts, val):
+                    info.primitive_attrs.add(tgt.attr)
+                if isinstance(val, ast.Call) \
+                        and isinstance(val.func, ast.Name):
+                    info.attr_types[tgt.attr] = val.func.id
+            elif isinstance(tgt, ast.Name) and kind is not None \
+                    and _enclosing_class(node) is None \
+                    and not _inside_function(node):
+                proj.module_locks[(tail, tgt.id)] = kind
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function scan with lexical held-sets
+# ---------------------------------------------------------------------------
+
+def _recv_name(expr):
+    """Rightmost simple name of a receiver (``self.a.b`` -> "b")."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _expr_label(expr):
+    parts = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif not parts:
+        return None
+    return ".".join(reversed(parts))
+
+
+def _local_types(fd, proj):
+    """name -> class for ``x = ClassName(...)`` assignments in *fd*."""
+    out = {}
+    for node in ast.walk(fd):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Name) \
+                and node.value.func.id in proj.classes:
+            out[node.targets[0].id] = node.value.func.id
+    return out
+
+
+def _resolve_lock_expr(proj, scan, expr):
+    """(lock_id, label) for an expression used as a lock; (None, label)
+    when it is lock-like but unresolvable; (None, None) when it is not a
+    lock at all."""
+    label = _expr_label(expr)
+    tail = proj.modtails[scan.mod]
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                     ast.Name):
+        base, attr = expr.value.id, expr.attr
+        if base == "self":
+            cls = scan.cls
+            if cls is not None and attr in cls.locks:
+                return cls.lock_id(attr), label
+        else:
+            cname = scan.local_types.get(base)
+            if cname is None and scan.cls is not None:
+                cname = scan.cls.attr_types.get(base)
+            if cname is not None and cname in proj.classes \
+                    and attr in proj.classes[cname].locks:
+                return proj.classes[cname].lock_id(attr), label
+        owners = proj.lock_decl_attr.get(attr)
+        if owners is not None and len(owners) == 1:
+            return proj.classes[next(iter(owners))].lock_id(attr), label
+        return (None, label) if _LOCKISH_RE.search(attr) else (None, None)
+    if isinstance(expr, ast.Attribute):        # deeper chain: self.a.b
+        attr = expr.attr
+        owners = proj.lock_decl_attr.get(attr)
+        if owners is not None and len(owners) == 1:
+            return proj.classes[next(iter(owners))].lock_id(attr), label
+        return (None, label) if _LOCKISH_RE.search(attr) else (None, None)
+    if isinstance(expr, ast.Name):
+        if (tail, expr.id) in proj.module_locks:
+            return "%s.%s" % (tail, expr.id), label
+        return (None, label) if _LOCKISH_RE.search(expr.id) \
+            else (None, None)
+    return None, None
+
+
+def _timeout_kw(call, names=("timeout",)):
+    for kw in call.keywords:
+        if kw.arg in names:
+            return kw
+    return None
+
+
+def _is_none(node):
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _nonblocking_acquire(call):
+    """acquire(blocking=False) / acquire(0) / acquire(timeout=...)."""
+    if call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and not a0.value:
+            return True
+        if len(call.args) > 1:      # positional timeout
+            return True
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and not kw.value.value:
+            return True
+        if kw.arg == "timeout" and not _is_none(kw.value):
+            return True
+    return False
+
+
+def _blocking_desc(call):
+    """(description, cond_receiver) when *call* is a JG007-class blocking
+    call, else None.  *cond_receiver* is the ``X`` of ``X.wait()`` so the
+    caller can apply the wait-on-own-lock exemption."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    rname = _recv_name(func.value) or ""
+    if attr == "recv":
+        kw = _timeout_kw(call)
+        if kw is None or _is_none(kw.value):
+            return ("unbounded %s.recv()" % (rname or "peer"), None)
+        return None
+    if attr in ("send", "sendall"):
+        if _CONNISH_RE.search(rname):
+            return ("%s.%s() peer write" % (rname, attr), None)
+        return None
+    if attr == "get":
+        if not _QUEUEISH_RE.search(rname):
+            return None
+        blockkw = next((k for k in call.keywords if k.arg == "block"),
+                       None)
+        if blockkw is not None and isinstance(blockkw.value,
+                                              ast.Constant) \
+                and not blockkw.value.value:
+            return None
+        if _timeout_kw(call) is not None:
+            return None
+        if len(call.args) > 1 and not _is_none(call.args[1]):
+            return None               # get(block, timeout)
+        return ("%s.get() without timeout" % rname, None)
+    if attr == "join":
+        if not call.args and not call.keywords:
+            return ("%s.join() without timeout" % (rname or "thread"),
+                    None)
+        return None
+    if attr == "wait":
+        if not call.args and _timeout_kw(call) is None:
+            return ("%s.wait() without timeout" % (rname or "event"),
+                    func.value)
+        return None
+    if attr == "wait_for":
+        if len(call.args) < 2 and _timeout_kw(call) is None:
+            return ("%s.wait_for() without timeout" % (rname or "cond"),
+                    func.value)
+        return None
+    if attr in _ENGINE_WAITS:
+        return ("%s() engine/device wait" % attr, None)
+    if attr == "accept":
+        return ("%s.accept()" % (rname or "socket"), None)
+    return None
+
+
+def _own_nodes(node):
+    """Walk *node* without descending into nested function bodies: a
+    nested def runs on its own schedule, not under the enclosing held
+    set (it is scanned separately as its own function)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.append(c)
+
+
+class _Scanner:
+    def __init__(self, proj, scan):
+        self.proj = proj
+        self.scan = scan
+
+    def run(self):
+        if isinstance(self.scan.fd, ast.Lambda):
+            return
+        self.stmts(self.scan.fd.body, ())
+
+    # -- statement walk with lexical held-sets ------------------------------
+
+    def stmts(self, body, held):
+        for stmt in body:
+            held = self.stmt(stmt, held)
+
+    def stmt(self, stmt, held):
+        """Process one statement; returns the held-set for statements
+        after it in the same suite (grows across a bare ``.acquire()``
+        until the matching ``.release()`` or the end of the suite)."""
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                self._exprs(item.context_expr, inner)
+                lid, label = _resolve_lock_expr(self.proj, self.scan,
+                                                item.context_expr)
+                if lid is not None or label is not None:
+                    self.scan.acquires.append(
+                        (lid, label, item.context_expr, inner, True))
+                    inner = inner + ((lid, label, item.context_expr),)
+            self.stmts(stmt.body, inner)
+            return held
+        if isinstance(stmt, ast.If):
+            self._exprs(stmt.test, held)
+            self.stmts(stmt.body, held)
+            self.stmts(stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._exprs(stmt.iter, held)
+            self.stmts(stmt.body, held)
+            self.stmts(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.While):
+            self._exprs(stmt.test, held)
+            self.stmts(stmt.body, held)
+            self.stmts(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.Try):
+            self.stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self.stmts(h.body, held)
+            self.stmts(stmt.orelse, held)
+            self.stmts(stmt.finalbody, held)
+            return held
+        call = self._bare_call(stmt)
+        if call is not None and isinstance(call.func, ast.Attribute):
+            if call.func.attr == "acquire":
+                lid, label = _resolve_lock_expr(self.proj, self.scan,
+                                                call.func.value)
+                if lid is not None or label is not None:
+                    self.scan.acquires.append(
+                        (lid, label, call, held,
+                         not _nonblocking_acquire(call)))
+                    return held + ((lid, label, call),)
+            elif call.func.attr == "release":
+                lid, label = _resolve_lock_expr(self.proj, self.scan,
+                                                call.func.value)
+                return tuple(h for h in held
+                             if not (h[0] == lid and h[1] == label))
+        self._exprs(stmt, held)
+        return held
+
+    @staticmethod
+    def _bare_call(stmt):
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Call):
+            return stmt.value
+        return None
+
+    # -- event recording ----------------------------------------------------
+
+    def _exprs(self, root, held):
+        """Record blocking calls, call sites, and self-attr mutations in
+        the expression nodes of one statement."""
+        scan = self.scan
+        for node in _own_nodes(root):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr in ("acquire", "release", "locked"):
+                    continue          # handled by the statement walk
+                desc = None
+                if not self._is_project_method(func):
+                    desc = _blocking_desc(node)
+                if desc is not None:
+                    text, cond_expr = desc
+                    exempt = False
+                    cond_lid = None
+                    if cond_expr is not None:
+                        lid, label = _resolve_lock_expr(self.proj, scan,
+                                                        cond_expr)
+                        cond_lid = lid
+                        own = lid if lid is not None else label
+                        if held:
+                            exempt = not [h for h in held
+                                          if (h[0] or h[1]) != own]
+                    scan.blockings.append(
+                        (text, node, held, exempt, cond_lid))
+                else:
+                    scan.calls.append((node, held))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    attr = self._self_attr(tgt)
+                    if attr is not None:
+                        scan.mutations.append((attr, node, held))
+            elif isinstance(node, ast.Attribute) \
+                    and node.attr in _MUTATOR_METHODS:
+                par = parent(node)
+                if isinstance(par, ast.Call) and par.func is node:
+                    attr = self._self_attr_base(node.value)
+                    if attr is not None:
+                        scan.mutations.append((attr, node, held))
+
+    def _is_project_method(self, func):
+        """``self.wait()`` where the class defines ``wait`` is a method
+        call for the call graph, not a stdlib blocking primitive (the
+        callee's own blockings propagate through the closure instead)."""
+        if not (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)):
+            return False
+        if func.value.id == "self":
+            cls = self.scan.cls
+            return cls is not None and func.attr in cls.methods
+        cname = self.scan.local_types.get(func.value.id)
+        if cname is None and self.scan.cls is not None:
+            cname = self.scan.cls.attr_types.get(func.value.id)
+        return cname is not None and cname in self.proj.classes \
+            and func.attr in self.proj.classes[cname].methods
+
+    @staticmethod
+    def _self_attr(tgt):
+        """``self.X`` / ``self.X[...]`` assignment target -> "X"."""
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value
+        if isinstance(tgt, ast.Attribute) \
+                and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self":
+            return tgt.attr
+        return None
+
+    @staticmethod
+    def _self_attr_base(expr):
+        """``self.X.append(...)`` receiver -> "X"."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        return None
+
+
+# ---------------------------------------------------------------------------
+# pass 3: call graph + closures
+# ---------------------------------------------------------------------------
+
+def _resolve_call(proj, scan, call, imports, defs_by_mod, index):
+    """fkeys a call may land in: same-class methods, same-module defs,
+    imported defs (cross-module), or a unique-named method project-wide."""
+    func = call.func
+    out = []
+    if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                      ast.Name) \
+            and func.value.id == "self" and scan.cls is not None:
+        for _m_mod, m_fd in scan.cls.methods.get(func.attr, ()):
+            out.append(id(m_fd))
+        if out:
+            return out
+    if isinstance(func, ast.Name):
+        modname = proj.modnames[scan.mod]
+        for fd in defs_by_mod.get(modname, {}).get(func.id, ()):
+            out.append(id(fd))
+        if out:
+            return out
+        tgt = imports.get(func.id)
+        if tgt is not None:
+            for cut in range(len(tgt) - 1, 0, -1):
+                m = ".".join(tgt[:cut])
+                if m in index:
+                    for fd in defs_by_mod.get(m, {}).get(tgt[cut], ()):
+                        out.append(id(fd))
+                    return out
+        return out
+    if isinstance(func, ast.Attribute):
+        base = _expr_label(func.value)
+        if base is not None and "." not in base:
+            tgt = imports.get(base)
+            if tgt is not None:
+                for cut in range(len(tgt), 0, -1):
+                    m = ".".join(tgt[:cut])
+                    if m in index:
+                        for fd in defs_by_mod.get(m, {}).get(
+                                func.attr, ()):
+                            out.append(id(fd))
+                        if out:
+                            return out
+        cname = None
+        if isinstance(func.value, ast.Name):
+            cname = scan.local_types.get(func.value.id)
+        elif isinstance(func.value, ast.Attribute) \
+                and isinstance(func.value.value, ast.Name) \
+                and func.value.value.id == "self" \
+                and scan.cls is not None:
+            cname = scan.cls.attr_types.get(func.value.attr)
+        if cname is None and func.attr not in _MUTATOR_METHODS \
+                and func.attr not in _GENERIC_METHODS:
+            # unique-name fallback — but never for names that collections
+            # and stdlib primitives also answer to (``b.waiting.discard``
+            # is a set method, not OverlapSession.discard)
+            owners = proj.method_owners.get(func.attr)
+            if owners is not None and len(owners) == 1:
+                cname = next(iter(owners))
+        if cname is not None and cname in proj.classes:
+            for _m_mod, m_fd in proj.classes[cname].methods.get(
+                    func.attr, ()):
+                out.append(id(m_fd))
+    return out
+
+
+def _compute_closures(proj, call_edges):
+    """Fixpoint acquire- and blocking-closures over the call graph."""
+    changed = True
+    while changed:
+        changed = False
+        for fkey, scan in proj.funcs.items():
+            acq = set(lid for (lid, _lab, _n, _h, _b) in scan.acquires
+                      if lid is not None)
+            blk = {}
+            for desc, node, _held, _exempt, cond_lid in scan.blockings:
+                if cond_lid is not None:
+                    # a wait on a Condition tied to a known project lock
+                    # RELEASES that lock — callers holding it are the
+                    # intended wait pattern (Server._wait_key), not a
+                    # stall; keep it out of the call-graph closure
+                    continue
+                blk.setdefault(desc, "%s:%d" % (scan.mod.path,
+                                                node.lineno))
+            for callee in call_edges.get(fkey, ()):
+                sub = proj.funcs.get(callee)
+                if sub is None:
+                    continue
+                acq |= sub.acq_closure
+                for desc, site in sub.block_closure.items():
+                    blk.setdefault(desc, site)
+            if acq != scan.acq_closure:
+                scan.acq_closure = acq
+                changed = True
+            if blk != scan.block_closure:
+                scan.block_closure = blk
+                changed = True
+
+
+# ---------------------------------------------------------------------------
+# pass 4: findings
+# ---------------------------------------------------------------------------
+
+def _compute_caller_guards(proj):
+    """For each function, the locks held at EVERY project call site
+    (``Server._apply`` only ever runs under ``Server._lock``, so its
+    mutations count as guarded).  Intersection fixpoint: start unknown
+    (None = ⊤) and narrow with each caller's effective held-set; a
+    function with no known callers — or used as a thread target — gets
+    the empty guard."""
+    for _fkey, scan in proj.funcs.items():
+        scan.caller_guard = None
+    changed = True
+    rounds = 0
+    while changed and rounds < 20:
+        changed = False
+        rounds += 1
+        incoming = {}
+        for fkey, scan in proj.funcs.items():
+            base = scan.caller_guard or frozenset()
+            for call, held in scan.calls:
+                eff = _held_ids(held) | base
+                for callee in scan.call_targets.get(id(call), ()):
+                    prev = incoming.get(callee)
+                    incoming[callee] = eff if prev is None \
+                        else (prev & eff)
+        for fkey, scan in proj.funcs.items():
+            new = frozenset(incoming.get(fkey) or ())
+            if new != (scan.caller_guard
+                       if scan.caller_guard is not None else None):
+                scan.caller_guard = new
+                changed = True
+
+
+def _order_edges(proj):
+    """held-lock -> acquired-lock edges, attributed to their sites."""
+    for fkey, scan in proj.funcs.items():
+        for lid, _label, node, held, blocking in scan.acquires:
+            if lid is None or not blocking:
+                continue
+            for h in _held_ids(held):
+                if h != lid:
+                    proj.edges.setdefault((h, lid), (scan.mod, node))
+        for call, held in scan.calls:
+            hids = _held_ids(held)
+            if not hids:
+                continue
+            for callee in scan.call_targets.get(id(call), ()):
+                sub = proj.funcs.get(callee)
+                if sub is None:
+                    continue
+                for lid in sub.acq_closure:
+                    for h in hids:
+                        if h != lid:
+                            proj.edges.setdefault((h, lid),
+                                                  (scan.mod, call))
+
+
+def _find_cycles(proj):
+    """One JG009 finding per strongly-connected lock cluster."""
+    adj = {}
+    for (a, b) in proj.edges:
+        adj.setdefault(a, set()).add(b)
+    nodes = sorted(set(adj) | {b for (_a, b) in proj.edges})
+
+    index_of, low, on_stack = {}, {}, set()
+    stack, sccs, counter = [], [], [0]
+
+    for root in nodes:
+        if root in index_of:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index_of[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index_of[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+
+    for comp in sorted(sccs):
+        members = set(comp)
+        start = comp[0]
+        path, seen = [start], {start}
+        node = start
+        while True:
+            nxts = [w for w in sorted(adj.get(node, ()))
+                    if w in members and w not in seen] \
+                or ([start] if start in adj.get(node, ()) else [])
+            if not nxts:
+                break
+            node = nxts[0]
+            if node == start:
+                break
+            path.append(node)
+            seen.add(node)
+        cycle = " -> ".join(path + [start])
+        witness = None
+        for a, b in zip(path, path[1:] + [start]):
+            witness = proj.edges.get((a, b))
+            if witness is not None:
+                break
+        if witness is None:
+            witness = next(v for k, v in sorted(proj.edges.items())
+                           if k[0] in members and k[1] in members)
+        mod, node_ = witness
+        proj.book(
+            "JG009", mod, node_,
+            "lock-order cycle: %s — threads taking these locks in "
+            "opposite orders can deadlock; pick one global acquisition "
+            "order" % cycle)
+
+
+def _blocking_findings(proj):
+    for fkey, scan in proj.funcs.items():
+        for desc, node, held, exempt, _cond_lid in scan.blockings:
+            if exempt or not held:
+                continue
+            proj.book(
+                "JG010", scan.mod, node,
+                "blocking call (%s) while holding %s — one stalled "
+                "peer wedges every thread contending for the lock; "
+                "move the call outside the critical section"
+                % (desc, ", ".join(_held_names(held))))
+        for call, held in scan.calls:
+            if not held:
+                continue
+            for callee in scan.call_targets.get(id(call), ()):
+                sub = proj.funcs.get(callee)
+                if sub is None or not sub.block_closure:
+                    continue
+                desc, site = sorted(sub.block_closure.items())[0]
+                proj.book(
+                    "JG010", scan.mod, call,
+                    "call to %s() may block (%s at %s) while holding "
+                    "%s — move the call outside the critical section"
+                    % (getattr(sub.fd, "name", "?"), desc, site,
+                       ", ".join(_held_names(held))))
+                break
+
+
+def _thread_targets(proj):
+    """(class name, method name) pairs used as thread entry points or
+    escaping bound-method callbacks."""
+    entries = set()
+    for fkey, scan in proj.funcs.items():
+        facts = _facts(scan.mod)
+        for node in _own_nodes(scan.fd):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = facts.qualname(node.func)
+            cand = []
+            if qual is not None and _THREAD_CTOR_RE.search(qual):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        cand.append(kw.value)
+                if qual.endswith("Timer") and len(node.args) > 1:
+                    cand.append(node.args[1])
+            else:
+                # escaping bound-method callback: self.m / obj.m handed
+                # to anything (registered hooks, accept loops, executors)
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    if isinstance(arg, ast.Attribute) \
+                            and isinstance(arg.value, ast.Name):
+                        cand.append(arg)
+            for c in cand:
+                if not (isinstance(c, ast.Attribute)
+                        and isinstance(c.value, ast.Name)):
+                    continue
+                if c.value.id == "self" and scan.cls is not None:
+                    if c.attr in scan.cls.methods:
+                        entries.add((scan.cls.name, c.attr))
+                    continue
+                cname = scan.local_types.get(c.value.id)
+                if cname is not None and cname in proj.classes \
+                        and c.attr in proj.classes[cname].methods:
+                    entries.add((cname, c.attr))
+    return entries
+
+
+def _method_closure(proj, cls, seeds):
+    """Methods of *cls* reachable from *seeds* via same-class calls."""
+    edges = {}
+    for mname, impls in cls.methods.items():
+        outs = set()
+        for _m_mod, m_fd in impls:
+            scan = proj.funcs.get(id(m_fd))
+            if scan is None:
+                continue
+            for call, _held in scan.calls:
+                f = call.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self" \
+                        and f.attr in cls.methods:
+                    outs.add(f.attr)
+        edges[mname] = outs
+    return _fixpoint(set(seeds) & set(cls.methods), edges)
+
+
+def _mutation_findings(proj):
+    by_cls = {}
+    for cname, mname in _thread_targets(proj):
+        by_cls.setdefault(cname, set()).add(mname)
+    for cname, seeds in sorted(by_cls.items()):
+        cls = proj.classes.get(cname)
+        if cls is None:
+            continue
+        entry_methods = _method_closure(proj, cls, seeds)
+        public = {m for m in cls.methods if not m.startswith("_")}
+        public_methods = _method_closure(proj, cls, public)
+        sides = {"entry": {}, "public": {}}
+        for mname, impls in cls.methods.items():
+            if mname in ("__init__", "__new__"):
+                continue
+            in_entry = mname in entry_methods
+            in_public = mname in public_methods
+            if not (in_entry or in_public):
+                continue
+            for m_mod, m_fd in impls:
+                scan = proj.funcs.get(id(m_fd))
+                if scan is None:
+                    continue
+                # a private helper only ever invoked under a lock is
+                # guarded by its callers; thread seeds and directly
+                # public methods get no such credit (their callers —
+                # Thread.run, external code — hold nothing)
+                inherited = scan.caller_guard or frozenset()
+                for attr, node, held in scan.mutations:
+                    if attr in cls.primitive_attrs or attr in cls.locks:
+                        continue
+                    guards = frozenset(h[0] or h[1] for h in held)
+                    if in_entry:
+                        e_guards = guards if mname in seeds \
+                            else guards | inherited
+                        sides["entry"].setdefault(attr, []).append(
+                            (mname, node, e_guards, scan.mod))
+                    if in_public:
+                        p_guards = guards if mname in public \
+                            else guards | inherited
+                        sides["public"].setdefault(attr, []).append(
+                            (mname, node, p_guards, scan.mod))
+        for attr in sorted(set(sides["entry"]) & set(sides["public"])):
+            done = False
+            for e_name, e_node, e_guards, _e_mod in sides["entry"][attr]:
+                if done:
+                    break
+                for p_name, p_node, p_guards, p_mod in \
+                        sides["public"][attr]:
+                    if e_name == p_name:
+                        continue
+                    if e_guards & p_guards:
+                        continue
+                    proj.book(
+                        "JG011", p_mod, p_node,
+                        "self.%s is written by thread-entry path %s.%s "
+                        "(line %d) and by public %s.%s with no common "
+                        "lock — guard both sides with one lock"
+                        % (attr, cname, e_name, e_node.lineno, cname,
+                           p_name))
+                    done = True
+                    break
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def link_lock_project(mods):
+    """Run the whole-project lock analysis once and stash per-module
+    findings on each SourceModule (``mod._graftlock``).  Called from
+    ``rules.link_project`` for multi-module scans and lazily by the
+    rule bodies for single-module lints."""
+    proj = _Project(mods)
+    _collect_declarations(proj)
+
+    index = {}
+    defs_by_mod = {}
+    for mod in mods:
+        modname = proj.modnames[mod]
+        index[modname] = mod
+        by_name = {}
+        for fd in _facts(mod).funcdefs:
+            by_name.setdefault(fd.name, []).append(fd)
+        defs_by_mod[modname] = by_name
+
+    for mod in mods:
+        for fd in _facts(mod).funcdefs:
+            cls_node = _enclosing_class(fd)
+            cls = proj.classes.get(cls_node.name) \
+                if cls_node is not None else None
+            scan = _FuncScan(id(fd), mod, fd, cls)
+            scan.local_types = _local_types(fd, proj)
+            proj.funcs[id(fd)] = scan
+            _Scanner(proj, scan).run()
+
+    call_edges = {}
+    for fkey, scan in proj.funcs.items():
+        imports = _import_targets(scan.mod, proj.modnames[scan.mod])
+        outs = set()
+        for call, _held in scan.calls:
+            targets = [tkey for tkey in
+                       _resolve_call(proj, scan, call, imports,
+                                     defs_by_mod, index)
+                       if tkey != fkey]
+            scan.call_targets[id(call)] = targets
+            outs.update(targets)
+        call_edges[fkey] = outs
+
+    _compute_closures(proj, call_edges)
+    _compute_caller_guards(proj)
+    _order_edges(proj)
+    _find_cycles(proj)
+    _blocking_findings(proj)
+    _mutation_findings(proj)
+
+    for mod in mods:
+        mod._graftlock = proj.findings[mod]
+    return proj
+
+
+def _ensure(mod):
+    booked = getattr(mod, "_graftlock", None)
+    if booked is None:
+        link_lock_project([mod])
+        booked = mod._graftlock
+    return booked
+
+
+@register("JG009", "lock-order-cycle",
+          "two threads taking the same locks in opposite orders can "
+          "deadlock; the global lock-order graph must stay acyclic")
+def _jg009(mod, facts):
+    for node, msg in _ensure(mod)["JG009"]:
+        yield mod.finding("JG009", node, msg)
+
+
+@register("JG010", "blocking-under-lock",
+          "an unbounded blocking call inside a critical section turns "
+          "one slow peer into a process-wide stall")
+def _jg010(mod, facts):
+    for node, msg in _ensure(mod)["JG010"]:
+        yield mod.finding("JG010", node, msg)
+
+
+@register("JG011", "unguarded-shared-mutation",
+          "an attribute written from both a thread-entry path and a "
+          "public method needs one common guarding lock")
+def _jg011(mod, facts):
+    for node, msg in _ensure(mod)["JG011"]:
+        yield mod.finding("JG011", node, msg)

@@ -1,0 +1,337 @@
+"""Runtime lock-order witness (``MXNET_LOCKCHECK``) for the threaded tier.
+
+The static side (:mod:`.lockcheck`) proves the lock-order graph the AST
+admits is acyclic; this module witnesses the graph the *process*
+actually walks.  The repo's threaded tier constructs its locks through
+the funnel below (``make_lock`` / ``make_rlock`` / ``make_condition``)
+instead of bare ``threading`` constructors.  Off (the default) the
+funnel returns plain stdlib primitives — the only cost anywhere is one
+cached module-level mode check at construction time, nothing per
+acquire.  Under ``MXNET_LOCKCHECK=warn`` (or ``=1`` to raise) every
+funnel lock is wrapped: each blocking acquire while other tracked locks
+are held records a ``held -> acquired`` edge in a process-global
+acquisition-order graph, and an edge that completes a cycle (the ABBA
+inversion) fires a structured violation — one warning per edge, a
+``lockcheck_violations`` telemetry bump, a flight-ring event, and an
+exception under ``=1`` so tests fail loudly.
+
+The chaos tier calls :func:`note_blocking` from its delayed/stalled
+``conn.send``/``conn.recv`` seams, so any lock held across a delayed
+peer write shows up in the report — running ``tools/chaos_smoke.py`` or
+``tools/fleet_smoke.py`` with ``MXNET_LOCKCHECK=1`` doubles as a
+lock-order witness for the whole dist/serving stack (both export
+:func:`snapshot`, which must come back ``cycle_free``).
+
+Mode is sampled once at import (``refresh_from_env`` / ``configure``
+re-sample for tests).  Wrapped locks interoperate with
+``threading.Condition``: the wrapper exposes ``acquire``/``release``/
+``_is_owned``, so ``Condition.wait`` releases and re-acquires through
+the tracked path and the held-stack stays truthful across waits.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+
+__all__ = ["enabled", "mode", "configure", "refresh_from_env",
+           "make_lock", "make_rlock", "make_condition", "held_locks",
+           "note_blocking", "snapshot", "reset", "violations"]
+
+
+def _env_mode():
+    raw = os.environ.get("MXNET_LOCKCHECK", "").strip().lower()
+    if raw in ("1", "true", "on", "yes", "raise"):
+        return "raise"
+    if raw == "warn":
+        return "warn"
+    return "off"
+
+
+_MODE = _env_mode()
+
+_tls = threading.local()
+
+# the witness's own bookkeeping lock is a PLAIN lock on purpose: it must
+# never appear in the graph it guards
+_graph_lock = threading.Lock()
+_edges = {}        # (held_name, acquired_name) -> edge record dict
+_adj = {}          # held_name -> set(acquired_name)
+_violations = []   # violation record dicts
+_warned = set()    # (a, b) pairs already warned (warn mode)
+_blocked = []      # note_blocking reports (site, held) dicts
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition-order inversion detected live (MXNET_LOCKCHECK=1)."""
+
+
+def mode():
+    return _MODE
+
+
+def enabled():
+    return _MODE != "off"
+
+
+def configure(new_mode):
+    """Set the witness mode programmatically ("off" | "warn" | "raise").
+
+    Only locks constructed *after* enabling are tracked — re-create the
+    objects under test after calling this."""
+    global _MODE
+    if new_mode not in ("off", "warn", "raise"):
+        raise ValueError("MXNET_LOCKCHECK mode must be off/warn/raise, "
+                         "got %r" % (new_mode,))
+    _MODE = new_mode
+
+
+def refresh_from_env():
+    global _MODE
+    _MODE = _env_mode()
+    return _MODE
+
+
+def reset():
+    """Drop the recorded graph and violations (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+        _adj.clear()
+        del _violations[:]
+        _warned.clear()
+        del _blocked[:]
+
+
+# ---------------------------------------------------------------------------
+# internals
+# ---------------------------------------------------------------------------
+
+def _site():
+    """file:line(function) of the first frame outside this module."""
+    import sys
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:       # pragma: no cover - defensive
+        return "<unknown>"
+    return "%s:%d(%s)" % (os.path.basename(f.f_code.co_filename),
+                          f.f_lineno, f.f_code.co_name)
+
+
+def _held_stack():
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _find_path(src, dst):
+    """A path src -> ... -> dst in the recorded graph, or None."""
+    seen = {src}
+    stack = [(src, [src])]
+    while stack:
+        node, path = stack.pop()
+        for nxt in sorted(_adj.get(node, ())):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _violation(record):
+    """Book one lock-order violation: telemetry + flight + warn/raise."""
+    _violations.append(record)
+    try:
+        from ..telemetry import core as _tel
+        _tel.bump("lockcheck_violations")
+    except Exception:       # pragma: no cover - telemetry unavailable
+        pass
+    try:
+        from ..telemetry import flight as _flight
+        _flight.record("lockcheck_violation", record["edge"],
+                       cycle=record["cycle"], site=record["site"])
+    except Exception:       # pragma: no cover
+        pass
+    msg = ("MXNET_LOCKCHECK: lock-order inversion %s at %s "
+           "(cycle: %s; prior order established at %s)"
+           % (record["edge"], record["site"], record["cycle"],
+              record["prior_site"]))
+    if _MODE == "raise":
+        raise LockOrderError(msg)
+    warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+
+def _note_edge(held_entry, lock, site):
+    """Record held -> acquired; detect the cycle the new edge closes."""
+    a, b = held_entry[0].name, lock.name
+    if a == b:
+        return
+    with _graph_lock:
+        key = (a, b)
+        rec = _edges.get(key)
+        if rec is not None:
+            rec["count"] += 1
+            return
+        # a cycle exists iff b already reaches a BEFORE inserting a->b
+        back = _find_path(b, a)
+        _edges[key] = {"from": a, "to": b, "count": 1,
+                       "from_site": held_entry[1], "to_site": site}
+        _adj.setdefault(a, set()).add(b)
+        if back is None:
+            return
+        cycle = " -> ".join([a, b] + back[1:])
+        prior = _edges.get((b, back[1] if len(back) > 1 else a), {})
+        record = {"edge": "%s -> %s" % (a, b), "cycle": cycle,
+                  "site": site,
+                  "prior_site": prior.get("to_site", "<unknown>")}
+        if key in _warned:
+            return
+        _warned.add(key)
+    _violation(record)
+
+
+class _TrackedLock:
+    """Order-witnessing wrapper around one threading Lock/RLock."""
+
+    __slots__ = ("_inner", "name", "_reentrant")
+
+    def __init__(self, inner, name, reentrant):
+        self._inner = inner
+        self.name = name
+        self._reentrant = reentrant
+
+    def acquire(self, blocking=True, timeout=-1):
+        stack = _held_stack()
+        # a blocking acquire with locks already held is an order edge;
+        # trylocks and bounded waits cannot complete a deadlock cycle
+        if blocking and (timeout is None or timeout < 0) and stack:
+            site = _site()
+            if not (self._reentrant
+                    and any(e[0] is self for e in stack)):
+                for entry in stack:
+                    if entry[0] is not self:
+                        _note_edge(entry, self, site)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            stack.append((self, _site()))
+        return ok
+
+    def release(self):
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is self:
+                del stack[i]
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        locked = getattr(self._inner, "locked", None)
+        return locked() if locked is not None else False
+
+    def _is_owned(self):
+        """Condition integration (``threading.Condition._is_owned``)."""
+        inner = self._inner
+        owned = getattr(inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return "<tracked %s %r>" % (
+            "rlock" if self._reentrant else "lock", self.name)
+
+
+# ---------------------------------------------------------------------------
+# the constructor funnel
+# ---------------------------------------------------------------------------
+
+def make_lock(name):
+    """A mutex; plain ``threading.Lock`` unless the witness is on."""
+    if _MODE == "off":
+        return threading.Lock()
+    return _TrackedLock(threading.Lock(), name, reentrant=False)
+
+
+def make_rlock(name):
+    """A reentrant mutex (re-acquisition by the holder takes no edge)."""
+    if _MODE == "off":
+        return threading.RLock()
+    return _TrackedLock(threading.RLock(), name, reentrant=True)
+
+
+def make_condition(lock=None, name=None):
+    """A condition variable over *lock* (or a fresh tracked lock).
+
+    ``Condition.wait`` releases and re-acquires through the wrapper, so
+    the held-stack stays truthful across waits."""
+    if _MODE == "off":
+        return threading.Condition(lock)
+    if lock is None:
+        lock = _TrackedLock(threading.Lock(), name or "<condition>",
+                            reentrant=False)
+    return threading.Condition(lock)
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+def held_locks():
+    """Names of tracked locks the calling thread holds right now."""
+    return [e[0].name for e in getattr(_tls, "held", ())]
+
+
+def note_blocking(site):
+    """Report a blocking/delayed operation (chaos-stalled peer IO) that
+    runs while tracked locks are held.  Warn-only: the chaos tier
+    injects these stalls on purpose; the report is the product."""
+    if _MODE == "off":
+        return
+    held = held_locks()
+    if not held:
+        return
+    rec = {"site": site, "held": held}
+    with _graph_lock:
+        _blocked.append(rec)
+        first = len(_blocked) == 1 or \
+            all(b["site"] != site or b is rec for b in _blocked)
+    try:
+        from ..telemetry import flight as _flight
+        _flight.record("lockcheck_blocked_io", site, held=",".join(held))
+    except Exception:       # pragma: no cover
+        pass
+    if first:
+        warnings.warn(
+            "MXNET_LOCKCHECK: blocking peer IO at %s while holding %s"
+            % (site, ", ".join(held)), RuntimeWarning, stacklevel=2)
+
+
+def violations():
+    with _graph_lock:
+        return list(_violations)
+
+
+def snapshot():
+    """The recorded acquisition-order graph, JSON-shaped."""
+    with _graph_lock:
+        return {
+            "mode": _MODE,
+            "edges": [dict(rec) for _k, rec in sorted(_edges.items())],
+            "violations": list(_violations),
+            "blocked_io": list(_blocked),
+            "cycle_free": not _violations,
+        }

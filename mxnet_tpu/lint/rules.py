@@ -406,6 +406,10 @@ def link_project(mods):
     ``external_traced``: the FunctionDef nodes on a hot path or under a
     jax trace once cross-module edges are followed.  Rules consult the
     annotations lazily, so linking must run before any rule does."""
+    # the concurrency tier (JG009-011) links the lock graph over the
+    # same module set — before the <2-module early return, because its
+    # rules consume the project annotation even for small scans
+    lockcheck.link_lock_project(mods)
     index = {}
     for mod in mods:
         name = _module_dotted(mod.path)
@@ -986,3 +990,9 @@ def _hot_functions(facts):
                         hot.add(target)
                         grew = True
     return hot
+
+
+# registered last: lockcheck imports the registry above, so the import
+# must come after every name it needs is bound (no circularity — the
+# tail import only runs once this module body is otherwise complete)
+from . import lockcheck  # noqa: E402,F401

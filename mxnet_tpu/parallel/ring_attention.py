@@ -42,8 +42,11 @@ def local_attention(q, k, v, causal=False, sm_scale=None,
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
-        q_pos = q_offset + jnp.arange(q.shape[2])
-        k_pos = k_offset + jnp.arange(k.shape[2])
+        # int32 positions: sequence indices never exceed 2**31 and the
+        # default int64 iota drags x64-widened compares into every
+        # sharded step program (the JX102 finding)
+        q_pos = q_offset + jnp.arange(q.shape[2], dtype=jnp.int32)
+        k_pos = k_offset + jnp.arange(k.shape[2], dtype=jnp.int32)
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)

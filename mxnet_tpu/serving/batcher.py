@@ -47,6 +47,7 @@ from collections import deque
 from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from ..base import MXNetError
+from ..lint import lockwitness as _lockwitness
 
 __all__ = ["Overloaded", "ContinuousBatcher", "CircuitBreaker",
            "refresh_from_env", "DEFAULT_BATCH_TIMEOUT_MS",
@@ -138,7 +139,7 @@ class CircuitBreaker:
         self._opened_at = None
         self._probing = False
         self._probe_started = 0.0
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("CircuitBreaker._lock")
 
     def allow(self):
         if not self.threshold:
@@ -268,7 +269,8 @@ class ContinuousBatcher:
         self._request_timeout_s = request_timeout_ms / 1e3
         self._breaker = CircuitBreaker() if breaker is None else breaker
         self._queue = deque()
-        self._cond = threading.Condition()
+        self._cond = _lockwitness.make_condition(
+            name="ContinuousBatcher._cond")
         self._stopping = False
         self._use_engine = use_engine
         self._eng = None

@@ -56,6 +56,7 @@ from .. import chaos as _chaos
 from .. import dist_ps as _ps
 from .. import telemetry as _telemetry
 from ..base import MXNetError
+from ..lint import lockwitness as _lockwitness
 from .slots import CircuitBreaker
 from .batcher import Overloaded
 
@@ -137,7 +138,7 @@ class _ReplicaHandle:
         self.outstanding = 0
         self.served = 0
         self.reported_outstanding = 0
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("_ReplicaHandle._lock")
         self._pool = []
 
     # -- connection pool ---------------------------------------------------
@@ -191,7 +192,7 @@ class _PredictBox:
     """Shared completion state between a request's attempt threads."""
 
     def __init__(self):
-        self.cond = threading.Condition()
+        self.cond = _lockwitness.make_condition(name="_PredictBox.cond")
         self.outs = None           # (names, arrays, replica_rank, kind)
         self.app_error = None
         self.fails = []            # [(kind, exception)]
@@ -203,9 +204,10 @@ class FleetRouter:
 
     def __init__(self, port=0, host="127.0.0.1"):
         self._replicas = {}            # rank -> _ReplicaHandle
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("FleetRouter._lock")
         self._stop = threading.Event()
-        self._reload_lock = threading.Lock()
+        self._reload_lock = _lockwitness.make_lock(
+            "FleetRouter._reload_lock")
         # p99 source for the derived hedge timeout: an unregistered
         # Histogram (per-router series, not the flat global registry)
         self._attempt_latency = _telemetry.Histogram("attempt_us")

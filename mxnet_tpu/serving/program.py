@@ -44,6 +44,7 @@ import numpy as np
 from .. import random as _random
 from .. import telemetry as _telemetry
 from ..base import MXNetError
+from ..lint import lockwitness as _lockwitness
 
 __all__ = ["PredictProgram", "bucket_sizes", "refresh_from_env",
            "DEFAULT_MAX_BATCH", "tracecheck_programs"]
@@ -137,7 +138,7 @@ class PredictProgram:
         self.buckets = bucket_sizes(max_batch=max_batch, buckets=buckets)
         self.max_batch = self.buckets[-1]
         self._variants = {}          # b -> (executable, fixed_args, cost)
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("PredictProgram._lock")
         if warmup:
             self.warmup()
 

@@ -59,6 +59,7 @@ from .. import chaos as _chaos
 from .. import dist_ps as _ps
 from .. import telemetry as _telemetry
 from ..base import MXNetError
+from ..lint import lockwitness as _lockwitness
 from .batcher import Overloaded
 from .slots import ModelRegistry
 from . import fleet as _fleet
@@ -95,7 +96,7 @@ class ReplicaServer:
             else ModelRegistry()
         self._outstanding = 0
         self._served = 0
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("ReplicaServer._lock")
         self._stop = threading.Event()
         self._hb_conn = None
         self._hb_thread = None
@@ -119,7 +120,7 @@ class ReplicaServer:
         """Warm one slot from the checkpoint tier (compiles the whole
         bucket table before returning — the warm-load cost that buys a
         retrace-free request path)."""
-        self.state = "warming"
+        self._set_state("warming")
         slot = self.registry.load(name, **kwargs)
         _telemetry.flight.record("replica_warm", name,
                                  rank=self.rank,
@@ -129,22 +130,28 @@ class ReplicaServer:
     def advertise_ready(self):
         """Flip to ``ready`` — call after every slot is loaded.  The
         next heartbeat carries the state; the router routes from then."""
-        self.state = "ready"
+        self._set_state("ready")
         self._send_heartbeat_now()
         return self
+
+    def _set_state(self, value):
+        # the state machine is written from the RPC threads (load/drain
+        # ops), the heartbeat thread, and the owner — one lock, one word
+        with self._lock:
+            self.state = value
 
     def stop(self, drain=True):
         """Stop serving.  *drain=False* is the test harness's stand-in
         for a crash: listener and conns die with requests in flight."""
         global _CURRENT
-        self.state = "draining" if drain else "stopped"
+        self._set_state("draining" if drain else "stopped")
         self._stop.set()
         self._listener.stop()
         conn = self._hb_conn
         if conn is not None:
             conn.close()
         self.registry.shutdown(drain=drain)
-        self.state = "stopped"
+        self._set_state("stopped")
         if _CURRENT is self:       # a stopped replica gates nothing
             _CURRENT = None
 
@@ -174,7 +181,8 @@ class ReplicaServer:
         self.rank = int(reply[1])
         hb = _ps.Conn.connect(self.router, retries=retries, delay=delay)
         hb.send(("hb_replica", self.rank))
-        self._hb_conn = hb
+        with self._lock:
+            self._hb_conn = hb
         _telemetry.flight.record("replica_registered", str(self.rank),
                                  addr="%s:%s" % self.addr)
         return self.rank
@@ -189,7 +197,8 @@ class ReplicaServer:
             conn.send(("hb", self.state, outstanding,
                        self.registry.names()))
         except (OSError, ConnectionError):
-            self._hb_conn = None       # the hb loop re-registers
+            with self._lock:
+                self._hb_conn = None   # the hb loop re-registers
 
     def _hb_loop(self):
         """Periodic state heartbeats; a lost router connection triggers
@@ -243,13 +252,13 @@ class ReplicaServer:
                 self.load(name, **self._load_kwargs(spec))
             finally:
                 if was_ready:
-                    self.state = "ready"
+                    self._set_state("ready")
                     self._send_heartbeat_now()
             return ("ok",)
         if op == "reload":
             return self._reload(*msg[1:])
         if op == "drain":
-            self.state = "draining"
+            self._set_state("draining")
             self._send_heartbeat_now()
             return ("ok",)
         if op == "shutdown":
@@ -312,7 +321,7 @@ class ReplicaServer:
         reports ``reloading`` (no new fleet traffic) for the compile,
         in-flight batches finish on the old program."""
         spec = spec or {}
-        self.state = "reloading"
+        self._set_state("reloading")
         self._send_heartbeat_now()
         try:
             self.registry.reload(model, prefix=spec.get("prefix"),
@@ -320,7 +329,7 @@ class ReplicaServer:
         except MXNetError as exc:
             return ("err", str(exc))
         finally:
-            self.state = "ready"
+            self._set_state("ready")
             self._send_heartbeat_now()
         return ("ok",)
 

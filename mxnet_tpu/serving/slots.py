@@ -18,6 +18,7 @@ import time
 
 from .. import telemetry as _telemetry
 from ..base import MXNetError
+from ..lint import lockwitness as _lockwitness
 from .batcher import CircuitBreaker, ContinuousBatcher
 from .program import PredictProgram
 
@@ -32,7 +33,7 @@ class SlotMetrics:
     the flat global registry and live in the slot's JSON instead)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("SlotMetrics._lock")
         self._counts = {"requests": 0, "batches": 0, "rows": 0,
                         "padded_rows": 0, "overloads": 0, "errors": 0,
                         "deadline_drops": 0, "breaker_shed": 0}
@@ -113,7 +114,7 @@ class ModelSlot:
         self.name = name
         self.source = dict(source or {})
         self.metrics = SlotMetrics()
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("ModelSlot._lock")
         self.predictor = predictor
         self.program = PredictProgram(predictor, buckets=buckets,
                                       max_batch=max_batch, name=name)
@@ -170,7 +171,7 @@ class ModelRegistry:
     def __init__(self):
         self._slots = {}
         self._loading = set()      # names mid-compile (the /readyz view)
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("ModelRegistry._lock")
 
     # -- management --------------------------------------------------------
 
@@ -317,7 +318,7 @@ class ModelRegistry:
 
 
 _registry = None
-_registry_lock = threading.Lock()
+_registry_lock = _lockwitness.make_lock("slots._registry_lock")
 _atexit_installed = False
 
 

@@ -416,6 +416,9 @@ COUNTERS = {
     "sanitizer_violations": "footguns caught at runtime by MXNET_SANITIZE "
                             "(tracer leaks, syncs-under-trace, engine "
                             "ordering)",
+    "lockcheck_violations": "lock acquisition-order inversions witnessed "
+                            "live by MXNET_LOCKCHECK (the runtime side "
+                            "of the JG009 static cycle check)",
     "flight_dumps": "flight-recorder post-mortem files written (crash, "
                     "signal, hang, or manual)",
     "tracecheck_findings": "trace-tier (JX rule) findings booked by the "

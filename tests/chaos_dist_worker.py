@@ -164,6 +164,11 @@ def main():
         "chaos": chaos.describe(),
         "done_wall": time.time(),
     }
+    from mxnet_tpu.lint import lockwitness
+    if lockwitness.enabled():
+        # MXNET_LOCKCHECK=1 turns the chaos run into a lock-order
+        # witness: the smoke driver asserts this graph is cycle-free
+        result["lockgraph"] = lockwitness.snapshot()
     _atomic_write(os.path.join(STATE, "result-%d.json" % rank),
                   json.dumps(result, indent=1).encode())
     print("worker %d/%d: %d iters, %d recoveries, %d injected faults"

@@ -482,7 +482,8 @@ def test_baseline_round_trip(tmp_path):
 
 def test_every_rule_registered_with_rationale():
     assert set(RULES) == {"JG001", "JG002", "JG003", "JG004", "JG005",
-                          "JG006", "JG007", "JG008"}
+                          "JG006", "JG007", "JG008", "JG009", "JG010",
+                          "JG011"}
     for rule in RULES.values():
         assert rule.name and rule.rationale
 

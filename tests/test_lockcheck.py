@@ -1,0 +1,358 @@
+"""graftlock: JG009/010/011 rule fixtures + the runtime lock witness.
+
+Static side: every concurrency rule gets a firing fixture and a clean
+twin through ``lint_source``/``lint_sources`` — including the two-module
+lock-order cycle that only exists once ``link_project`` stitches the
+cross-module call graph.  Runtime side: a deterministically sequenced
+ABBA inversion across two threads must produce a violation that names
+both locks and both acquisition sites, the off path must hand back plain
+stdlib primitives, and ``reset`` must clear the recorded graph.
+"""
+import textwrap
+import threading
+import warnings
+
+import pytest
+
+from mxnet_tpu.lint import lint_source, lint_sources
+from mxnet_tpu.lint import lockwitness
+
+
+def codes(src, select=None):
+    findings = lint_source(textwrap.dedent(src), path="fixture.py",
+                           select=select)
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# JG009 lock-order-cycle
+# ---------------------------------------------------------------------------
+
+def test_jg009_fires_on_abba_order():
+    src = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def forward():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def backward():
+        with LOCK_B:
+            with LOCK_A:
+                pass
+    """
+    found = codes(src, {"JG009"})
+    assert found == ["JG009"]
+
+
+def test_jg009_clean_on_consistent_order():
+    src = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def forward():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def also_forward():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+    """
+    assert codes(src, {"JG009"}) == []
+
+
+MOD_A = """
+import threading
+from pkg.b import with_b
+
+LOCK_A = threading.Lock()
+
+def with_a():
+    with LOCK_A:
+        pass
+
+def a_then_b():
+    with LOCK_A:
+        with_b()
+"""
+
+MOD_B = """
+import threading
+from pkg.a import with_a
+
+LOCK_B = threading.Lock()
+
+def with_b():
+    with LOCK_B:
+        pass
+
+def b_then_a():
+    with LOCK_B:
+        with_a()
+"""
+
+
+def test_jg009_sees_cycle_across_modules():
+    """The ISSUE 20 acceptance fixture: neither module has a cycle on
+    its own; only the linked project (a holds A while calling into b's
+    B-acquirer, b holds B while calling into a's A-acquirer) does."""
+    findings = lint_sources([("pkg/a.py", MOD_A), ("pkg/b.py", MOD_B)],
+                            select={"JG009"})
+    assert [f.rule for f in findings] == ["JG009"]
+    msg = findings[0].message
+    assert "LOCK_A" in msg and "LOCK_B" in msg
+
+
+def test_jg009_single_modules_are_clean_alone():
+    for path, src in (("pkg/a.py", MOD_A), ("pkg/b.py", MOD_B)):
+        assert [f.rule for f in lint_sources([(path, src)],
+                                             select={"JG009"})] == []
+
+
+# ---------------------------------------------------------------------------
+# JG010 blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_jg010_fires_on_recv_under_lock():
+    src = """
+    import threading
+
+    class Server:
+        def __init__(self, conn):
+            self._lock = threading.Lock()
+            self.conn = conn
+
+        def handle(self):
+            with self._lock:
+                return self.conn.recv()
+    """
+    assert codes(src, {"JG010"}) == ["JG010"]
+
+
+def test_jg010_fires_on_queue_get_through_callee():
+    """The closure direction: the lock holder never blocks itself, it
+    calls a helper whose body does."""
+    src = """
+    import threading
+    import queue
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.inbox = queue.Queue()
+
+        def _take(self):
+            return self.inbox.get()
+
+        def step(self):
+            with self._lock:
+                return self._take()
+    """
+    assert "JG010" in codes(src, {"JG010"})
+
+
+def test_jg010_clean_when_call_moves_outside():
+    src = """
+    import threading
+
+    class Server:
+        def __init__(self, conn):
+            self._lock = threading.Lock()
+            self.conn = conn
+
+        def handle(self):
+            with self._lock:
+                conn = self.conn
+            return conn.recv()
+    """
+    assert codes(src, {"JG010"}) == []
+
+
+def test_jg010_exempts_wait_on_own_condition():
+    """Condition.wait RELEASES the lock it is built over — waiting on
+    your own condition while holding exactly that lock is the legal
+    release-and-wait idiom, not a blocking call under the lock."""
+    src = """
+    import threading
+
+    class Waiter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self.ready = False
+
+        def wait_ready(self):
+            with self._lock:
+                while not self.ready:
+                    self._cv.wait()
+    """
+    assert codes(src, {"JG010"}) == []
+
+
+# ---------------------------------------------------------------------------
+# JG011 unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+
+def test_jg011_fires_on_unguarded_two_sided_write():
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+            self.thread = threading.Thread(target=self._run)
+
+        def _run(self):
+            self.value += 1
+
+        def reset(self):
+            self.value = 0
+    """
+    assert codes(src, {"JG011"}) == ["JG011"]
+
+
+def test_jg011_clean_when_both_sides_share_the_lock():
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+            self.thread = threading.Thread(target=self._run)
+
+        def _run(self):
+            with self._lock:
+                self.value += 1
+
+        def reset(self):
+            with self._lock:
+                self.value = 0
+    """
+    assert codes(src, {"JG011"}) == []
+
+
+# ---------------------------------------------------------------------------
+# the runtime witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def witness():
+    lockwitness.reset()
+    lockwitness.configure("warn")
+    yield lockwitness
+    lockwitness.reset()
+    lockwitness.refresh_from_env()
+
+
+def test_witness_off_path_returns_plain_primitives():
+    lockwitness.reset()
+    lockwitness.configure("off")
+    lock = lockwitness.make_lock("plain")
+    assert type(lock) is type(threading.Lock())
+    rlock = lockwitness.make_rlock("plain_r")
+    assert type(rlock) is type(threading.RLock())
+    cond = lockwitness.make_condition(name="plain_cv")
+    assert isinstance(cond, threading.Condition)
+
+
+def test_witness_names_both_locks_and_sites_on_abba(witness):
+    """Two threads, deterministically sequenced (t1 fully finishes
+    before t2 starts): t1 establishes A -> B, t2's B -> A closes the
+    cycle — the violation must name both locks and both sites."""
+    a = lockwitness.make_lock("fixture.A")
+    b = lockwitness.make_lock("fixture.B")
+
+    def t1_establish_ab():
+        with a:
+            with b:
+                pass
+
+    def t2_invert_ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=t1_establish_ab)
+    t1.start()
+    t1.join()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        t2 = threading.Thread(target=t2_invert_ba)
+        t2.start()
+        t2.join()
+
+    snap = lockwitness.snapshot()
+    assert not snap["cycle_free"]
+    (violation,) = snap["violations"]
+    assert violation["edge"] == "fixture.B -> fixture.A"
+    assert "fixture.A" in violation["cycle"] \
+        and "fixture.B" in violation["cycle"]
+    assert "t2_invert_ba" in violation["site"]
+    assert "t1_establish_ab" in violation["prior_site"]
+    edges = {(e["from"], e["to"]) for e in snap["edges"]}
+    assert ("fixture.A", "fixture.B") in edges
+    assert ("fixture.B", "fixture.A") in edges
+
+
+def test_witness_raise_mode_raises_before_taking_the_lock(witness):
+    lockwitness.configure("raise")
+    a = lockwitness.make_lock("raise.A")
+    b = lockwitness.make_lock("raise.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(lockwitness.LockOrderError) as exc:
+            with a:
+                pass
+    assert "raise.B -> raise.A" in str(exc.value)
+    # the raise happened BEFORE the inner acquire: nothing leaked into
+    # the thread's held stack and both locks are free again
+    assert lockwitness.held_locks() == []
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_witness_condition_wait_keeps_held_stack_truthful(witness):
+    done = []
+    cv = lockwitness.make_condition(name="fixture.cv")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+
+        def waiter():
+            with cv:
+                while not done:
+                    cv.wait(timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cv:
+            done.append(True)
+            cv.notify_all()
+        t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert lockwitness.held_locks() == []
+    assert lockwitness.snapshot()["cycle_free"]
+
+
+def test_witness_reset_clears_the_graph(witness):
+    a = lockwitness.make_lock("reset.A")
+    b = lockwitness.make_lock("reset.B")
+    with a:
+        with b:
+            pass
+    assert lockwitness.snapshot()["edges"]
+    lockwitness.reset()
+    snap = lockwitness.snapshot()
+    assert snap["edges"] == [] and snap["violations"] == []
+    assert snap["cycle_free"]

@@ -1,0 +1,99 @@
+"""Tier-1 gate: the repo's threaded tier stays graftlock-clean.
+
+Static half: zero unsuppressed JG009/JG010/JG011 findings across
+``mxnet_tpu/``, ``tools/``, and ``examples/`` — the concurrency rules
+are held to the same zero-new-findings bar as the TPU footgun rules,
+and the LINT_BASELINE.json escape hatch is closed to them entirely
+(only justified inline ``# graftlint: disable=`` suppressions remain,
+each carrying its reason at the site).
+
+Runtime half: a 3-thread engine + kvstore smoke under
+``MXNET_LOCKCHECK=1`` (raise mode) must finish with a cycle-free
+acquisition-order graph that actually recorded edges — the live witness
+agreeing with the static proof, not vacuously passing.
+"""
+import json
+import os
+import subprocess
+import sys
+
+from mxnet_tpu.lint import (default_baseline_path, lint_paths,
+                            repo_root)
+
+REPO = repo_root()
+SCAN_ROOTS = [os.path.join(REPO, d)
+              for d in ("mxnet_tpu", "tools", "examples")]
+LOCK_RULES = {"JG009", "JG010", "JG011"}
+
+_WITNESS_SMOKE = r"""
+import json
+import threading
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+from mxnet_tpu.lint import lockwitness
+
+assert lockwitness.mode() == "raise", lockwitness.mode()
+
+kv = mx.kv.create("local")
+kv.init("w", mx.nd.zeros((8,)))
+
+def worker(rank):
+    for step in range(20):
+        out = engine.push(lambda r=rank, s=step: mx.nd.ones((8,))
+                          * (r + s))
+        kv.push("w", out)
+        pulled = mx.nd.zeros((8,))
+        kv.pull("w", out=pulled)
+        pulled.asnumpy()
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+engine.wait_for_all()
+# the funnel was live: the engine's own core lock is a tracked wrapper,
+# so the clean graph below is a real witness, not an unplugged one
+core = engine.engine()._core
+assert type(core.lock).__name__ == "_TrackedLock", type(core.lock)
+print(json.dumps(lockwitness.snapshot()))
+"""
+
+
+def test_zero_unsuppressed_lock_findings_repo_wide():
+    findings = lint_paths(SCAN_ROOTS, select=LOCK_RULES, rel_root=REPO)
+    assert not findings, (
+        "concurrency findings in the repo (fix the lock discipline or "
+        "suppress inline with a justification comment — the baseline "
+        "is closed to JG009-011):\n"
+        + "\n".join(f.format_text() for f in findings))
+
+
+def test_baseline_is_closed_to_lock_rules():
+    with open(default_baseline_path()) as f:
+        entries = json.load(f)["entries"]
+    lock_entries = [e for e in entries if e["rule"] in LOCK_RULES]
+    assert lock_entries == [], (
+        "JG009-011 never go in LINT_BASELINE.json (fix or suppress "
+        "inline at the site): %s"
+        % [(e["rule"], e["path"]) for e in lock_entries])
+
+
+def test_runtime_witness_is_cycle_free_on_threaded_smoke():
+    """3 threads hammering engine.push + local kvstore push/pull under
+    MXNET_LOCKCHECK=1: any acquisition-order inversion raises inside the
+    subprocess (nonzero exit).  The subprocess proves the funnel was
+    live (the engine core lock is a tracked wrapper) and the exported
+    graph must come back cycle-free."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_LOCKCHECK="1")
+    out = subprocess.run([sys.executable, "-c", _WITNESS_SMOKE],
+                         capture_output=True, text=True, env=env,
+                         cwd=REPO, timeout=300)
+    assert out.returncode == 0, (
+        "witness smoke failed (a lock-order inversion raises under "
+        "MXNET_LOCKCHECK=1):\n" + out.stdout + out.stderr)
+    snap = json.loads(out.stdout.strip().splitlines()[-1])
+    assert snap["mode"] == "raise"
+    assert snap["cycle_free"], snap["violations"]
+    assert snap["violations"] == []

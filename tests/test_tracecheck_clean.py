@@ -7,6 +7,10 @@ its program surfaces as a JX000 finding rather than silently shrinking
 coverage, and the coverage list itself is asserted so removing an entry
 point from the driver (instead of migrating it) also fails.
 """
+import os
+import subprocess
+import sys
+
 from mxnet_tpu.lint import tracecheck
 
 # every program the framework owns, by watch_jit/driver name; growing the
@@ -61,3 +65,21 @@ def test_owned_programs_are_jx_clean():
     assert not missing, (
         "owned entry points not analyzed (provider lost or renamed): %s"
         % sorted(missing))
+
+
+def test_zero1_step_is_jx102_clean_at_one_device():
+    """The int64 position findings on ``transformer_train_step_zero1``
+    (the 6 burned down in ISSUE 20) only reproduce at n_devices=1, where
+    the ring shard collapses onto the ``local_attention`` path — and the
+    tier-1 rig above forces 8 devices, so the main gate never sees that
+    topology.  Pin the 1-device sweep in a subprocess."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.lint", "--trace", "--no-memory",
+         "--select", "JX102", "--no-baseline", "transformer"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=600)
+    assert out.returncode == 0, (
+        "JX102 findings in the 1-device transformer sweep:\n"
+        + out.stdout + out.stderr)

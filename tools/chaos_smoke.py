@@ -66,6 +66,9 @@ def run_once(label, state_dir, args, chaos_spec):
         # single-threaded workers => bitwise-reproducible fault logs
         "MXNET_PS_HEARTBEAT_S": "0",
         "MXNET_FLIGHT_DIR": state_dir,
+        # lock-order witness passthrough: workers export their recorded
+        # acquisition graph when this is set, and main() gates on it
+        "MXNET_LOCKCHECK": os.environ.get("MXNET_LOCKCHECK", ""),
     }
     for knob, val in _TRACE_PASSTHROUGH:
         if val:
@@ -140,6 +143,19 @@ def main(argv=None):
                     "replay rank %d fault sequence differs from chaos "
                     "run (determinism regression):\n  %s\n  %s"
                     % (a["rank"], a["fault_log"], b["fault_log"]))
+        lockgraphs = {}
+        for label, results in (("baseline", baseline),
+                               ("chaos", chaotic), ("replay", replay)):
+            for r in results:
+                graph = r.get("lockgraph")
+                if graph is None:
+                    continue
+                lockgraphs["%s-%d" % (label, r["rank"])] = graph
+                if not graph.get("cycle_free", True):
+                    problems.append(
+                        "%s rank %d lock-order witness saw a cycle: %r"
+                        % (label, r["rank"],
+                           [v["cycle"] for v in graph["violations"]]))
 
         summary = {
             "ok": not problems,
@@ -151,6 +167,8 @@ def main(argv=None):
             "final_loss": baseline[0]["losses"][-1],
             "problems": problems,
         }
+        if lockgraphs:
+            summary["lockgraphs"] = lockgraphs
         if args.json:
             print(json.dumps(summary))
         else:

@@ -48,9 +48,10 @@ def main():
         print("jax          : import failed:", exc)
 
     print("----------Environment----------")
-    for key in sorted(os.environ):
+    env = dict(os.environ)     # one snapshot, not a read per iteration
+    for key in sorted(env):
         if key.startswith(("MXNET_", "DMLC_", "JAX_", "XLA_")):
-            print("%-28s: %s" % (key, os.environ[key]))
+            print("%-28s: %s" % (key, env[key]))
 
 
 if __name__ == "__main__":

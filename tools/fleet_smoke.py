@@ -266,6 +266,17 @@ def main(argv=None):
                 except subprocess.TimeoutExpired:
                     p.kill()
 
+    from mxnet_tpu.lint import lockwitness
+    if lockwitness.enabled():
+        # the router ran in-process: its recorded acquisition-order
+        # graph is the fleet tier's live lock-order witness
+        lockgraph = lockwitness.snapshot()
+        summary["lockgraph"] = lockgraph
+        if not lockgraph["cycle_free"]:
+            problems.append(
+                "lock-order witness saw a cycle: %r"
+                % [v["cycle"] for v in lockgraph["violations"]])
+
     summary["ok"] = not problems
     summary["problems"] = problems
     if args.json:
